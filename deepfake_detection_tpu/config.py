@@ -1,0 +1,318 @@
+"""Configuration system.
+
+Replaces the reference's three config mechanisms with one dataclass tree:
+
+* the ~60-flag argparse surface (``/root/reference/dfd/runners/train.py:55-235``),
+* the two-stage ``--config`` YAML-overrides-defaults parse (``train.py:238-249``),
+* the cluster-topology JSON (``/root/reference/dfd/server_json.py``).
+
+Every field keeps the reference flag's name (dashes→underscores) and default so
+a reference user can map their launch scripts 1:1.  ``TrainConfig.from_args``
+reproduces the two-stage semantics: YAML file (if given) resets defaults, CLI
+flags override YAML.  The resolved config serialises back to YAML
+(``args.yaml`` parity, ``train.py:251-253``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import socket
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+try:
+    import yaml
+    _HAS_YAML = True
+except ImportError:  # pragma: no cover
+    _HAS_YAML = False
+
+
+# ---------------------------------------------------------------------------
+# Cluster topology (server_json.py parity)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ServerSpec:
+    """One host in the cluster map (``server_json.py:25-45``)."""
+    name: str
+    gpus: str = ""           # kept for config-file compatibility; unused on TPU
+    local_size: int = 1      # processes on this host
+    start_rank: int = 0      # first global process index on this host
+
+
+@dataclass
+class ClusterConfig:
+    """Topology for multi-host runs.
+
+    On TPU pods ``jax.distributed.initialize`` discovers topology natively, so
+    this config is only needed to (a) run the same JSON files the reference
+    shipped (``scripts/train_server_config.json``) and (b) drive explicit
+    coordinator-based init on non-pod clusters.
+    """
+    servers: List[ServerSpec] = field(default_factory=list)
+    world_size: int = 1
+    share_file: str = ""                 # legacy rendezvous file (unused)
+    coordinator_address: Optional[str] = None  # "host:port" for jax.distributed
+
+    @classmethod
+    def from_json(cls, path: str) -> "ClusterConfig":
+        with open(path) as f:
+            raw = json.load(f)
+        servers = [ServerSpec(
+            name=s.get("name", ""),
+            gpus=str(s.get("gpus", "")),
+            local_size=int(s.get("local_size", 1)),
+            start_rank=int(s.get("start_rank", 0)),
+        ) for s in raw.get("servers", [])]
+        return cls(servers=servers,
+                   world_size=int(raw.get("world_size", 1)),
+                   share_file=raw.get("share_file", ""),
+                   coordinator_address=raw.get("coordinator_address"))
+
+    def local_spec(self, hostname: Optional[str] = None) -> ServerSpec:
+        """Match this host against the server map (``server_json.py:29-30``)."""
+        hostname = hostname or socket.gethostname()
+        for s in self.servers:
+            if s.name == hostname:
+                return s
+        raise LookupError(
+            f"hostname {hostname!r} not found in cluster config "
+            f"(servers: {[s.name for s in self.servers]})")
+
+    def process_id(self, hostname: Optional[str] = None, local_rank: int = 0) -> int:
+        return self.local_spec(hostname).start_rank + local_rank
+
+
+# ---------------------------------------------------------------------------
+# Training config (train.py argparse parity)
+# ---------------------------------------------------------------------------
+
+def _tuple_of_ints(s) -> Optional[Tuple[int, ...]]:
+    """Parse ``--input-size-v2 "12,600,600"`` style strings (config.py:17-21)."""
+    if s is None or s == "":
+        return None
+    if isinstance(s, (tuple, list)):
+        return tuple(int(x) for x in s)
+    return tuple(int(x) for x in str(s).split(","))
+
+
+@dataclass
+class TrainConfig:
+    # --- data ---
+    data: str = ""                       # root dir(s), ':'-separated for multi-dir
+    eval_data: str = ""                  # separate eval root(s); default: split from train
+    dataset: str = "deepfake_v3"         # deepfake_v3 | folder | synthetic
+    train_split: float = 0.95            # seeded train/val split fraction
+    split_seed: int = 42
+    label_balance: bool = False          # fake-bucket balancing (dataset.py:460-491)
+    noise_fake: float = 0.0              # label-flip prob for fakes (dataset.py:520-521)
+    img_num: int = 4                     # frames per clip
+    workers: int = 8
+    pin_memory: bool = False
+    prefetch_depth: int = 2
+
+    # --- model ---
+    model: str = "efficientnet_deepfake_v4"
+    model_version: str = "v4"            # create_deepfake_model | _v3 | _v4 selection
+    pretrained: bool = False
+    initial_checkpoint: str = ""
+    resume: str = ""
+    no_resume_opt: bool = False
+    num_classes: int = 2
+    gp: str = "avg"                      # global pool: avg|max|avgmax|catavgmax
+    in_chans: Optional[int] = None       # derived from input_size if None
+    drop: float = 0.0
+    drop_path: Optional[float] = None
+    drop_block: Optional[float] = None
+    bn_tf: bool = False
+    bn_momentum: Optional[float] = None
+    bn_eps: Optional[float] = None
+
+    # --- input geometry ---
+    input_size: Optional[Tuple[int, ...]] = None      # (C,H,W) — reference order
+    input_size_v2: Optional[Tuple[int, ...]] = None   # (12,600,600) string flag
+    img_size: Optional[int] = None
+    crop_pct: Optional[float] = None
+    mean: Optional[Tuple[float, ...]] = None
+    std: Optional[Tuple[float, ...]] = None
+    interpolation: str = ""
+
+    # --- optimization ---
+    opt: str = "rmsproptf"
+    opt_eps: float = 1e-8
+    momentum: float = 0.9
+    weight_decay: float = 1e-5
+    lr: Optional[float] = None           # if None: batch*world*basic_lr (train.py:814)
+    basic_lr: float = 5e-7
+    sched: str = "step"
+    epochs: int = 200
+    start_epoch: Optional[int] = None
+    decay_epochs: float = 2.0
+    decay_rate: float = 0.92
+    warmup_lr: float = 1e-4
+    warmup_epochs: int = 0
+    cooldown_epochs: int = 10
+    patience_epochs: int = 10
+    lr_noise: Optional[Tuple[float, ...]] = None
+    lr_noise_pct: float = 0.67
+    lr_noise_std: float = 1.0
+    lr_cycle_mul: float = 1.0
+    lr_cycle_limit: int = 1
+    min_lr: float = 1e-5
+    batch_size: int = 3
+    clip_grad: Optional[float] = None
+
+    # --- augmentation ---
+    no_aug: bool = False
+    scale: Tuple[float, float] = (0.08, 1.0)
+    ratio: Tuple[float, float] = (3. / 4., 4. / 3.)
+    hflip: float = 0.5
+    vflip: float = 0.0
+    color_jitter: float = 0.4
+    aa: Optional[str] = None             # AutoAugment / RandAugment policy string
+    aug_splits: int = 0
+    jsd: bool = False
+    reprob: float = 0.0                  # RandomErasing prob
+    remode: str = "const"
+    recount: int = 1
+    remax: float = 0.4                   # max erase area fraction
+    resplit: bool = False
+    mixup: float = 0.0
+    mixup_off_epoch: int = 0
+    smoothing: float = 0.1
+    train_interpolation: str = "random"
+    # multi-frame (deepfake) specific
+    rotate_range: float = 0.0
+    blur_prob: float = 0.0
+    flicker: float = 0.0
+
+    # --- batch norm ---
+    sync_bn: bool = False
+    dist_bn: str = ""                    # '' | 'broadcast' | 'reduce'
+    split_bn: bool = False
+
+    # --- EMA ---
+    model_ema: bool = False
+    model_ema_decay: float = 0.9998
+
+    # --- precision / compile ---
+    amp: bool = False                    # reference flag; maps to bf16 compute on TPU
+    compute_dtype: str = "bfloat16"      # bfloat16 | float32
+    param_dtype: str = "float32"
+
+    # --- misc / infra ---
+    seed: int = 42
+    log_interval: int = 50
+    recovery_interval: int = 0
+    save_images: bool = False
+    output: str = "./output"
+    eval_metric: str = "loss"
+    tta: int = 0
+    use_multi_epochs_loader: bool = False
+    json_file: str = ""                  # cluster topology JSON
+    local_rank: int = 0
+    experiment: str = ""
+
+    # --- parallelism (TPU-native; no reference analog) ---
+    mesh_shape: Optional[Tuple[int, ...]] = None   # default: (n_devices,)
+    mesh_axes: Tuple[str, ...] = ("data",)
+    fsdp: bool = False                   # shard params over 'data' axis
+    checkpoint_policy: str = "none"      # remat policy: none|full|dots
+
+    # ------------------------------------------------------------------
+    def __post_init__(self):
+        for f_ in ("input_size", "input_size_v2", "lr_noise"):
+            v = getattr(self, f_)
+            if isinstance(v, str):
+                setattr(self, f_, _tuple_of_ints(v) if f_ != "lr_noise"
+                        else tuple(float(x) for x in v.split(",")))
+        if isinstance(self.scale, list):
+            self.scale = tuple(self.scale)
+        if isinstance(self.ratio, list):
+            self.ratio = tuple(self.ratio)
+
+    # ------------------------------------------------------------------
+    @property
+    def resolved_input_size(self) -> Tuple[int, int, int]:
+        """(C, H, W) with the v2 string flag taking priority (config.py:12-24)."""
+        if self.input_size_v2:
+            return tuple(self.input_size_v2)  # type: ignore
+        if self.input_size:
+            return tuple(self.input_size)     # type: ignore
+        if self.img_size:
+            return (3, self.img_size, self.img_size)
+        return (3, 224, 224)
+
+    @property
+    def resolved_in_chans(self) -> int:
+        return self.in_chans if self.in_chans is not None else self.resolved_input_size[0]
+
+    def resolved_lr(self, world_size: int) -> float:
+        """Linear LR scaling rule (``train.py:814``)."""
+        if self.lr is not None:
+            return self.lr
+        return self.batch_size * world_size * self.basic_lr
+
+    # ------------------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_yaml(self) -> str:
+        if _HAS_YAML:
+            return yaml.safe_dump(self.to_dict(), default_flow_style=False)
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrainConfig":
+        known = {f_.name for f_ in fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "TrainConfig":
+        with open(path) as f:
+            if _HAS_YAML:
+                d = yaml.safe_load(f)
+            else:
+                d = json.load(f)
+        return cls.from_dict(d or {})
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def argument_parser(cls) -> argparse.ArgumentParser:
+        """Argparse surface generated from the dataclass (flag-name parity)."""
+        p = argparse.ArgumentParser(description="TPU deepfake-detection training")
+        p.add_argument("-c", "--config", default="", metavar="FILE",
+                       help="YAML config; its values reset defaults, CLI overrides")
+        for f_ in fields(cls):
+            flag = "--" + f_.name.replace("_", "-")
+            if f_.type == "bool" or isinstance(f_.default, bool):
+                p.add_argument(flag, action="store_true", default=None,
+                               dest=f_.name)
+                continue
+            p.add_argument(flag, default=None, dest=f_.name)
+        p.add_argument("-b", dest="batch_size", default=None)
+        return p
+
+    @classmethod
+    def from_args(cls, argv: Optional[Sequence[str]] = None) -> "TrainConfig":
+        """Two-stage parse: YAML resets defaults, CLI overrides (train.py:238-249)."""
+        parser = cls.argument_parser()
+        ns, _ = parser.parse_known_args(argv)
+        base = cls.from_yaml(ns.config) if ns.config else cls()
+        out = dataclasses.asdict(base)
+        hints = {f_.name: f_ for f_ in fields(cls)}
+        for k, v in vars(ns).items():
+            if k == "config" or v is None or k not in hints:
+                continue
+            default = hints[k].default
+            if isinstance(default, bool):
+                out[k] = bool(v)
+            elif isinstance(default, int) and not isinstance(default, bool):
+                out[k] = int(v)
+            elif isinstance(default, float):
+                out[k] = float(v)
+            else:
+                out[k] = v
+        return cls.from_dict(out)

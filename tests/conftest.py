@@ -1,0 +1,35 @@
+"""Test configuration: force an 8-device CPU mesh.
+
+Must run before any jax backend is initialized.  The environment's
+sitecustomize registers the 'axon' TPU plugin and forces
+``jax_platforms="axon,cpu"`` in every interpreter; tests override back to pure
+CPU here (backend init is lazy, so this works as long as no fixture touched
+jax.devices() earlier).  Eight virtual CPU devices let multi-chip sharding
+tests run without TPU hardware (SURVEY.md §4).
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    import numpy as np
+    from jax.sharding import Mesh
+    return Mesh(np.asarray(devices).reshape(4, 2), ("data", "model"))

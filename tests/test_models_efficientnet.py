@@ -1,0 +1,129 @@
+"""EfficientNet family: registry, construction, shapes, param counts."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepfake_detection_tpu.models import (create_deepfake_model,
+                                           create_deepfake_model_v3,
+                                           create_deepfake_model_v4,
+                                           create_model, init_model)
+from deepfake_detection_tpu.registry import is_model, list_models
+
+
+def _param_count(model, input_shape):
+    shapes = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros(input_shape), training=False),
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)})
+    return sum(int(jnp.prod(jnp.asarray(x.shape)))
+               for x in jax.tree.leaves(shapes["params"]))
+
+
+def test_registry_has_core_models():
+    for name in ["efficientnet_b0", "efficientnet_b7",
+                 "efficientnet_deepfake_v3", "efficientnet_deepfake_v4",
+                 "efficientnet_b7_deepfake", "mixnet_s", "mnasnet_100",
+                 "fbnetc_100", "spnasnet_100", "efficientnet_es",
+                 "efficientnet_cc_b0_4e"]:
+        assert is_model(name), name
+    assert "efficientnet_b0" in list_models("efficientnet_*")
+
+
+def test_b0_param_count_parity():
+    # timm efficientnet_b0 @ 1000 classes = 5,288,548 params; the head swap to
+    # 2 classes removes 1280*998 + 998 bias params.
+    m = create_model("efficientnet_b0", num_classes=1000)
+    assert _param_count(m, (1, 32, 32, 3)) == 5288548
+    m2 = create_model("efficientnet_b0", num_classes=2)
+    assert _param_count(m2, (1, 32, 32, 3)) == 4010110
+
+
+def test_b0_forward_shape():
+    m = create_model("efficientnet_b0", num_classes=2)
+    v = init_model(m, jax.random.PRNGKey(0), (2, 64, 64, 3))
+    out = m.apply(v, jnp.zeros((2, 64, 64, 3)), training=False)
+    assert out.shape == (2, 2)
+
+
+def test_deepfake_v4_structure():
+    """Reference parity: stem 128, features 256, 12-chan input, 2 classes
+    (efficientnet.py:806-848)."""
+    m = create_deepfake_model_v4("efficientnet_deepfake_v4")
+    assert m.stem_size == 128
+    assert m.num_features == 256
+    assert m.in_chans == 12
+    assert m.num_classes == 2
+    assert m.act == "swish"
+    shapes = jax.eval_shape(
+        lambda r: m.init(r, jnp.zeros((1, 64, 64, 12)), training=False),
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)})
+    stem_kernel = shapes["params"]["conv_stem"]["conv"]["conv"]["kernel"]
+    assert stem_kernel.shape == (3, 3, 12, 128)
+    cls_kernel = shapes["params"]["classifier"]["kernel"]
+    assert cls_kernel.shape == (256, 2)
+
+
+def test_deepfake_v3_v4_name_asserts():
+    with pytest.raises(AssertionError):
+        create_deepfake_model_v3("efficientnet_b0")
+    with pytest.raises(AssertionError):
+        create_deepfake_model_v4("efficientnet_b0")
+
+
+def test_deepfake_model_depth_scaling():
+    """depth_multiplier=3.1 with ceil trunc: B0 stage repeats [1,2,2,3,3,4,1]
+    → [4,7,7,10,10,13,4] blocks."""
+    m = create_deepfake_model_v4("efficientnet_deepfake_v4")
+    stage_lens = [len(s) for s in m.block_configs]
+    assert stage_lens == [4, 7, 7, 10, 10, 13, 4]
+
+
+def test_b7_deepfake_defaults():
+    m = create_deepfake_model()
+    assert m.num_classes == 2
+
+
+def test_bn_momentum_plumbs_through():
+    m = create_deepfake_model_v4("efficientnet_deepfake_v4", bn_momentum=0.001)
+    assert m.bn_momentum == 0.001
+
+
+def test_training_forward_updates_batch_stats():
+    m = create_model("efficientnet_b0", num_classes=2)
+    v = init_model(m, jax.random.PRNGKey(0), (2, 64, 64, 3))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
+    out, mutated = m.apply(v, x, training=True, mutable=["batch_stats"],
+                           rngs={"dropout": jax.random.PRNGKey(2)})
+    assert out.shape == (2, 2)
+    # running stats must move
+    old = jax.tree.leaves(v["batch_stats"])
+    new = jax.tree.leaves(mutated["batch_stats"])
+    assert any(bool(jnp.any(a != b)) for a, b in zip(old, new))
+
+
+def test_mixnet_and_edge_and_condconv_build():
+    for name, chans in [("mixnet_s", 3), ("efficientnet_es", 3),
+                        ("efficientnet_cc_b0_4e", 3), ("mnasnet_100", 3),
+                        ("fbnetc_100", 3), ("spnasnet_100", 3)]:
+        m = create_model(name, num_classes=4)
+        v = init_model(m, jax.random.PRNGKey(0), (1, 64, 64, chans))
+        out = m.apply(v, jnp.zeros((1, 64, 64, chans)), training=False)
+        assert out.shape == (1, 4), name
+
+
+def test_features_only():
+    m = create_model("efficientnet_b0", num_classes=2)
+    v = init_model(m, jax.random.PRNGKey(0), (1, 64, 64, 3))
+    feats = m.apply(v, jnp.zeros((1, 64, 64, 3)), training=False,
+                    features_only=True)
+    assert len(feats) == 7
+    # strides: stem /2, stages at /4 /8 /16 /32 by the end
+    assert feats[-1].shape[1] == 64 // 32
+
+
+def test_output_stride_dilation():
+    m = create_model("efficientnet_b0", num_classes=0, output_stride=16)
+    v = init_model(m, jax.random.PRNGKey(0), (1, 64, 64, 3))
+    feats = m.apply(v, jnp.zeros((1, 64, 64, 3)), training=False,
+                    features_only=True)
+    assert feats[-1].shape[1] == 64 // 16

@@ -1,0 +1,150 @@
+"""Ops layer: activations, conv variants, norm, drop, pooling, attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepfake_detection_tpu.ops as ops
+
+
+def test_activations():
+    x = jnp.linspace(-3, 3, 13)
+    np.testing.assert_allclose(ops.swish(x), x * jax.nn.sigmoid(x), rtol=1e-6)
+    np.testing.assert_allclose(
+        ops.mish(x), x * jnp.tanh(jax.nn.softplus(x)), rtol=1e-6)
+    np.testing.assert_allclose(
+        ops.hard_sigmoid(x), jnp.clip((x + 3) / 6, 0, 1), rtol=1e-6)
+    assert ops.get_act_fn("relu") is jax.nn.relu
+    with pytest.raises(KeyError):
+        ops.get_act_fn("nope")
+
+
+def test_conv2d_same_padding_shapes():
+    x = jnp.zeros((1, 17, 17, 4))
+    m = ops.Conv2d(8, 3, stride=2)
+    v = m.init(jax.random.PRNGKey(0), x)
+    y = m.apply(v, x)
+    assert y.shape == (1, 9, 9, 8)  # TF SAME: ceil(17/2)
+
+
+def test_depthwise_conv_param_shape():
+    x = jnp.zeros((1, 8, 8, 6))
+    m = ops.create_conv2d(6, 3, depthwise=True)
+    v = m.init(jax.random.PRNGKey(0), x)
+    kern = v["params"]["conv"]["kernel"]
+    assert kern.shape == (3, 3, 1, 6)
+
+
+def test_mixed_conv_splits():
+    x = jnp.zeros((2, 8, 8, 16))
+    m = ops.MixedConv2d(24, kernel_size=(3, 5, 7))
+    v = m.init(jax.random.PRNGKey(0), x)
+    y = m.apply(v, x)
+    assert y.shape == (2, 8, 8, 24)
+
+
+def test_cond_conv_routing():
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 8, 8, 4))
+    m = ops.CondConv2d(6, 3, num_experts=4)
+    routing = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(1), (3, 4)))
+    v = m.init(jax.random.PRNGKey(2), x, routing)
+    y = m.apply(v, x, routing)
+    assert y.shape == (3, 8, 8, 6)
+    # one-hot routing on sample i must equal conv with expert k alone
+    onehot = jnp.eye(4)[jnp.array([0, 1, 2])]
+    y1 = m.apply(v, x, onehot)
+    w = v["params"]["weight"]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape[1:],
+                                        ("NHWC", "HWIO", "NHWC"))
+    ref0 = jax.lax.conv_general_dilated(x[:1], w[0], (1, 1), "SAME",
+                                        dimension_numbers=dn)
+    np.testing.assert_allclose(y1[0], ref0[0], rtol=2e-5, atol=2e-5)
+
+
+def test_batchnorm_torch_momentum_convention():
+    bn = ops.BatchNorm2d(momentum=0.5)
+    x = jnp.ones((4, 2, 2, 3)) * 2.0
+    v = bn.init(jax.random.PRNGKey(0), x, training=True)
+    _, mut = bn.apply(v, x, training=True, mutable=["batch_stats"])
+    # torch: new_mean = (1-m)*0 + m*batch_mean = 0.5*2 = 1.0
+    np.testing.assert_allclose(mut["batch_stats"]["bn"]["mean"],
+                               jnp.ones(3), rtol=1e-6)
+
+
+def test_split_batchnorm():
+    m = ops.SplitBatchNorm2d(num_splits=2, momentum=0.1)
+    x = jnp.concatenate([jnp.zeros((2, 2, 2, 3)), jnp.ones((2, 2, 2, 3))])
+    v = m.init(jax.random.PRNGKey(0), x, training=True)
+    _, mut = m.apply(v, x, training=True, mutable=["batch_stats"])
+    main_mean = mut["batch_stats"]["main"]["bn"]["mean"]
+    aux_mean = mut["batch_stats"]["aux0"]["bn"]["mean"]
+    np.testing.assert_allclose(main_mean, jnp.zeros(3), atol=1e-6)
+    np.testing.assert_allclose(aux_mean, 0.1 * jnp.ones(3), rtol=1e-5)
+    # eval goes through main only
+    y = m.apply(v, x, training=False)
+    assert y.shape == x.shape
+
+
+def test_drop_path_eval_identity_and_train_scaling():
+    x = jnp.ones((8, 2, 2, 3))
+    m = ops.DropPath(0.5)
+    v = m.init({"params": jax.random.PRNGKey(0)}, x, training=False)
+    np.testing.assert_array_equal(m.apply(v, x, training=False), x)
+    y = m.apply(v, x, training=True, rngs={"dropout": jax.random.PRNGKey(1)})
+    # each sample row is either all-0 or all-2 (1/keep_prob)
+    per_sample = y.reshape(8, -1)
+    for row in np.asarray(per_sample):
+        assert np.allclose(row, 0.0) or np.allclose(row, 2.0)
+
+
+def test_drop_block_masks_blocks():
+    x = jnp.ones((2, 16, 16, 4))
+    m = ops.DropBlock2d(drop_prob=0.3, block_size=5)
+    v = m.init({"params": jax.random.PRNGKey(0)}, x, training=False)
+    y = m.apply(v, x, training=True, rngs={"dropout": jax.random.PRNGKey(3)})
+    assert float(jnp.sum(y == 0.0)) > 0
+    np.testing.assert_array_equal(m.apply(v, x, training=False), x)
+
+
+def test_select_adaptive_pool_variants():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 6))
+    for pt, c in [("avg", 6), ("max", 6), ("avgmax", 6), ("catavgmax", 12)]:
+        m = ops.SelectAdaptivePool2d(pt)
+        y = m.apply({}, x)
+        assert y.shape == (2, c), pt
+        assert ops.adaptive_pool_feat_mult(pt) == c // 6
+    np.testing.assert_allclose(
+        ops.SelectAdaptivePool2d("avgmax").apply({}, x),
+        0.5 * (x.mean((1, 2)) + x.max((1, 2))), rtol=1e-6)
+
+
+def test_median_pool():
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y = ops.median_pool2d(x, kernel_size=3, stride=1)
+    assert y.shape == (1, 4, 4, 1)
+    assert float(y[0, 1, 1, 0]) == 5.0  # median of 0..10 window
+
+def test_attention_modules_shapes():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 32))
+    for mod in [ops.SEModule(), ops.EcaModule(), ops.CecaModule(),
+                ops.CbamModule(), ops.LightCbamModule()]:
+        v = mod.init(jax.random.PRNGKey(1), x)
+        y = mod.apply(v, x)
+        assert y.shape == x.shape, type(mod).__name__
+    assert ops.create_attn(None) is None
+    assert isinstance(ops.create_attn("se"), ops.SEModule)
+
+
+def test_selective_kernel_conv():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 16))
+    m = ops.SelectiveKernelConv(out_chs=16)
+    v = m.init(jax.random.PRNGKey(1), x, training=False)
+    y = m.apply(v, x, training=False)
+    assert y.shape == (2, 8, 8, 16)
+
+
+def test_make_divisible():
+    assert ops.make_divisible(32 * 2.0) == 64
+    assert ops.make_divisible(33) == 32
+    assert ops.make_divisible(1) == 8
