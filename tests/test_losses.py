@@ -1,0 +1,92 @@
+"""Loss tests, cross-checked against independent torch-CPU implementations."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from deepfake_detection_tpu.losses import (create_loss_fn, cross_entropy,
+                                           jsd_cross_entropy,
+                                           label_smoothing_cross_entropy,
+                                           one_hot,
+                                           soft_target_cross_entropy)
+
+rng = np.random.default_rng(7)
+LOGITS = rng.normal(size=(12, 2)).astype(np.float32)
+LABELS = rng.integers(0, 2, size=12).astype(np.int32)
+
+
+def test_cross_entropy_matches_torch():
+    ours = float(cross_entropy(jnp.asarray(LOGITS), jnp.asarray(LABELS)))
+    theirs = float(F.cross_entropy(torch.tensor(LOGITS),
+                                   torch.tensor(LABELS, dtype=torch.long)))
+    assert ours == pytest.approx(theirs, rel=1e-5)
+
+
+def test_label_smoothing_matches_formula():
+    s = 0.1
+    ours = float(label_smoothing_cross_entropy(
+        jnp.asarray(LOGITS), jnp.asarray(LABELS), smoothing=s))
+    logp = F.log_softmax(torch.tensor(LOGITS), dim=-1)
+    nll = -logp.gather(1, torch.tensor(LABELS, dtype=torch.long)[:, None])[:, 0]
+    smooth = -logp.mean(dim=-1)
+    theirs = float(((1 - s) * nll + s * smooth).mean())
+    assert ours == pytest.approx(theirs, rel=1e-5)
+
+
+def test_soft_target_matches_torch():
+    target = rng.dirichlet((1.0, 1.0), size=12).astype(np.float32)
+    ours = float(soft_target_cross_entropy(jnp.asarray(LOGITS),
+                                           jnp.asarray(target)))
+    logp = F.log_softmax(torch.tensor(LOGITS), dim=-1)
+    theirs = float((-torch.tensor(target) * logp).sum(-1).mean())
+    assert ours == pytest.approx(theirs, rel=1e-5)
+
+
+def test_jsd_matches_torch():
+    ours = float(jsd_cross_entropy(jnp.asarray(LOGITS), jnp.asarray(LABELS),
+                                   num_splits=3, alpha=12.0, smoothing=0.1))
+    x = torch.tensor(LOGITS)
+    split = 4
+    splits = torch.split(x, split)
+    logp = F.log_softmax(splits[0], dim=-1)
+    nll = -logp.gather(1, torch.tensor(LABELS[:split], dtype=torch.long)[:, None])[:, 0]
+    ce = (0.9 * nll + 0.1 * -logp.mean(-1)).mean()
+    probs = [F.softmax(s, dim=1) for s in splits]
+    logp_mix = torch.clamp(torch.stack(probs).mean(0), 1e-7, 1).log()
+    kl = sum(F.kl_div(logp_mix, p, reduction="batchmean") for p in probs) / 3
+    theirs = float(ce + 12.0 * kl)
+    assert ours == pytest.approx(theirs, rel=1e-4)
+
+
+def test_masked_eval_padding():
+    # padded rows must not change the loss (TPU static-shape eval pattern)
+    w = jnp.asarray([1.0] * 8 + [0.0] * 4)
+    full = float(cross_entropy(jnp.asarray(LOGITS[:8]), jnp.asarray(LABELS[:8])))
+    masked = float(cross_entropy(jnp.asarray(LOGITS), jnp.asarray(LABELS),
+                                 weight=w))
+    assert masked == pytest.approx(full, rel=1e-6)
+
+
+def test_one_hot_smoothing():
+    oh = one_hot(jnp.asarray([0, 1]), 2, on_value=0.9, off_value=0.1)
+    np.testing.assert_allclose(np.asarray(oh), [[0.9, 0.1], [0.1, 0.9]],
+                               rtol=1e-6)
+
+
+def test_selection_precedence():
+    class Cfg:
+        jsd = False
+        mixup = 0.0
+        smoothing = 0.0
+        aug_splits = 0
+    cfg = Cfg()
+    assert create_loss_fn(cfg) is cross_entropy
+    cfg.smoothing = 0.1
+    assert create_loss_fn(cfg) is not cross_entropy
+    cfg.mixup = 0.2
+    assert create_loss_fn(cfg) is soft_target_cross_entropy
+    cfg.jsd = True
+    fn = create_loss_fn(cfg)
+    assert fn is not soft_target_cross_entropy

@@ -1,0 +1,130 @@
+"""Optimizer tests: TF-parity RMSprop semantics, factory dispatch, lookahead."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepfake_detection_tpu.optim import (create_optimizer, lookahead,
+                                          rmsprop_tf, weight_decay_mask)
+
+
+def _np_rmsprop_tf_steps(p0, grads, lr, alpha=0.9, eps=1e-10, momentum=0.9):
+    """Independent numpy model of the TF-RMSprop semantics documented in
+    rmsprop_tf.py (ones-init accumulator, eps in sqrt, lr in momentum buf)."""
+    p = p0.copy()
+    sa = np.ones_like(p)      # ones init
+    buf = np.zeros_like(p)
+    for g in grads:
+        sa = sa + (1 - alpha) * (g * g - sa)
+        rms = np.sqrt(sa + eps)          # eps inside sqrt
+        buf = momentum * buf + lr * g / rms   # lr folded into buffer
+        p = p - buf
+    return p
+
+
+class TestRMSpropTF:
+    def test_matches_reference_semantics(self):
+        rng = np.random.default_rng(0)
+        p0 = rng.normal(size=(5, 3)).astype(np.float32)
+        grads = [rng.normal(size=(5, 3)).astype(np.float32) for _ in range(4)]
+        lr = 0.01
+
+        tx = rmsprop_tf(lr, alpha=0.9, eps=1e-10, momentum=0.9)
+        params = {"w": jnp.asarray(p0)}
+        state = tx.init(params)
+        for g in grads:
+            updates, state = tx.update({"w": jnp.asarray(g)}, state, params)
+            params = optax.apply_updates(params, updates)
+
+        expected = _np_rmsprop_tf_steps(p0, grads, lr)
+        np.testing.assert_allclose(np.asarray(params["w"]), expected,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_ones_init_damps_first_step(self):
+        # zero-init RMSprop would give |step| ~ lr/sqrt(eps) >> lr for small
+        # grads; ones-init gives |step| ~ lr * g.
+        tx = rmsprop_tf(0.1, momentum=0.0)
+        params = {"w": jnp.zeros(3)}
+        state = tx.init(params)
+        g = {"w": jnp.full(3, 1e-3)}
+        updates, _ = tx.update(g, state, params)
+        assert float(jnp.abs(updates["w"]).max()) < 0.1 * 2e-3
+
+    def test_no_momentum_path(self):
+        tx = rmsprop_tf(0.05, momentum=0.0)
+        params = {"w": jnp.ones(4)}
+        state = tx.init(params)
+        g = {"w": jnp.ones(4)}
+        updates, state = tx.update(g, state, params)
+        # sa = 1 + 0.1*(1-1) = 1; delta = -lr*g/sqrt(1+eps) ≈ -lr
+        np.testing.assert_allclose(np.asarray(updates["w"]), -0.05, rtol=1e-5)
+
+    def test_centered(self):
+        tx = rmsprop_tf(0.01, momentum=0.9, centered=True)
+        params = {"w": jnp.ones(4)}
+        state = tx.init(params)
+        updates, state = tx.update({"w": jnp.ones(4)}, state, params)
+        assert jnp.all(jnp.isfinite(updates["w"]))
+
+
+class _Cfg:
+    opt = "rmsproptf"
+    opt_eps = 1e-8
+    momentum = 0.9
+    weight_decay = 1e-5
+    lr = 1e-3
+
+
+@pytest.mark.parametrize("name", [
+    "sgd", "adam", "adamw", "nadam", "radam", "adadelta", "rmsprop",
+    "rmsproptf", "novograd", "nvnovograd", "lookahead_rmsproptf",
+    "fusedsgd", "fusedadamw", "fusedlamb",
+])
+def test_factory_dispatch_and_step(name):
+    cfg = _Cfg()
+    cfg.opt = name
+    tx = create_optimizer(cfg)
+    params = {"kernel": jnp.ones((3, 4)), "bias": jnp.zeros(4)}
+    state = tx.init(params)
+    grads = jax.tree.map(jnp.ones_like, params)
+    updates, state = tx.update(grads, state, params)
+    new_params = optax.apply_updates(params, updates)
+    assert jax.tree.all(jax.tree.map(
+        lambda a: bool(jnp.all(jnp.isfinite(a))), new_params))
+    # lr is injectable
+    assert "learning_rate" in state.hyperparams
+
+
+def test_factory_invalid_name():
+    cfg = _Cfg()
+    cfg.opt = "doesnotexist"
+    with pytest.raises(ValueError):
+        create_optimizer(cfg)
+
+
+def test_weight_decay_mask():
+    params = {"conv": {"kernel": jnp.ones((3, 3, 4, 8)), "bias": jnp.ones(8)},
+              "bn": {"scale": jnp.ones(8)}}
+    mask = weight_decay_mask(params)
+    assert mask["conv"]["kernel"] is True
+    assert mask["conv"]["bias"] is False
+    assert mask["bn"]["scale"] is False
+
+
+def test_lookahead_sync():
+    inner = optax.sgd(1.0)
+    tx = lookahead(inner, sync_period=2, alpha=0.5)
+    params = {"w": jnp.zeros(2)}
+    state = tx.init(params)
+    g = {"w": jnp.ones(2)}
+    # step 1 (no sync): p = -1
+    u, state = tx.update(g, state, params)
+    params = optax.apply_updates(params, u)
+    np.testing.assert_allclose(np.asarray(params["w"]), -1.0)
+    # step 2 (sync): fast would be -2; target = 0 + 0.5*(-2-0) = -1
+    u, state = tx.update(g, state, params)
+    params = optax.apply_updates(params, u)
+    np.testing.assert_allclose(np.asarray(params["w"]), -1.0)
+    np.testing.assert_allclose(np.asarray(state.slow_params["w"]), -1.0)

@@ -1,0 +1,129 @@
+"""Scheduler tests: step/cosine/tanh/plateau values, warmup, noise, factory."""
+
+import math
+
+import pytest
+
+from deepfake_detection_tpu.scheduler import (CosineSchedule, PlateauSchedule,
+                                              StepSchedule, TanhSchedule,
+                                              create_scheduler)
+
+
+class TestStepSchedule:
+    def test_canonical_run(self):
+        # canonical deepfake run: decay every 2 epochs by 0.92 (train.sh:5-7)
+        s = StepSchedule(1.2e-5, decay_t=2, decay_rate=0.92)
+        assert s.step(0) == pytest.approx(1.2e-5)
+        assert s.step(1) == pytest.approx(1.2e-5)
+        assert s.step(2) == pytest.approx(1.2e-5 * 0.92)
+        assert s.step(7) == pytest.approx(1.2e-5 * 0.92 ** 3)
+
+    def test_warmup(self):
+        s = StepSchedule(1.0, decay_t=10, decay_rate=0.5, warmup_t=4,
+                         warmup_lr_init=0.2)
+        assert s.last_lr == pytest.approx(0.2)   # pre-loop init
+        assert s.step(0) == pytest.approx(0.2)
+        assert s.step(2) == pytest.approx(0.2 + 2 * (1.0 - 0.2) / 4)
+        assert s.step(4) == pytest.approx(1.0)
+
+    def test_update_granularity_ignored_by_default(self):
+        s = StepSchedule(1.0, decay_t=2, decay_rate=0.5)
+        lr0 = s.step(0)
+        assert s.step_update(999) == lr0   # t_in_epochs → updates don't move lr
+
+
+class TestCosineSchedule:
+    def test_endpoints(self):
+        s = CosineSchedule(1.0, t_initial=10, lr_min=0.1, cycle_limit=1)
+        assert s.step(0) == pytest.approx(1.0)
+        assert s.step(5) == pytest.approx(0.1 + 0.45 * (1 + math.cos(math.pi / 2)))
+        # past the single cycle → lr_min
+        assert s.step(10) == pytest.approx(0.1)
+
+    def test_cycle_length(self):
+        s = CosineSchedule(1.0, t_initial=10, cycle_limit=1)
+        assert s.get_cycle_length() == 10
+
+    def test_restarts(self):
+        s = CosineSchedule(1.0, t_initial=4, decay_rate=0.5, cycle_limit=0)
+        # second cycle starts at gamma=0.5
+        assert s.step(4) == pytest.approx(0.5)
+
+
+class TestTanhSchedule:
+    def test_monotone_decay(self):
+        s = TanhSchedule(1.0, t_initial=20, lr_min=0.0, cycle_limit=1)
+        vals = [s.step(t) for t in range(20)]
+        assert all(a >= b for a, b in zip(vals, vals[1:]))
+        assert vals[0] == pytest.approx(
+            0.5 * (1 - math.tanh(-6.0)), rel=1e-6)
+
+
+class TestPlateauSchedule:
+    def test_decay_on_plateau(self):
+        s = PlateauSchedule(1.0, decay_rate=0.1, patience_t=2)
+        assert s.step(1, metric=1.0) == pytest.approx(1.0)   # best
+        for e in range(2, 5):  # 3 bad epochs > patience 2
+            lr = s.step(e, metric=2.0)
+        assert lr == pytest.approx(0.1)
+
+    def test_improvement_resets(self):
+        s = PlateauSchedule(1.0, decay_rate=0.1, patience_t=2)
+        s.step(1, metric=1.0)
+        s.step(2, metric=2.0)
+        s.step(3, metric=0.5)      # improvement
+        assert s.num_bad == 0
+        assert s.step(4, metric=0.6) == pytest.approx(1.0)
+
+    def test_state_roundtrip(self):
+        s = PlateauSchedule(1.0, decay_rate=0.1, patience_t=1)
+        s.step(1, metric=1.0)
+        s.step(2, metric=2.0)
+        sd = s.state_dict()
+        s2 = PlateauSchedule(1.0, decay_rate=0.1, patience_t=1)
+        s2.load_state_dict(sd)
+        assert s2.best == s.best and s2.num_bad == s.num_bad
+
+
+class _Cfg:
+    epochs = 200
+    sched = "step"
+    lr = 1.2e-5
+    min_lr = 1e-5
+    decay_epochs = 2.0
+    decay_rate = 0.92
+    warmup_lr = 1e-4
+    warmup_epochs = 0
+    cooldown_epochs = 10
+    patience_epochs = 10
+    lr_noise = None
+    lr_noise_pct = 0.67
+    lr_noise_std = 1.0
+    seed = 42
+
+
+def test_factory_step():
+    sched, epochs = create_scheduler(_Cfg())
+    assert isinstance(sched, StepSchedule)
+    assert epochs == 200
+
+
+def test_factory_cosine_extends_epochs():
+    cfg = _Cfg()
+    cfg.sched = "cosine"
+    sched, epochs = create_scheduler(cfg)
+    assert isinstance(sched, CosineSchedule)
+    assert epochs == 200 + 10   # cycle + cooldown (scheduler_factory.py:38)
+
+
+def test_lr_noise_applied_in_range():
+    cfg = _Cfg()
+    cfg.lr_noise = (0.5,)   # noise from epoch 100 on
+    sched, _ = create_scheduler(cfg)
+    base = StepSchedule(cfg.lr, decay_t=2, decay_rate=0.92)
+    assert sched.step(10) == pytest.approx(base.step(10))     # pre-range
+    noisy = sched.step(150)
+    clean = base.step(150)
+    assert noisy != pytest.approx(clean)                       # noise active
+    assert abs(noisy - clean) < clean * 0.67 * 1.0001          # bounded by pct
+    assert sched.step(150) == pytest.approx(noisy)             # seeded/determin.
