@@ -92,7 +92,22 @@ def _base_optimizer(name: str, learning_rate, *, opt_eps: float,
                        momentum=momentum),
         )
     elif name in ("novograd", "nvnovograd"):
-        tx = optax.novograd(learning_rate, eps=opt_eps, weight_decay=wd)
+        # optax.novograd has no mask arg; partition leaves so 1-dim params and
+        # biases stay undecayed like every other optimizer here (reference
+        # add_weight_decay applies to NovoGrad too, optim_factory.py:35-37).
+        # NovoGrad's normalization is per-leaf, so the split is exact.
+        if wd and mask is not None:
+            def _labels(params):
+                m = mask(params) if callable(mask) else mask
+                return jax.tree.map(
+                    lambda b: "decay" if b else "no_decay", m)
+            tx = optax.multi_transform(
+                {"decay": optax.novograd(learning_rate, eps=opt_eps,
+                                         weight_decay=wd),
+                 "no_decay": optax.novograd(learning_rate, eps=opt_eps)},
+                _labels)
+        else:
+            tx = optax.novograd(learning_rate, eps=opt_eps, weight_decay=wd)
     elif name == "lamb":
         tx = optax.lamb(learning_rate, eps=opt_eps, weight_decay=wd,
                         mask=mask)

@@ -260,16 +260,18 @@ class PlateauSchedule(Scheduler):
             if self._is_better(metric):
                 self.best = metric
                 self.num_bad = 0
-            elif self.cooldown_counter > 0:
-                self.cooldown_counter -= 1
-                self.num_bad = 0
             else:
                 self.num_bad += 1
-                if self.num_bad > self.patience_t:
-                    self.current_lr = max(self.current_lr * self.decay_rate,
-                                          self.lr_min)
-                    self.cooldown_counter = self.cooldown_t
-                    self.num_bad = 0
+            # torch semantics: cooldown ticks down every epoch it is active,
+            # improving or not, and bad epochs inside it don't count
+            if self.cooldown_counter > 0:
+                self.cooldown_counter -= 1
+                self.num_bad = 0
+            if self.cooldown_counter == 0 and self.num_bad > self.patience_t:
+                self.current_lr = max(self.current_lr * self.decay_rate,
+                                      self.lr_min)
+                self.cooldown_counter = self.cooldown_t
+                self.num_bad = 0
         self.last_lr = self.current_lr
         return self.last_lr
 

@@ -1,0 +1,392 @@
+"""Data pipeline tests (SURVEY.md §4: deterministic-seed unit tests)."""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from deepfake_detection_tpu.data import (DeepFakeClipDataset,
+                                         FastCollateMixup, SyntheticDataset,
+                                         create_deepfake_loader_v3,
+                                         fast_collate, resolve_data_config)
+from deepfake_detection_tpu.data.auto_augment import (
+    augment_and_mix_transform, auto_augment_transform, rand_augment_transform)
+from deepfake_detection_tpu.data.random_erasing import random_erasing
+from deepfake_detection_tpu.data.samplers import (OrderedShardedSampler,
+                                                  ShardedTrainSampler)
+from deepfake_detection_tpu.data.transforms import (Compose, MultiConcate,
+                                                    MultiRandomCrop,
+                                                    MultiRandomHorizontalFlip,
+                                                    MultiRandomResize,
+                                                    MultiRotate, MultiToNumpy)
+from deepfake_detection_tpu.data.transforms_factory import (
+    transforms_deepfake_eval_v3, transforms_deepfake_train_v3)
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _frames(n=4, size=(64, 48), seed=0):
+    g = _rng(seed)
+    return [Image.fromarray(
+        g.integers(0, 255, (size[1], size[0], 3), dtype=np.uint8))
+        for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Multi* transforms
+# ---------------------------------------------------------------------------
+
+class TestMultiTransforms:
+    def test_shared_flip(self):
+        imgs = _frames()
+        flipped = MultiRandomHorizontalFlip(p=1.0)(imgs, _rng())
+        for orig, fl in zip(imgs, flipped):
+            assert np.array_equal(np.asarray(fl),
+                                  np.asarray(orig)[:, ::-1])
+
+    def test_shared_resize_and_crop(self):
+        imgs = _frames()
+        out = MultiRandomResize(scale=(2. / 3, 3. / 2))(imgs, _rng(1))
+        sizes = {im.size for im in out}
+        assert len(sizes) == 1  # all frames share the same target size
+        out = MultiRandomCrop(32, pad_if_needed=True)(out, _rng(2))
+        assert all(im.size == (32, 32) for im in out)
+
+    def test_rotate_shared_angle(self):
+        imgs = _frames()
+        out = MultiRotate(30)(imgs, _rng(3))
+        assert len({im.size for im in out}) == 1  # expand=True, same canvas
+
+    def test_concat_nhwc(self):
+        imgs = _frames()
+        arrs = MultiToNumpy()(imgs)
+        cat = MultiConcate()(arrs)
+        assert cat.shape == (48, 64, 12)
+        assert cat.dtype == np.uint8
+
+    def test_train_pipeline_shape_and_determinism(self):
+        tf = transforms_deepfake_train_v3(
+            600, color_jitter=0.4, flicker=0.05, rotate_range=5,
+            blur_radiu=1, blur_prob=0.05)
+        imgs = _frames(4, size=(700, 500))
+        a = tf(imgs, _rng(7))
+        b = tf(imgs, _rng(7))
+        c = tf(imgs, _rng(8))
+        assert a.shape == (600, 600, 12) and a.dtype == np.uint8
+        np.testing.assert_array_equal(a, b)  # same rng → same output
+        assert not np.array_equal(a, c)
+
+    def test_eval_pipeline(self):
+        tf = transforms_deepfake_eval_v3(600)
+        out = tf(_frames(4, size=(650, 620)), _rng())
+        assert out.shape == (600, 600, 12)
+
+
+# ---------------------------------------------------------------------------
+# Dataset
+# ---------------------------------------------------------------------------
+
+def _make_v3_tree(root, n_real=3, n_fake=6, frames=(4, 2, 4, 4, 1, 3)):
+    os.makedirs(root, exist_ok=True)
+    real_lines, fake_lines = [], []
+    for i in range(n_real):
+        name = f"realclip{i}"
+        d = os.path.join(root, "real", name)
+        os.makedirs(d, exist_ok=True)
+        nf = 4
+        for j in range(nf):
+            Image.new("RGB", (32, 32), (i, j, 0)).save(
+                os.path.join(d, f"{j}.jpg"))
+        real_lines.append(f"{name}:{nf}")
+    for i in range(n_fake):
+        name = f"fakeclip{i}"
+        d = os.path.join(root, "fake", name)
+        os.makedirs(d, exist_ok=True)
+        nf = frames[i % len(frames)]
+        for j in range(nf):
+            Image.new("RGB", (32, 32), (i, j, 100)).save(
+                os.path.join(d, f"{j}.jpg"))
+        fake_lines.append(f"{name}:{nf}")
+    with open(os.path.join(root, "real_list.txt"), "w") as f:
+        f.write("\n".join(real_lines) + "\n")
+    with open(os.path.join(root, "fake_list.txt"), "w") as f:
+        f.write("\n".join(fake_lines) + "\n")
+
+
+class TestDeepFakeClipDataset:
+    def test_lengths_and_labels(self, tmp_path):
+        root = str(tmp_path / "d")
+        _make_v3_tree(root)
+        ds = DeepFakeClipDataset(root)
+        # no label_balance: every fake is its own bucket → 6 + 3
+        assert len(ds) == 9
+        paths, y = ds.sample_paths(0)
+        assert y == 0 and len(paths) == 4
+        paths, y = ds.sample_paths(len(ds) - 1)
+        assert y == 1
+
+    def test_short_clip_padding(self, tmp_path):
+        root = str(tmp_path / "d")
+        _make_v3_tree(root)
+        ds = DeepFakeClipDataset(root)
+        # fakeclip1 has 2 frames → padded with 0.jpg twice then frames 0,1
+        idx = [i for i in range(len(ds))
+               if "fakeclip1/" in ds.sample_paths(i)[0][0].replace(os.sep, "/")]
+        paths, _ = ds.sample_paths(idx[0])
+        names = [os.path.basename(p) for p in paths]
+        assert names == ["0.jpg", "0.jpg", "0.jpg", "1.jpg"]
+
+    def test_label_balance_rotation(self, tmp_path):
+        root = str(tmp_path / "d")
+        _make_v3_tree(root)
+        ds = DeepFakeClipDataset(root, label_balance=True)
+        # 6 fakes into 3 buckets of 2 → index space 3 fake + 3 real
+        assert len(ds) == 6
+        p0, _ = ds.sample_paths(0, epoch=0)
+        p1, _ = ds.sample_paths(0, epoch=1)
+        p2, _ = ds.sample_paths(0, epoch=2)
+        assert p0 != p1          # rotation advances with epoch
+        assert p0 == p2          # bucket size 2 → period 2
+
+    def test_split_determinism(self, tmp_path):
+        root = str(tmp_path / "d")
+        _make_v3_tree(root, n_real=10, n_fake=10)
+        tr1 = DeepFakeClipDataset(root, train_split=True, train_ratio=0.7,
+                                  is_training=True, split_seed=5)
+        tr2 = DeepFakeClipDataset(root, train_split=True, train_ratio=0.7,
+                                  is_training=True, split_seed=5)
+        va = DeepFakeClipDataset(root, train_split=True, train_ratio=0.7,
+                                 is_training=False, split_seed=5)
+        assert tr1.real_clips == tr2.real_clips
+        names_tr = {c[0] for c in tr1.real_clips}
+        names_va = {c[0] for c in va.real_clips}
+        assert not names_tr & names_va
+        assert len(names_tr) + len(names_va) == 10
+
+    def test_getitem_with_transform(self, tmp_path):
+        root = str(tmp_path / "d")
+        _make_v3_tree(root)
+        ds = DeepFakeClipDataset(root,
+                                 transform=transforms_deepfake_eval_v3(32))
+        img, y = ds[0]
+        assert img.shape == (32, 32, 12)
+
+
+# ---------------------------------------------------------------------------
+# Samplers
+# ---------------------------------------------------------------------------
+
+class TestSamplers:
+    def test_train_shard_partition(self):
+        samplers = [ShardedTrainSampler(103, num_shards=4, shard_index=i,
+                                        batch_size=2, seed=1)
+                    for i in range(4)]
+        all_idx = np.concatenate([s.local_indices() for s in samplers])
+        assert len(all_idx) == (103 // 8) * 8
+        assert len(set(all_idx.tolist())) == len(all_idx)  # disjoint
+
+    def test_train_epoch_reshuffle(self):
+        s = ShardedTrainSampler(50, batch_size=5, seed=1)
+        a = s.local_indices().copy()
+        s.set_epoch(1)
+        b = s.local_indices()
+        assert not np.array_equal(a, b)
+
+    def test_eval_padding_and_mask(self):
+        samplers = [OrderedShardedSampler(10, num_shards=4, shard_index=i,
+                                          batch_size=2) for i in range(4)]
+        idx = np.concatenate([s.local_indices()[0] for s in samplers])
+        valid = np.concatenate([s.local_indices()[1] for s in samplers])
+        assert len(idx) == 16                      # padded to 4*2*2
+        assert valid.sum() == 10                   # exactly dataset_len valid
+        assert set(idx[valid].tolist()) == set(range(10))
+
+
+# ---------------------------------------------------------------------------
+# Mixup / collate
+# ---------------------------------------------------------------------------
+
+class TestMixup:
+    def test_fast_collate(self):
+        samples = [(np.full((8, 8, 12), i, np.uint8), i % 2)
+                   for i in range(4)]
+        imgs, tgts = fast_collate(samples)
+        assert imgs.shape == (4, 8, 8, 12) and imgs.dtype == np.uint8
+        assert tgts.tolist() == [0, 1, 0, 1]
+
+    def test_collate_mixup_soft_targets(self):
+        m = FastCollateMixup(mixup_alpha=1.0, label_smoothing=0.1,
+                             num_classes=2)
+        imgs = np.stack([np.zeros((4, 4, 3), np.uint8),
+                         np.full((4, 4, 3), 200, np.uint8)])
+        tgts = np.array([0, 1])
+        out, soft = m(imgs, tgts, _rng(3))
+        assert soft.shape == (2, 2)
+        np.testing.assert_allclose(soft.sum(-1), 1.0, atol=1e-5)
+        assert out.dtype == np.uint8
+
+
+# ---------------------------------------------------------------------------
+# RandomErasing (device)
+# ---------------------------------------------------------------------------
+
+class TestRandomErasing:
+    def test_erase_const(self):
+        import jax
+        x = np.ones((2, 32, 32, 6), np.float32)
+        out = random_erasing(jax.random.PRNGKey(0), x, probability=1.0,
+                             min_area=0.1, max_area=0.3, img_num=2)
+        out = np.asarray(out)
+        assert out.shape == x.shape
+        assert (out == 0).any()          # something was erased
+        # frames erased independently: zero masks differ between frame slices
+        z0 = (out[..., :3] == 0).sum()
+        z1 = (out[..., 3:] == 0).sum()
+        assert z0 > 0 and z1 > 0
+
+    def test_no_erase_when_prob_zero(self):
+        import jax
+        x = np.ones((1, 16, 16, 3), np.float32)
+        out = np.asarray(random_erasing(jax.random.PRNGKey(0), x,
+                                        probability=0.0))
+        np.testing.assert_array_equal(out, x)
+
+    def test_aug_split_skips_clean(self):
+        import jax
+        x = np.ones((4, 32, 32, 3), np.float32)
+        out = np.asarray(random_erasing(
+            jax.random.PRNGKey(1), x, probability=1.0, min_area=0.2,
+            max_area=0.4, num_splits=2))
+        assert (out[:2] == 1).all()      # clean split untouched
+        assert (out[2:] == 0).any()
+
+
+# ---------------------------------------------------------------------------
+# Loader end-to-end
+# ---------------------------------------------------------------------------
+
+class TestLoader:
+    def test_synthetic_end_to_end(self):
+        import jax.numpy as jnp
+        ds = SyntheticDataset(length=16, image_shape=(64, 64, 12))
+        loader = create_deepfake_loader_v3(
+            ds, (12, 64, 64), batch_size=4, is_training=True, re_prob=0.2,
+            re_max=0.05, num_workers=2, rotate_range=5, flicker=0.05,
+            dtype=jnp.float32)
+        batches = list(iter(loader))
+        assert len(batches) == 4
+        x, y = batches[0]
+        assert x.shape == (4, 64, 64, 12)
+        assert x.dtype == jnp.float32
+        assert abs(float(x.mean())) < 3.0  # roughly normalized
+
+    def test_eval_loader_mask(self):
+        import jax.numpy as jnp
+        ds = SyntheticDataset(length=10, image_shape=(32, 32, 12))
+        loader = create_deepfake_loader_v3(
+            ds, (12, 32, 32), batch_size=4, is_training=False,
+            distributed=False, num_workers=1, dtype=jnp.float32)
+        total_valid = 0
+        for x, y, valid in loader:
+            assert x.shape[0] == 4
+            total_valid += int(np.asarray(valid).sum())
+        assert total_valid == 10
+
+    def test_determinism_across_worker_counts(self):
+        import jax.numpy as jnp
+        ds1 = SyntheticDataset(length=8, image_shape=(32, 32, 12))
+        ds2 = SyntheticDataset(length=8, image_shape=(32, 32, 12))
+        mk = lambda ds, w: create_deepfake_loader_v3(
+            ds, (12, 32, 32), batch_size=4, is_training=True,
+            num_workers=w, dtype=jnp.float32, re_prob=0.0)
+        b1 = [np.asarray(x) for x, _ in mk(ds1, 1)]
+        b2 = [np.asarray(x) for x, _ in mk(ds2, 4)]
+        for a, b in zip(b1, b2):
+            np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# AutoAugment family
+# ---------------------------------------------------------------------------
+
+class TestAutoAugment:
+    def test_autoaugment(self):
+        tf = auto_augment_transform("original-mstd0.5", {})
+        img = _frames(1, size=(64, 64))[0]
+        out = tf(img, _rng(0))
+        assert out.size == (64, 64)
+
+    def test_randaugment(self):
+        tf = rand_augment_transform("rand-m9-mstd0.5-inc1",
+                                    {"translate_const": 20})
+        img = _frames(1, size=(64, 64))[0]
+        out = tf(img, _rng(0))
+        assert out.size == (64, 64)
+        # determinism
+        a = np.asarray(tf(img, _rng(5)))
+        b = np.asarray(tf(img, _rng(5)))
+        np.testing.assert_array_equal(a, b)
+
+    def test_augmix(self):
+        tf = augment_and_mix_transform("augmix-m3-w3", {})
+        img = _frames(1, size=(48, 48))[0]
+        out = tf(img, _rng(0))
+        assert out.size == (48, 48)
+
+
+# ---------------------------------------------------------------------------
+# Data config resolver
+# ---------------------------------------------------------------------------
+
+class TestResolveDataConfig:
+    def test_v2_string_priority(self):
+        cfg = resolve_data_config({"input_size_v2": "12,600,600",
+                                   "input_size": (3, 224, 224)},
+                                  verbose=False)
+        assert cfg["input_size"] == (12, 600, 600)
+
+    def test_model_mean_selection(self):
+        cfg = resolve_data_config({"model": "xception"}, verbose=False)
+        assert cfg["mean"] == (0.5, 0.5, 0.5)
+        cfg = resolve_data_config({"model": "efficientnet_b0"}, verbose=False)
+        assert cfg["mean"] == (0.485, 0.456, 0.406)
+
+    def test_default_cfg_fallthrough(self):
+        cfg = resolve_data_config(
+            {}, default_cfg={"input_size": (3, 299, 299),
+                             "interpolation": "bicubic", "crop_pct": 0.9},
+            verbose=False)
+        assert cfg["input_size"] == (3, 299, 299)
+        assert cfg["crop_pct"] == 0.9
+
+
+class TestCodeReviewRegressions:
+    def test_autoaugment_originalr(self):
+        tf = auto_augment_transform("originalr-mstd0.5", {})
+        img = _frames(1, size=(64, 64))[0]
+        assert tf(img, _rng(0)).size == (64, 64)
+
+    def test_augmix_non_square(self):
+        tf = augment_and_mix_transform("augmix-m3-w3", {})
+        img = _frames(1, size=(64, 48))[0]  # W=64, H=48
+        out = tf(img, _rng(0))
+        assert out.size == (64, 48)
+
+    def test_loader_abandoned_iteration_no_deadlock(self):
+        import threading
+        ds = SyntheticDataset(length=32, image_shape=(16, 16, 12))
+        from deepfake_detection_tpu.data.loader import HostLoader
+        from deepfake_detection_tpu.data.samplers import ShardedTrainSampler
+        host = HostLoader(ds, ShardedTrainSampler(32, batch_size=4),
+                          batch_size=4, num_workers=2, prefetch_depth=1)
+        before = threading.active_count()
+        for _ in range(3):
+            it = iter(host)
+            next(it)
+            it.close()  # abandon mid-iteration
+        import time
+        time.sleep(1.0)
+        assert threading.active_count() <= before + 2  # producers drained

@@ -128,3 +128,24 @@ def test_lookahead_sync():
     params = optax.apply_updates(params, u)
     np.testing.assert_allclose(np.asarray(params["w"]), -1.0)
     np.testing.assert_allclose(np.asarray(state.slow_params["w"]), -1.0)
+
+
+class TestNovogradWeightDecayMask:
+    def test_bias_and_norm_params_not_decayed(self):
+        import jax.numpy as jnp
+        from types import SimpleNamespace
+
+        def updates(wd):
+            cfg = SimpleNamespace(opt="novograd", opt_eps=1e-8, momentum=0.9,
+                                  weight_decay=wd, lr=0.1)
+            tx = create_optimizer(cfg, inject=False)
+            params = {"kernel": jnp.ones((3, 3)), "bias": jnp.ones((3,))}
+            g = {"kernel": jnp.ones((3, 3)) * 0.5, "bias": jnp.ones((3,)) * 0.5}
+            u, _ = tx.update(g, tx.init(params), params)
+            return u
+
+        u_wd, u_nowd = updates(0.5), updates(0.0)
+        # bias (1-dim) exempt from decay → identical with/without wd
+        assert jnp.allclose(u_wd["bias"], u_nowd["bias"])
+        # kernel is decayed → differs
+        assert not jnp.allclose(u_wd["kernel"], u_nowd["kernel"])

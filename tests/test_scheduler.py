@@ -127,3 +127,19 @@ def test_lr_noise_applied_in_range():
     assert noisy != pytest.approx(clean)                       # noise active
     assert abs(noisy - clean) < clean * 0.67 * 1.0001          # bounded by pct
     assert sched.step(150) == pytest.approx(noisy)             # seeded/determin.
+
+
+class TestPlateauCooldownTorchParity:
+    def test_cooldown_ticks_during_improvement(self):
+        # decay fires, then metric improves through the whole cooldown window;
+        # torch semantics: cooldown expires during the improvements, so later
+        # bad epochs immediately count toward patience.
+        s = PlateauSchedule(1.0, decay_rate=0.1, patience_t=0, cooldown_t=3)
+        s.step(1, 1.0)
+        s.step(2, 2.0)          # bad > patience 0 → decay, cooldown=3
+        assert s.last_lr == 0.1
+        for e, m in zip(range(3, 7), [0.9, 0.8, 0.7, 0.6]):
+            s.step(e, m)        # improving; cooldown ticks down to 0
+        assert s.cooldown_counter == 0
+        s.step(7, 5.0)          # first bad epoch after cooldown → decays now
+        assert s.last_lr == pytest.approx(0.01)
