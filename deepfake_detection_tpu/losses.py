@@ -25,11 +25,7 @@ __all__ = [
 ]
 
 
-def _masked_mean(x: jnp.ndarray, weight: Optional[jnp.ndarray]) -> jnp.ndarray:
-    if weight is None:
-        return x.mean()
-    weight = weight.astype(x.dtype)
-    return (x * weight).sum() / jnp.maximum(weight.sum(), 1.0)
+from .utils.metrics import masked_mean as _masked_mean  # canonical helper
 
 
 def one_hot(labels: jnp.ndarray, num_classes: int,

@@ -1,0 +1,155 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no sequence models — its "temporal" axis is 4 frames
+channel-concatenated (SURVEY.md §5) — but long-context attention is a
+first-class requirement for the TPU framework (it backs the ViT/TimeSformer
+stretch configs in BASELINE.json).  Two standard schemes, both expressed over
+a mesh axis with XLA collectives riding ICI:
+
+* **Ring attention** (Liu et al. 2023, blockwise; PAPERS.md): each device
+  holds one sequence block of Q/K/V.  K/V blocks rotate around the ring via
+  ``lax.ppermute`` while each device accumulates its queries' attention with
+  a numerically-stable online softmax (flash-attention style running max /
+  denominator).  Communication overlaps with the block matmuls; memory is
+  O(L/n) per device.
+* **Ulysses** (DeepSpeed-Ulysses): ``all_to_all`` re-shards from
+  sequence-split to head-split, runs *local* full attention on the head
+  shard, and re-shards back.  Cheaper collectives for moderate L, requires
+  heads % n == 0.
+
+Both are plain functions over *local* blocks with an ``axis_name`` — usable
+directly inside ``shard_map``; :func:`ring_self_attention` wraps the
+shard_map boilerplate over a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention", "ring_self_attention",
+           "full_attention"]
+
+
+def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   causal: bool = False, scale: Optional[float] = None
+                   ) -> jnp.ndarray:
+    """Reference dense attention (single device) for parity tests.
+
+    Shapes: (B, L, H, D) → (B, L, H, D).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        mask = jnp.arange(lk)[None, :] > jnp.arange(lq)[:, None]
+        s = jnp.where(mask[None, None], -jnp.inf, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Blockwise ring attention over local (B, L_local, H, D) blocks.
+
+    Call inside ``shard_map`` with the sequence dim sharded over
+    ``axis_name``.  K/V rotate ``axis_size`` times; accumulation is float32.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+
+    q32 = q.astype(jnp.float32) * scale
+    q_pos = idx * lq + jnp.arange(lq)                      # global query rows
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def accumulate(t, k_blk, v_blk, acc, m, l):
+        """Fold block (idx - t) mod n into the online-softmax accumulators."""
+        src = (idx - t) % n
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                       k_blk.astype(jnp.float32))          # (B,H,Lq,Lk)
+        if causal:
+            k_pos = src * lk + jnp.arange(lk)
+            mask = k_pos[None, :] > q_pos[:, None]          # (Lq, Lk)
+            s = jnp.where(mask[None, None], -jnp.inf, s)
+        m_blk = jnp.max(s, axis=-1)                         # (B,H,Lq)
+        m_new = jnp.maximum(m, m_blk)
+        # guard fully-masked rows (m_new == -inf) against NaNs
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        acc_new = acc * corr.transpose(0, 2, 1)[..., None] + pv
+        return acc_new, m_new, l_new
+
+    def body(t, carry):
+        k_blk, v_blk, acc, m, l = carry
+        acc, m, l = accumulate(t, k_blk, v_blk, acc, m, l)
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return k_nxt, v_nxt, acc, m, l
+
+    # mark the fresh accumulators as device-varying over the ring axis so the
+    # fori_loop carry type matches the (sharded, hence varying) K/V blocks
+    def vary(x):
+        return lax.pcast(x, axis_name, to="varying")
+    acc0 = vary(jnp.zeros((b, lq, h, d), jnp.float32))
+    m0 = vary(jnp.full((b, h, lq), -jnp.inf, jnp.float32))
+    l0 = vary(jnp.zeros((b, h, lq), jnp.float32))
+    # n-1 rotated steps, then fold the final resident block without the dead
+    # trailing ppermute pair
+    k_f, v_f, acc, m, l = lax.fori_loop(0, n - 1, body,
+                                        (k, v, acc0, m0, l0))
+    acc, m, l = accumulate(n - 1, k_f, v_f, acc, m, l)
+    l = jnp.maximum(l, 1e-20)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None) -> jnp.ndarray:
+    """All-to-all sequence parallelism over local (B, L_local, H, D) blocks.
+
+    Re-shards seq→heads, runs dense local attention on H/n heads over the
+    full sequence, re-shards back.  Requires ``H % axis_size == 0``.
+    """
+    n = lax.axis_size(axis_name)
+    assert q.shape[2] % n == 0, f"heads {q.shape[2]} not divisible by {n}"
+
+    def to_heads(x):  # (B, L/n, H, D) -> (B, L, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(x):    # (B, L, H/n, D) -> (B, L/n, H, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    out = full_attention(to_heads(q), to_heads(k), to_heads(v),
+                         causal=causal, scale=scale)
+    return to_seq(out)
+
+
+def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        mesh: Mesh, seq_axis: str = "data",
+                        causal: bool = False,
+                        impl: str = "ring") -> jnp.ndarray:
+    """shard_map wrapper: global (B, L, H, D) arrays, sequence sharded over
+    ``seq_axis`` of ``mesh``; batch replicated across that axis."""
+    from jax import shard_map
+    fn = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
+    spec = P(None, seq_axis, None, None)
+    sharded = shard_map(
+        functools.partial(fn, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return sharded(q, k, v)

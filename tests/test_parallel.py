@@ -1,0 +1,136 @@
+"""Distributed runtime tests on the 8-device CPU mesh (SURVEY.md §4)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepfake_detection_tpu.parallel import (batch_sharding, distribute_bn,
+                                             fsdp_param_specs, full_attention,
+                                             make_mesh, param_sharding,
+                                             ring_attention,
+                                             ring_self_attention, shard_batch,
+                                             ulysses_attention)
+
+
+class TestMesh:
+    def test_default_1d(self, devices):
+        mesh = make_mesh()
+        assert mesh.axis_names == ("data",)
+        assert mesh.shape["data"] == 8
+
+    def test_2d_with_inference(self, devices):
+        mesh = make_mesh((-1, 2), ("data", "model"))
+        assert mesh.shape["data"] == 4
+        assert mesh.shape["model"] == 2
+
+    def test_bad_shape_raises(self, devices):
+        with pytest.raises(AssertionError):
+            make_mesh((3, 2), ("data", "model"))
+
+
+class TestSharding:
+    def test_batch_sharding_distributes_rows(self, devices):
+        mesh = make_mesh()
+        x = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+        arr = shard_batch(x, mesh)
+        assert arr.shape == (16, 4)
+        assert len(arr.addressable_shards) == 8
+        assert arr.addressable_shards[0].data.shape == (2, 4)
+        np.testing.assert_array_equal(np.asarray(arr), x)
+
+    def test_fsdp_specs(self, devices):
+        mesh = make_mesh()
+        params = {"big": jnp.zeros((1024, 256)), "small": jnp.zeros((7,)),
+                  "odd": jnp.zeros((129, 3, 3, 129))}
+        specs = fsdp_param_specs(params, mesh, min_size=1024)
+        assert specs["big"] == P("data", None)   # largest dim divisible by 8
+        assert specs["small"] == P()             # too small
+        assert specs["odd"] == P()               # nothing divisible
+        shardings = param_sharding(params, mesh, fsdp=True)
+        assert isinstance(shardings["big"], NamedSharding)
+
+    def test_pjit_dp_matmul(self, devices):
+        mesh = make_mesh()
+        w = jnp.ones((4, 2))
+        x = shard_batch(np.ones((16, 4), np.float32), mesh)
+
+        @functools.partial(jax.jit,
+                           out_shardings=NamedSharding(mesh, P()))
+        def step(w, x):
+            return (x @ w).sum()
+
+        assert float(step(w, x)) == 16 * 4 * 2
+
+
+class TestDistributeBn:
+    def test_replicated_identity(self):
+        stats = {"mean": jnp.ones(4)}
+        out = distribute_bn(stats, "reduce", inside_pjit=False)
+        np.testing.assert_array_equal(np.asarray(out["mean"]), 1.0)
+
+    def test_reduce_inside_shard_map(self, devices):
+        from jax import shard_map
+        mesh = make_mesh()
+
+        def f(stats):
+            return distribute_bn(stats, "reduce", inside_pjit=True)
+
+        stats = {"mean": np.arange(8, dtype=np.float32).reshape(8, 1)}
+        out = shard_map(f, mesh=mesh, in_specs=({"mean": P("data", None)},),
+                        out_specs={"mean": P("data", None)})(stats)
+        np.testing.assert_allclose(np.asarray(out["mean"]),
+                                   np.full((8, 1), 3.5))
+
+    def test_broadcast_inside_shard_map(self, devices):
+        from jax import shard_map
+        mesh = make_mesh()
+
+        def f(stats):
+            return distribute_bn(stats, "broadcast", inside_pjit=True)
+
+        stats = {"mean": np.arange(8, dtype=np.float32).reshape(8, 1)}
+        out = shard_map(f, mesh=mesh, in_specs=({"mean": P("data", None)},),
+                        out_specs={"mean": P("data", None)})(stats)
+        np.testing.assert_allclose(np.asarray(out["mean"]),
+                                   np.zeros((8, 1)))  # rank 0's value
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, devices, causal):
+        mesh = make_mesh()
+        b, l, h, d = 2, 32, 4, 8
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+        ref = full_attention(q, k, v, causal=causal)
+        out = ring_self_attention(q, k, v, mesh, seq_axis="data",
+                                  causal=causal, impl="ring")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_ulysses_matches_full_attention(self, devices):
+        mesh = make_mesh()
+        b, l, h, d = 2, 32, 8, 4            # heads divisible by 8
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+        ref = full_attention(q, k, v)
+        out = ring_self_attention(q, k, v, mesh, seq_axis="data",
+                                  impl="ulysses")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_ring_jits_under_shard_map(self, devices):
+        mesh = make_mesh()
+        b, l, h, d = 1, 16, 2, 4
+        x = jnp.ones((b, l, h, d), jnp.float32)
+        f = jax.jit(lambda q, k, v: ring_self_attention(q, k, v, mesh))
+        out = f(x, x, x)
+        assert out.shape == (b, l, h, d)

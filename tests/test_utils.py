@@ -1,0 +1,88 @@
+"""Tests for training utilities (metrics, EMA, summary, logging)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepfake_detection_tpu.utils import (AverageMeter, accuracy, get_outdir,
+                                          init_ema, masked_mean,
+                                          update_ema, update_summary)
+
+
+class TestAverageMeter:
+    def test_running_average(self):
+        m = AverageMeter()
+        m.update(1.0, n=2)
+        m.update(4.0, n=1)
+        assert m.val == 4.0
+        assert m.avg == (1.0 * 2 + 4.0) / 3
+
+
+class TestAccuracy:
+    def test_top1(self):
+        logits = jnp.array([[2.0, 1.0], [0.0, 3.0], [5.0, 0.0]])
+        target = jnp.array([0, 1, 1])
+        acc = accuracy(logits, target)
+        np.testing.assert_allclose(float(acc), 200.0 / 3, rtol=1e-6)
+
+    def test_topk_and_soft_targets(self):
+        logits = jnp.array([[0.1, 0.2, 0.7], [0.5, 0.3, 0.2]])
+        soft = jnp.array([[0.0, 0.0, 1.0], [0.0, 1.0, 0.0]])
+        a1, a2 = accuracy(logits, soft, topk=(1, 2))
+        assert float(a1) == 50.0
+        assert float(a2) == 100.0
+
+    def test_masked(self):
+        logits = jnp.array([[2.0, 1.0], [0.0, 3.0]])
+        target = jnp.array([0, 0])        # second is wrong but masked out
+        acc = accuracy(logits, target, weight=jnp.array([1, 0]))
+        assert float(acc) == 100.0
+
+    def test_jit_compatible(self):
+        f = jax.jit(lambda o, t: accuracy(o, t))
+        out = f(jnp.eye(4), jnp.arange(4))
+        assert float(out) == 100.0
+
+
+class TestEma:
+    def test_update_math(self):
+        v = {"params": {"w": jnp.ones(3)}, "batch_stats": {"m": jnp.zeros(3)}}
+        ema = init_ema(v)
+        v2 = {"params": {"w": jnp.full(3, 2.0)},
+              "batch_stats": {"m": jnp.ones(3)}}
+        ema = update_ema(ema, v2, decay=0.9)
+        np.testing.assert_allclose(np.asarray(ema["params"]["w"]),
+                                   0.9 * 1 + 0.1 * 2)
+        np.testing.assert_allclose(np.asarray(ema["batch_stats"]["m"]), 0.1)
+
+    def test_jit_inside_step(self):
+        step = jax.jit(lambda e, v: update_ema(e, v, 0.99))
+        e = step({"w": jnp.zeros(2)}, {"w": jnp.ones(2)})
+        np.testing.assert_allclose(np.asarray(e["w"]), 0.01)
+
+
+class TestSummary:
+    def test_csv_append_and_plots(self, tmp_path):
+        f = str(tmp_path / "summary.csv")
+        plots = str(tmp_path / "plots")
+        update_summary(1, {"loss": 0.5}, {"loss": 0.6, "prec1": 70.0}, f,
+                       plots, write_header=True)
+        update_summary(2, {"loss": 0.4}, {"loss": 0.5, "prec1": 75.0}, f,
+                       plots)
+        lines = open(f).read().strip().splitlines()
+        assert lines[0] == "epoch,train_loss,eval_loss,eval_prec1"
+        assert len(lines) == 3
+        assert os.path.isfile(os.path.join(plots, "eval_prec1.jpg"))
+
+    def test_get_outdir_inc(self, tmp_path):
+        a = get_outdir(str(tmp_path), "run")
+        b = get_outdir(str(tmp_path), "run", inc=True)
+        assert a != b and os.path.isdir(b)
+
+
+def test_masked_mean():
+    x = jnp.array([1.0, 2.0, 100.0])
+    assert float(masked_mean(x, jnp.array([1, 1, 0]))) == 1.5
+    assert float(masked_mean(x)) == float(x.mean())
