@@ -306,13 +306,28 @@ class TrainConfig:
         for k, v in vars(ns).items():
             if k == "config" or v is None or k not in hints:
                 continue
-            default = hints[k].default
-            if isinstance(default, bool):
-                out[k] = bool(v)
-            elif isinstance(default, int) and not isinstance(default, bool):
-                out[k] = int(v)
-            elif isinstance(default, float):
-                out[k] = float(v)
-            else:
-                out[k] = v
+            out[k] = cls._convert(hints[k], v)
         return cls.from_dict(out)
+
+    @staticmethod
+    def _convert(field_, v):
+        """Coerce a CLI string to the field's annotated type (defaults of
+        ``None`` carry no type, so the annotation is authoritative)."""
+        ann = str(field_.type)
+        default = field_.default
+        if isinstance(default, bool) or ann == "bool":
+            return bool(v)
+        if not isinstance(v, str):
+            return v
+        if "Tuple[float" in ann:
+            return tuple(float(x) for x in v.split(","))
+        if "Tuple[int" in ann:
+            return _tuple_of_ints(v)
+        if "Tuple[str" in ann:
+            return tuple(x for x in v.split(",") if x)
+        if "float" in ann or isinstance(default, float):
+            return float(v)
+        if "int" in ann or (isinstance(default, int)
+                            and not isinstance(default, bool)):
+            return int(v)
+        return v

@@ -219,7 +219,8 @@ class DeviceLoader:
 
     def _put(self, arr: np.ndarray):
         if self.sharding is not None:
-            return jax.device_put(arr, self.sharding)
+            from ..parallel.sharding import put_process_local
+            return put_process_local(arr, self.sharding)
         return jax.device_put(arr)
 
     def __iter__(self):

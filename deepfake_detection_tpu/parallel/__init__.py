@@ -10,4 +10,4 @@ from .mesh import (initialize_distributed, local_batch_size, make_mesh,
 from .ring_attention import (full_attention, ring_attention,
                              ring_self_attention, ulysses_attention)
 from .sharding import (batch_sharding, fsdp_param_specs, param_sharding,
-                       replicated_sharding, shard_batch)
+                       put_process_local, replicated_sharding, shard_batch)

@@ -52,12 +52,11 @@ def initialize_distributed(cluster=None, hostname: Optional[str] = None,
         kwargs["coordinator_address"] = cluster.coordinator_address
         kwargs["num_processes"] = cluster.world_size
         kwargs["process_id"] = cluster.process_id(hostname, local_rank)
-    try:
-        jax.distributed.initialize(**kwargs)
-        _logger.info("jax.distributed initialized: process %d/%d",
-                     jax.process_index(), jax.process_count())
-    except (RuntimeError, ValueError) as e:  # already-initialized runtimes
-        _logger.warning("jax.distributed.initialize skipped: %s", e)
+    # no try/except: a failed init on a required multi-host setup must abort
+    # the job — swallowing it would silently train N isolated copies
+    jax.distributed.initialize(**kwargs)
+    _logger.info("jax.distributed initialized: process %d/%d",
+                 jax.process_index(), jax.process_count())
 
 
 def make_mesh(mesh_shape: Optional[Sequence[int]] = None,

@@ -66,22 +66,22 @@ def param_sharding(params: Any, mesh: Mesh, fsdp: bool = False,
                         is_leaf=lambda x: isinstance(x, P))
 
 
-def shard_batch(batch: Any, mesh: Mesh, axis: str = "data") -> Any:
-    """Assemble per-process host arrays into a global batch-sharded array.
+def put_process_local(x: Any, sharding: NamedSharding) -> Any:
+    """One per-process host array → global sharded jax.Array.
 
-    Each process holds ``global_batch / process_count`` rows; this glues them
-    into one global jax.Array sharded over ``axis`` (replaces the
-    per-process DataLoader shard of DDP).  Single-process: a plain
-    device_put with the sharding.
+    Single-process: a plain sharded device_put.  Multi-host: each process
+    contributes ``global_batch / process_count`` leading rows via
+    ``make_array_from_process_local_data``.
     """
+    x = np.asarray(x)
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
+    return jax.make_array_from_process_local_data(sharding, x, global_shape)
+
+
+def shard_batch(batch: Any, mesh: Mesh, axis: str = "data") -> Any:
+    """Assemble per-process host arrays into a global batch-sharded array
+    (replaces the per-process DataLoader shard of DDP)."""
     sharding = batch_sharding(mesh, axis)
-
-    def put(x):
-        x = np.asarray(x)
-        if jax.process_count() == 1:
-            return jax.device_put(x, sharding)
-        global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
-        return jax.make_array_from_process_local_data(sharding, x,
-                                                      global_shape)
-
-    return jax.tree.map(put, batch)
+    return jax.tree.map(lambda x: put_process_local(x, sharding), batch)

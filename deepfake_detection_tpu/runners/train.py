@@ -1,0 +1,265 @@
+"""Distributed training runner.
+
+Re-design of ``/root/reference/dfd/runners/train.py`` (819 LoC) for TPU:
+
+* ``launch_main`` (:769-816) — arg parse, cluster config, output-dir setup,
+  linear LR scaling — maps to :func:`launch_main`.  The ``mp.spawn``
+  per-GPU process fan-out and the NCCL file rendezvous disappear: one process
+  per *host* drives all local devices through the mesh, and
+  ``jax.distributed.initialize`` handles multi-host (parallel/mesh.py).
+* ``main`` (:256-592) — model/optimizer/scheduler/dataset construction,
+  resume, epoch loop — maps to :func:`main`.
+* apex AMP O1 (:353) → bfloat16 compute policy (``--compute-dtype``), no
+  loss scaling needed on TPU.
+* apex DDP (:402) → the jitted train step over the mesh (train/steps.py).
+
+Safety deviation: the reference's rank-0 setup *deletes* an existing output
+dir (``dfd/utils.py:77-80``); here collisions get a ``-N`` suffix instead
+(utils.get_outdir(inc=True)).
+
+Usage::
+
+    python -m deepfake_detection_tpu.runners.train \
+        --data /path/DFDC --model efficientnet_deepfake_v4 \
+        --input-size-v2 12,600,600 -b 3 --opt rmsproptf --basic-lr 5e-7 \
+        --sched step --decay-epochs 2 --decay-rate .92 --amp \
+        --reprob 0.2 --remax 0.05 --flicker 0.05 --rotate-range 5 \
+        --blur-prob 0.05 --bn-momentum 0.001 --mixup 0.1 --label-balance \
+        --eval-metric loss      # == scripts/train.sh:3-22
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ClusterConfig, TrainConfig
+from ..data import (DeepFakeClipDataset, FastCollateMixup, SyntheticDataset,
+                    create_deepfake_loader_v3, resolve_data_config)
+from ..losses import create_loss_fn, cross_entropy
+from ..models import (create_deepfake_model, create_deepfake_model_v3,
+                      create_deepfake_model_v4, create_model, init_model)
+from ..optim import create_optimizer
+from ..parallel import batch_sharding, initialize_distributed, make_mesh
+from ..scheduler import create_scheduler
+from ..train import (CheckpointSaver, create_train_state, make_eval_step,
+                     make_train_step, restore_train_state, set_learning_rate,
+                     train_one_epoch, validate)
+from ..utils import get_outdir, setup_default_logging, update_summary
+
+_logger = logging.getLogger("train")
+
+_MODEL_FACTORIES = {
+    "": create_model,
+    "v1": create_deepfake_model,
+    "v3": create_deepfake_model_v3,
+    "v4": create_deepfake_model_v4,
+}
+
+
+def _dtype(name: str):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+            "float16": jnp.float16}[name]
+
+
+def build_model(cfg: TrainConfig, in_chans: int):
+    """Model construction (reference train.py:305-320)."""
+    factory = _MODEL_FACTORIES.get(cfg.model_version, create_model)
+    kwargs: Dict[str, Any] = dict(
+        pretrained=cfg.pretrained, num_classes=cfg.num_classes,
+        in_chans=in_chans, drop_rate=cfg.drop,
+        drop_path_rate=cfg.drop_path, bn_tf=cfg.bn_tf,
+        bn_momentum=cfg.bn_momentum, bn_eps=cfg.bn_eps,
+        global_pool=cfg.gp,
+        dtype=_dtype(cfg.compute_dtype) if (cfg.amp or
+                                            cfg.compute_dtype != "float32")
+        else None)
+    kwargs = {k: v for k, v in kwargs.items() if v is not None}
+    if factory is create_model:
+        return create_model(cfg.model, **kwargs)
+    return factory(cfg.model, **kwargs)
+
+
+def build_datasets(cfg: TrainConfig, input_size) -> Tuple[Any, Any]:
+    """Train/eval dataset construction (reference train.py:422-504)."""
+    c, h, w = input_size
+    if cfg.dataset == "synthetic":
+        n = max(cfg.batch_size * 8, 16)
+        return (SyntheticDataset(n, (h, w, c), cfg.num_classes, cfg.seed),
+                SyntheticDataset(max(n // 2, 8), (h, w, c), cfg.num_classes,
+                                 cfg.seed + 1))
+    if cfg.dataset == "deepfake_v3":
+        common = dict(frames_per_clip=max(1, c // 3),
+                      label_balance=cfg.label_balance,
+                      noise_fake=cfg.noise_fake > 0,
+                      split_seed=cfg.split_seed)
+        if cfg.eval_data:
+            train_ds = DeepFakeClipDataset(cfg.data, **common)
+            eval_ds = DeepFakeClipDataset(cfg.eval_data,
+                                          frames_per_clip=max(1, c // 3),
+                                          split_seed=cfg.split_seed)
+        else:  # seeded split out of the train roots (reference :424-438)
+            train_ds = DeepFakeClipDataset(
+                cfg.data, train_split=True, train_ratio=cfg.train_split,
+                is_training=True, **common)
+            eval_ds = DeepFakeClipDataset(
+                cfg.data, train_split=True, train_ratio=cfg.train_split,
+                is_training=False, frames_per_clip=max(1, c // 3),
+                split_seed=cfg.split_seed)
+        return train_ds, eval_ds
+    raise ValueError(f"unknown dataset {cfg.dataset!r}")
+
+
+def main(cfg: TrainConfig, world_size: int = 1) -> Dict[str, float]:
+    """Train to completion; returns the best eval metrics."""
+    rank = jax.process_index()
+    mesh = make_mesh(cfg.mesh_shape, cfg.mesh_axes)
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    _logger.info("Training with %d devices, mesh %s, process %d/%d",
+                 n_dev, dict(mesh.shape), rank, jax.process_count())
+
+    rng = jax.random.PRNGKey(cfg.seed + rank)   # per-rank seed (train.py:299)
+    data_config = resolve_data_config(cfg.to_dict(), verbose=rank == 0)
+    input_size = data_config["input_size"]
+    in_chans = input_size[0]
+    img_num = max(1, in_chans // 3)
+
+    model = build_model(cfg, in_chans)
+    init_rng, rng = jax.random.split(rng)
+    variables = init_model(model, init_rng,
+                           (1, input_size[1], input_size[2], in_chans),
+                           training=True)
+    n_params = sum(x.size for x in jax.tree.leaves(variables["params"]))
+    _logger.info("Model %s created, param count: %d", cfg.model, n_params)
+
+    # linear LR scaling: per-device batch × total devices (train.py:814)
+    lr = cfg.lr if cfg.lr is not None else \
+        cfg.batch_size * n_dev * cfg.basic_lr
+    tx = create_optimizer(cfg, learning_rate=lr)
+    state = create_train_state(variables, tx, with_ema=cfg.model_ema)
+
+    lr_scheduler, num_epochs = create_scheduler(cfg, base_lr=lr)
+    start_epoch = cfg.start_epoch or 0
+
+    if cfg.resume:
+        state, meta = restore_train_state(cfg.resume, state,
+                                          load_opt=not cfg.no_resume_opt)
+        start_epoch = cfg.start_epoch if cfg.start_epoch is not None \
+            else int(meta.get("epoch", -1)) + 1   # helpers.py:47-73
+        _logger.info("Resumed from %s (epoch %d)", cfg.resume, start_epoch)
+    if lr_scheduler is not None and start_epoch > 0:
+        state = set_learning_rate(
+            state, lr_scheduler.step(start_epoch))    # train.py:416-417
+
+    train_ds, eval_ds = build_datasets(cfg, input_size)
+    sharding = batch_sharding(mesh)
+    # loaders produce the *per-process* slice of the global batch; the device
+    # prologue assembles the global sharded array
+    global_batch = cfg.batch_size * n_dev
+    local_batch = global_batch // jax.process_count()
+    loader_kwargs = dict(
+        mean=data_config["mean"], std=data_config["std"],
+        num_workers=cfg.workers, seed=cfg.seed,
+        dtype=_dtype(cfg.compute_dtype), sharding=sharding,
+        distributed=jax.process_count() > 1,
+        num_shards=jax.process_count(), shard_index=rank,
+        prefetch_depth=cfg.prefetch_depth)
+    collate_mixup = FastCollateMixup(cfg.mixup, cfg.smoothing,
+                                     cfg.num_classes) if cfg.mixup > 0 \
+        else None
+    train_loader = create_deepfake_loader_v3(
+        train_ds, input_size, local_batch, is_training=True,
+        re_prob=cfg.reprob, re_mode=cfg.remode, re_count=cfg.recount,
+        re_split=cfg.resplit, re_max=cfg.remax, color_jitter=cfg.color_jitter,
+        num_aug_splits=cfg.aug_splits, collate_mixup=collate_mixup,
+        flicker=cfg.flicker, rotate_range=cfg.rotate_range,
+        blur_radiu=1, blur_prob=cfg.blur_prob, **loader_kwargs)
+    eval_loader = create_deepfake_loader_v3(
+        eval_ds, input_size, local_batch * 2, is_training=False,
+        **loader_kwargs)                          # eval bs ×2 (train.py:492)
+
+    train_loss_fn = create_loss_fn(cfg)
+    train_step = make_train_step(
+        model, tx, train_loss_fn, mesh=mesh,
+        bn_mode="global" if cfg.sync_bn else "local",
+        ema_decay=cfg.model_ema_decay if cfg.model_ema else 0.0,
+        clip_grad=cfg.clip_grad)
+    eval_step = make_eval_step(model, cross_entropy)
+    eval_step_ema = make_eval_step(model, cross_entropy, use_ema=True) \
+        if cfg.model_ema else None
+
+    # output dir + config dump (reference :785-808, :527-532)
+    output_dir, saver = "", None
+    if rank == 0:
+        exp_name = cfg.experiment or "-".join(
+            [cfg.model_version or cfg.model,
+             os.path.basename(cfg.data.split(":")[0]) or cfg.dataset])
+        output_dir = get_outdir(cfg.output, exp_name, inc=True)
+        with open(os.path.join(output_dir, "args.yaml"), "w") as f:
+            f.write(cfg.to_yaml())
+        decreasing = cfg.eval_metric == "loss"
+        saver = CheckpointSaver(
+            checkpoint_dir=output_dir, bak_dir=os.path.join(
+                output_dir, "_bak"), decreasing=decreasing)
+
+    meta = {"arch": cfg.model, "version": 2}
+    best_metric, best_epoch = None, None
+    eval_metrics: Dict[str, float] = {}
+    try:
+        for epoch in range(start_epoch, num_epochs):
+            train_loader.set_epoch(epoch)          # reference :549
+            epoch_rng = jax.random.fold_in(rng, epoch)
+            state, train_metrics = train_one_epoch(
+                epoch, train_step, state, train_loader, cfg, epoch_rng,
+                lr_scheduler=lr_scheduler, saver=saver,
+                output_dir=output_dir, meta=meta)
+
+            eval_metrics = validate(eval_step, state, eval_loader, cfg)
+            if eval_step_ema is not None:
+                # EMA eval *replaces* the metrics (reference :563-569)
+                eval_metrics = validate(eval_step_ema, state, eval_loader,
+                                        cfg, log_suffix=" (EMA)")
+
+            if lr_scheduler is not None:
+                new_lr = lr_scheduler.step(
+                    epoch + 1, eval_metrics[cfg.eval_metric])  # :571-573
+                state = set_learning_rate(state, new_lr)
+
+            if output_dir:
+                update_summary(epoch, train_metrics, eval_metrics,
+                               os.path.join(output_dir, "summary.csv"),
+                               os.path.join(output_dir, "plots"),
+                               write_header=epoch == start_epoch)
+            if saver is not None:
+                best_metric, best_epoch = saver.save_checkpoint(
+                    state, meta, epoch,
+                    metric=eval_metrics[cfg.eval_metric])
+    except KeyboardInterrupt:                      # reference :588
+        pass
+    if best_metric is not None:
+        _logger.info("*** Best metric: %s (epoch %s)", best_metric,
+                     best_epoch)
+    return {"best_metric": best_metric, "best_epoch": best_epoch,
+            **eval_metrics}
+
+
+def launch_main(argv=None) -> Dict[str, float]:
+    """CLI entry (reference launch_main, train.py:769-816)."""
+    setup_default_logging()
+    cfg = TrainConfig.from_args(argv)
+    world_size = 1
+    if cfg.json_file:
+        cluster = ClusterConfig.from_json(cfg.json_file)
+        initialize_distributed(cluster, local_rank=cfg.local_rank)
+        world_size = cluster.world_size
+    return main(cfg, world_size=world_size)
+
+
+if __name__ == "__main__":
+    launch_main(sys.argv[1:])

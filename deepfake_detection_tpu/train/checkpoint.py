@@ -1,0 +1,173 @@
+"""Training-loop checkpointing: top-K retention, best-copy, recovery.
+
+Parity with ``CheckpointSaver`` (``/root/reference/dfd/timm/utils.py:36-149``):
+
+* keeps the top ``max_history`` (10) checkpoints ranked by the eval metric
+  (``decreasing=True`` for loss, :66-79);
+* ``checkpoint-<epoch>.ckpt`` + ``model_best.ckpt`` copy (:86-89) + mirror of
+  the best into a ``_bak`` backup dir (:92-93);
+* payload = epoch / arch / model state / optimizer state / EMA / config /
+  metric / version (:97-112) — here the whole :class:`TrainState` pytree in
+  one flax-serialization msgpack blob;
+* in-epoch ``save_recovery`` with previous-file cleanup (:128-140) and
+  ``find_recovery`` (:142-147).
+
+Atomic writes (tmp + rename) so a preempted TPU host never leaves a torn
+checkpoint — the reference's ``torch.save`` has no such guard.
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import operator
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["CheckpointSaver", "save_checkpoint_file", "load_checkpoint_file",
+           "restore_train_state"]
+
+_EXT = ".ckpt"
+
+
+def save_checkpoint_file(path: str, state: Any,
+                         meta: Optional[Dict[str, Any]] = None) -> None:
+    """Serialize {state, meta} atomically to ``path``."""
+    payload = {"state": jax.tree.map(np.asarray,
+                                     serialization.to_state_dict(state)),
+               "meta": meta or {}}   # meta stays plain python (strs allowed)
+    blob = serialization.msgpack_serialize(payload)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+
+
+def load_checkpoint_file(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Read a raw {state_dict, meta} pair."""
+    with open(path, "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    return payload["state"], payload.get("meta", {})
+
+
+def restore_train_state(path: str, target_state: Any,
+                        load_opt: bool = True) -> Tuple[Any, Dict[str, Any]]:
+    """Rebuild a TrainState from file given a freshly-built template.
+
+    ``load_opt=False`` mirrors ``--no-resume-opt`` (train.py:89,:365-373):
+    weights/EMA restore but the optimizer state stays fresh.
+    """
+    sd, meta = load_checkpoint_file(path)
+    if not load_opt:
+        sd = dict(sd)
+        sd["opt_state"] = serialization.to_state_dict(
+            target_state.opt_state)
+        sd["step"] = serialization.to_state_dict(target_state.step)
+    state = serialization.from_state_dict(target_state, sd)
+    return state, meta
+
+
+class CheckpointSaver:
+    def __init__(self, checkpoint_dir: str = "",
+                 recovery_dir: str = "", bak_dir: str = "",
+                 decreasing: bool = False, max_history: int = 10,
+                 checkpoint_prefix: str = "checkpoint",
+                 recovery_prefix: str = "recovery"):
+        self.checkpoint_files: List[Tuple[str, float]] = []  # (path, metric)
+        self.best_epoch: Optional[int] = None
+        self.best_metric: Optional[float] = None
+        self.curr_recovery_file = ""
+        self.last_recovery_file = ""
+        self.checkpoint_dir = checkpoint_dir
+        self.recovery_dir = recovery_dir or checkpoint_dir
+        self.bak_dir = bak_dir
+        self.checkpoint_prefix = checkpoint_prefix
+        self.recovery_prefix = recovery_prefix
+        self.decreasing = decreasing          # lower is better (loss)
+        self.cmp = operator.lt if decreasing else operator.gt
+        self.max_history = max_history
+        assert self.max_history >= 1
+        for d in (checkpoint_dir, self.recovery_dir, bak_dir):
+            if d:
+                os.makedirs(d, exist_ok=True)
+
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, state: Any, meta: Dict[str, Any], epoch: int,
+                        metric: Optional[float] = None) -> Tuple[Optional[float], Optional[int]]:
+        """Epoch-boundary save with top-K pruning (reference :66-95)."""
+        worst = self.checkpoint_files[-1] if self.checkpoint_files else None
+        if len(self.checkpoint_files) < self.max_history or metric is None \
+                or self.cmp(metric, worst[1]):
+            if len(self.checkpoint_files) >= self.max_history:
+                self._cleanup_checkpoints(1)
+            path = os.path.join(
+                self.checkpoint_dir,
+                f"{self.checkpoint_prefix}-{epoch}{_EXT}")
+            meta = dict(meta, epoch=epoch, metric=metric)
+            save_checkpoint_file(path, state, meta)
+            self.checkpoint_files.append((path, metric))
+            self.checkpoint_files = sorted(
+                self.checkpoint_files,
+                key=lambda x: (x[1] is None, x[1]),
+                reverse=not self.decreasing)
+            files_str = "\n".join(f" {c}" for c in self.checkpoint_files)
+            _logger.info("Current checkpoints:\n%s", files_str)
+            if metric is not None and (self.best_metric is None
+                                       or self.cmp(metric, self.best_metric)):
+                self.best_epoch = epoch
+                self.best_metric = metric
+                best = os.path.join(self.checkpoint_dir, f"model_best{_EXT}")
+                shutil.copyfile(path, best)
+                if self.bak_dir:
+                    shutil.copyfile(
+                        path, os.path.join(self.bak_dir, f"model_best{_EXT}"))
+        return (None, None) if self.best_metric is None \
+            else (self.best_metric, self.best_epoch)
+
+    def _cleanup_checkpoints(self, trim: int = 0) -> None:
+        """Drop the worst ``trim`` retained checkpoints (reference :114-126)."""
+        delete_index = self.max_history - trim
+        if delete_index < 0 or len(self.checkpoint_files) <= delete_index:
+            return
+        to_delete = self.checkpoint_files[delete_index:]
+        for path, _ in to_delete:
+            try:
+                _logger.debug("Cleaning checkpoint: %s", path)
+                os.remove(path)
+            except OSError as e:
+                _logger.error("Exception %r while deleting checkpoint", e)
+        self.checkpoint_files = self.checkpoint_files[:delete_index]
+
+    # ------------------------------------------------------------------
+    def save_recovery(self, state: Any, meta: Dict[str, Any], epoch: int,
+                      batch_idx: int = 0) -> None:
+        """In-epoch recovery snapshot, previous one removed (reference
+        :128-140)."""
+        path = os.path.join(
+            self.recovery_dir,
+            f"{self.recovery_prefix}-{epoch}-{batch_idx}{_EXT}")
+        save_checkpoint_file(path, state, dict(meta, epoch=epoch,
+                                               batch_idx=batch_idx))
+        if os.path.exists(self.last_recovery_file):
+            try:
+                _logger.debug("Cleaning recovery: %s",
+                              self.last_recovery_file)
+                os.remove(self.last_recovery_file)
+            except OSError as e:
+                _logger.error("Exception %r while removing %s", e,
+                              self.last_recovery_file)
+        self.last_recovery_file = self.curr_recovery_file
+        self.curr_recovery_file = path
+
+    def find_recovery(self) -> str:
+        """Most recent recovery file, '' if none (reference :142-147)."""
+        files = glob.glob(os.path.join(
+            self.recovery_dir, self.recovery_prefix + "*" + _EXT))
+        return sorted(files)[-1] if files else ""

@@ -1,0 +1,152 @@
+"""Epoch-level training and validation loops.
+
+Parity with the reference runner's ``train_epoch`` (``/root/reference/dfd/
+runners/train.py:594-700``) and ``validate`` (:703-767): the same meters, the
+same log line (loss/prec1 val(avg), s/batch, s/image, LR, data time, ETA),
+``--save-images`` batch dumps, in-epoch recovery checkpoints, per-update LR
+scheduling, and mixup-off-epoch switching.  What disappears on TPU: the
+explicit ``torch.cuda.synchronize`` (the runner only blocks when it reads the
+logged scalars — JAX async dispatch keeps the device busy) and the per-step
+metric allreduce (it lives inside the compiled step).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..utils.metrics import AverageMeter
+from .state import TrainState, get_learning_rate, set_learning_rate
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["train_one_epoch", "validate", "save_image_batch"]
+
+
+def save_image_batch(x, path: str, img_num: int = 4) -> None:
+    """Dump a normalized NHWC batch as a tiled jpg (reference :679-684).
+
+    Frames of each clip are laid out horizontally, batch vertically; values
+    min-max normalized like torchvision's ``save_image(normalize=True)``.
+    """
+    from PIL import Image
+    a = np.asarray(x, np.float32)
+    lo, hi = a.min(), a.max()
+    a = (a - lo) / max(hi - lo, 1e-6)
+    b, h, w, c = a.shape
+    assert c % img_num == 0
+    cpf = c // img_num
+    frames = a.reshape(b, h, w, img_num, cpf).transpose(0, 3, 1, 2, 4)
+    grid = frames.reshape(b, img_num * h, w, cpf).transpose(1, 0, 2, 3) \
+        .reshape(img_num * h, b * w, cpf)
+    if cpf == 1:
+        grid = np.repeat(grid, 3, axis=-1)
+    Image.fromarray((grid[..., :3] * 255).astype(np.uint8)).save(path)
+
+
+def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
+                    loader, cfg, rng: jax.Array,
+                    lr_scheduler=None, saver=None, output_dir: str = "",
+                    meta: Optional[Dict[str, Any]] = None):
+    """One epoch of the hot loop.  Returns ``(state, metrics)``."""
+    if cfg.mixup > 0 and hasattr(loader, "mixup_enabled"):
+        if cfg.mixup_off_epoch and epoch >= cfg.mixup_off_epoch:
+            loader.mixup_enabled = False    # reference :597-599
+
+    batch_time_m, data_time_m = AverageMeter(), AverageMeter()
+    losses_m, prec1_m = AverageMeter(), AverageMeter()
+
+    end = time.time()
+    num_batches = len(loader)
+    last_idx = num_batches - 1
+    num_updates = epoch * num_batches
+    lr = get_learning_rate(state)
+
+    for batch_idx, batch in enumerate(loader):
+        x, y = batch[0], batch[1]
+        last_batch = batch_idx == last_idx
+        data_time_m.update(time.time() - end)
+
+        step_rng = jax.random.fold_in(rng, num_updates)
+        state, metrics = train_step(state, x, y, step_rng)
+
+        # reading the scalars is the only host sync (reference synced the
+        # whole device every step, train.py:639)
+        loss_value = float(metrics["loss"])
+        bs = x.shape[0]
+        if not np.isnan(loss_value):
+            losses_m.update(loss_value, bs)
+        prec1_m.update(float(metrics["prec1"]), bs)
+        num_updates += 1
+        batch_time_m.update(time.time() - end)
+
+        if last_batch or batch_idx % cfg.log_interval == 0:
+            lr = get_learning_rate(state) or 0.0
+            ets_time = batch_time_m.avg * (num_batches - batch_idx) / 60
+            _logger.info(
+                "Train:%d [%4d/%d] "
+                "Loss:%.5f(%.5f) Prec@1:%7.4f(%7.4f) "
+                "Time:%.3f(%.3f)s/batch %.5f(%.5f)s/image "
+                "LR:%.3e Data:%.3f(%.3f)s/batch ETS:%.3fmin",
+                epoch, batch_idx, num_batches,
+                losses_m.val, losses_m.avg, prec1_m.val, prec1_m.avg,
+                batch_time_m.val, batch_time_m.avg,
+                batch_time_m.val / bs, batch_time_m.avg / bs,
+                lr, data_time_m.val, data_time_m.avg, ets_time)
+            if cfg.save_images and output_dir:
+                save_image_batch(
+                    x, os.path.join(output_dir,
+                                    f"train-batch-{batch_idx}.jpg"),
+                    img_num=max(1, cfg.resolved_in_chans // 3))
+
+        if saver is not None and cfg.recovery_interval and (
+                last_batch or (batch_idx + 1) % cfg.recovery_interval == 0):
+            saver.save_recovery(state, meta or {}, epoch,
+                                batch_idx=batch_idx)   # reference :686-689
+
+        if lr_scheduler is not None:
+            new_lr = lr_scheduler.step_update(num_updates=num_updates,
+                                              metric=losses_m.avg)
+            if new_lr is not None and new_lr != lr:
+                state = set_learning_rate(state, new_lr)
+        end = time.time()
+
+    return state, OrderedDict([("loss", losses_m.avg),
+                               ("prec1", prec1_m.avg),
+                               ("learning_rate", lr)])
+
+
+def validate(eval_step: Callable, state: TrainState, loader, cfg,
+             log_suffix: str = "") -> "OrderedDict[str, float]":
+    """Full-dataset eval (reference validate, train.py:703-767), exact thanks
+    to the validity mask on padded batches."""
+    batch_time_m = AverageMeter()
+    losses_m, prec1_m = AverageMeter(), AverageMeter()
+    end = time.time()
+    num_batches = len(loader)
+    last_idx = num_batches - 1
+    log_name = "Test" + log_suffix
+    for batch_idx, batch in enumerate(loader):
+        x, y = batch[0], batch[1]
+        valid = batch[2] if len(batch) > 2 else None
+        metrics = eval_step(state, x, y, valid)
+        n = float(metrics["count"])
+        if n > 0:
+            losses_m.update(float(metrics["loss"]), n)
+            prec1_m.update(float(metrics["prec1"]), n)
+        batch_time_m.update(time.time() - end)
+        if batch_idx == last_idx or batch_idx % cfg.log_interval == 0:
+            _logger.info(
+                "%s: [%4d/%d] Time:%.3f(%.3f) "
+                "Loss:%.4f(%.4f) Prec@1:%7.4f(%7.4f)",
+                log_name, batch_idx, num_batches,
+                batch_time_m.val, batch_time_m.avg,
+                losses_m.val, losses_m.avg, prec1_m.val, prec1_m.avg)
+        end = time.time()
+    return OrderedDict([("loss", losses_m.avg), ("prec1", prec1_m.avg)])

@@ -21,8 +21,13 @@ __all__ = ["init_ema", "update_ema"]
 
 
 def init_ema(variables: Any) -> Any:
-    """EMA state starts as a copy of the model state (reference :306)."""
-    return jax.tree.map(lambda x: x, variables)
+    """EMA state starts as a copy of the model state (reference :306).
+
+    A *real* copy, not aliased references — the train step donates its input
+    state, and donating the same underlying buffer via both ``params`` and
+    ``ema`` is an error (and undefined behavior when it isn't caught).
+    """
+    return jax.tree.map(jax.numpy.copy, variables)
 
 
 def update_ema(ema: Any, variables: Any, decay: float = 0.9998) -> Any:

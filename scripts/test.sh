@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Single-image inference demo (reference scripts/test.sh:3).
+python -m deepfake_detection_tpu.runners.test "$@" --model-path "${MODEL_PATH:-../models/model_best.ckpt}"

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Canonical flagship training config (reference scripts/train.sh:3-22).
+# One process per host; devices come from the TPU runtime / mesh.
+python -m deepfake_detection_tpu.runners.train \
+  --data "$1" \
+  --model efficientnet_deepfake_v4 --model-version v4 \
+  --input-size-v2 12,600,600 \
+  -b 3 \
+  --opt rmsproptf --basic-lr 5e-7 \
+  --sched step --decay-epochs 2 --decay-rate .92 \
+  --epochs 200 \
+  --amp \
+  --reprob 0.2 --remax 0.05 \
+  --flicker 0.05 --rotate-range 5 --blur-prob 0.05 \
+  --bn-momentum 0.001 \
+  --mixup 0.1 \
+  --label-balance \
+  --eval-metric loss \
+  --workers 8 \
+  "${@:2}"

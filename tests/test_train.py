@@ -1,0 +1,211 @@
+"""Train runtime tests: state, steps, checkpointing, end-to-end smoke."""
+
+import os
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepfake_detection_tpu.config import TrainConfig
+from deepfake_detection_tpu.losses import cross_entropy
+from deepfake_detection_tpu.models import create_model, init_model
+from deepfake_detection_tpu.optim import create_optimizer
+from deepfake_detection_tpu.parallel import batch_sharding, make_mesh
+from deepfake_detection_tpu.train import (CheckpointSaver, create_train_state,
+                                          get_learning_rate, make_eval_step,
+                                          make_train_step,
+                                          restore_train_state,
+                                          save_checkpoint_file,
+                                          set_learning_rate, train_one_epoch,
+                                          validate)
+from deepfake_detection_tpu.train.state import TrainState
+
+
+def _opt_cfg(**kw):
+    base = dict(opt="sgd", opt_eps=1e-8, momentum=0.9, weight_decay=0.0,
+                lr=1e-3)
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+def _tiny_setup(mesh=None, num_classes=2, with_ema=False, **step_kw):
+    model = create_model("mnasnet_small", num_classes=num_classes, in_chans=3)
+    variables = init_model(model, jax.random.PRNGKey(0), (2, 32, 32, 3),
+                           training=True)
+    tx = create_optimizer(_opt_cfg(), inject=True)
+    state = create_train_state(variables, tx, with_ema=with_ema)
+    step = make_train_step(model, tx, cross_entropy, mesh=mesh,
+                           ema_decay=0.5 if with_ema else 0.0, **step_kw)
+    return model, state, step
+
+
+class TestTrainState:
+    def test_set_get_learning_rate(self):
+        _, state, _ = _tiny_setup()
+        assert get_learning_rate(state) == pytest.approx(1e-3)
+        state = set_learning_rate(state, 0.01)
+        assert get_learning_rate(state) == pytest.approx(0.01)
+
+
+class TestTrainStep:
+    @pytest.mark.parametrize("bn_mode", ["local", "global"])
+    def test_loss_decreases(self, devices, bn_mode):
+        mesh = make_mesh()
+        model, state, step = _tiny_setup(mesh=mesh, bn_mode=bn_mode)
+        rng = np.random.default_rng(0)
+        # ≥2 samples per device: with 1, local BN over a 1×1 final feature
+        # map degenerates to zeros (single-value normalization)
+        x = jax.device_put(
+            rng.normal(size=(16, 32, 32, 3)).astype(np.float32),
+            batch_sharding(mesh))
+        y = jax.device_put(np.array([0, 1] * 8), batch_sharding(mesh))
+        key = jax.random.PRNGKey(1)
+        losses = []
+        for i in range(8):
+            state, metrics = step(state, x, y, jax.random.fold_in(key, i))
+            losses.append(float(metrics["loss"]))
+        # SGD+momentum oscillates on the large train-mode init logits; demand
+        # net improvement, not monotonicity
+        assert np.mean(losses[-3:]) < losses[0], losses
+        assert int(state.step) == 8
+
+    def test_ema_tracks_params(self, devices):
+        mesh = make_mesh()
+        model, state, step = _tiny_setup(mesh=mesh, with_ema=True)
+        x = jax.device_put(np.ones((8, 32, 32, 3), np.float32),
+                           batch_sharding(mesh))
+        y = jax.device_put(np.zeros(8, np.int64), batch_sharding(mesh))
+        p0 = jax.tree.leaves(state.params)[0].copy()
+        state, _ = step(state, x, y, jax.random.PRNGKey(0))
+        e1 = jax.tree.leaves(state.ema["params"])[0]
+        p1 = jax.tree.leaves(state.params)[0]
+        # ema = 0.5*old + 0.5*new, strictly between old and new where moved
+        moved = np.abs(np.asarray(p1 - p0)) > 1e-9
+        if moved.any():
+            mid = np.asarray(0.5 * p0 + 0.5 * p1)
+            np.testing.assert_allclose(np.asarray(e1)[moved], mid[moved],
+                                       rtol=1e-5, atol=1e-7)
+
+    def test_grad_clip_runs(self, devices):
+        mesh = make_mesh()
+        _, state, step = _tiny_setup(mesh=mesh, clip_grad=0.1)
+        x = jax.device_put(np.ones((8, 32, 32, 3), np.float32) * 10,
+                           batch_sharding(mesh))
+        y = jax.device_put(np.zeros(8, np.int64), batch_sharding(mesh))
+        state, metrics = step(state, x, y, jax.random.PRNGKey(0))
+        assert np.isfinite(float(metrics["loss"]))
+
+
+class TestEvalStep:
+    def test_masked_eval(self, devices):
+        model, state, _ = _tiny_setup()
+        es = make_eval_step(model)
+        x = jnp.ones((4, 32, 32, 3))
+        y = jnp.array([0, 0, 1, 1])
+        m_all = es(state, x, y, jnp.array([1, 1, 1, 1]))
+        m_half = es(state, x, y, jnp.array([1, 1, 0, 0]))
+        assert float(m_all["count"]) == 4
+        assert float(m_half["count"]) == 2
+        assert m_all["logits"].shape == (4, 2)
+
+
+class TestCheckpointing:
+    def test_round_trip(self, tmp_path, devices):
+        _, state, step = _tiny_setup(mesh=make_mesh())
+        path = str(tmp_path / "ck.ckpt")
+        save_checkpoint_file(path, state, {"epoch": 3})
+        _, state2, _ = _tiny_setup(mesh=make_mesh())
+        restored, meta = restore_train_state(path, state2)
+        assert meta["epoch"] == 3
+        a = jax.tree.leaves(state.params)[0]
+        b = jax.tree.leaves(restored.params)[0]
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_no_resume_opt(self, tmp_path):
+        _, state, _ = _tiny_setup()
+        state = set_learning_rate(state, 123.0)
+        path = str(tmp_path / "ck.ckpt")
+        save_checkpoint_file(path, state, {})
+        _, fresh, _ = _tiny_setup()
+        restored, _ = restore_train_state(path, fresh, load_opt=False)
+        assert get_learning_rate(restored) == pytest.approx(1e-3)
+
+    def test_saver_topk_best_and_recovery(self, tmp_path):
+        _, state, _ = _tiny_setup()
+        saver = CheckpointSaver(checkpoint_dir=str(tmp_path / "out"),
+                                bak_dir=str(tmp_path / "bak"),
+                                decreasing=True, max_history=2)
+        metrics = [0.9, 0.5, 0.7, 0.4]
+        for epoch, m in enumerate(metrics):
+            best, best_ep = saver.save_checkpoint(state, {}, epoch, metric=m)
+        assert best == pytest.approx(0.4) and best_ep == 3
+        kept = sorted(f for f in os.listdir(tmp_path / "out")
+                      if f.startswith("checkpoint-"))
+        assert kept == ["checkpoint-1.ckpt", "checkpoint-3.ckpt"]  # top-2
+        assert os.path.isfile(tmp_path / "out" / "model_best.ckpt")
+        assert os.path.isfile(tmp_path / "bak" / "model_best.ckpt")
+        # recovery keeps only the current + one previous
+        for b in range(3):
+            saver.save_recovery(state, {}, epoch=5, batch_idx=b)
+        recs = [f for f in os.listdir(tmp_path / "out")
+                if f.startswith("recovery-")]
+        assert len(recs) == 2
+        assert saver.find_recovery().endswith("recovery-5-2.ckpt")
+
+
+class TestEndToEndSmoke:
+    def test_synthetic_train_two_epochs(self, tmp_path, devices):
+        """SURVEY.md §4: e2e 2-class smoke train on synthetic data."""
+        from deepfake_detection_tpu.runners.train import launch_main
+        out = launch_main([
+            "--dataset", "synthetic", "--model", "mnasnet_small",
+            "--model-version", "", "--input-size-v2", "3,32,32",
+            "--batch-size", "1", "--epochs", "2", "--decay-epochs", "1",
+            "--opt", "rmsproptf", "--basic-lr", "1e-4", "--sched", "step",
+            "--log-interval", "1", "--workers", "2", "--mixup", "0.1",
+            "--model-ema", "--smoothing", "0.1", "--reprob", "0.2",
+            "--compute-dtype", "float32",
+            "--output", str(tmp_path / "out")])
+        assert out["best_metric"] is not None
+        run_dirs = os.listdir(tmp_path / "out")
+        assert len(run_dirs) == 1
+        run = tmp_path / "out" / run_dirs[0]
+        assert (run / "summary.csv").is_file()
+        assert (run / "args.yaml").is_file()
+        assert (run / "model_best.ckpt").is_file()
+
+    def test_resume_from_checkpoint(self, tmp_path, devices):
+        from deepfake_detection_tpu.runners.train import launch_main
+        args = [
+            "--dataset", "synthetic", "--model", "mnasnet_small",
+            "--model-version", "", "--input-size-v2", "3,32,32",
+            "--batch-size", "1", "--epochs", "1",
+            "--opt", "sgd", "--lr", "0.01", "--sched", "step",
+            "--log-interval", "10", "--workers", "1",
+            "--compute-dtype", "float32",
+            "--output", str(tmp_path / "o1")]
+        launch_main(args)
+        run = os.path.join(tmp_path, "o1", os.listdir(tmp_path / "o1")[0])
+        ckpt = os.path.join(run, "checkpoint-0.ckpt")
+        assert os.path.isfile(ckpt)
+        out = launch_main(args[:-1] + [str(tmp_path / "o2"),
+                                       "--resume", ckpt, "--epochs", "2"])
+        assert out["best_metric"] is not None
+
+
+class TestInference:
+    def test_preprocess_and_score(self, tmp_path):
+        from PIL import Image
+        from deepfake_detection_tpu.runners.test import preprocess, test_img
+        img = tmp_path / "x.png"
+        Image.fromarray(
+            np.random.default_rng(0).integers(0, 255, (80, 50, 3),
+                                              dtype=np.uint8)).save(img)
+        x = preprocess(str(img), size=64)
+        assert x.shape == (1, 64, 64, 12)
+        # replicate ×4: all frame slices identical
+        np.testing.assert_array_equal(x[..., :3], x[..., 3:6])
+        scores = test_img(None, [str(img)], size=64)
+        assert len(scores) == 1 and 0.0 <= scores[0] <= 1.0
