@@ -1,0 +1,127 @@
+"""Benchmark: train throughput (frames/sec/chip) on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
+MFU / 0.70 — the fraction of the driver-set north-star target of ≥70% MFU
+(BASELINE.json) achieved by the measured step time.  FLOPs come from XLA's
+own cost analysis of the compiled train step; peak chip FLOPs from the
+device kind.
+
+Default config: EfficientNet-B4 (the north-star benchmark model), 380×380,
+bf16, per-chip batch 16, full train step (fwd+bwd+RMSpropTF+EMA).  Set
+BENCH_MODEL / BENCH_BATCH / BENCH_SIZE / BENCH_CHANS env vars to override
+(e.g. BENCH_MODEL=efficientnet_deepfake_v4 BENCH_SIZE=600 BENCH_CHANS=12
+BENCH_BATCH=3 for the flagship deepfake config).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bf16 peak FLOPs/s per chip by device kind (public spec sheets)
+_PEAK_FLOPS = {
+    "TPU v2": 22.5e12, "TPU v3": 61.5e12 / 2, "TPU v4": 137.5e12 * 2,
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5": 229.5e12 * 2,
+    "TPU v5p": 459e12, "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+    "TPU v7": 2307e12, "cpu": 1e11,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for k, v in _PEAK_FLOPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    for k, v in _PEAK_FLOPS.items():
+        if k.lower() in kind.lower():
+            return v
+    return 275e12   # unknown TPU: assume v4-class
+
+
+def main() -> None:
+    on_tpu = jax.devices()[0].platform == "tpu"
+    model_name = os.environ.get("BENCH_MODEL", "efficientnet_b4")
+    if on_tpu:
+        batch = int(os.environ.get("BENCH_BATCH", 16))
+        size = int(os.environ.get("BENCH_SIZE", 380))
+        steps = int(os.environ.get("BENCH_STEPS", 20))
+        dtype = jnp.bfloat16
+    else:   # CPU fallback so the script always completes locally
+        model_name = os.environ.get("BENCH_MODEL", "efficientnet_b0")
+        batch = int(os.environ.get("BENCH_BATCH", 2))
+        size = int(os.environ.get("BENCH_SIZE", 64))
+        steps = int(os.environ.get("BENCH_STEPS", 3))
+        dtype = jnp.float32
+    chans = int(os.environ.get("BENCH_CHANS", 3))
+
+    from deepfake_detection_tpu.losses import cross_entropy
+    from deepfake_detection_tpu.models import create_model, init_model
+    from deepfake_detection_tpu.optim import create_optimizer
+    from deepfake_detection_tpu.train import create_train_state, \
+        make_train_step
+
+    model = create_model(model_name, num_classes=2, in_chans=chans,
+                         dtype=dtype if dtype != jnp.float32 else None)
+    variables = init_model(model, jax.random.PRNGKey(0),
+                           (2, size, size, chans), training=True)
+    cfg = SimpleNamespace(opt="rmsproptf", opt_eps=1e-8, momentum=0.9,
+                          weight_decay=1e-5, lr=1.2e-5)
+    tx = create_optimizer(cfg)
+    state = create_train_state(variables, tx, with_ema=True)
+    # single chip → no mesh; plain jit path
+    step = make_train_step(model, tx, cross_entropy, mesh=None,
+                           bn_mode="global", ema_decay=0.9998)
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.normal(size=(batch, size, size, chans))
+                       .astype(np.float32).astype(dtype))
+    y = jax.device_put(rng.integers(0, 2, batch))
+    key = jax.random.PRNGKey(1)
+
+    # FLOPs of the whole compiled step from XLA cost analysis
+    lowered = jax.jit(step.__wrapped__ if hasattr(step, "__wrapped__")
+                      else step).lower(state, x, y, key)
+    compiled = lowered.compile()
+    try:
+        flops_per_step = float(compiled.cost_analysis()["flops"])
+    except (KeyError, TypeError):
+        flops_per_step = float("nan")
+
+    # warmup (also primes the donated-buffer path)
+    for i in range(3):
+        state, metrics = step(state, x, y, jax.random.fold_in(key, i))
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step(state, x, y, jax.random.fold_in(key, 100 + i))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    frames_per_sec = batch * steps / dt
+    peak = _peak_flops(jax.devices()[0])
+    mfu = (flops_per_step * steps / dt) / peak if np.isfinite(
+        flops_per_step) else float("nan")
+    result = {
+        "metric": f"train_throughput_{model_name}_{size}x{size}x{chans}_b{batch}",
+        "value": round(frames_per_sec, 2),
+        "unit": "frames/sec/chip",
+        "vs_baseline": round(mfu / 0.70, 4) if np.isfinite(mfu) else None,
+        "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
+        "step_ms": round(dt / steps * 1000, 2),
+        "device": jax.devices()[0].device_kind,
+        "loss": round(float(metrics["loss"]), 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,17 @@ def load_state_dict(checkpoint_path: str, use_ema: bool = False) -> Dict[str, An
     with open(checkpoint_path, "rb") as f:
         payload = serialization.msgpack_restore(f.read())
     meta = payload.get("meta", {})
+    if "state" in payload and "variables" not in payload:
+        # trainer checkpoint (train/checkpoint.py): TrainState state-dict
+        # {step, params, batch_stats, opt_state, ema}
+        st = payload["state"]
+        ema = st.get("ema") or None
+        if use_ema and ema:
+            _logger.info("Loaded EMA stream from %s", checkpoint_path)
+            return {"params": ema["params"],
+                    "batch_stats": ema.get("batch_stats", {})}
+        return {"params": st["params"],
+                "batch_stats": st.get("batch_stats", {})}
     if use_ema and "variables_ema" in payload:
         _logger.info("Loaded state_dict_ema from %s", checkpoint_path)
         return payload["variables_ema"]

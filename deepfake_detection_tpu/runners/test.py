@@ -18,6 +18,7 @@ import os
 import sys
 from typing import List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from PIL import Image
@@ -45,7 +46,7 @@ def test_img(model_path: Optional[str], img_files: Sequence[str],
     print(f"To load model from {model_path}")
     model = create_deepfake_model_v4("efficientnet_deepfake_v4",
                                      num_classes=2, in_chans=12)
-    variables = init_model(model, __import__("jax").random.PRNGKey(0),
+    variables = init_model(model, jax.random.PRNGKey(0),
                            (1, size, size, 12))
     if model_path:
         variables = load_checkpoint(variables, model_path, strict=False)

@@ -116,7 +116,7 @@ def build_datasets(cfg: TrainConfig, input_size) -> Tuple[Any, Any]:
     raise ValueError(f"unknown dataset {cfg.dataset!r}")
 
 
-def main(cfg: TrainConfig, world_size: int = 1) -> Dict[str, float]:
+def main(cfg: TrainConfig) -> Dict[str, float]:
     """Train to completion; returns the best eval metrics."""
     rank = jax.process_index()
     mesh = make_mesh(cfg.mesh_shape, cfg.mesh_axes)
@@ -124,7 +124,12 @@ def main(cfg: TrainConfig, world_size: int = 1) -> Dict[str, float]:
     _logger.info("Training with %d devices, mesh %s, process %d/%d",
                  n_dev, dict(mesh.shape), rank, jax.process_count())
 
-    rng = jax.random.PRNGKey(cfg.seed + rank)   # per-rank seed (train.py:299)
+    # ONE seed for every host: params are logically replicated, so init must
+    # be identical everywhere (the reference's per-rank seed, train.py:299,
+    # was safe only because DDP broadcast rank-0's weights; SPMD has no such
+    # broadcast).  Per-device randomness comes from fold_in(axis_index)
+    # inside the step.
+    rng = jax.random.PRNGKey(cfg.seed)
     data_config = resolve_data_config(cfg.to_dict(), verbose=rank == 0)
     input_size = data_config["input_size"]
     in_chans = input_size[0]
@@ -139,8 +144,7 @@ def main(cfg: TrainConfig, world_size: int = 1) -> Dict[str, float]:
     _logger.info("Model %s created, param count: %d", cfg.model, n_params)
 
     # linear LR scaling: per-device batch × total devices (train.py:814)
-    lr = cfg.lr if cfg.lr is not None else \
-        cfg.batch_size * n_dev * cfg.basic_lr
+    lr = cfg.resolved_lr(world_size=n_dev)
     tx = create_optimizer(cfg, learning_rate=lr)
     state = create_train_state(variables, tx, with_ema=cfg.model_ema)
 
@@ -253,12 +257,10 @@ def launch_main(argv=None) -> Dict[str, float]:
     """CLI entry (reference launch_main, train.py:769-816)."""
     setup_default_logging()
     cfg = TrainConfig.from_args(argv)
-    world_size = 1
     if cfg.json_file:
         cluster = ClusterConfig.from_json(cfg.json_file)
         initialize_distributed(cluster, local_rank=cfg.local_rank)
-        world_size = cluster.world_size
-    return main(cfg, world_size=world_size)
+    return main(cfg)
 
 
 if __name__ == "__main__":

@@ -104,7 +104,7 @@ class CheckpointSaver:
         """Epoch-boundary save with top-K pruning (reference :66-95)."""
         worst = self.checkpoint_files[-1] if self.checkpoint_files else None
         if len(self.checkpoint_files) < self.max_history or metric is None \
-                or self.cmp(metric, worst[1]):
+                or worst[1] is None or self.cmp(metric, worst[1]):
             if len(self.checkpoint_files) >= self.max_history:
                 self._cleanup_checkpoints(1)
             path = os.path.join(
@@ -113,10 +113,13 @@ class CheckpointSaver:
             meta = dict(meta, epoch=epoch, metric=metric)
             save_checkpoint_file(path, state, meta)
             self.checkpoint_files.append((path, metric))
-            self.checkpoint_files = sorted(
-                self.checkpoint_files,
-                key=lambda x: (x[1] is None, x[1]),
-                reverse=not self.decreasing)
+            # best-first; metric-less entries always rank worst (last) so
+            # they are the first pruned
+            with_metric = sorted(
+                (c for c in self.checkpoint_files if c[1] is not None),
+                key=lambda x: x[1], reverse=not self.decreasing)
+            self.checkpoint_files = with_metric + [
+                c for c in self.checkpoint_files if c[1] is None]
             files_str = "\n".join(f" {c}" for c in self.checkpoint_files)
             _logger.info("Current checkpoints:\n%s", files_str)
             if metric is not None and (self.best_metric is None

@@ -66,7 +66,6 @@ def make_train_step(model, tx: optax.GradientTransformation,
     (replaces the per-step ``reduce_tensor`` calls, train.py:625-627).
     """
     assert bn_mode in ("local", "global"), bn_mode
-    has_bn = True  # models without batch_stats just carry an empty dict
 
     def forward_backward(params, batch_stats, x, y, rng):
         def lossf(p):

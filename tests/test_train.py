@@ -209,3 +209,30 @@ class TestInference:
         np.testing.assert_array_equal(x[..., :3], x[..., 3:6])
         scores = test_img(None, [str(img)], size=64)
         assert len(scores) == 1 and 0.0 <= scores[0] <= 1.0
+
+
+class TestCodeReviewRegressions:
+    def test_inference_loads_trainer_checkpoint(self, tmp_path):
+        """models/helpers.load_state_dict must read trainer {'state','meta'}
+        checkpoints (the format scripts/test.sh consumes after training)."""
+        from deepfake_detection_tpu.models.helpers import load_state_dict
+        _, state, _ = _tiny_setup(with_ema=True)
+        path = str(tmp_path / "model_best.ckpt")
+        save_checkpoint_file(path, state, {"epoch": 1})
+        v = load_state_dict(path)
+        assert "params" in v and "batch_stats" in v
+        ve = load_state_dict(path, use_ema=True)
+        a = jax.tree.leaves(v["params"])[0]
+        b = jax.tree.leaves(ve["params"])[0]
+        assert a.shape == b.shape
+
+    def test_saver_none_metric(self, tmp_path):
+        _, state, _ = _tiny_setup()
+        saver = CheckpointSaver(checkpoint_dir=str(tmp_path / "o"),
+                                decreasing=False, max_history=2)
+        saver.save_checkpoint(state, {}, 0, metric=None)
+        saver.save_checkpoint(state, {}, 1, metric=0.5)
+        saver.save_checkpoint(state, {}, 2, metric=0.7)  # evicts the None one
+        kept = sorted(f for f in os.listdir(tmp_path / "o")
+                      if f.startswith("checkpoint-"))
+        assert kept == ["checkpoint-1.ckpt", "checkpoint-2.ckpt"]
