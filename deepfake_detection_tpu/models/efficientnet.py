@@ -99,6 +99,7 @@ class EfficientNet(nn.Module):
     global_pool: str = "avg"
     head_type: str = "efficientnet"   # 'efficientnet' | 'mobilenetv3'
     head_bias: bool = True
+    se_kwargs: Any = None             # SE overrides (MobileNetV3: hard-sigmoid gate)
     norm_layer: str = "bn"
     bn_momentum: float = 0.1
     bn_eps: float = 1e-5
@@ -131,6 +132,8 @@ class EfficientNet(nn.Module):
                     for k in ("noskip", "dw_kernel_size", "se_ratio",
                               "drop_path_rate"):
                         cfg.pop(k, None)
+                elif self.se_kwargs is not None:
+                    cfg.setdefault("se_kwargs", self.se_kwargs)
                 block = _BLOCK_TYPES[btype](**cfg, **bnk, act=block_act,
                                             name=f"blocks_{si}_{bi}")
                 x = block(x, training=training)
@@ -203,7 +206,9 @@ def _make(arch_def, channel_multiplier=1.0, depth_multiplier=1.0,
                  norm_layer=kwargs.pop("norm_layer", "bn"),
                  bn_axis_name=kwargs.pop("bn_axis_name", None),
                  dtype=kwargs.pop("dtype", None),
-                 head_type=kwargs.pop("head_type", "efficientnet"))
+                 head_type=kwargs.pop("head_type", "efficientnet"),
+                 head_bias=kwargs.pop("head_bias", True),
+                 se_kwargs=kwargs.pop("se_kwargs", None))
     kwargs.pop("strict", None)
     if kwargs:
         raise TypeError(f"unexpected model kwargs: {sorted(kwargs)}")

@@ -113,6 +113,7 @@ class DepthwiseSeparableConv(nn.Module):
     pw_act: bool = False
     se_ratio: float = 0.0
     se_gate_fn: Any = "sigmoid"
+    se_kwargs: Any = None    # {'act','gate_fn','reduce_mid','divisor'} overrides
     drop_path_rate: float = 0.0
     norm_layer: str = "bn"
     bn_momentum: float = 0.1
@@ -134,8 +135,12 @@ class DepthwiseSeparableConv(nn.Module):
                   self.bn_axis_name, self.dtype, "bn1")(x, training=training)
         x = act(x)
         if self.se_ratio > 0.0:
+            sek = dict(self.se_kwargs or {})
+            sek.pop("reduce_mid", None)   # dw block: mid == in chs
             x = SqueezeExcite(self.se_ratio, reduced_base_chs=in_chs,
-                              act=self.act, gate_fn=self.se_gate_fn,
+                              act=sek.pop("act", self.act),
+                              gate_fn=sek.pop("gate_fn", self.se_gate_fn),
+                              divisor=sek.pop("divisor", 1),
                               dtype=self.dtype, name="se")(x)
         x = create_conv2d(self.out_chs, self.pw_kernel_size,
                           padding=self.pad_type, dtype=self.dtype,
@@ -164,6 +169,7 @@ class InvertedResidual(nn.Module):
     pw_kernel_size: int = 1
     se_ratio: float = 0.0
     se_gate_fn: Any = "sigmoid"
+    se_kwargs: Any = None    # {'act','gate_fn','reduce_mid','divisor'} overrides
     drop_path_rate: float = 0.0
     norm_layer: str = "bn"
     bn_momentum: float = 0.1
@@ -196,8 +202,12 @@ class InvertedResidual(nn.Module):
                   self.bn_axis_name, self.dtype, "bn2")(x, training=training)
         x = act(x)
         if self.se_ratio > 0.0:
-            x = SqueezeExcite(self.se_ratio, reduced_base_chs=in_chs,
-                              act=self.act, gate_fn=self.se_gate_fn,
+            sek = dict(self.se_kwargs or {})
+            base = mid_chs if sek.pop("reduce_mid", False) else in_chs
+            x = SqueezeExcite(self.se_ratio, reduced_base_chs=base,
+                              act=sek.pop("act", self.act),
+                              gate_fn=sek.pop("gate_fn", self.se_gate_fn),
+                              divisor=sek.pop("divisor", 1),
                               dtype=self.dtype, name="se")(x)
         # point-wise linear projection
         x = create_conv2d(self.out_chs, self.pw_kernel_size,
@@ -227,6 +237,7 @@ class CondConvResidual(nn.Module):
     pw_kernel_size: int = 1
     se_ratio: float = 0.0
     se_gate_fn: Any = "sigmoid"
+    se_kwargs: Any = None    # {'act','gate_fn','reduce_mid','divisor'} overrides
     drop_path_rate: float = 0.0
     norm_layer: str = "bn"
     bn_momentum: float = 0.1
@@ -289,6 +300,7 @@ class EdgeResidual(nn.Module):
     pw_kernel_size: int = 1
     se_ratio: float = 0.0
     se_gate_fn: Any = "sigmoid"
+    se_kwargs: Any = None    # {'act','gate_fn','reduce_mid','divisor'} overrides
     drop_path_rate: float = 0.0
     norm_layer: str = "bn"
     bn_momentum: float = 0.1

@@ -59,9 +59,13 @@ class BatchNorm2d(nn.Module):
     use_bias: bool = True
     axis_name: Optional[str] = None
     dtype: Any = None
+    scale_init: Any = None          # e.g. zeros for zero-init-last-BN blocks
 
     @nn.compact
     def __call__(self, x, training: bool = False):
+        kwargs = {}
+        if self.scale_init is not None:
+            kwargs["scale_init"] = self.scale_init
         return nn.BatchNorm(
             use_running_average=not training,
             momentum=1.0 - self.momentum,
@@ -71,6 +75,7 @@ class BatchNorm2d(nn.Module):
             axis_name=self.axis_name,
             dtype=self.dtype,
             name="bn",
+            **kwargs,
         )(x)
 
 
