@@ -10,21 +10,86 @@ device kind.
 
 Default config: EfficientNet-B4 (the north-star benchmark model), 380×380,
 bf16, per-chip batch 16, full train step (fwd+bwd+RMSpropTF+EMA).  Set
-BENCH_MODEL / BENCH_BATCH / BENCH_SIZE / BENCH_CHANS env vars to override
-(e.g. BENCH_MODEL=efficientnet_deepfake_v4 BENCH_SIZE=600 BENCH_CHANS=12
-BENCH_BATCH=3 for the flagship deepfake config).
+BENCH_MODEL / BENCH_BATCH / BENCH_SIZE / BENCH_CHANS / BENCH_STEPS env vars
+to override (e.g. BENCH_MODEL=efficientnet_deepfake_v4 BENCH_SIZE=600
+BENCH_CHANS=12 BENCH_BATCH=3 for the flagship deepfake config).
+
+Robustness (round-1 postmortem): backend init is probed under a watchdog —
+if the TPU backend errors out (round 1: "Unable to initialize backend
+'axon': UNAVAILABLE") or hangs past BENCH_INIT_TIMEOUT (default 240 s), the
+process re-execs itself with a pure-CPU JAX env so a JSON line is ALWAYS
+produced; phase progress goes to stderr so a slow compile is
+distinguishable from a hang.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 from types import SimpleNamespace
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+_T0 = time.perf_counter()
+
+
+def _log(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - _T0:6.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def _fail_json(stage: str, err: str) -> None:
+    print(json.dumps({
+        "metric": "train_throughput_error", "value": 0.0,
+        "unit": "frames/sec/chip", "vs_baseline": 0.0,
+        "error_stage": stage, "error": err[:500],
+    }), flush=True)
+
+
+def _reexec_cpu(reason: str) -> None:
+    """Replace this process with a pure-CPU run of the same script."""
+    _log(f"falling back to CPU: {reason}")
+    env = dict(os.environ)
+    env["_BENCH_CPU_FALLBACK"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    # sitecustomize registers the axon TPU plugin (and may block) whenever
+    # this var is set — clear it so the fallback interpreter starts clean
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.stderr.flush()
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
+def _init_backend():
+    """Return jax.devices(), with watchdog + CPU fallback on error/hang."""
+    import threading
+
+    box: dict = {}
+
+    def probe() -> None:
+        try:
+            import jax
+            box["devices"] = jax.devices()
+        except BaseException as e:  # noqa: BLE001 — must survive anything
+            box["error"] = repr(e)
+
+    timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 240))
+    _log(f"initializing jax backend (watchdog {timeout:.0f}s) ...")
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        if os.environ.get("_BENCH_CPU_FALLBACK"):
+            _fail_json("backend_init", "CPU backend init hung")
+            os._exit(1)
+        _reexec_cpu(f"backend init exceeded {timeout:.0f}s")
+    if "error" in box:
+        if os.environ.get("_BENCH_CPU_FALLBACK"):
+            _fail_json("backend_init", box["error"])
+            os._exit(1)
+        _reexec_cpu(f"backend init failed: {box['error']}")
+    _log(f"devices: {box['devices']}")
+    return box["devices"]
+
 
 # bf16 peak FLOPs/s per chip by device kind (public spec sheets)
 _PEAK_FLOPS = {
@@ -47,7 +112,12 @@ def _peak_flops(device) -> float:
 
 
 def main() -> None:
-    on_tpu = jax.devices()[0].platform == "tpu"
+    devices = _init_backend()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    on_tpu = devices[0].platform == "tpu"
     model_name = os.environ.get("BENCH_MODEL", "efficientnet_b4")
     if on_tpu:
         batch = int(os.environ.get("BENCH_BATCH", 16))
@@ -61,6 +131,8 @@ def main() -> None:
         steps = int(os.environ.get("BENCH_STEPS", 3))
         dtype = jnp.float32
     chans = int(os.environ.get("BENCH_CHANS", 3))
+    _log(f"config: {model_name} {size}x{size}x{chans} b{batch} "
+         f"steps={steps} dtype={dtype.__name__} on {devices[0].device_kind}")
 
     from deepfake_detection_tpu.losses import cross_entropy
     from deepfake_detection_tpu.models import create_model, init_model
@@ -68,6 +140,7 @@ def main() -> None:
     from deepfake_detection_tpu.train import create_train_state, \
         make_train_step
 
+    _log("building + initializing model ...")
     model = create_model(model_name, num_classes=2, in_chans=chans,
                          dtype=dtype if dtype != jnp.float32 else None)
     variables = init_model(model, jax.random.PRNGKey(0),
@@ -87,6 +160,7 @@ def main() -> None:
     key = jax.random.PRNGKey(1)
 
     # FLOPs of the whole compiled step from XLA cost analysis
+    _log("lowering + compiling train step ...")
     lowered = jax.jit(step.__wrapped__ if hasattr(step, "__wrapped__")
                       else step).lower(state, x, y, key)
     compiled = lowered.compile()
@@ -94,12 +168,15 @@ def main() -> None:
         flops_per_step = float(compiled.cost_analysis()["flops"])
     except (KeyError, TypeError):
         flops_per_step = float("nan")
+    _log(f"compiled; XLA cost analysis: {flops_per_step:.3e} flops/step")
 
     # warmup (also primes the donated-buffer path)
+    _log("warmup (3 steps) ...")
     for i in range(3):
         state, metrics = step(state, x, y, jax.random.fold_in(key, i))
     jax.block_until_ready(metrics["loss"])
 
+    _log(f"measuring ({steps} steps) ...")
     t0 = time.perf_counter()
     for i in range(steps):
         state, metrics = step(state, x, y, jax.random.fold_in(key, 100 + i))
@@ -107,9 +184,11 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     frames_per_sec = batch * steps / dt
-    peak = _peak_flops(jax.devices()[0])
+    peak = _peak_flops(devices[0])
     mfu = (flops_per_step * steps / dt) / peak if np.isfinite(
         flops_per_step) else float("nan")
+    _log(f"done: {frames_per_sec:.1f} frames/s, "
+         f"{dt / steps * 1000:.1f} ms/step, mfu={mfu:.3f}")
     result = {
         "metric": f"train_throughput_{model_name}_{size}x{size}x{chans}_b{batch}",
         "value": round(frames_per_sec, 2),
@@ -117,11 +196,19 @@ def main() -> None:
         "vs_baseline": round(mfu / 0.70, 4) if np.isfinite(mfu) else None,
         "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
         "step_ms": round(dt / steps * 1000, 2),
-        "device": jax.devices()[0].device_kind,
+        "device": devices[0].device_kind,
         "loss": round(float(metrics["loss"]), 4),
     }
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — always emit a JSON line
+        import traceback
+        traceback.print_exc()
+        _fail_json("run", repr(e))
+        sys.exit(1)
