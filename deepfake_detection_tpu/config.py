@@ -232,6 +232,9 @@ class TrainConfig:
             self.scale = tuple(self.scale)
         if isinstance(self.ratio, list):
             self.ratio = tuple(self.ratio)
+        if self.checkpoint_policy not in ("none", "full", "dots"):
+            raise ValueError("checkpoint_policy must be none|full|dots, got "
+                             f"{self.checkpoint_policy!r}")
 
     # ------------------------------------------------------------------
     @property

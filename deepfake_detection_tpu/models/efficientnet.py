@@ -104,6 +104,13 @@ class EfficientNet(nn.Module):
     bn_momentum: float = 0.1
     bn_eps: float = 1e-5
     bn_axis_name: Optional[str] = None
+    # rematerialization policy (consumes TrainConfig.checkpoint_policy):
+    # 'none' — save all activations; 'full' — recompute every block in the
+    # backward pass; 'dots' — save only matmul/conv outputs
+    # (checkpoint_dots_with_no_batch_dims keeps weight-only dots).  At the
+    # flagship 12×600×600/B7 scale 'dots' trades ~⅓ more FLOPs for the HBM
+    # needed to fit a useful per-chip batch.
+    remat_policy: str = "none"
     dtype: Any = None
     default_cfg: Any = None
 
@@ -119,6 +126,15 @@ class EfficientNet(nn.Module):
             f"expected {self.in_chans} input channels (NHWC), got {x.shape}"
         act = get_act_fn(self.act)
         bnk = self._bn_kwargs()
+        assert self.remat_policy in ("none", "full", "dots"), \
+            f"remat_policy must be none|full|dots, got {self.remat_policy!r}"
+        if self.remat_policy == "none":
+            block_types = _BLOCK_TYPES
+        else:   # per-block remat; param names are unchanged by nn.remat
+            policy = None if self.remat_policy == "full" \
+                else jax.checkpoint_policies.checkpoint_dots
+            block_types = {k: nn.remat(v, policy=policy, static_argnums=(2,))
+                           for k, v in _BLOCK_TYPES.items()}
         # stem: conv 3x3 s2 (reference efficientnet.py:275-279)
         x = ConvBnAct(self.stem_size, 3, stride=2, act=self.act, **bnk,
                       name="conv_stem")(x, training=training)
@@ -134,9 +150,9 @@ class EfficientNet(nn.Module):
                         cfg.pop(k, None)
                 elif self.se_kwargs is not None:
                     cfg.setdefault("se_kwargs", self.se_kwargs)
-                block = _BLOCK_TYPES[btype](**cfg, **bnk, act=block_act,
-                                            name=f"blocks_{si}_{bi}")
-                x = block(x, training=training)
+                block = block_types[btype](**cfg, **bnk, act=block_act,
+                                           name=f"blocks_{si}_{bi}")
+                x = block(x, training)
             stage_feats.append(x)
         if features_only:
             return stage_feats
@@ -205,6 +221,7 @@ def _make(arch_def, channel_multiplier=1.0, depth_multiplier=1.0,
                  global_pool=kwargs.pop("global_pool", "avg"),
                  norm_layer=kwargs.pop("norm_layer", "bn"),
                  bn_axis_name=kwargs.pop("bn_axis_name", None),
+                 remat_policy=kwargs.pop("remat_policy", "none"),
                  dtype=kwargs.pop("dtype", None),
                  head_type=kwargs.pop("head_type", "efficientnet"),
                  head_bias=kwargs.pop("head_bias", True),

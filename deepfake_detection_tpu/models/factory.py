@@ -38,7 +38,7 @@ def create_model(model_name: str, pretrained: bool = False,
     model_args = dict(pretrained=pretrained, num_classes=num_classes,
                       in_chans=in_chans)
     if not is_model_in_modules(model_name, _BN_KWARG_MODULES):
-        for k in ("bn_tf", "bn_momentum", "bn_eps"):
+        for k in ("bn_tf", "bn_momentum", "bn_eps", "remat_policy"):
             kwargs.pop(k, None)
     dcr = kwargs.pop("drop_connect_rate", None)
     if dcr is not None and "drop_path_rate" not in kwargs:

@@ -77,6 +77,7 @@ def build_model(cfg: TrainConfig, in_chans: int):
         drop_path_rate=cfg.drop_path, bn_tf=cfg.bn_tf,
         bn_momentum=cfg.bn_momentum, bn_eps=cfg.bn_eps,
         global_pool=cfg.gp,
+        remat_policy=cfg.checkpoint_policy,
         dtype=_dtype(cfg.compute_dtype) if (cfg.amp or
                                             cfg.compute_dtype != "float32")
         else None)
@@ -222,7 +223,7 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
             state, train_metrics = train_one_epoch(
                 epoch, train_step, state, train_loader, cfg, epoch_rng,
                 lr_scheduler=lr_scheduler, saver=saver,
-                output_dir=output_dir, meta=meta)
+                output_dir=output_dir, meta=meta, world_size=n_dev)
 
             eval_metrics = validate(eval_step, state, eval_loader, cfg)
             if eval_step_ema is not None:

@@ -53,8 +53,13 @@ def save_image_batch(x, path: str, img_num: int = 4) -> None:
 def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                     loader, cfg, rng: jax.Array,
                     lr_scheduler=None, saver=None, output_dir: str = "",
-                    meta: Optional[Dict[str, Any]] = None):
-    """One epoch of the hot loop.  Returns ``(state, metrics)``."""
+                    meta: Optional[Dict[str, Any]] = None,
+                    world_size: int = 1):
+    """One epoch of the hot loop.  Returns ``(state, metrics)``.
+
+    ``world_size`` is the data-parallel degree; s/image in the log line is
+    per-device (the reference's ``bs`` is the per-GPU batch, train.py:658).
+    """
     if cfg.mixup > 0 and hasattr(loader, "mixup_enabled"):
         if cfg.mixup_off_epoch and epoch >= cfg.mixup_off_epoch:
             loader.mixup_enabled = False    # reference :597-599
@@ -68,6 +73,23 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
     num_updates = epoch * num_batches
     lr = get_learning_rate(state)
 
+    # Device-side metric scalars are buffered and only materialized at log
+    # boundaries: a float() on every step would block the host on each
+    # step's completion and serialize dispatch, forfeiting the async-
+    # dispatch overlap that replaces the reference's CUDA-stream prefetch.
+    # Consequence: batch_time_m.val at a log step absorbs the wait for the
+    # whole buffered backlog (so .avg is the accurate number); the plateau
+    # scheduler sees a loss avg that is up to log_interval steps stale.
+    pending: list = []
+
+    def _drain() -> None:
+        for m, n in pending:
+            loss_value = float(m["loss"])     # host sync, log steps only
+            if not np.isnan(loss_value):
+                losses_m.update(loss_value, n)
+            prec1_m.update(float(m["prec1"]), n)
+        pending.clear()
+
     for batch_idx, batch in enumerate(loader):
         x, y = batch[0], batch[1]
         last_batch = batch_idx == last_idx
@@ -76,14 +98,13 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
         step_rng = jax.random.fold_in(rng, num_updates)
         state, metrics = train_step(state, x, y, step_rng)
 
-        # reading the scalars is the only host sync (reference synced the
-        # whole device every step, train.py:639)
-        loss_value = float(metrics["loss"])
-        bs = x.shape[0]
-        if not np.isnan(loss_value):
-            losses_m.update(loss_value, bs)
-        prec1_m.update(float(metrics["prec1"]), bs)
+        bs = x.shape[0]     # GLOBAL batch: the loader assembles the global
+        # sharded array even multi-host (parallel/sharding.py:69-80)
+        pending.append((metrics, bs))
         num_updates += 1
+
+        if last_batch or batch_idx % cfg.log_interval == 0:
+            _drain()
         batch_time_m.update(time.time() - end)
 
         if last_batch or batch_idx % cfg.log_interval == 0:
@@ -97,7 +118,8 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                 epoch, batch_idx, num_batches,
                 losses_m.val, losses_m.avg, prec1_m.val, prec1_m.avg,
                 batch_time_m.val, batch_time_m.avg,
-                batch_time_m.val / bs, batch_time_m.avg / bs,
+                batch_time_m.val / max(bs // world_size, 1),
+                batch_time_m.avg / max(bs // world_size, 1),
                 lr, data_time_m.val, data_time_m.avg, ets_time)
             if cfg.save_images and output_dir:
                 save_image_batch(

@@ -127,3 +127,34 @@ def test_output_stride_dilation():
     feats = m.apply(v, jnp.zeros((1, 64, 64, 3)), training=False,
                     features_only=True)
     assert feats[-1].shape[1] == 64 // 16
+
+
+def test_remat_policies_match_baseline():
+    """checkpoint_policy wiring (config.py): same params, same outputs, same
+    grads — remat changes the schedule, not the math."""
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
+
+    def loss_of(policy):
+        m = create_model("efficientnet_b0", num_classes=2,
+                         remat_policy=policy)
+        v = init_model(m, jax.random.PRNGKey(0), (2, 64, 64, 3),
+                       training=True)
+
+        def loss_fn(params):
+            out, _ = m.apply(
+                {"params": params, "batch_stats": v["batch_stats"]}, x,
+                training=True, mutable=["batch_stats"],
+                rngs={"dropout": jax.random.PRNGKey(2)})
+            return jnp.sum(out ** 2)
+
+        val, grads = jax.value_and_grad(loss_fn)(v["params"])
+        return val, grads
+
+    base_val, base_grads = loss_of("none")
+    for policy in ("full", "dots"):
+        val, grads = loss_of(policy)
+        assert jnp.allclose(val, base_val, rtol=1e-5), policy
+        flat_a = jax.tree.leaves(base_grads)
+        flat_b = jax.tree.leaves(grads)
+        assert all(jnp.allclose(a, b, rtol=1e-4, atol=1e-6)
+                   for a, b in zip(flat_a, flat_b)), policy
