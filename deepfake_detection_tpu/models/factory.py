@@ -39,7 +39,12 @@ def create_model(model_name: str, pretrained: bool = False,
                       in_chans=in_chans)
     if not is_model_in_modules(model_name, _BN_KWARG_MODULES):
         for k in ("bn_tf", "bn_momentum", "bn_eps", "remat_policy"):
-            kwargs.pop(k, None)
+            v = kwargs.pop(k, None)
+            if k == "remat_policy" and v not in (None, "none"):
+                import logging
+                logging.getLogger(__name__).warning(
+                    "remat_policy=%r is only consumed by the %s families; "
+                    "ignored for %s", v, _BN_KWARG_MODULES, model_name)
     dcr = kwargs.pop("drop_connect_rate", None)
     if dcr is not None and "drop_path_rate" not in kwargs:
         kwargs["drop_path_rate"] = dcr

@@ -196,7 +196,16 @@ class Bottleneck(nn.Module):
         return act(y + residual)
 
 
+# Block registry: res2net.py / sknet.py extend this with their block types so
+# the one generic ResNet drives every derived family (the reference passes
+# block *classes* into ResNet, resnet.py:280; string keys keep the flax
+# module hashable/static).
 _BLOCKS = {"basic": BasicBlock, "bottleneck": Bottleneck}
+
+
+def register_block(name: str, cls) -> None:
+    """Register an extra residual block type for :class:`ResNet`."""
+    _BLOCKS[name] = cls
 
 
 class ResNet(nn.Module):
@@ -220,6 +229,7 @@ class ResNet(nn.Module):
     drop_block_rate: float = 0.0
     global_pool: str = "avg"
     zero_init_last_bn: bool = True
+    block_args: Any = None        # extra per-block kwargs (reference :280)
     bn_momentum: float = 0.1
     bn_eps: float = 1e-5
     bn_axis_name: Optional[str] = None
@@ -283,7 +293,7 @@ class ResNet(nn.Module):
                 need_ds = bi == 0 and (
                     s != 1 or in_expanded != chs * block_cls.expansion)
                 first_dilation = prev_dilation if bi == 0 else dilation
-                x = block_cls(
+                common = dict(
                     planes=chs, stride=s, has_downsample=need_ds,
                     cardinality=self.cardinality, base_width=self.base_width,
                     reduce_first=self.block_reduce_first, dilation=dilation,
@@ -293,8 +303,10 @@ class ResNet(nn.Module):
                     drop_block_rate=db, drop_block_gamma=db_gamma,
                     drop_path_rate=self.drop_path_rate,
                     zero_init_last_bn=self.zero_init_last_bn, bn=bn,
-                    dtype=self.dtype,
-                    name=f"layer{si + 1}_{bi}")(x, training=training)
+                    dtype=self.dtype)
+                common.update(self.block_args or {})
+                x = block_cls(**common, name=f"layer{si + 1}_{bi}")(
+                    x, training=training)
                 in_expanded = chs * block_cls.expansion
             prev_dilation = dilation
             stage_feats.append(x)

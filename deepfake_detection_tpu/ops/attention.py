@@ -183,6 +183,7 @@ class SelectiveKernelConv(nn.Module):
     out_chs: int
     kernel_size: Sequence[int] = (3, 5)
     stride: int = 1
+    dilation: int = 1
     groups: int = 1
     attn_reduction: int = 16
     min_attn_channels: int = 32
@@ -195,11 +196,11 @@ class SelectiveKernelConv(nn.Module):
     def __call__(self, x, training: bool = False):
         act = get_act_fn(self.act)
         kernel_sizes = list(self.kernel_size)
-        dilations = [1] * len(kernel_sizes)
+        dilations = [self.dilation] * len(kernel_sizes)
         if self.keep_3x3:
             # larger kernels become dilated 3x3s (selective_kernel.py:63-69)
-            dilations = [d * (k - 1) // 2 for k, d in zip(kernel_sizes, [1] * len(kernel_sizes))]
-            dilations = [max(d, 1) for d in dilations]
+            dilations = [max(self.dilation * (k - 1) // 2, 1)
+                         for k in kernel_sizes]
             kernel_sizes = [3] * len(kernel_sizes)
         n = len(kernel_sizes)
         in_chs = x.shape[-1]
