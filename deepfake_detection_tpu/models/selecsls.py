@@ -92,7 +92,7 @@ _FEATS_60 = [(0, 64, 64, True, 2), (64, 64, 128, False, 1),
              (0, 128, 128, True, 2), (128, 128, 128, False, 1),
              (128, 128, 288, False, 1), (0, 288, 288, True, 2),
              (288, 288, 288, False, 1), (288, 288, 288, False, 1),
-             (288, 288, 288, False, 1), (288, 288, 416, False, 1)]
+             (288, 288, 416, False, 1)]
 _FEATS_84 = [(0, 64, 64, True, 2), (64, 64, 144, False, 1),
              (0, 144, 144, True, 2), (144, 144, 144, False, 1),
              (144, 144, 144, False, 1), (144, 144, 144, False, 1),

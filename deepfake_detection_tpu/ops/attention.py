@@ -223,7 +223,7 @@ class SelectiveKernelConv(nn.Module):
         s = jnp.mean(summed, axis=(1, 2), keepdims=True)
         s = Conv2d(attn_chs, 1, use_bias=False, dtype=self.dtype, name="attn_fc")(s)
         s = act(BatchNorm2d(dtype=self.dtype, name="attn_bn")(s, training=training))
-        s = Conv2d(self.out_chs * n, 1, use_bias=True, dtype=self.dtype,
+        s = Conv2d(self.out_chs * n, 1, use_bias=False, dtype=self.dtype,
                    name="attn_sel")(s)              # (B,1,1,C*n)
         B = x.shape[0]
         s = s.reshape(B, 1, 1, n, self.out_chs).transpose(0, 3, 1, 2, 4)

@@ -156,6 +156,7 @@ class TestCheckpointing:
 
 
 class TestEndToEndSmoke:
+    @pytest.mark.slow
     def test_synthetic_train_two_epochs(self, tmp_path, devices):
         """SURVEY.md §4: e2e 2-class smoke train on synthetic data."""
         from deepfake_detection_tpu.runners.train import launch_main
@@ -176,6 +177,7 @@ class TestEndToEndSmoke:
         assert (run / "args.yaml").is_file()
         assert (run / "model_best.ckpt").is_file()
 
+    @pytest.mark.slow
     def test_resume_from_checkpoint(self, tmp_path, devices):
         from deepfake_detection_tpu.runners.train import launch_main
         args = [

@@ -1,0 +1,84 @@
+"""Dump golden param counts from the reference torch model zoo.
+
+Loads individual vendored model files from ``/root/reference/dfd/timm/models``
+standalone via importlib (stubbing the absolute ``timm.*`` imports and the
+removed ``torch._six``), instantiates each entrypoint at 1000 classes, and
+prints ``name: n_params``.  Used to generate the golden numbers in
+``tests/test_models_backbones.py`` — the vendored 2019-era timm differs from
+modern timm for several families (e.g. DLA), so published model-zoo numbers
+are NOT authoritative; this is.
+
+Usage: python tools/reference_param_counts.py [module ...]
+"""
+
+import collections.abc
+import importlib.util
+import json
+import sys
+import types
+
+ROOT = "/root/reference/dfd/timm"
+
+
+def _stub_env():
+    six = types.ModuleType("torch._six")
+    six.container_abcs = collections.abc
+    six.int_classes = int
+    six.string_classes = str
+    sys.modules["torch._six"] = six
+    timm = types.ModuleType("timm")
+    timm.__path__ = [ROOT]
+    sys.modules["timm"] = timm
+    td = types.ModuleType("timm.data")
+    td.IMAGENET_DEFAULT_MEAN = (0.485, 0.456, 0.406)
+    td.IMAGENET_DEFAULT_STD = (0.229, 0.224, 0.225)
+    td.IMAGENET_INCEPTION_MEAN = (0.5,) * 3
+    td.IMAGENET_INCEPTION_STD = (0.5,) * 3
+    td.IMAGENET_DPN_MEAN = tuple(x / 255 for x in (124, 117, 104))
+    td.IMAGENET_DPN_STD = tuple(1 / (.0167 * 255) for _ in range(3))
+    sys.modules["timm.data"] = td
+    tmm = types.ModuleType("timm.models")
+    tmm.__path__ = [ROOT + "/models"]
+    sys.modules["timm.models"] = tmm
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(modules):
+    _stub_env()
+    _load("timm.models.registry", f"{ROOT}/models/registry.py")
+    _load("timm.models.layers", f"{ROOT}/models/layers/__init__.py")
+    _load("timm.models.helpers", f"{ROOT}/models/helpers.py")
+    from timm.models.registry import _model_entrypoints  # noqa: E402
+    out = {}
+    for modname in modules:
+        before = set(_model_entrypoints)
+        try:
+            mod = _load(f"timm.models.{modname}", f"{ROOT}/models/{modname}.py")
+        except Exception as e:  # noqa: BLE001 — report and move on
+            print(f"# {modname}: LOAD FAILED: {e}", file=sys.stderr)
+            continue
+        for name in sorted(set(_model_entrypoints) - before):
+            try:
+                m = _model_entrypoints[name](pretrained=False,
+                                             num_classes=1000)
+                out[name] = sum(p.numel() for p in m.parameters())
+            except Exception as e:  # noqa: BLE001
+                print(f"# {name}: BUILD FAILED: {e}", file=sys.stderr)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    mods = sys.argv[1:] or [
+        "dla", "dpn", "senet", "densenet", "selecsls", "res2net", "sknet",
+        "gluon_resnet", "resnet", "xception", "gluon_xception",
+        "inception_v4", "inception_resnet_v2", "nasnet", "pnasnet", "hrnet",
+        "mobilenetv3",
+    ]
+    main(mods)
