@@ -342,6 +342,24 @@ def _gen_mnasnet_small(variant, channel_multiplier=1.0, **kwargs):
                  act="relu", variant=variant, **kwargs)
 
 
+_MOBILENETV2_ARCH = [
+    ["ds_r1_k3_s1_c16"],
+    ["ir_r2_k3_s2_e6_c24"],
+    ["ir_r3_k3_s2_e6_c32"],
+    ["ir_r4_k3_s2_e6_c64"],
+    ["ir_r3_k3_s1_e6_c96"],
+    ["ir_r3_k3_s2_e6_c160"],
+    ["ir_r1_k3_s1_e6_c320"],
+]
+
+
+def _gen_mobilenet_v2(variant, channel_multiplier=1.0, depth_multiplier=1.0,
+                      **kwargs):
+    """MobileNet-V2 (reference efficientnet.py:669-692): ReLU6, stem 32."""
+    return _make(_MOBILENETV2_ARCH, channel_multiplier, depth_multiplier,
+                 stem_size=32, act="relu6", variant=variant, **kwargs)
+
+
 def _gen_fbnetc(variant, channel_multiplier=1.0, **kwargs):
     arch = [
         ["ir_r1_k3_s1_e1_c16"],
@@ -398,9 +416,10 @@ _MIXNET_M_ARCH = [
 ]
 
 
-def _gen_mixnet_s(variant, channel_multiplier=1.0, **kwargs):
-    return _make(_MIXNET_S_ARCH, channel_multiplier, stem_size=16,
-                 fix_stem=True, num_features=1536, act="relu",
+def _gen_mixnet_s(variant, channel_multiplier=1.0, depth_multiplier=1.0,
+                  **kwargs):
+    return _make(_MIXNET_S_ARCH, channel_multiplier, depth_multiplier,
+                 stem_size=16, fix_stem=True, num_features=1536, act="relu",
                  variant=variant, **kwargs)
 
 
@@ -421,24 +440,73 @@ _B_SCALING = {  # (channel_multiplier, depth_multiplier)
 }
 
 
+def _register_scaled(name, gen, cm, dm=1.0, tf=False, doc=""):
+    def fn(pretrained=False, *, _name=name, _cm=cm, _dm=dm, _tf=tf,
+           _gen=gen, **kwargs):
+        if _tf:
+            kwargs.setdefault("bn_tf", True)   # pad 'same' is XLA-native
+        return _gen(_name, _cm, _dm, **kwargs)
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__module__ = __name__
+    fn.__doc__ = doc or f"{name} (w={cm}, d={dm})."
+    register_model(fn)
+
+
 def _register_b_series():
     for i, (cm, dm) in _B_SCALING.items():
-        for prefix, tf in (("efficientnet", False), ("tf_efficientnet", True)):
-            name = f"{prefix}_b{i}"
-
-            def fn(pretrained=False, *, _name=name, _cm=cm, _dm=dm, _tf=tf,
-                   **kwargs):
-                if _tf:
-                    kwargs.setdefault("bn_tf", True)
-                return _gen_efficientnet(_name, _cm, _dm, **kwargs)
-            fn.__name__ = name
-            fn.__qualname__ = name
-            fn.__module__ = __name__
-            fn.__doc__ = f"EfficientNet-B{i} (w={cm}, d={dm})."
-            register_model(fn)
+        _register_scaled(f"efficientnet_b{i}", _gen_efficientnet, cm, dm,
+                         doc=f"EfficientNet-B{i} (w={cm}, d={dm}).")
+        _register_scaled(f"tf_efficientnet_b{i}", _gen_efficientnet, cm, dm,
+                         tf=True, doc=f"TF EfficientNet-B{i}.")
+        # AdvProp / Noisy-Student weight variants (reference :1358-1530) —
+        # same architectures, TF BN defaults
+        if i <= 8:
+            _register_scaled(f"tf_efficientnet_b{i}_ap", _gen_efficientnet,
+                             cm, dm, tf=True,
+                             doc=f"TF EfficientNet-B{i} AdvProp.")
+        if i <= 7:
+            _register_scaled(f"tf_efficientnet_b{i}_ns", _gen_efficientnet,
+                             cm, dm, tf=True,
+                             doc=f"TF EfficientNet-B{i} NoisyStudent.")
 
 
 _register_b_series()
+
+# crop-pct 'a' variants (reference :1106-1131) and TF L2 NoisyStudent
+_register_scaled("efficientnet_b2a", _gen_efficientnet, 1.1, 1.2)
+_register_scaled("efficientnet_b3a", _gen_efficientnet, 1.2, 1.4)
+_register_scaled("tf_efficientnet_l2_ns", _gen_efficientnet, 4.3, 5.3,
+                 tf=True, doc="TF EfficientNet-L2 NoisyStudent (:1544).")
+_register_scaled("tf_efficientnet_l2_ns_475", _gen_efficientnet, 4.3, 5.3,
+                 tf=True, doc="TF EfficientNet-L2 NS @475 (:1533).")
+# TF edge / condconv / mixnet weight variants (reference :1555-1706)
+_register_scaled("tf_efficientnet_es", _gen_efficientnet_edge, 1.0, 1.0,
+                 tf=True)
+_register_scaled("tf_efficientnet_em", _gen_efficientnet_edge, 1.0, 1.1,
+                 tf=True)
+_register_scaled("tf_efficientnet_el", _gen_efficientnet_edge, 1.2, 1.4,
+                 tf=True)
+_register_scaled("tf_mixnet_s", _gen_mixnet_s, 1.0, tf=True)
+_register_scaled("tf_mixnet_m", _gen_mixnet_m, 1.0, tf=True)
+_register_scaled("tf_mixnet_l", _gen_mixnet_m, 1.3, tf=True)
+_register_scaled("mixnet_xxl", _gen_mixnet_m, 2.4, 1.3)
+_register_scaled("mobilenetv2_100", _gen_mobilenet_v2, 1.0)
+
+
+def _gen_condconv_tf(variant, channel_multiplier=1.0, depth_multiplier=1.0,
+                     **kwargs):
+    experts = 2 if variant.endswith("8e") else 1
+    return _gen_efficientnet_condconv(variant, channel_multiplier,
+                                      depth_multiplier, experts, **kwargs)
+
+
+_register_scaled("tf_efficientnet_cc_b0_4e", _gen_condconv_tf, 1.0, 1.0,
+                 tf=True)
+_register_scaled("tf_efficientnet_cc_b0_8e", _gen_condconv_tf, 1.0, 1.0,
+                 tf=True)
+_register_scaled("tf_efficientnet_cc_b1_8e", _gen_condconv_tf, 1.0, 1.1,
+                 tf=True)
 
 
 @register_model

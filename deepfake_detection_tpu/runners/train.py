@@ -264,5 +264,11 @@ def launch_main(argv=None) -> Dict[str, float]:
     return main(cfg)
 
 
+def cli(argv=None) -> None:
+    """Console-script entry: discard launch_main's metrics dict so the
+    setuptools wrapper's ``sys.exit(...)`` sees None (exit 0)."""
+    launch_main(argv)
+
+
 if __name__ == "__main__":
     launch_main(sys.argv[1:])

@@ -141,3 +141,24 @@ def test_res2net_training_step_grads():
     grads = jax.grad(loss_fn)(v["params"])
     flat = jax.tree.leaves(grads)
     assert any(bool(jnp.any(g != 0)) for g in flat)
+
+
+def test_full_entrypoint_name_parity():
+    """Every one of the reference's 221 registered entrypoints (dumped via
+    tools/reference_param_counts.py machinery) must resolve here."""
+    names = open(os.path.join(os.path.dirname(__file__),
+                              "reference_model_names.txt")).read().split()
+    assert len(names) >= 217
+    missing = [n for n in names if not is_model(n)]
+    assert not missing, missing
+
+
+@pytest.mark.parametrize("name", ["hrnet_w18_small", "inception_v4",
+                                  "gluon_xception65", "dpn68",
+                                  "mobilenetv2_100"])
+def test_new_family_forward(name):
+    hw = 128 if "xception" in name else (299 if "inception" in name else 64)
+    m = create_model(name, num_classes=3)
+    v = init_model(m, jax.random.PRNGKey(0), (1, hw, hw, 3))
+    out = m.apply(v, jnp.zeros((1, hw, hw, 3)), training=False)
+    assert out.shape == (1, 3), name
