@@ -4,7 +4,7 @@ Re-design of ``/root/reference/dfd/timm/models/efficientnet.py`` (1,696 LoC):
 the generic EfficientNet covering B0–B8/L2, EdgeTPU, CondConv, MixNet,
 MNasNet-A1/B1/small, FBNet-C, Single-Path-NAS — plus the custom deepfake
 configs ``efficientnet_deepfake_v3``/``_v4`` (12 input channels = 4 RGB frames,
-600×600, B7 width/depth scaling with stem 128 / features 256; reference
+600×600, B7 width/depth scaling with stem 256 / features 256; reference
 :806-848, :1178-1196) and ``efficientnet_b7_deepfake`` (:93-94).
 
 TPU notes:
@@ -193,7 +193,7 @@ class EfficientNet(nn.Module):
 
 def _make(arch_def, channel_multiplier=1.0, depth_multiplier=1.0,
           depth_trunc="ceil", experts_multiplier=1, fix_first_last=False,
-          stem_size=32, fix_stem=False, num_features=None, num_features_base=1280,
+          stem_size=32, num_features=None, num_features_base=1280,
           act="relu", output_stride=32, **kwargs) -> EfficientNet:
     """Shared generator plumbing: decode DSL, scale, round, build module."""
     variant = kwargs.pop("variant", None)
@@ -211,9 +211,12 @@ def _make(arch_def, channel_multiplier=1.0, depth_multiplier=1.0,
         output_stride=output_stride, drop_path_rate=drop_path_rate,
         default_act=act)
     if num_features is None:
+        # generators that scale the head pass num_features_base (reference
+        # _gen_efficientnet: round_channels(1280, cm)); others pass a fixed
+        # num_features — the reference EfficientNet class never scales it
         num_features = round_channels(num_features_base, channel_multiplier)
-    if not fix_stem:
-        stem_size = round_channels(stem_size, channel_multiplier)
+    # the stem is ALWAYS scaled (reference EfficientNet.__init__:273)
+    stem_size = round_channels(stem_size, channel_multiplier)
     cfg = default_cfgs.get(variant, _cfg()) if variant else _cfg()
     known = dict(num_classes=kwargs.pop("num_classes", cfg.get("num_classes", 1000)),
                  in_chans=kwargs.pop("in_chans", 3),
@@ -256,12 +259,13 @@ def _gen_efficientnet(variant, channel_multiplier=1.0, depth_multiplier=1.0,
 
 def _gen_efficientnet_deepfake(variant, channel_multiplier=2.0,
                                depth_multiplier=3.1, **kwargs):
-    """Custom deepfake config (reference :806-848): B7 width/depth scaling but
-    ``stem_size=128`` (fixed) and ``num_features=round_channels(128,2.0)=256``,
-    Swish activations, BatchNorm (the norm-free variant is dead code in the
+    """Custom deepfake config (reference :806-848): B7 width/depth scaling,
+    ``stem_size=round_channels(128, 2.0)=256`` (the class scales every stem,
+    reference :273) and ``num_features=round_channels(128,2.0)=256``, Swish
+    activations, BatchNorm (the norm-free variant is dead code in the
     reference's active path, :544-554)."""
     return _make(_EFFICIENTNET_ARCH, channel_multiplier, depth_multiplier,
-                 stem_size=128, fix_stem=True, num_features_base=128,
+                 stem_size=128, num_features_base=128,
                  act=kwargs.pop("act", "swish"), variant=variant, **kwargs)
 
 
@@ -311,7 +315,7 @@ def _gen_mnasnet_b1(variant, channel_multiplier=1.0, **kwargs):
         ["ir_r1_k3_s1_e6_c320_noskip"],
     ]
     return _make(arch, channel_multiplier, depth_trunc="round", stem_size=32,
-                 fix_stem=True, act="relu", variant=variant, **kwargs)
+                 num_features=1280, act="relu", variant=variant, **kwargs)
 
 
 def _gen_mnasnet_a1(variant, channel_multiplier=1.0, **kwargs):
@@ -325,7 +329,7 @@ def _gen_mnasnet_a1(variant, channel_multiplier=1.0, **kwargs):
         ["ir_r1_k3_s1_e6_c320"],
     ]
     return _make(arch, channel_multiplier, depth_trunc="round", stem_size=32,
-                 fix_stem=True, act="relu", variant=variant, **kwargs)
+                 num_features=1280, act="relu", variant=variant, **kwargs)
 
 
 def _gen_mnasnet_small(variant, channel_multiplier=1.0, **kwargs):
@@ -339,7 +343,7 @@ def _gen_mnasnet_small(variant, channel_multiplier=1.0, **kwargs):
         ["ir_r1_k3_s1_e6_c144"],
     ]
     return _make(arch, channel_multiplier, depth_trunc="round", stem_size=8,
-                 act="relu", variant=variant, **kwargs)
+                 num_features=1280, act="relu", variant=variant, **kwargs)
 
 
 _MOBILENETV2_ARCH = [
@@ -357,7 +361,8 @@ def _gen_mobilenet_v2(variant, channel_multiplier=1.0, depth_multiplier=1.0,
                       **kwargs):
     """MobileNet-V2 (reference efficientnet.py:669-692): ReLU6, stem 32."""
     return _make(_MOBILENETV2_ARCH, channel_multiplier, depth_multiplier,
-                 stem_size=32, act="relu6", variant=variant, **kwargs)
+                 stem_size=32, num_features=1280, act="relu6",
+                 variant=variant, **kwargs)
 
 
 def _gen_fbnetc(variant, channel_multiplier=1.0, **kwargs):
@@ -386,7 +391,7 @@ def _gen_spnasnet(variant, channel_multiplier=1.0, **kwargs):
         ["ir_r1_k3_s1_e6_c320_noskip"],
     ]
     return _make(arch, channel_multiplier, depth_trunc="round", stem_size=32,
-                 fix_stem=True, act="relu", variant=variant, **kwargs)
+                 num_features=1280, act="relu", variant=variant, **kwargs)
 
 
 _MIXNET_S_ARCH = [
@@ -419,14 +424,14 @@ _MIXNET_M_ARCH = [
 def _gen_mixnet_s(variant, channel_multiplier=1.0, depth_multiplier=1.0,
                   **kwargs):
     return _make(_MIXNET_S_ARCH, channel_multiplier, depth_multiplier,
-                 stem_size=16, fix_stem=True, num_features=1536, act="relu",
+                 stem_size=16, num_features=1536, act="relu",
                  variant=variant, **kwargs)
 
 
 def _gen_mixnet_m(variant, channel_multiplier=1.0, depth_multiplier=1.0,
                   **kwargs):
     return _make(_MIXNET_M_ARCH, channel_multiplier, depth_multiplier,
-                 depth_trunc="round", stem_size=24, fix_stem=True,
+                 depth_trunc="round", stem_size=24,
                  num_features=1536, act="relu", variant=variant, **kwargs)
 
 

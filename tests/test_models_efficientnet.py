@@ -46,10 +46,13 @@ def test_b0_forward_shape():
 
 
 def test_deepfake_v4_structure():
-    """Reference parity: stem 128, features 256, 12-chan input, 2 classes
-    (efficientnet.py:806-848)."""
+    """Reference parity: the generator passes stem_size=128 but the
+    EfficientNet class scales every stem by channel_multiplier
+    (reference efficientnet.py:273: round_channels(128, 2.0) = 256) —
+    verified against the reference torch model's own param count and
+    conv_stem weight shape (3, 3, 12 -> 256)."""
     m = create_deepfake_model_v4("efficientnet_deepfake_v4")
-    assert m.stem_size == 128
+    assert m.stem_size == 256
     assert m.num_features == 256
     assert m.in_chans == 12
     assert m.num_classes == 2
@@ -58,7 +61,7 @@ def test_deepfake_v4_structure():
         lambda r: m.init(r, jnp.zeros((1, 64, 64, 12)), training=False),
         {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)})
     stem_kernel = shapes["params"]["conv_stem"]["conv"]["conv"]["kernel"]
-    assert stem_kernel.shape == (3, 3, 12, 128)
+    assert stem_kernel.shape == (3, 3, 12, 256)
     cls_kernel = shapes["params"]["classifier"]["kernel"]
     assert cls_kernel.shape == (256, 2)
 
