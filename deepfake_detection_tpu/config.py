@@ -205,6 +205,7 @@ class TrainConfig:
     # --- misc / infra ---
     seed: int = 42
     log_interval: int = 50
+    profile: int = 0      # trace N train steps with jax.profiler (SURVEY §5)
     recovery_interval: int = 0
     save_images: bool = False
     output: str = "./output"

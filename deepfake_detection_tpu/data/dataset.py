@@ -38,8 +38,8 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 import numpy as np
 from PIL import Image
 
-__all__ = ["DeepFakeClipDataset", "FolderDataset", "SyntheticDataset",
-           "read_clip_list", "split_clips"]
+__all__ = ["AugMixDataset", "DeepFakeClipDataset", "FolderDataset",
+           "SyntheticDataset", "read_clip_list", "split_clips"]
 
 _IMG_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp")
 
@@ -273,3 +273,54 @@ class SyntheticDataset:
         img = g.integers(0, 256, self.image_shape, dtype=np.uint8)
         target = int(g.integers(0, self.num_classes))
         return img, target
+
+
+class AugMixDataset:
+    """Clean + augmented multi-view wrapper (reference dataset.py:633-670).
+
+    Wraps any dataset producing post-transform ``(H, W, 3*img_num)`` uint8
+    clips and emits ``num_splits`` stacked views per sample: the clean base
+    output first, then ``num_splits-1`` AugMix-augmented copies (each frame
+    slice augmented independently in the uint8 domain — equivalent to the
+    reference's augment-before-normalize split, since normalization here
+    happens on device and applies to every split identically).  The JSD loss
+    (losses.py:jsd_cross_entropy) consumes the split-major batch the collate
+    builds from these.
+    """
+
+    def __init__(self, dataset, num_splits: int = 2,
+                 aug_config: str = "augmix-m3-w3"):
+        from .auto_augment import augment_and_mix_transform
+        assert num_splits >= 2, num_splits
+        self.dataset = dataset
+        self.num_splits = num_splits
+        self.augment = augment_and_mix_transform(aug_config)
+
+    def set_transform(self, transform: Callable) -> None:
+        self.dataset.set_transform(transform)
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def _augment_clip(self, clip: np.ndarray,
+                      rng: np.random.Generator) -> np.ndarray:
+        frames = []
+        for f in range(clip.shape[-1] // 3):
+            img = Image.fromarray(clip[..., 3 * f:3 * f + 3])
+            frames.append(np.asarray(self.augment(img, rng), dtype=np.uint8))
+        return np.concatenate(frames, axis=-1)
+
+    def __getitem__(self, index: int,
+                    rng: Optional[np.random.Generator] = None):
+        rng = rng if rng is not None else np.random.default_rng(
+            np.random.SeedSequence([0, index]))
+        clip, target = self.dataset.__getitem__(index, rng=rng)
+        clip = np.asarray(clip, dtype=np.uint8)
+        views = [clip]
+        for _ in range(self.num_splits - 1):
+            views.append(self._augment_clip(clip, rng))
+        return np.stack(views), target

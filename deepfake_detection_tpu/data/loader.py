@@ -46,9 +46,20 @@ __all__ = ["fast_collate", "HostLoader", "DeviceLoader",
 
 def fast_collate(samples: Sequence[Tuple[np.ndarray, int]]
                  ) -> Tuple[np.ndarray, np.ndarray]:
-    """Stack uint8 NHWC samples + int labels (reference :12-46)."""
+    """Stack uint8 NHWC samples + int labels (reference :12-46).
+
+    AugMix multi-view samples — ``(S, H, W, C)`` per sample — collate
+    split-major: ``[view0 of all samples, view1 of all samples, ...]`` with
+    labels tiled, the layout ``jsd_cross_entropy`` splits back apart
+    (reference fast_collate tuple branch, loader.py:15-27).
+    """
     images = np.stack([s[0] for s in samples]).astype(np.uint8, copy=False)
     targets = np.asarray([s[1] for s in samples], dtype=np.int64)
+    if images.ndim == 5:                       # (B, S, H, W, C)
+        b, s = images.shape[:2]
+        images = np.transpose(images, (1, 0, 2, 3, 4)).reshape(
+            b * s, *images.shape[2:])
+        targets = np.tile(targets, s)
     return images, targets
 
 
@@ -269,6 +280,14 @@ def create_deepfake_loader_v3(
             blur_prob=blur_prob)
     else:
         transform = transforms_deepfake_eval_v3(img_size)
+    if is_training and num_aug_splits > 1:
+        # clean + (num_aug_splits-1) AugMix views per sample, feeding the
+        # JSD consistency loss (reference dataset.py:633-670)
+        assert collate_mixup is None, \
+            "aug_splits and mixup are mutually exclusive (reference " \
+            "train.py:446 asserts num_aug_splits precludes the mixup collate)"
+        from .dataset import AugMixDataset
+        dataset = AugMixDataset(dataset, num_splits=num_aug_splits)
     dataset.set_transform(transform)
 
     if not distributed:

@@ -91,7 +91,10 @@ def jsd_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
 def create_loss_fn(cfg) -> Callable:
     """Loss precedence from the reference runner (train.py:506-520)."""
     if getattr(cfg, "jsd", False):
-        ns = getattr(cfg, "aug_splits", 0) or 3
+        ns = getattr(cfg, "aug_splits", 0)
+        # without view splits the JSD slicing silently corrupts the loss
+        # (reference train.py:507 asserts the same)
+        assert ns > 1, "--jsd requires --aug-splits > 1"
         return lambda logits, target, weight=None: jsd_cross_entropy(
             logits, target, num_splits=ns, smoothing=cfg.smoothing)
     if getattr(cfg, "mixup", 0.0) > 0:

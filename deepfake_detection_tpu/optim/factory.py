@@ -26,6 +26,7 @@ import jax
 import optax
 
 from .lookahead import lookahead
+from .nvnovograd import nvnovograd
 from .rmsprop_tf import rmsprop_tf
 
 __all__ = ["create_optimizer", "weight_decay_mask"]
@@ -92,22 +93,27 @@ def _base_optimizer(name: str, learning_rate, *, opt_eps: float,
                        momentum=momentum),
         )
     elif name in ("novograd", "nvnovograd"):
-        # optax.novograd has no mask arg; partition leaves so 1-dim params and
-        # biases stay undecayed like every other optimizer here (reference
-        # add_weight_decay applies to NovoGrad too, optim_factory.py:35-37).
-        # NovoGrad's normalization is per-leaf, so the split is exact.
+        # two DISTINCT reference implementations: novograd.py:12 (optax's
+        # matches) vs NVIDIA's nvnovograd.py:13 (per-tensor scalar ‖g‖² EMA
+        # seeded from the first step — optim/nvnovograd.py here).
+        # Neither takes a mask; partition leaves so 1-dim params and biases
+        # stay undecayed (reference add_weight_decay, optim_factory.py:35-37).
+        # Both normalize per-leaf, so the split is exact.
+        def _make(weight_decay):
+            if name == "nvnovograd":
+                return nvnovograd(learning_rate, eps=opt_eps,
+                                  weight_decay=weight_decay)
+            return optax.novograd(learning_rate, eps=opt_eps,
+                                  weight_decay=weight_decay)
         if wd and mask is not None:
             def _labels(params):
                 m = mask(params) if callable(mask) else mask
                 return jax.tree.map(
                     lambda b: "decay" if b else "no_decay", m)
             tx = optax.multi_transform(
-                {"decay": optax.novograd(learning_rate, eps=opt_eps,
-                                         weight_decay=wd),
-                 "no_decay": optax.novograd(learning_rate, eps=opt_eps)},
-                _labels)
+                {"decay": _make(wd), "no_decay": _make(0.0)}, _labels)
         else:
-            tx = optax.novograd(learning_rate, eps=opt_eps, weight_decay=wd)
+            tx = _make(wd)
     elif name == "lamb":
         tx = optax.lamb(learning_rate, eps=opt_eps, weight_decay=wd,
                         mask=mask)

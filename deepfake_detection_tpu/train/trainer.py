@@ -73,6 +73,14 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
     num_updates = epoch * num_batches
     lr = get_learning_rate(state)
 
+    # jax.profiler window (SURVEY §5: the reference has no profiler; an MFU
+    # target can't be tuned blind).  Steps [start, start+N) of epoch 0 are
+    # traced into <output_dir>/profile — view with TensorBoard or Perfetto.
+    profile_n = getattr(cfg, "profile", 0) if epoch == 0 and output_dir \
+        else 0
+    profile_start = min(10, max(num_batches - profile_n, 0))
+    profiling = False
+
     # Device-side metric scalars are buffered and only materialized at log
     # boundaries: a float() on every step would block the host on each
     # step's completion and serialize dispatch, forfeiting the async-
@@ -95,8 +103,20 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
         last_batch = batch_idx == last_idx
         data_time_m.update(time.time() - end)
 
+        if profile_n and batch_idx == profile_start and not profiling:
+            jax.profiler.start_trace(os.path.join(output_dir, "profile"))
+            profiling = True
+
         step_rng = jax.random.fold_in(rng, num_updates)
         state, metrics = train_step(state, x, y, step_rng)
+
+        if profiling and (batch_idx + 1 >= profile_start + profile_n
+                          or last_batch):
+            jax.block_until_ready(metrics["loss"])
+            jax.profiler.stop_trace()
+            profiling = False
+            _logger.info("Profiler trace written to %s",
+                         os.path.join(output_dir, "profile"))
 
         bs = x.shape[0]     # GLOBAL batch: the loader assembles the global
         # sharded array even multi-host (parallel/sharding.py:69-80)

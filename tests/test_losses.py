@@ -88,5 +88,8 @@ def test_selection_precedence():
     cfg.mixup = 0.2
     assert create_loss_fn(cfg) is soft_target_cross_entropy
     cfg.jsd = True
+    with pytest.raises(AssertionError):
+        create_loss_fn(cfg)       # --jsd without --aug-splits is an error
+    cfg.aug_splits = 3
     fn = create_loss_fn(cfg)
     assert fn is not soft_target_cross_entropy

@@ -148,3 +148,48 @@ def test_make_divisible():
     assert ops.make_divisible(32 * 2.0) == 64
     assert ops.make_divisible(33) == 32
     assert ops.make_divisible(1) == 8
+
+
+class TestTimePool:
+    def test_test_time_pool_logits(self):
+        import jax
+        import jax.numpy as jnp
+        from deepfake_detection_tpu.models import create_model, init_model
+        from deepfake_detection_tpu.models.test_time_pool import (
+            apply_test_time_pool, test_time_pool_apply)
+        m = create_model("mnasnet_small", num_classes=4)
+        v = init_model(m, jax.random.PRNGKey(0), (1, 64, 64, 3))
+        # input 96 > default 224? use config claiming larger input
+        pool, on = apply_test_time_pool(
+            m, {"input_size": (3, 256, 256)})
+        assert on and pool == 7
+        _, off = apply_test_time_pool(m, {"input_size": (3, 224, 224)})
+        assert not off
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 96, 96, 3))
+        out = test_time_pool_apply(m, v, x, original_pool=2)
+        assert out.shape == (2, 4)
+        # at the native size with pool 1 this must equal the plain forward
+        x2 = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 32, 3))
+        plain = m.apply(v, x2, training=False)
+        tta = test_time_pool_apply(m, v, x2, original_pool=1)
+        assert jnp.allclose(plain, tta, atol=1e-5)
+
+
+class TestFeatureHooks:
+    def test_extract_named_features(self):
+        import jax
+        import jax.numpy as jnp
+        from deepfake_detection_tpu.models import create_model, init_model
+        from deepfake_detection_tpu.models.feature_hooks import \
+            extract_features
+        m = create_model("mnasnet_small", num_classes=4)
+        v = init_model(m, jax.random.PRNGKey(0), (1, 32, 32, 3))
+        out, feats = extract_features(
+            m, v, jnp.zeros((1, 32, 32, 3)), names=["conv_stem",
+                                                    "blocks_1_0"])
+        assert out.shape == (1, 4)
+        assert any(k.startswith("conv_stem") for k in feats)
+        assert any(k.startswith("blocks_1_0") for k in feats)
+        # features are real arrays with spatial dims
+        k = next(k for k in feats if k.startswith("conv_stem"))
+        assert feats[k].ndim == 4

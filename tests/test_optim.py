@@ -149,3 +149,61 @@ class TestNovogradWeightDecayMask:
         assert jnp.allclose(u_wd["bias"], u_nowd["bias"])
         # kernel is decayed → differs
         assert not jnp.allclose(u_wd["kernel"], u_nowd["kernel"])
+
+
+class TestNvNovoGrad:
+    def _torch_reference_step(self, params, grads, steps, lr=0.1, b1=0.95,
+                              b2=0.98, eps=1e-8, wd=0.01):
+        """Literal numpy transcription of reference nvnovograd.py:60-118."""
+        p = {k: v.copy() for k, v in params.items()}
+        state = {k: {"exp_avg": np.zeros_like(v), "exp_avg_sq": 0.0}
+                 for k, v in params.items()}
+        for t in range(steps):
+            for k in p:
+                g = grads[t][k].copy()
+                st = state[k]
+                norm = float(np.sum(g ** 2))
+                if st["exp_avg_sq"] == 0.0:
+                    st["exp_avg_sq"] = norm
+                else:
+                    st["exp_avg_sq"] = st["exp_avg_sq"] * b2 + (1 - b2) * norm
+                g = g / (np.sqrt(st["exp_avg_sq"]) + eps)
+                g = g + wd * p[k]
+                st["exp_avg"] = b1 * st["exp_avg"] + g
+                p[k] = p[k] - lr * st["exp_avg"]
+        return p
+
+    def test_matches_reference_semantics(self):
+        from deepfake_detection_tpu.optim.nvnovograd import nvnovograd
+        rng = np.random.default_rng(0)
+        params = {"w": rng.normal(size=(4, 3)).astype(np.float32),
+                  "b": rng.normal(size=(3,)).astype(np.float32)}
+        grads = [{k: rng.normal(size=v.shape).astype(np.float32)
+                  for k, v in params.items()} for _ in range(4)]
+        want = self._torch_reference_step(params, grads, 4)
+
+        tx = nvnovograd(0.1, weight_decay=0.01)
+        jp = {k: jnp.asarray(v) for k, v in params.items()}
+        st = tx.init(jp)
+        for t in range(4):
+            deltas, st = tx.update(
+                {k: jnp.asarray(v) for k, v in grads[t].items()}, st, jp)
+            jp = jax.tree.map(lambda p, d: p + d, jp, deltas)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(jp[k]), want[k],
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_factory_dispatch_distinct(self):
+        from types import SimpleNamespace
+        from deepfake_detection_tpu.optim import create_optimizer
+        for name in ("novograd", "nvnovograd"):
+            cfg = SimpleNamespace(opt=name, opt_eps=1e-8, momentum=0.9,
+                                  weight_decay=1e-5, lr=1e-3)
+            tx = create_optimizer(cfg)
+            params = {"kernel": jnp.ones((3, 3)), "bias": jnp.ones((3,))}
+            st = tx.init(params)
+            deltas, _ = tx.update(
+                {"kernel": jnp.ones((3, 3)) * 0.1,
+                 "bias": jnp.ones((3,)) * 0.1}, st, params)
+            assert all(bool(jnp.all(jnp.isfinite(d)))
+                       for d in jax.tree.leaves(deltas)), name

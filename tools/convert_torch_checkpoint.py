@@ -1,0 +1,180 @@
+"""PyTorch → Flax checkpoint converter (SURVEY.md §7 hard part #6).
+
+Converts the reference's torch ``.pth.tar`` checkpoints (most importantly the
+released ``model_half.pth.tar`` for ``efficientnet_deepfake_v4``, reference
+``README.md:35-40`` / ``dfd/runners/test.py:64``) into this package's msgpack
+model-checkpoint format so the "AUC ≥ released GPU checkpoint" comparison can
+run on TPU.
+
+Handles (reference ``dfd/timm/models/helpers.py:19-43``):
+* ``module.``-prefix stripping (DDP wrapping),
+* the ``state_dict`` / ``state_dict_ema`` streams inside a dict checkpoint,
+* NCHW→NHWC weight layout: conv OIHW → HWIO (depthwise (C,1,kh,kw) →
+  (kh,kw,1,C) falls out of the same transpose), linear (out,in) → (in,out),
+* BN ``weight/bias`` → params ``scale/bias`` and ``running_mean/var`` →
+  the ``batch_stats`` collection; ``num_batches_tracked`` dropped.
+
+Name mapping targets the EfficientNet family — the reference's entire active
+model surface (``create_deepfake_model_v4``); the flax tree deliberately
+mirrors timm's module names (``blocks.{s}.{b}.conv_pw`` ↔
+``blocks_{s}_{b}.conv_pw``) so the translation is direct.
+
+Usage::
+
+    python tools/convert_torch_checkpoint.py model_half.pth.tar out.msgpack \
+        [--model efficientnet_deepfake_v4] [--ema] [--verify]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_BN_LEAF = {"weight": ("params", "scale"), "bias": ("params", "bias"),
+            "running_mean": ("batch_stats", "mean"),
+            "running_var": ("batch_stats", "var")}
+
+
+def _bn(base: str, leaf: str) -> Optional[Tuple[str, str]]:
+    if leaf not in _BN_LEAF:
+        return None
+    collection, name = _BN_LEAF[leaf]
+    return collection, f"{base}.bn.{name}"
+
+
+def map_key(torch_key: str) -> Optional[Tuple[str, str]]:
+    """Torch dotted key → (collection, flax dotted path); None = drop."""
+    key = torch_key
+    if key.startswith("module."):                     # DDP (helpers.py:19)
+        key = key[len("module."):]
+    if key.endswith("num_batches_tracked"):
+        return None
+    parts = key.split(".")
+    head, leaf = parts[0], parts[-1]
+    if head == "conv_stem":
+        return "params", "conv_stem.conv.conv.kernel"
+    if head == "bn1":               # stem BN (ConvBnAct names it bn1)
+        return _bn("conv_stem.bn1", leaf)
+    if head == "bn2":                                 # head BN
+        return _bn("bn2", leaf)
+    if head == "conv_head":
+        return "params", "conv_head.conv.kernel"
+    if head == "classifier":
+        return "params", ("classifier.kernel" if leaf == "weight"
+                          else "classifier.bias")
+    if head == "blocks" and len(parts) >= 4:
+        prefix = f"blocks_{parts[1]}_{parts[2]}"
+        rest = parts[3:]
+        if rest[0] == "se" and len(rest) == 3:        # se.conv_reduce/expand
+            return "params", (f"{prefix}.se.{rest[1]}.conv."
+                              + ("kernel" if leaf == "weight" else "bias"))
+        if rest[0].startswith("bn"):
+            return _bn(f"{prefix}.{rest[0]}", leaf)
+        if rest[0].startswith("conv") and leaf == "weight":
+            return "params", f"{prefix}.{rest[0]}.conv.kernel"
+    return None
+
+
+def _transform_value(flax_path: str, v: np.ndarray) -> np.ndarray:
+    if v.ndim == 4:
+        return np.transpose(v, (2, 3, 1, 0))          # OIHW → HWIO
+    if v.ndim == 2 and flax_path.endswith("kernel"):
+        return np.transpose(v, (1, 0))                # (out,in) → (in,out)
+    return v
+
+
+def convert_state_dict(sd: Dict[str, Any]) -> Dict[str, Any]:
+    """Torch state dict → {'params': tree, 'batch_stats': tree}."""
+    out: Dict[str, Dict[str, Any]] = {"params": {}, "batch_stats": {}}
+    unmapped = []
+    for k, v in sd.items():
+        mapped = map_key(k)
+        if mapped is None:
+            if not k.endswith("num_batches_tracked"):
+                unmapped.append(k)
+            continue
+        collection, path = mapped
+        arr = _transform_value(path, np.asarray(
+            v.float().cpu().numpy() if hasattr(v, "cpu") else v))
+        node = out[collection]
+        parts = path.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    if unmapped:
+        print(f"WARNING: {len(unmapped)} unmapped keys, e.g. {unmapped[:5]}",
+              file=sys.stderr)
+    return out
+
+
+def convert_checkpoint(path: str, use_ema: bool = False) -> Dict[str, Any]:
+    import torch
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    if isinstance(ckpt, dict) and "state_dict" in ckpt:
+        key = "state_dict_ema" if use_ema and "state_dict_ema" in ckpt \
+            else "state_dict"
+        sd = ckpt[key]
+    else:
+        sd = ckpt
+    return convert_state_dict(sd)
+
+
+def verify_against_model(variables: Dict[str, Any], model_name: str) -> int:
+    """Compare the converted tree against a fresh init; returns #problems."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from flax.traverse_util import flatten_dict
+
+    from deepfake_detection_tpu.models import create_model
+
+    model = create_model(model_name)
+    c = getattr(model, "in_chans", 3)
+    shapes = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((1, 64, 64, c)), training=True),
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)})
+    problems = 0
+    for coll in ("params", "batch_stats"):
+        want = flatten_dict(shapes[coll], sep=".")
+        got = flatten_dict(variables.get(coll, {}), sep=".")
+        missing = sorted(set(want) - set(got))
+        extra = sorted(set(got) - set(want))
+        shape_bad = [k for k in set(want) & set(got)
+                     if tuple(want[k].shape) != tuple(got[k].shape)]
+        print(f"verify[{coll}]: {len(want)} expected, {len(got)} converted, "
+              f"{len(missing)} missing, {len(extra)} extra, "
+              f"{len(shape_bad)} shape mismatches")
+        for k in missing[:5] + extra[:5] + shape_bad[:5]:
+            print("   ", k)
+        problems += len(missing) + len(extra) + len(shape_bad)
+    return problems
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description="Convert a reference torch checkpoint to flax msgpack")
+    ap.add_argument("torch_ckpt")
+    ap.add_argument("out_path")
+    ap.add_argument("--model", default="efficientnet_deepfake_v4")
+    ap.add_argument("--ema", action="store_true",
+                    help="convert the state_dict_ema stream")
+    ap.add_argument("--verify", action="store_true",
+                    help="check the converted tree matches --model's "
+                         "structure exactly")
+    args = ap.parse_args(argv)
+    variables = convert_checkpoint(args.torch_ckpt, use_ema=args.ema)
+    if args.verify and verify_against_model(variables, args.model):
+        print("verification FAILED", file=sys.stderr)
+        sys.exit(1)
+    from deepfake_detection_tpu.models.helpers import save_model_checkpoint
+    save_model_checkpoint(args.out_path, variables,
+                          meta={"source": args.torch_ckpt,
+                                "ema": args.ema, "arch": args.model})
+    print(f"wrote {args.out_path}")
+
+
+if __name__ == "__main__":
+    main()
