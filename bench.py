@@ -160,10 +160,16 @@ def main() -> None:
     step = make_train_step(model, tx, cross_entropy, mesh=None,
                            bn_mode="global", ema_decay=0.9998)
 
+    # several distinct device-resident batches, cycled during measurement —
+    # a single fixed batch gets memorized within ~2 steps (loss→0 in the
+    # report) and lets XLA's scheduler see an unrealistically stable stream
     rng = np.random.default_rng(0)
-    x = jax.device_put(rng.normal(size=(batch, size, size, chans))
-                       .astype(np.float32).astype(dtype))
-    y = jax.device_put(rng.integers(0, 2, batch))
+    n_batches = 4
+    xs = [jax.device_put(rng.normal(size=(batch, size, size, chans))
+                         .astype(np.float32).astype(dtype))
+          for _ in range(n_batches)]
+    ys = [jax.device_put(rng.integers(0, 2, batch)) for _ in range(n_batches)]
+    x, y = xs[0], ys[0]
     key = jax.random.PRNGKey(1)
 
     # FLOPs of the whole compiled step from XLA cost analysis
@@ -186,7 +192,8 @@ def main() -> None:
     _log(f"measuring ({steps} steps) ...")
     t0 = time.perf_counter()
     for i in range(steps):
-        state, metrics = step(state, x, y, jax.random.fold_in(key, 100 + i))
+        state, metrics = step(state, xs[i % n_batches], ys[i % n_batches],
+                              jax.random.fold_in(key, 100 + i))
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
 

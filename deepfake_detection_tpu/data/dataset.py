@@ -38,10 +38,36 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 import numpy as np
 from PIL import Image
 
+from . import native
+
 __all__ = ["AugMixDataset", "DeepFakeClipDataset", "FolderDataset",
            "SyntheticDataset", "read_clip_list", "split_clips"]
 
 _IMG_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp")
+
+
+def _load_images(paths: List[str]) -> List[Image.Image]:
+    """Decode a clip's frames — C++ pool when available, PIL otherwise.
+
+    The native path decodes all of the clip's JPEG frames concurrently
+    outside the GIL (data/native.py); non-JPEG paths go straight to PIL
+    (no wasted native read), and any JPEG the native decoder rejects
+    (corrupt, exotic colorspace) falls back to PIL individually, so behavior
+    is identical either way.
+    """
+    pool = native.default_pool()
+    if pool is not None:
+        # dedup: front-padded clips repeat 0.jpg — decode it once
+        jpeg_paths = list(dict.fromkeys(
+            p for p in paths if p.lower().endswith((".jpg", ".jpeg"))))
+        decoded = dict(zip(jpeg_paths, pool.decode_files(jpeg_paths)))
+        out = []
+        for p in paths:
+            a = decoded.get(p)
+            out.append(Image.fromarray(a) if a is not None
+                       else Image.open(p).convert("RGB"))
+        return out
+    return [Image.open(p).convert("RGB") for p in paths]
 
 
 def read_clip_list(list_file: str, root_index: int = 0
@@ -193,7 +219,7 @@ class DeepFakeClipDataset:
         rng = rng if rng is not None else np.random.default_rng(
             np.random.SeedSequence([self.epoch, index]))
         paths, target = self.sample_paths(index)
-        imgs = [Image.open(p).convert("RGB") for p in paths]
+        imgs = _load_images(paths)
         if self.transform is not None:
             imgs = self.transform(imgs, rng)
         if target == 0 and self.noise_fake:
@@ -238,7 +264,7 @@ class FolderDataset:
         rng = rng if rng is not None else np.random.default_rng(
             np.random.SeedSequence([self.epoch, index]))
         path, target = self.samples[index]
-        img = Image.open(path).convert("RGB")
+        img = _load_images([path])[0]
         if self.transform is not None:
             img = self.transform(img, rng)
         return img, target
@@ -316,8 +342,9 @@ class AugMixDataset:
 
     def __getitem__(self, index: int,
                     rng: Optional[np.random.Generator] = None):
+        epoch = getattr(self.dataset, "epoch", 0)
         rng = rng if rng is not None else np.random.default_rng(
-            np.random.SeedSequence([0, index]))
+            np.random.SeedSequence([epoch, index]))
         clip, target = self.dataset.__getitem__(index, rng=rng)
         clip = np.asarray(clip, dtype=np.uint8)
         views = [clip]

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.drop import DropPath
+from ..ops.flash_attention import flash_attention
 from ..parallel.ring_attention import full_attention, ring_self_attention
 from ..registry import register_model
 
@@ -46,7 +47,7 @@ class _Attention(nn.Module):
     """Multi-head self-attention with a pluggable kernel."""
     num_heads: int
     qkv_bias: bool = True
-    attn_impl: str = "full"       # 'full' | 'ring' | 'ulysses'
+    attn_impl: str = "full"       # 'full' | 'flash' | 'ring' | 'ulysses'
     sp_mesh: Any = None           # jax.sharding.Mesh for ring/ulysses
     seq_axis: str = "data"
     dtype: Any = None
@@ -59,7 +60,10 @@ class _Attention(nn.Module):
                        name="qkv")(x)
         q, k, v = jnp.split(qkv.reshape(B, L, 3, H, C // H), 3, axis=2)
         q, k, v = (t[:, :, 0] for t in (q, k, v))      # (B, L, H, D)
-        if self.attn_impl == "full" or self.sp_mesh is None:
+        if self.attn_impl == "flash":
+            # fused Pallas kernel: scores stay in VMEM, O(L) HBM traffic
+            out = flash_attention(q, k, v)
+        elif self.attn_impl == "full" or self.sp_mesh is None:
             out = full_attention(q, k, v)
         else:
             out = ring_self_attention(q, k, v, self.sp_mesh,

@@ -1,0 +1,337 @@
+"""Fused flash attention as Pallas TPU kernels (fwd + bwd, custom VJP).
+
+The reference framework has no attention op at all (its temporal axis is a
+channel concat, SURVEY.md §2.7); attention enters this framework through the
+ViT stretch configs (BASELINE.json) and the sequence-parallel machinery in
+``parallel/ring_attention.py``.  XLA's dense softmax-attention materialises
+the (L, L) score matrix in HBM — O(L²) memory traffic, which caps sequence
+length and wastes HBM bandwidth (the usual TPU bottleneck).  This module
+implements the standard blocked online-softmax formulation (FlashAttention-2
+schedule) as Pallas kernels so scores never leave VMEM:
+
+* forward:  grid over (batch·heads, Q blocks); K/V stream through VMEM in
+  BK-sized tiles under a ``fori_loop``; running max / denominator keep the
+  softmax numerically stable; the kernel also emits the per-row logsumexp
+  needed by the backward pass.
+* backward: two kernels — one gridded over K blocks (computes dK, dV by
+  streaming Q/dO blocks), one over Q blocks (computes dQ by streaming K/V
+  blocks) — the textbook split that keeps every accumulation local to the
+  grid cell writing it (no cross-cell reductions, no atomics).
+
+All matmuls run on the MXU in float32 accumulation (``preferred_element_type``)
+regardless of the bf16 inputs; masking (padded keys, causal) is computed from
+``broadcasted_iota`` inside the kernel, so padded shapes stay static.
+
+On non-TPU backends the same kernels run under the Pallas interpreter
+(``interpret=True``), which is how the CPU test suite checks parity against
+``parallel.ring_attention.full_attention`` for values *and* gradients.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover - exercised only on exotic installs
+    pltpu = None
+    _VMEM = None
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = float("-inf")
+
+
+def _vmem_spec(block_shape, index_map):
+    if _VMEM is not None:
+        return pl.BlockSpec(block_shape, index_map, memory_space=_VMEM)
+    return pl.BlockSpec(block_shape, index_map)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
+                seq_len, causal):
+    """One (bh, q-block) grid cell: stream K/V tiles, online softmax."""
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    lp = k_ref.shape[1]
+    nk = lp // block_k
+    iq = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * scale                    # (BQ, D)
+    q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(jk, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_pos = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        invalid = k_pos >= seq_len
+        if causal:
+            invalid = jnp.logical_or(invalid, k_pos > q_pos)
+        s = jnp.where(invalid, _NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))             # (BQ,)
+        # rows that have seen no valid key yet: keep exp() argument finite
+        m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.where(invalid, 0.0, p)
+        corr = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_safe))
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    if causal:
+        # blocks strictly after the diagonal contribute nothing — skip them
+        nk_eff = jax.lax.min(
+            jnp.int32(nk), ((iq + 1) * bq + block_k - 1) // block_k)
+    else:
+        nk_eff = nk
+    acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    m_safe = jnp.where(m == _NEG_INF, 0.0, m)
+    lse_ref[0] = m_safe + jnp.log(l_safe)
+
+
+def _fwd(q, k, v, scale, block_q, block_k, causal, seq_len, interpret):
+    bh, lp, d = q.shape
+    grid = (bh, lp // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
+                          seq_len=seq_len, causal=causal),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
+            _vmem_spec((1, lp, d), lambda b, i: (b, 0, 0)),
+            _vmem_spec((1, lp, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
+            _vmem_spec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lp, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, lp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, block_q, seq_len, causal):
+    """One (bh, k-block) grid cell: stream Q/dO tiles → dK, dV."""
+    bk, d = k_ref.shape[1], k_ref.shape[2]
+    lp = q_ref.shape[1]
+    nq = lp // block_q
+    jk = pl.program_id(1)
+
+    k = k_ref[0].astype(jnp.float32)                            # (BK, D)
+    v = v_ref[0].astype(jnp.float32)
+    k_pos = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(iq, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(iq * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(iq * block_q, block_q)]
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        invalid = k_pos >= seq_len
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            invalid = jnp.logical_or(invalid, k_pos > q_pos)
+        p = jnp.where(invalid, 0.0, jnp.exp(s - lse[:, None]))   # (BQ, BK)
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    if causal:
+        # q blocks strictly before this k block's diagonal see none of it
+        iq0 = (jk * bk) // block_q
+    else:
+        iq0 = 0
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(iq0, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, scale, block_k, seq_len, causal):
+    """One (bh, q-block) grid cell: stream K/V tiles → dQ."""
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    lp = k_ref.shape[1]
+    nk = lp // block_k
+    iq = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(jk, dq):
+        k = k_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_pos = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        invalid = k_pos >= seq_len
+        if causal:
+            invalid = jnp.logical_or(invalid, k_pos > q_pos)
+        p = jnp.where(invalid, 0.0, jnp.exp(s - lse[:, None]))
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        nk_eff = jax.lax.min(
+            jnp.int32(nk), ((iq + 1) * bq + block_k - 1) // block_k)
+    else:
+        nk_eff = nk
+    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd(scale, block_q, block_k, causal, interpret, seq_len, res, g):
+    q, k, v, out, lse = res
+    do = g[0] if isinstance(g, (tuple, list)) else g
+    bh, lp, d = q.shape
+    # delta_i = rowsum(dO_i ⊙ O_i) — tiny elementwise reduce; XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    kern = functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
+                             seq_len=seq_len, causal=causal)
+    dk, dv = pl.pallas_call(
+        kern,
+        grid=(bh, lp // block_k),
+        in_specs=[
+            _vmem_spec((1, lp, d), lambda b, j: (b, 0, 0)),        # q
+            _vmem_spec((1, block_k, d), lambda b, j: (b, j, 0)),   # k
+            _vmem_spec((1, block_k, d), lambda b, j: (b, j, 0)),   # v
+            _vmem_spec((1, lp, d), lambda b, j: (b, 0, 0)),        # do
+            _vmem_spec((1, lp), lambda b, j: (b, 0)),              # lse
+            _vmem_spec((1, lp), lambda b, j: (b, 0)),              # delta
+        ],
+        out_specs=[
+            _vmem_spec((1, block_k, d), lambda b, j: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lp, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, lp, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    kern = functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k,
+                             seq_len=seq_len, causal=causal)
+    dq = pl.pallas_call(
+        kern,
+        grid=(bh, lp // block_q),
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),   # q
+            _vmem_spec((1, lp, d), lambda b, i: (b, 0, 0)),        # k
+            _vmem_spec((1, lp, d), lambda b, i: (b, 0, 0)),        # v
+            _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),   # do
+            _vmem_spec((1, block_q), lambda b, i: (b, i)),         # lse
+            _vmem_spec((1, block_q), lambda b, i: (b, i)),         # delta
+        ],
+        out_specs=_vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, lp, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = False, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Fused O(L) -memory attention.  Shapes ``(B, L, H, D) → (B, L, H, D)``
+    (same convention as :func:`parallel.ring_attention.full_attention`).
+
+    Inputs are padded to block/lane multiples (L → block, D → 128) and the
+    pad keys masked inside the kernel, so any static shape works.  Gradients
+    flow through a custom VJP whose backward is also Pallas.  ``interpret``
+    defaults to True off-TPU so tests run on the CPU interpreter.
+    """
+    assert q.ndim == 4, f"expected (B, L, H, D), got {q.shape}"
+    b, l, h, d = q.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = scale if scale is not None else d ** -0.5
+    block_q = min(block_q, _round_up(l, 128))
+    block_k = min(block_k, _round_up(l, 128))
+    lp = _round_up(l, max(block_q, block_k))
+    dp = _round_up(d, 128)
+
+    def prep(x):  # (B, L, H, D) -> (B*H, Lp, Dp)
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
+        return jnp.pad(x, ((0, 0), (0, lp - l), (0, dp - d)))
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=())
+    def _op(qp, kp, vp):
+        out, _ = _fwd_call(qp, kp, vp)
+        return out
+
+    def _op_fwd(qp, kp, vp):
+        out, lse = _fwd_call(qp, kp, vp)
+        return out, (qp, kp, vp, out, lse)
+
+    def _fwd_call(qp, kp, vp):
+        return _fwd(qp, kp, vp, scale, block_q, block_k, causal, l,
+                    interpret)
+
+    def _op_bwd(res, g):
+        return _bwd(scale, block_q, block_k, causal, interpret, l, res, g)
+
+    _op.defvjp(_op_fwd, _op_bwd)
+
+    out = _op(prep(q), prep(k), prep(v))
+    out = out[:, :l, :d].reshape(b, h, l, d)
+    return jnp.transpose(out, (0, 2, 1, 3))

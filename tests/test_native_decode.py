@@ -1,0 +1,101 @@
+"""Native C++ JPEG decode pool: parity with PIL, pool semantics, fallback.
+
+Skips cleanly if the toolchain can't build the library (it is baked into the
+image, so in practice these always run).
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from deepfake_detection_tpu.data import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native decoder unavailable")
+
+
+@pytest.fixture(scope="module")
+def jpeg_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("jpegs")
+    rng = np.random.default_rng(0)
+    paths = []
+    for i, (h, w) in enumerate([(240, 320), (67, 123), (600, 600)]):
+        img = (rng.random((h, w, 3)) * 255).astype(np.uint8)
+        p = str(d / f"{i}.jpg")
+        Image.fromarray(img).save(p, quality=90)
+        paths.append(p)
+    return paths
+
+
+def test_decode_matches_pil(jpeg_dir):
+    # PIL links the same libjpeg, so decode must be bit-identical
+    for p in jpeg_dir:
+        a = native.decode_jpeg_file(p)
+        b = np.asarray(Image.open(p).convert("RGB"))
+        assert a is not None and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+
+
+def test_decode_bytes(jpeg_dir):
+    data = open(jpeg_dir[0], "rb").read()
+    a = native.decode_jpeg_bytes(data)
+    b = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_dct_scaled_decode(tmp_path):
+    # scale_denom decodes in the DCT domain: exact output dims = ceil(dim/n).
+    # Use a smooth gradient — on noise every downscale filter disagrees.
+    y, x = np.mgrid[0:240, 0:320]
+    img = np.stack([x % 256, y % 256, (x + y) % 256], -1).astype(np.uint8)
+    p = str(tmp_path / "grad.jpg")
+    Image.fromarray(img).save(p, quality=90)
+    full = native.decode_jpeg_file(p)
+    half = native.decode_jpeg_file(p, scale_denom=2)
+    assert half.shape == ((full.shape[0] + 1) // 2,
+                          (full.shape[1] + 1) // 2, 3)
+    ref = np.asarray(Image.fromarray(full).resize(
+        (half.shape[1], half.shape[0]), Image.BILINEAR)).astype(int)
+    assert np.abs(half.astype(int) - ref).mean() < 4
+
+
+def test_pool_batch_and_errors(jpeg_dir, tmp_path):
+    corrupt = str(tmp_path / "corrupt.jpg")
+    open(corrupt, "wb").write(b"\xff\xd8\xff\xe0 not a real jpeg")
+    pool = native.DecodePool(4)
+    try:
+        paths = list(jpeg_dir) * 3 + [corrupt, str(tmp_path / "missing.jpg")]
+        outs = pool.decode_files(paths)
+        for p, o in zip(paths[:9], outs[:9]):
+            ref = np.asarray(Image.open(p).convert("RGB"))
+            np.testing.assert_array_equal(o, ref)
+        assert outs[9] is None and outs[10] is None
+    finally:
+        pool.close()
+
+
+def test_dataset_uses_native_path(jpeg_dir, tmp_path, monkeypatch):
+    # DeepFakeClipDataset list-file layout: <root>/{fake,real}/<name>/<i>.jpg
+    root = tmp_path / "root"
+    for kind, label_clip in [("fake", "f0"), ("real", "r0")]:
+        d = root / kind / label_clip
+        d.mkdir(parents=True)
+        src = np.asarray(Image.open(jpeg_dir[0]))
+        for i in range(4):
+            Image.fromarray(src).save(str(d / f"{i}.jpg"), quality=90)
+    (root / "fake_list.txt").write_text("f0:4\n")
+    (root / "real_list.txt").write_text("r0:4\n")
+
+    from deepfake_detection_tpu.data.dataset import DeepFakeClipDataset
+    ds = DeepFakeClipDataset([str(root)])
+    imgs_native, target = ds[0]
+    assert len(imgs_native) == 4
+
+    monkeypatch.setenv("DFD_NO_NATIVE_DECODE", "1")
+    imgs_pil, target2 = ds[0]
+    assert target == target2
+    for a, b in zip(imgs_native, imgs_pil):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
