@@ -154,6 +154,8 @@ class DecodePool:
 
     def decode_files(self, paths: Sequence[str], scale_denom: int = 1
                      ) -> List[Optional[np.ndarray]]:
+        if not getattr(self, "_pool", None):
+            raise ValueError("DecodePool is closed")
         n = len(paths)
         if n == 0:
             return []

@@ -47,7 +47,7 @@ class _Attention(nn.Module):
     """Multi-head self-attention with a pluggable kernel."""
     num_heads: int
     qkv_bias: bool = True
-    attn_impl: str = "full"       # 'full' | 'flash' | 'ring' | 'ulysses'
+    attn_impl: str = "full"  # 'full'|'flash'|'ring'|'ring_flash'|'ulysses'
     sp_mesh: Any = None           # jax.sharding.Mesh for ring/ulysses
     seq_axis: str = "data"
     dtype: Any = None

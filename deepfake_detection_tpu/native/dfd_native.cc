@@ -65,7 +65,10 @@ uint8_t* decode_buffer(const uint8_t* data, size_t size, int scale_denom,
   cinfo.err = jpeg_std_error(&jerr.pub);
   jerr.pub.error_exit = error_exit;
   jerr.pub.output_message = silent_output;
-  uint8_t* out = nullptr;
+  // volatile: modified between setjmp and longjmp — without it the
+  // error-path free() would see an indeterminate value and leak every
+  // corrupt frame's row buffer
+  uint8_t* volatile out = nullptr;
 
   if (setjmp(jerr.jump)) {
     jpeg_destroy_decompress(&cinfo);

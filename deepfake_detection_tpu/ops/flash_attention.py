@@ -7,20 +7,28 @@ ViT stretch configs (BASELINE.json) and the sequence-parallel machinery in
 the (L, L) score matrix in HBM — O(L²) memory traffic, which caps sequence
 length and wastes HBM bandwidth (the usual TPU bottleneck).  This module
 implements the standard blocked online-softmax formulation (FlashAttention-2
-schedule) as Pallas kernels so scores never leave VMEM:
+schedule) as Pallas kernels so scores never leave VMEM.
 
-* forward:  grid over (batch·heads, Q blocks); K/V stream through VMEM in
-  BK-sized tiles under a ``fori_loop``; running max / denominator keep the
-  softmax numerically stable; the kernel also emits the per-row logsumexp
-  needed by the backward pass.
-* backward: two kernels — one gridded over K blocks (computes dK, dV by
-  streaming Q/dO blocks), one over Q blocks (computes dQ by streaming K/V
-  blocks) — the textbook split that keeps every accumulation local to the
-  grid cell writing it (no cross-cell reductions, no atomics).
+All three kernels use the canonical TPU grid structure: the *tile* axis is
+the innermost (sequential) grid dimension, so Pallas pipelines one
+``(block, d)`` tile at a time through VMEM — O(block) on-chip residency
+regardless of sequence length — while online-softmax / gradient accumulators
+live in VMEM scratch that persists across the inner grid steps:
 
-All matmuls run on the MXU in float32 accumulation (``preferred_element_type``)
-regardless of the bf16 inputs; masking (padded keys, causal) is computed from
-``broadcasted_iota`` inside the kernel, so padded shapes stay static.
+* forward:          grid (B·H, Q blocks, K tiles) — scratch (acc, m, l);
+                    emits O and the per-row logsumexp the backward reuses.
+* backward dQ:      grid (B·H, Q blocks, K tiles) — scratch dQ.
+* backward dK/dV:   grid (B·H, K blocks, Q tiles) — scratch (dK, dV);
+                    the per-(i,j) work is the FlashAttention-2 identity
+                    ``dS = P ∘ (dP − δ)`` with δ = rowsum(dO ∘ O).
+
+All matmuls run on the MXU in float32 accumulation
+(``preferred_element_type``) regardless of the bf16 inputs; masking (padded
+keys, causal) is computed from ``broadcasted_iota`` against dynamic global
+offsets held in SMEM, so the same kernels serve the standalone op (offsets
+0) and every step of ring attention (offsets = ring position, see
+``parallel/ring_attention.py``).  Fully-masked tiles are skipped with
+``pl.when``.
 
 On non-TPU backends the same kernels run under the Pallas interpreter
 (``interpret=True``), which is how the CPU test suite checks parity against
@@ -35,103 +43,152 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-
-try:  # pallas TPU backend is absent on some CPU-only installs
-    from jax.experimental.pallas import tpu as pltpu
-    _VMEM = pltpu.VMEM
-except Exception:  # pragma: no cover - exercised only on exotic installs
-    pltpu = None
-    _VMEM = None
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention"]
 
 _NEG_INF = float("-inf")
+_LANES = 128          # scalar-per-row scratch is lane-replicated to 128
 
 
 def _vmem_spec(block_shape, index_map):
-    if _VMEM is not None:
-        return pl.BlockSpec(block_shape, index_map, memory_space=_VMEM)
-    return pl.BlockSpec(block_shape, index_map)
+    return pl.BlockSpec(block_shape, index_map, memory_space=pltpu.VMEM)
+
+
+def _smem_scalar_spec():
+    """(1, 1) int32 scalar operand (offsets); scalars live in SMEM on TPU."""
+    return pl.BlockSpec((1, 1), lambda *_: (0, 0), memory_space=pltpu.SMEM)
+
+
+def _as_scalar(x) -> jnp.ndarray:
+    return jnp.asarray(x, jnp.int32).reshape(1, 1)
+
+
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct whose varying-mesh-axes set matches ``like``.
+
+    Inside ``shard_map`` (ring attention) pallas outputs must declare which
+    mesh axes they vary over; inherit that from an input operand so the same
+    kernels work standalone and under any mesh.
+    """
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _ceil_div(a, b):
+    """ceil(a / b) for possibly-negative traced ints (jnp ``//`` floors)."""
+    return -((-a) // b)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
 
 
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
-                seq_len, causal):
-    """One (bh, q-block) grid cell: stream K/V tiles, online softmax."""
+def _fwd_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, seq_len, causal):
+    """One (bh, q-block, k-tile) grid cell of the online softmax.
+
+    ``q_off``/``kv_off`` are *global* sequence offsets of this Q shard / KV
+    buffer — 0 standalone; under ring attention they locate the shard in the
+    global sequence so the causal mask is right at every ring step.
+    ``seq_len`` counts the valid (un-padded) keys in the KV buffer.
+    """
     bq, d = q_ref.shape[1], q_ref.shape[2]
-    lp = k_ref.shape[1]
-    nk = lp // block_k
+    bk = k_ref.shape[1]
     iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    nk = pl.num_programs(2)
+    q_off = q_off_ref[0, 0]
+    kv_off = kv_off_ref[0, 0]
 
-    q = q_ref[0].astype(jnp.float32) * scale                    # (BQ, D)
-    q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
 
-    def body(jk, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+    relevant = jk * bk < seq_len               # tile has ≥1 un-padded key
+    if causal:
+        last_q = q_off + (iq + 1) * bq - 1
+        relevant = jnp.logical_and(relevant, kv_off + jk * bk <= last_q)
+
+    @pl.when(relevant)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        k_pos = jk * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, block_k), 1)
-        invalid = k_pos >= seq_len
+        k_loc = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        invalid = k_loc >= seq_len
         if causal:
-            invalid = jnp.logical_or(invalid, k_pos > q_pos)
+            q_pos = q_off + iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            invalid = jnp.logical_or(invalid, kv_off + k_loc > q_pos)
         s = jnp.where(invalid, _NEG_INF, s)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))             # (BQ,)
+
+        m_prev = m_ref[:, :1]                                  # (BQ, 1)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # rows that have seen no valid key yet: keep exp() argument finite
         m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
-        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.exp(s - m_safe)
         p = jnp.where(invalid, 0.0, p)
-        corr = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_safe))
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        corr = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_safe))
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    if causal:
-        # blocks strictly after the diagonal contribute nothing — skip them
-        nk_eff = jax.lax.min(
-            jnp.int32(nk), ((iq + 1) * bq + block_k - 1) // block_k)
-    else:
-        nk_eff = nk
-    acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
-
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    m_safe = jnp.where(m == _NEG_INF, 0.0, m)
-    lse_ref[0] = m_safe + jnp.log(l_safe)
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)                   # (BQ, 1)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        m = m_ref[:, 0]
+        m_safe = jnp.where(m == _NEG_INF, 0.0, m)
+        lse_ref[0] = m_safe + jnp.log(l[:, 0])
 
 
-def _fwd(q, k, v, scale, block_q, block_k, causal, seq_len, interpret):
-    bh, lp, d = q.shape
-    grid = (bh, lp // block_q)
+def _fwd(q, k, v, scale, block_q, block_k, causal, seq_len, interpret,
+         q_off=0, kv_off=0):
+    """Padded-layout forward: (BH, Lq, D), (BH, Lk, D)² → (out, lse)."""
+    bh, lpq, d = q.shape
+    lpk = k.shape[1]
+    grid = (bh, lpq // block_q, lpk // block_k)
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
-                          seq_len=seq_len, causal=causal),
+        functools.partial(_fwd_kernel, scale=scale, seq_len=seq_len,
+                          causal=causal),
         grid=grid,
         in_specs=[
-            _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
-            _vmem_spec((1, lp, d), lambda b, i: (b, 0, 0)),
-            _vmem_spec((1, lp, d), lambda b, i: (b, 0, 0)),
+            _smem_scalar_spec(),
+            _smem_scalar_spec(),
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
-            _vmem_spec((1, block_q), lambda b, i: (b, i)),
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_q), lambda b, i, j: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lp, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, lp), jnp.float32),
+            _out_struct((bh, lpq, d), q.dtype, q),
+            _out_struct((bh, lpq), jnp.float32, q),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(_as_scalar(q_off), _as_scalar(kv_off), q, k, v)
     return out, lse
 
 
@@ -139,154 +196,190 @@ def _fwd(q, k, v, scale, block_q, block_k, causal, seq_len, interpret):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, block_q, seq_len, causal):
-    """One (bh, k-block) grid cell: stream Q/dO tiles → dK, dV."""
+def _bwd_dkv_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, seq_len, causal):
+    """One (bh, k-block, q-tile) grid cell accumulating dK, dV."""
     bk, d = k_ref.shape[1], k_ref.shape[2]
-    lp = q_ref.shape[1]
-    nq = lp // block_q
+    bq = q_ref.shape[1]
     jk = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+    q_off = q_off_ref[0, 0]
+    kv_off = kv_off_ref[0, 0]
 
-    k = k_ref[0].astype(jnp.float32)                            # (BK, D)
-    v = v_ref[0].astype(jnp.float32)
-    k_pos = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    def body(iq, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(iq * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(iq * block_q, block_q)]
+    relevant = jk * bk < seq_len
+    if causal:
+        # this q tile's last global row must reach the k block's first row
+        last_q = q_off + (iq + 1) * bq - 1
+        relevant = jnp.logical_and(relevant, kv_off + jk * bk <= last_q)
+
+    @pl.when(relevant)
+    def _accumulate():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        invalid = k_pos >= seq_len
+        k_loc = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        invalid = k_loc >= seq_len
         if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
-            invalid = jnp.logical_or(invalid, k_pos > q_pos)
-        p = jnp.where(invalid, 0.0, jnp.exp(s - lse[:, None]))   # (BQ, BK)
-        dv_new = dv + jax.lax.dot_general(
+            q_pos = q_off + iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            invalid = jnp.logical_or(invalid, kv_off + k_loc > q_pos)
+        p = jnp.where(invalid, 0.0, jnp.exp(s - lse[:, None]))  # (BQ, BK)
+        dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        dk_new = dk + jax.lax.dot_general(
+        dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk_new, dv_new
 
-    if causal:
-        # q blocks strictly before this k block's diagonal see none of it
-        iq0 = (jk * bk) // block_q
-    else:
-        iq0 = 0
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(iq0, nq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, *, scale, block_k, seq_len, causal):
-    """One (bh, q-block) grid cell: stream K/V tiles → dQ."""
+def _bwd_dq_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, dq_acc, *, scale, seq_len,
+                   causal):
+    """One (bh, q-block, k-tile) grid cell accumulating dQ."""
     bq, d = q_ref.shape[1], q_ref.shape[2]
-    lp = k_ref.shape[1]
-    nk = lp // block_k
+    bk = k_ref.shape[1]
     iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    nk = pl.num_programs(2)
+    q_off = q_off_ref[0, 0]
+    kv_off = kv_off_ref[0, 0]
 
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
-    q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    @pl.when(jk == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    def body(jk, dq):
-        k = k_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+    relevant = jk * bk < seq_len
+    if causal:
+        last_q = q_off + (iq + 1) * bq - 1
+        relevant = jnp.logical_and(relevant, kv_off + jk * bk <= last_q)
+
+    @pl.when(relevant)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        k_pos = jk * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, block_k), 1)
-        invalid = k_pos >= seq_len
+        k_loc = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        invalid = k_loc >= seq_len
         if causal:
-            invalid = jnp.logical_or(invalid, k_pos > q_pos)
+            q_pos = q_off + iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            invalid = jnp.logical_or(invalid, kv_off + k_loc > q_pos)
         p = jnp.where(invalid, 0.0, jnp.exp(s - lse[:, None]))
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(
+        dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        nk_eff = jax.lax.min(
-            jnp.int32(nk), ((iq + 1) * bq + block_k - 1) // block_k)
-    else:
-        nk_eff = nk
-    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv(q, k, v, do, lse, delta, scale, block_q, block_k, causal,
+             seq_len, interpret, q_off=0, kv_off=0):
+    """dK, dV for one KV buffer, streaming Q tiles.  Padded layout."""
+    bh, lpq, d = q.shape
+    lpk = k.shape[1]
+    kern = functools.partial(_bwd_dkv_kernel, scale=scale, seq_len=seq_len,
+                             causal=causal)
+    return pl.pallas_call(
+        kern,
+        grid=(bh, lpk // block_k, lpq // block_q),
+        in_specs=[
+            _smem_scalar_spec(),
+            _smem_scalar_spec(),
+            _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
+            _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # do
+            _vmem_spec((1, block_q), lambda b, j, i: (b, i)),         # lse
+            _vmem_spec((1, block_q), lambda b, j, i: (b, i)),         # delta
+        ],
+        out_specs=[
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            _out_struct((bh, lpk, d), jnp.float32, k),
+            _out_struct((bh, lpk, d), jnp.float32, k),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(_as_scalar(q_off), _as_scalar(kv_off), q, k, v, do, lse, delta)
+
+
+def _bwd_dq(q, k, v, do, lse, delta, scale, block_q, block_k, causal,
+            seq_len, interpret, q_off=0, kv_off=0):
+    """dQ for this Q shard against one KV buffer, streaming K tiles."""
+    bh, lpq, d = q.shape
+    lpk = k.shape[1]
+    kern = functools.partial(_bwd_dq_kernel, scale=scale, seq_len=seq_len,
+                             causal=causal)
+    return pl.pallas_call(
+        kern,
+        grid=(bh, lpq // block_q, lpk // block_k),
+        in_specs=[
+            _smem_scalar_spec(),
+            _smem_scalar_spec(),
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # do
+            _vmem_spec((1, block_q), lambda b, i, j: (b, i)),         # lse
+            _vmem_spec((1, block_q), lambda b, i, j: (b, i)),         # delta
+        ],
+        out_specs=_vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=_out_struct((bh, lpq, d), jnp.float32, q),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(_as_scalar(q_off), _as_scalar(kv_off), q, k, v, do, lse, delta)
 
 
 def _bwd(scale, block_q, block_k, causal, interpret, seq_len, res, g):
     q, k, v, out, lse = res
     do = g[0] if isinstance(g, (tuple, list)) else g
-    bh, lp, d = q.shape
     # delta_i = rowsum(dO_i ⊙ O_i) — tiny elementwise reduce; XLA fuses it
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-
-    kern = functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                             seq_len=seq_len, causal=causal)
-    dk, dv = pl.pallas_call(
-        kern,
-        grid=(bh, lp // block_k),
-        in_specs=[
-            _vmem_spec((1, lp, d), lambda b, j: (b, 0, 0)),        # q
-            _vmem_spec((1, block_k, d), lambda b, j: (b, j, 0)),   # k
-            _vmem_spec((1, block_k, d), lambda b, j: (b, j, 0)),   # v
-            _vmem_spec((1, lp, d), lambda b, j: (b, 0, 0)),        # do
-            _vmem_spec((1, lp), lambda b, j: (b, 0)),              # lse
-            _vmem_spec((1, lp), lambda b, j: (b, 0)),              # delta
-        ],
-        out_specs=[
-            _vmem_spec((1, block_k, d), lambda b, j: (b, j, 0)),
-            _vmem_spec((1, block_k, d), lambda b, j: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, lp, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, lp, d), v.dtype),
-        ],
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-
-    kern = functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k,
-                             seq_len=seq_len, causal=causal)
-    dq = pl.pallas_call(
-        kern,
-        grid=(bh, lp // block_q),
-        in_specs=[
-            _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),   # q
-            _vmem_spec((1, lp, d), lambda b, i: (b, 0, 0)),        # k
-            _vmem_spec((1, lp, d), lambda b, i: (b, 0, 0)),        # v
-            _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),   # do
-            _vmem_spec((1, block_q), lambda b, i: (b, i)),         # lse
-            _vmem_spec((1, block_q), lambda b, i: (b, i)),         # delta
-        ],
-        out_specs=_vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, lp, d), q.dtype),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    dk, dv = _bwd_dkv(q, k, v, do, lse, delta, scale, block_q, block_k,
+                      causal, seq_len, interpret)
+    dq = _bwd_dq(q, k, v, do, lse, delta, scale, block_q, block_k,
+                 causal, seq_len, interpret)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 # ---------------------------------------------------------------------------
 # public op
 # ---------------------------------------------------------------------------
-
-def _round_up(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
-
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = False, scale: Optional[float] = None,
@@ -295,9 +388,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Fused O(L) -memory attention.  Shapes ``(B, L, H, D) → (B, L, H, D)``
     (same convention as :func:`parallel.ring_attention.full_attention`).
 
-    Inputs are padded to block/lane multiples (L → block, D → 128) and the
-    pad keys masked inside the kernel, so any static shape works.  Gradients
-    flow through a custom VJP whose backward is also Pallas.  ``interpret``
+    The Q buffer pads to a ``block_q`` multiple and the KV buffer to a
+    ``block_k`` multiple (head dim to the 128-lane width); pad keys are
+    masked inside the kernel, so any static shape works.  Gradients flow
+    through a custom VJP whose backward is also Pallas.  ``interpret``
     defaults to True off-TPU so tests run on the CPU interpreter.
     """
     assert q.ndim == 4, f"expected (B, L, H, D), got {q.shape}"
@@ -307,10 +401,11 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     scale = scale if scale is not None else d ** -0.5
     block_q = min(block_q, _round_up(l, 128))
     block_k = min(block_k, _round_up(l, 128))
-    lp = _round_up(l, max(block_q, block_k))
+    lpq = _round_up(l, block_q)
+    lpk = _round_up(l, block_k)
     dp = _round_up(d, 128)
 
-    def prep(x):  # (B, L, H, D) -> (B*H, Lp, Dp)
+    def prep(x, lp):  # (B, L, H, D) -> (B*H, lp, Dp)
         x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
         return jnp.pad(x, ((0, 0), (0, lp - l), (0, dp - d)))
 
@@ -332,6 +427,6 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     _op.defvjp(_op_fwd, _op_bwd)
 
-    out = _op(prep(q), prep(k), prep(v))
+    out = _op(prep(q, lpq), prep(k, lpk), prep(v, lpk))
     out = out[:, :l, :d].reshape(b, h, l, d)
     return jnp.transpose(out, (0, 2, 1, 3))

@@ -32,8 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["ring_attention", "ulysses_attention", "ring_self_attention",
-           "full_attention"]
+__all__ = ["ring_attention", "ring_flash_attention", "ulysses_attention",
+           "ring_self_attention", "full_attention"]
 
 
 def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -116,6 +116,139 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.astype(q.dtype)
 
 
+def _merge_blocks(o, lse, o_b, lse_b):
+    """Fold a new normalized block result into the running (o, lse).
+
+    Given per-block outputs already normalized by their own softmax
+    denominators ``l_i = exp(lse_i)``, the exact combination is
+    ``o = (l₁·o₁ + l₂·o₂) / (l₁ + l₂)`` — computed in log-space for
+    stability.  This is how independently-flash-attended KV blocks compose
+    (same identity FlashAttention-2 uses across its K tiles).
+    """
+    m = jnp.maximum(lse, lse_b)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    w = jnp.where(jnp.isneginf(lse), 0.0, jnp.exp(lse - m_safe))
+    w_b = jnp.where(jnp.isneginf(lse_b), 0.0, jnp.exp(lse_b - m_safe))
+    tot = jnp.maximum(w + w_b, 1e-30)
+    o_new = (w[..., None] * o + w_b[..., None] * o_b) / tot[..., None]
+    return o_new, m_safe + jnp.log(tot)
+
+
+def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                         axis_name: str, causal: bool = False,
+                         scale: Optional[float] = None,
+                         block_q: int = 128, block_k: int = 128,
+                         interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Ring attention with fused Pallas flash blocks (the TPU production
+    path; :func:`ring_attention` is the pure-XLA reference).
+
+    Same calling convention as :func:`ring_attention` — local
+    ``(B, L_local, H, D)`` blocks inside ``shard_map``, K/V rotating via
+    ``lax.ppermute`` — but each resident block is attended by the
+    flash-attention kernel (ops/flash_attention.py), so the (Lq, Lk) score
+    tile never leaves VMEM: O(L_local) HBM traffic per step instead of the
+    XLA path's materialized per-block score matrices.  Per-block results
+    merge via the log-space identity in :func:`_merge_blocks`.
+
+    The backward is the ring schedule from the Ring Attention paper
+    (PAPERS.md): dK/dV accumulators travel the ring *with* their K/V blocks
+    (arriving home after the full cycle with every device's contribution)
+    while dQ accumulates locally; each per-block gradient is the Pallas
+    backward kernel pair, reusing the forward's global logsumexp.
+    """
+    from ..ops.flash_attention import (_bwd_dkv, _bwd_dq, _fwd, _round_up)
+
+    n = lax.axis_size(axis_name)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale_ = scale if scale is not None else d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, _round_up(lq, 128))
+    block_k = min(block_k, _round_up(lk, 128))
+    lpq, lpk = _round_up(lq, block_q), _round_up(lk, block_k)
+    dp = _round_up(d, 128)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def prep(x, l, lp):                     # (B, l, H, D) -> (BH, lp, Dp)
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
+        return jnp.pad(x, ((0, 0), (0, lp - l), (0, dp - d)))
+
+    def unprep(x, l):                       # (BH, lp, Dp) -> (B, l, H, D)
+        x = x[:, :l, :d].reshape(b, h, l, d)
+        return jnp.transpose(x, (0, 2, 1, 3))
+
+    def vary(x):
+        return lax.pcast(x, axis_name, to="varying")
+
+    # the device's ring position enters as a (float) operand, not a closure:
+    # custom_vjp functions must not close over traced values
+    def _block_fwd(t, idx, qp, k_blk, v_blk):
+        src = (idx - t) % n
+        return _fwd(qp, k_blk, v_blk, scale_, block_q, block_k, causal,
+                    lk, interpret, q_off=idx * lq, kv_off=src * lk)
+
+    @jax.custom_vjp
+    def _op(idx_f, qp, kp, vp):
+        out, _ = _op_fwd(idx_f, qp, kp, vp)
+        return out
+
+    def _op_fwd(idx_f, qp, kp, vp):
+        idx = idx_f.astype(jnp.int32)
+
+        def body(t, carry):
+            k_blk, v_blk, o, lse = carry
+            o_b, lse_b = _block_fwd(t, idx, qp, k_blk, v_blk)
+            o, lse = _merge_blocks(o, lse, o_b.astype(jnp.float32), lse_b)
+            return (lax.ppermute(k_blk, axis_name, perm),
+                    lax.ppermute(v_blk, axis_name, perm), o, lse)
+
+        o0 = vary(jnp.zeros((b * h, lpq, dp), jnp.float32))
+        lse0 = vary(jnp.full((b * h, lpq), -jnp.inf, jnp.float32))
+        # n-1 rotated steps + final resident block (no dead trailing permute)
+        k_f, v_f, o, lse = lax.fori_loop(0, n - 1, body, (kp, vp, o0, lse0))
+        o_b, lse_b = _block_fwd(n - 1, idx, qp, k_f, v_f)
+        o, lse = _merge_blocks(o, lse, o_b.astype(jnp.float32), lse_b)
+        out = o.astype(qp.dtype)
+        return out, (idx_f, qp, kp, vp, out, lse)
+
+    def _op_bwd(res, g):
+        idx_f, qp, kp, vp, out, lse = res
+        idx = idx_f.astype(jnp.int32)
+        do = g.astype(jnp.float32)
+        delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)
+
+        def body(t, carry):
+            k_blk, v_blk, dk_blk, dv_blk, dq = carry
+            src = (idx - t) % n
+            dk_p, dv_p = _bwd_dkv(qp, k_blk, v_blk, do, lse, delta, scale_,
+                                  block_q, block_k, causal, lk, interpret,
+                                  q_off=idx * lq, kv_off=src * lk)
+            dq_p = _bwd_dq(qp, k_blk, v_blk, do, lse, delta, scale_,
+                           block_q, block_k, causal, lk, interpret,
+                           q_off=idx * lq, kv_off=src * lk)
+            # dK/dV ride the ring with their block: after the full cycle
+            # each block is home, carrying every device's contribution
+            return (lax.ppermute(k_blk, axis_name, perm),
+                    lax.ppermute(v_blk, axis_name, perm),
+                    lax.ppermute(dk_blk + dk_p, axis_name, perm),
+                    lax.ppermute(dv_blk + dv_p, axis_name, perm),
+                    dq + dq_p)
+
+        dk0 = vary(jnp.zeros((b * h, lpk, dp), jnp.float32))
+        dv0 = vary(jnp.zeros((b * h, lpk, dp), jnp.float32))
+        dq0 = vary(jnp.zeros((b * h, lpq, dp), jnp.float32))
+        _, _, dk, dv, dq = lax.fori_loop(
+            0, n, body, (kp, vp, dk0, dv0, dq0))
+        return (jnp.zeros_like(idx_f), dq.astype(qp.dtype),
+                dk.astype(kp.dtype), dv.astype(vp.dtype))
+
+    _op.defvjp(_op_fwd, _op_bwd)
+    idx_f = lax.axis_index(axis_name).astype(jnp.float32)
+    out = _op(idx_f, prep(q, lq, lpq), prep(k, lk, lpk), prep(v, lk, lpk))
+    return unprep(out, lq).astype(q.dtype)
+
+
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       axis_name: str, causal: bool = False,
                       scale: Optional[float] = None) -> jnp.ndarray:
@@ -145,11 +278,20 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         causal: bool = False,
                         impl: str = "ring") -> jnp.ndarray:
     """shard_map wrapper: global (B, L, H, D) arrays, sequence sharded over
-    ``seq_axis`` of ``mesh``; batch replicated across that axis."""
+    ``seq_axis`` of ``mesh``; batch replicated across that axis.
+
+    ``impl='ring_flash'`` fuses each per-block attention into the Pallas
+    flash kernel (the TPU production path); its shard_map sets
+    ``check_vma=False`` because the Pallas *interpreter* (CPU tests) mixes
+    its own non-varying block counters with varying refs, which the vma
+    checker rejects — the computation itself is identical.
+    """
     from jax import shard_map
-    fn = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
+    fn = {"ring": ring_attention, "ring_flash": ring_flash_attention,
+          "ulysses": ulysses_attention}[impl]
     spec = P(None, seq_axis, None, None)
     sharded = shard_map(
         functools.partial(fn, axis_name=seq_axis, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=impl != "ring_flash")
     return sharded(q, k, v)

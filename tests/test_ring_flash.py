@@ -1,0 +1,71 @@
+"""Fused ring attention (Pallas blocks over a ppermute ring) vs dense.
+
+Runs on the 8-virtual-device CPU mesh; the Pallas kernels execute under the
+interpreter, the ring schedule (ppermute of K/V forward, of dK/dV backward)
+is the real compiled collective program.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deepfake_detection_tpu.parallel.ring_attention import (
+    full_attention, ring_self_attention)
+
+
+def _qkv(b, l, h, d, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, l, h, d)) for k in ks)
+
+
+@pytest.fixture()
+def sp_mesh(devices):
+    return Mesh(np.asarray(devices[:4]), ("sp",))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_dense(sp_mesh, causal):
+    # L_local = 96: exercises both seq padding (96→128) and D padding
+    q, k, v = _qkv(2, 4 * 96, 2, 32)
+    out = jax.jit(lambda q, k, v: ring_self_attention(
+        q, k, v, sp_mesh, seq_axis="sp", causal=causal,
+        impl="ring_flash"))(q, k, v)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_grads_match_dense(sp_mesh, causal):
+    q, k, v = _qkv(1, 4 * 64, 2, 32, seed=1)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_self_attention(
+            q, k, v, sp_mesh, seq_axis="sp", causal=causal,
+            impl="ring_flash") ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=causal) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd, name in zip(g_ring, g_dense, "qkv"):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_ring_flash_agrees_with_xla_ring(sp_mesh):
+    # the two ring implementations are independent programs; agreement is a
+    # strong cross-check of both
+    q, k, v = _qkv(2, 4 * 128, 2, 64, seed=2)
+    o1 = jax.jit(lambda q, k, v: ring_self_attention(
+        q, k, v, sp_mesh, seq_axis="sp", impl="ring"))(q, k, v)
+    o2 = jax.jit(lambda q, k, v: ring_self_attention(
+        q, k, v, sp_mesh, seq_axis="sp", impl="ring_flash"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                               atol=2e-5, rtol=2e-5)
