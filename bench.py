@@ -150,8 +150,12 @@ def main() -> None:
         make_train_step
 
     _log("building + initializing model ...")
+    extra = {}
+    if os.environ.get("BENCH_ATTN"):      # ViT attention impl: full|flash
+        extra["attn_impl"] = os.environ["BENCH_ATTN"]
     model = create_model(model_name, num_classes=2, in_chans=chans,
-                         dtype=dtype if dtype != jnp.float32 else None)
+                         dtype=dtype if dtype != jnp.float32 else None,
+                         **extra)
     variables = init_model(model, jax.random.PRNGKey(0),
                            (2, size, size, chans), training=True)
     cfg = SimpleNamespace(opt="rmsproptf", opt_eps=1e-8, momentum=0.9,

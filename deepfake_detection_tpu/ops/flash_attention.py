@@ -43,7 +43,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+try:  # pallas TPU backend is absent on some CPU-only installs; the rest of
+    # the package (and the interpreter path) must keep importing
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover - exercised only on exotic installs
+    pltpu = None
 
 __all__ = ["flash_attention"]
 
@@ -52,12 +57,24 @@ _LANES = 128          # scalar-per-row scratch is lane-replicated to 128
 
 
 def _vmem_spec(block_shape, index_map):
-    return pl.BlockSpec(block_shape, index_map, memory_space=pltpu.VMEM)
+    if pltpu is not None:
+        return pl.BlockSpec(block_shape, index_map, memory_space=pltpu.VMEM)
+    return pl.BlockSpec(block_shape, index_map)
 
 
 def _smem_scalar_spec():
     """(1, 1) int32 scalar operand (offsets); scalars live in SMEM on TPU."""
-    return pl.BlockSpec((1, 1), lambda *_: (0, 0), memory_space=pltpu.SMEM)
+    if pltpu is not None:
+        return pl.BlockSpec((1, 1), lambda *_: (0, 0),
+                            memory_space=pltpu.SMEM)
+    return pl.BlockSpec((1, 1), lambda *_: (0, 0))
+
+
+def _scratch(shape):
+    """float32 VMEM scratch buffer declaration."""
+    if pltpu is not None:
+        return pltpu.VMEM(shape, jnp.float32)
+    return pl.MemoryRef(shape, jnp.float32)  # interpreter fallback
 
 
 def _as_scalar(x) -> jnp.ndarray:
@@ -75,11 +92,6 @@ def _out_struct(shape, dtype, like):
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
-
-
-def _ceil_div(a, b):
-    """ceil(a / b) for possibly-negative traced ints (jnp ``//`` floors)."""
-    return -((-a) // b)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -152,9 +164,13 @@ def _fwd_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-30)                   # (BQ, 1)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        m = m_ref[:, 0]
+        m = m_ref[:, :1]
         m_safe = jnp.where(m == _NEG_INF, 0.0, m)
-        lse_ref[0] = m_safe + jnp.log(l[:, 0])
+        # lse is lane-replicated to the 128-wide tile (Mosaic requires the
+        # last two block dims be (8·k, 128); same layout as the reference
+        # jax.experimental.pallas TPU flash kernel's residuals)
+        lse_ref[0] = jnp.broadcast_to(m_safe + jnp.log(l),
+                                      lse_ref.shape[1:])
 
 
 def _fwd(q, k, v, scale, block_q, block_k, causal, seq_len, interpret,
@@ -176,16 +192,16 @@ def _fwd(q, k, v, scale, block_q, block_k, causal, seq_len, interpret,
         ],
         out_specs=[
             _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            _vmem_spec((1, block_q), lambda b, i, j: (b, i)),
+            _vmem_spec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             _out_struct((bh, lpq, d), q.dtype, q),
-            _out_struct((bh, lpq), jnp.float32, q),
+            _out_struct((bh, lpq, _LANES), jnp.float32, q),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            _scratch((block_q, d)),
+            _scratch((block_q, _LANES)),
+            _scratch((block_q, _LANES)),
         ],
         interpret=interpret,
     )(_as_scalar(q_off), _as_scalar(kv_off), q, k, v)
@@ -225,8 +241,8 @@ def _bwd_dkv_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
         v = v_ref[0].astype(jnp.float32)
         q = q_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, :, :1]                                 # (BQ, 1)
+        delta = delta_ref[0, :, :1]
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         k_loc = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -235,13 +251,13 @@ def _bwd_dkv_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
             q_pos = q_off + iq * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             invalid = jnp.logical_or(invalid, kv_off + k_loc > q_pos)
-        p = jnp.where(invalid, 0.0, jnp.exp(s - lse[:, None]))  # (BQ, BK)
+        p = jnp.where(invalid, 0.0, jnp.exp(s - lse))           # (BQ, BK)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -279,8 +295,8 @@ def _bwd_dq_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, :, :1]                                 # (BQ, 1)
+        delta = delta_ref[0, :, :1]
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         k_loc = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -289,10 +305,10 @@ def _bwd_dq_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
             q_pos = q_off + iq * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             invalid = jnp.logical_or(invalid, kv_off + k_loc > q_pos)
-        p = jnp.where(invalid, 0.0, jnp.exp(s - lse[:, None]))
+        p = jnp.where(invalid, 0.0, jnp.exp(s - lse))
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -319,8 +335,8 @@ def _bwd_dkv(q, k, v, do, lse, delta, scale, block_q, block_k, causal,
             _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
             _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
             _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # do
-            _vmem_spec((1, block_q), lambda b, j, i: (b, i)),         # lse
-            _vmem_spec((1, block_q), lambda b, j, i: (b, i)),         # delta
+            _vmem_spec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+            _vmem_spec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -331,8 +347,8 @@ def _bwd_dkv(q, k, v, do, lse, delta, scale, block_q, block_k, causal,
             _out_struct((bh, lpk, d), jnp.float32, k),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
+            _scratch((block_k, d)),
+            _scratch((block_k, d)),
         ],
         interpret=interpret,
     )(_as_scalar(q_off), _as_scalar(kv_off), q, k, v, do, lse, delta)
@@ -355,21 +371,26 @@ def _bwd_dq(q, k, v, do, lse, delta, scale, block_q, block_k, causal,
             _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
             _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
             _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # do
-            _vmem_spec((1, block_q), lambda b, i, j: (b, i)),         # lse
-            _vmem_spec((1, block_q), lambda b, i, j: (b, i)),         # delta
+            _vmem_spec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=_vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=_out_struct((bh, lpq, d), jnp.float32, q),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        scratch_shapes=[_scratch((block_q, d))],
         interpret=interpret,
     )(_as_scalar(q_off), _as_scalar(kv_off), q, k, v, do, lse, delta)
+
+
+def _delta(do, out):
+    """δ = rowsum(dO ⊙ O), lane-replicated to match the lse layout."""
+    d = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    return jnp.broadcast_to(d[..., None], (*d.shape, _LANES))
 
 
 def _bwd(scale, block_q, block_k, causal, interpret, seq_len, res, g):
     q, k, v, out, lse = res
     do = g[0] if isinstance(g, (tuple, list)) else g
-    # delta_i = rowsum(dO_i ⊙ O_i) — tiny elementwise reduce; XLA fuses it
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = _delta(do, out)
     dk, dv = _bwd_dkv(q, k, v, do, lse, delta, scale, block_q, block_k,
                       causal, seq_len, interpret)
     dq = _bwd_dq(q, k, v, do, lse, delta, scale, block_q, block_k,

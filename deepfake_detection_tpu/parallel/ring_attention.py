@@ -181,20 +181,28 @@ def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     def vary(x):
         return lax.pcast(x, axis_name, to="varying")
 
-    # the device's ring position enters as a (float) operand, not a closure:
-    # custom_vjp functions must not close over traced values
+    # K/V (and dK/dV in the backward) travel the ring in their raw
+    # (B, l, H, D) layout: the ppermute link is the scarce ICI resource,
+    # and padding to (BH, lp, 128·k) is a cheap *local* copy done fresh at
+    # each step inside the kernel call.
+    #
+    # The device's ring position enters as a (float) operand, not a closure:
+    # custom_vjp functions must not close over traced values.
     def _block_fwd(t, idx, qp, k_blk, v_blk):
         src = (idx - t) % n
-        return _fwd(qp, k_blk, v_blk, scale_, block_q, block_k, causal,
-                    lk, interpret, q_off=idx * lq, kv_off=src * lk)
+        o_b, lse_b = _fwd(qp, prep(k_blk, lk, lpk), prep(v_blk, lk, lpk),
+                          scale_, block_q, block_k, causal, lk, interpret,
+                          q_off=idx * lq, kv_off=src * lk)
+        return o_b, lse_b[:, :, 0]       # lse arrives lane-replicated
 
     @jax.custom_vjp
-    def _op(idx_f, qp, kp, vp):
-        out, _ = _op_fwd(idx_f, qp, kp, vp)
+    def _op(idx_f, q, k, v):
+        out, _ = _op_fwd(idx_f, q, k, v)
         return out
 
-    def _op_fwd(idx_f, qp, kp, vp):
+    def _op_fwd(idx_f, q, k, v):
         idx = idx_f.astype(jnp.int32)
+        qp = prep(q, lq, lpq)
 
         def body(t, carry):
             k_blk, v_blk, o, lse = carry
@@ -206,47 +214,53 @@ def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         o0 = vary(jnp.zeros((b * h, lpq, dp), jnp.float32))
         lse0 = vary(jnp.full((b * h, lpq), -jnp.inf, jnp.float32))
         # n-1 rotated steps + final resident block (no dead trailing permute)
-        k_f, v_f, o, lse = lax.fori_loop(0, n - 1, body, (kp, vp, o0, lse0))
+        k_f, v_f, o, lse = lax.fori_loop(0, n - 1, body, (k, v, o0, lse0))
         o_b, lse_b = _block_fwd(n - 1, idx, qp, k_f, v_f)
         o, lse = _merge_blocks(o, lse, o_b.astype(jnp.float32), lse_b)
-        out = o.astype(qp.dtype)
-        return out, (idx_f, qp, kp, vp, out, lse)
+        out_p = o.astype(q.dtype)
+        return unprep(out_p, lq), (idx_f, q, k, v, out_p, lse)
 
     def _op_bwd(res, g):
-        idx_f, qp, kp, vp, out, lse = res
+        from ..ops.flash_attention import _LANES, _delta
+        idx_f, q, k, v, out_p, lse2 = res
         idx = idx_f.astype(jnp.int32)
-        do = g.astype(jnp.float32)
-        delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)
+        qp = prep(q, lq, lpq)
+        do = prep(g, lq, lpq).astype(jnp.float32)
+        delta = _delta(do, out_p)
+        # kernels expect the lane-replicated lse layout
+        lse = jnp.broadcast_to(lse2[..., None], (*lse2.shape, _LANES))
 
         def body(t, carry):
             k_blk, v_blk, dk_blk, dv_blk, dq = carry
             src = (idx - t) % n
-            dk_p, dv_p = _bwd_dkv(qp, k_blk, v_blk, do, lse, delta, scale_,
+            kp_t = prep(k_blk, lk, lpk)
+            vp_t = prep(v_blk, lk, lpk)
+            dk_p, dv_p = _bwd_dkv(qp, kp_t, vp_t, do, lse, delta, scale_,
                                   block_q, block_k, causal, lk, interpret,
                                   q_off=idx * lq, kv_off=src * lk)
-            dq_p = _bwd_dq(qp, k_blk, v_blk, do, lse, delta, scale_,
+            dq_p = _bwd_dq(qp, kp_t, vp_t, do, lse, delta, scale_,
                            block_q, block_k, causal, lk, interpret,
                            q_off=idx * lq, kv_off=src * lk)
-            # dK/dV ride the ring with their block: after the full cycle
-            # each block is home, carrying every device's contribution
+            # dK/dV ride the ring with their block (raw layout, f32): after
+            # the full cycle each block is home with every device's
+            # contribution
             return (lax.ppermute(k_blk, axis_name, perm),
                     lax.ppermute(v_blk, axis_name, perm),
-                    lax.ppermute(dk_blk + dk_p, axis_name, perm),
-                    lax.ppermute(dv_blk + dv_p, axis_name, perm),
+                    lax.ppermute(dk_blk + unprep(dk_p, lk), axis_name, perm),
+                    lax.ppermute(dv_blk + unprep(dv_p, lk), axis_name, perm),
                     dq + dq_p)
 
-        dk0 = vary(jnp.zeros((b * h, lpk, dp), jnp.float32))
-        dv0 = vary(jnp.zeros((b * h, lpk, dp), jnp.float32))
+        dk0 = vary(jnp.zeros((b, lk, h, d), jnp.float32))
+        dv0 = vary(jnp.zeros((b, lk, h, d), jnp.float32))
         dq0 = vary(jnp.zeros((b * h, lpq, dp), jnp.float32))
         _, _, dk, dv, dq = lax.fori_loop(
-            0, n, body, (kp, vp, dk0, dv0, dq0))
-        return (jnp.zeros_like(idx_f), dq.astype(qp.dtype),
-                dk.astype(kp.dtype), dv.astype(vp.dtype))
+            0, n, body, (k, v, dk0, dv0, dq0))
+        return (jnp.zeros_like(idx_f), unprep(dq, lq).astype(q.dtype),
+                dk.astype(k.dtype), dv.astype(v.dtype))
 
     _op.defvjp(_op_fwd, _op_bwd)
     idx_f = lax.axis_index(axis_name).astype(jnp.float32)
-    out = _op(idx_f, prep(q, lq, lpq), prep(k, lk, lpk), prep(v, lk, lpk))
-    return unprep(out, lq).astype(q.dtype)
+    return _op(idx_f, q, k, v).astype(q.dtype)
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -281,17 +295,19 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``seq_axis`` of ``mesh``; batch replicated across that axis.
 
     ``impl='ring_flash'`` fuses each per-block attention into the Pallas
-    flash kernel (the TPU production path); its shard_map sets
-    ``check_vma=False`` because the Pallas *interpreter* (CPU tests) mixes
-    its own non-varying block counters with varying refs, which the vma
-    checker rejects — the computation itself is identical.
+    flash kernel (the TPU production path).  Off-TPU its shard_map sets
+    ``check_vma=False`` because the Pallas *interpreter* mixes its own
+    non-varying block counters with varying refs, which the vma checker
+    rejects — on TPU (compiled Mosaic) the check stays on.
     """
     from jax import shard_map
     fn = {"ring": ring_attention, "ring_flash": ring_flash_attention,
           "ulysses": ulysses_attention}[impl]
     spec = P(None, seq_axis, None, None)
+    interpreted_flash = (impl == "ring_flash"
+                         and jax.default_backend() != "tpu")
     sharded = shard_map(
         functools.partial(fn, axis_name=seq_axis, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=impl != "ring_flash")
+        check_vma=not interpreted_flash)
     return sharded(q, k, v)
