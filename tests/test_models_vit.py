@@ -50,7 +50,7 @@ class TestSequenceParallel:
                             attn_impl=impl, sp_mesh=mesh)
         return m_full, m_sp
 
-    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    @pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
     def test_sp_attention_matches_full(self, devices, impl):
         """128 tokens sharded 8-ways through the SP kernels must match the
         dense forward (BASELINE.json: 'ViT … stress XLA attention path')."""
