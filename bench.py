@@ -118,8 +118,49 @@ def _peak_flops(device) -> float:
     return 275e12   # unknown TPU: assume v4-class
 
 
+def _probe_execution(devices) -> None:
+    """Fail fast if the backend lists a device but can't actually run.
+
+    Round-3 postmortem: during an axon relay outage ``jax.devices()``
+    returns [TPU v5 lite0] instantly while the first *execution* blocks
+    forever — init watchdogs never fire and the run eats the full
+    BENCH_RUN_TIMEOUT before falling back.  A tiny matmul with a short
+    watchdog converts that 15-minute stall into a 2-minute CPU fallback.
+    """
+    import threading
+
+    if devices[0].platform != "tpu":
+        return
+    box: dict = {}
+
+    def probe() -> None:
+        try:
+            import jax
+            import jax.numpy as jnp
+            y = jax.jit(lambda x: x @ x)(jnp.ones((256, 256)))
+            jax.block_until_ready(y)
+            box["ok"] = True
+        except BaseException as e:  # noqa: BLE001
+            box["error"] = repr(e)
+
+    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+    _log(f"probing device execution (watchdog {timeout:.0f}s) ...")
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        _reexec_cpu(f"device execution probe hung for {timeout:.0f}s "
+                    f"(relay outage?)")
+    if "error" in box:
+        # raise instead of falling back so _run_watched's one-retry policy
+        # for transient relay faults applies before demoting to CPU
+        raise RuntimeError(f"device execution probe failed: {box['error']}")
+    _log("device executes ok")
+
+
 def main() -> None:
     devices = _init_backend()
+    _probe_execution(devices)
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -17,7 +17,8 @@ from .helpers import (load_checkpoint, load_pretrained, load_state_dict,
 for _mod in ("resnet", "xception", "senet", "vit", "mobilenetv3", "densenet",
              "inception_v3", "inception_v4", "inception_resnet_v2", "dpn",
              "hrnet", "dla", "res2net", "sknet", "selecsls", "nasnet",
-             "pnasnet", "gluon_resnet", "gluon_xception", "video"):
+             "pnasnet", "gluon_resnet", "gluon_xception", "timesformer",
+             "video"):
     try:
         __import__(f"{__name__}.{_mod}")
     except ModuleNotFoundError as e:      # tolerate only a missing family
