@@ -30,12 +30,10 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 from ..ops.drop import DropPath
-from ..ops.flash_attention import flash_attention
-from ..parallel.ring_attention import full_attention, ring_self_attention
 from ..registry import register_model
+from .vit import _Attention
 
 __all__ = ["TimeSformer"]
 
@@ -47,36 +45,6 @@ def _cfg(**kwargs):
                first_conv="patch_embed", classifier="head")
     cfg.update(kwargs)
     return cfg
-
-
-class _MHA(nn.Module):
-    """Multi-head attention over (B, L, C) with a pluggable kernel."""
-    num_heads: int
-    attn_impl: str = "full"
-    sp_mesh: Any = None
-    seq_axis: str = "data"
-    qkv_bias: bool = True
-    dtype: Any = None
-
-    @nn.compact
-    def __call__(self, x):
-        B, L, C = x.shape
-        H = self.num_heads
-        qkv = nn.Dense(3 * C, use_bias=self.qkv_bias, dtype=self.dtype,
-                       name="qkv")(x)
-        q, k, v = jnp.split(qkv.reshape(B, L, 3, H, C // H), 3, axis=2)
-        q, k, v = (t[:, :, 0] for t in (q, k, v))
-        if self.attn_impl == "flash":
-            out = flash_attention(q, k, v)
-        elif self.attn_impl in ("ring", "ring_flash", "ulysses") \
-                and self.sp_mesh is not None:
-            out = ring_self_attention(q, k, v, self.sp_mesh,
-                                      seq_axis=self.seq_axis,
-                                      impl=self.attn_impl)
-        else:
-            out = full_attention(q, k, v)
-        return nn.Dense(C, dtype=self.dtype, name="proj")(
-            out.reshape(B, L, C))
 
 
 class _DividedBlock(nn.Module):
@@ -104,16 +72,17 @@ class _DividedBlock(nn.Module):
         y = nn.LayerNorm(dtype=self.dtype, name="norm_t")(x)
         y = y.transpose(0, 2, 1, 3).reshape(B * N, F, C)
         # F is tiny (4): always the dense kernel — one fused batched GEMM
-        y = _MHA(self.num_heads, attn_impl="full", dtype=self.dtype,
-                 name="attn_t")(y)
+        y = _Attention(self.num_heads, attn_impl="full", dtype=self.dtype,
+                       name="attn_t")(y)
         y = y.reshape(B, N, F, C).transpose(0, 2, 1, 3)
         x = x + droppath("dp_t", y)
 
         # spatial: patches attend within their own frame
         y = nn.LayerNorm(dtype=self.dtype, name="norm_s")(x)
-        y = _MHA(self.num_heads, self.attn_impl, self.sp_mesh,
-                 self.seq_axis, dtype=self.dtype,
-                 name="attn_s")(y.reshape(B * F, N, C))
+        y = _Attention(self.num_heads, attn_impl=self.attn_impl,
+                       sp_mesh=self.sp_mesh, seq_axis=self.seq_axis,
+                       dtype=self.dtype,
+                       name="attn_s")(y.reshape(B * F, N, C))
         y = y.reshape(B, F, N, C)
         x = x + droppath("dp_s", y)
 
@@ -206,8 +175,11 @@ def _register():
         def fn(pretrained=False, *, _p=p, _dim=dim, _depth=depth,
                _heads=heads, _size=size, **kwargs):
             kwargs.pop("pretrained", None)
+            # default_cfg channels must track the constructed in_chans
+            # (create_model always passes one, default 3 ⇒ single frame)
+            in_chans = kwargs.get("in_chans", 12)
             kwargs.setdefault("default_cfg",
-                              _cfg(input_size=(12, _size, _size)))
+                              _cfg(input_size=(in_chans, _size, _size)))
             return TimeSformer(patch_size=_p, embed_dim=_dim, depth=_depth,
                                num_heads=_heads, **kwargs)
         fn.__name__ = name

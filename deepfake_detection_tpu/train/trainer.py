@@ -21,7 +21,7 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import numpy as np
 
-from ..utils.metrics import AverageMeter
+from ..utils.metrics import AverageMeter, auc
 from .state import TrainState, get_learning_rate, set_learning_rate
 
 _logger = logging.getLogger(__name__)
@@ -170,6 +170,7 @@ def validate(eval_step: Callable, state: TrainState, loader, cfg,
     to the validity mask on padded batches."""
     batch_time_m = AverageMeter()
     losses_m, prec1_m = AverageMeter(), AverageMeter()
+    all_scores, all_labels, all_valid = [], [], []
     end = time.time()
     num_batches = len(loader)
     last_idx = num_batches - 1
@@ -182,6 +183,26 @@ def validate(eval_step: Callable, state: TrainState, loader, cfg,
         if n > 0:
             losses_m.update(float(metrics["loss"]), n)
             prec1_m.update(float(metrics["prec1"]), n)
+        logits = metrics.get("logits")
+        if logits is not None and logits.shape[-1] == 2:
+            # P(real): labels are 0=fake / 1=real, so AUC ranks real above
+            # fake (the released-checkpoint quality gate, BASELINE.md)
+            scores = jax.nn.softmax(logits, axis=-1)[:, 1]
+            y_h, v_h = y, valid
+            if jax.process_count() > 1:
+                # the global batch spans non-addressable devices; gather it
+                # before pulling to host
+                from jax.experimental import multihost_utils
+                gathered = multihost_utils.process_allgather(
+                    (scores, y) if valid is None else (scores, y, valid),
+                    tiled=True)
+                scores, y_h = gathered[0], gathered[1]
+                v_h = gathered[2] if valid is not None else None
+            scores = np.asarray(scores, np.float32).reshape(-1)
+            all_scores.append(scores)
+            all_labels.append(np.asarray(y_h).reshape(-1))
+            all_valid.append(np.ones(len(scores)) if v_h is None
+                             else np.asarray(v_h, np.float32).reshape(-1))
         batch_time_m.update(time.time() - end)
         if batch_idx == last_idx or batch_idx % cfg.log_interval == 0:
             _logger.info(
@@ -191,4 +212,10 @@ def validate(eval_step: Callable, state: TrainState, loader, cfg,
                 batch_time_m.val, batch_time_m.avg,
                 losses_m.val, losses_m.avg, prec1_m.val, prec1_m.avg)
         end = time.time()
-    return OrderedDict([("loss", losses_m.avg), ("prec1", prec1_m.avg)])
+    out = OrderedDict([("loss", losses_m.avg), ("prec1", prec1_m.avg)])
+    if all_scores:
+        out["auc"] = float(auc(np.concatenate(all_scores),
+                               np.concatenate(all_labels),
+                               np.concatenate(all_valid)))
+        _logger.info("%s: AUC %.5f", log_name, out["auc"])
+    return out

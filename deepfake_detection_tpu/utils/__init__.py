@@ -2,5 +2,5 @@
 
 from .ema import init_ema, update_ema
 from .log import FormatterNoInfo, setup_default_logging
-from .metrics import AverageMeter, accuracy, masked_mean
+from .metrics import AverageMeter, accuracy, auc, masked_mean
 from .summary import get_outdir, natural_key, plot_csv, update_summary
