@@ -126,15 +126,9 @@ class EfficientNet(nn.Module):
             f"expected {self.in_chans} input channels (NHWC), got {x.shape}"
         act = get_act_fn(self.act)
         bnk = self._bn_kwargs()
-        assert self.remat_policy in ("none", "full", "dots"), \
-            f"remat_policy must be none|full|dots, got {self.remat_policy!r}"
-        if self.remat_policy == "none":
-            block_types = _BLOCK_TYPES
-        else:   # per-block remat; param names are unchanged by nn.remat
-            policy = None if self.remat_policy == "full" \
-                else jax.checkpoint_policies.checkpoint_dots
-            block_types = {k: nn.remat(v, policy=policy, static_argnums=(2,))
-                           for k, v in _BLOCK_TYPES.items()}
+        from .helpers import maybe_remat
+        block_types = {k: maybe_remat(v, self.remat_policy)
+                       for k, v in _BLOCK_TYPES.items()}
         # stem: conv 3x3 s2 (reference efficientnet.py:275-279)
         x = ConvBnAct(self.stem_size, 3, stride=2, act=self.act, **bnk,
                       name="conv_stem")(x, training=training)

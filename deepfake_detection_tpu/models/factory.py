@@ -25,6 +25,8 @@ __all__ = ["create_model", "create_deepfake_model", "create_deepfake_model_v3",
 
 # modules whose generators understand TF-BN kwargs (factory.py:33-38)
 _BN_KWARG_MODULES = ("efficientnet", "mobilenetv3")
+# modules that consume the remat policy (TrainConfig.checkpoint_policy)
+_REMAT_MODULES = _BN_KWARG_MODULES + ("vit", "timesformer")
 
 
 def create_model(model_name: str, pretrained: bool = False,
@@ -38,13 +40,15 @@ def create_model(model_name: str, pretrained: bool = False,
     model_args = dict(pretrained=pretrained, num_classes=num_classes,
                       in_chans=in_chans)
     if not is_model_in_modules(model_name, _BN_KWARG_MODULES):
-        for k in ("bn_tf", "bn_momentum", "bn_eps", "remat_policy"):
-            v = kwargs.pop(k, None)
-            if k == "remat_policy" and v not in (None, "none"):
-                import logging
-                logging.getLogger(__name__).warning(
-                    "remat_policy=%r is only consumed by the %s families; "
-                    "ignored for %s", v, _BN_KWARG_MODULES, model_name)
+        for k in ("bn_tf", "bn_momentum", "bn_eps"):
+            kwargs.pop(k, None)
+    if not is_model_in_modules(model_name, _REMAT_MODULES):
+        v = kwargs.pop("remat_policy", None)
+        if v not in (None, "none"):
+            import logging
+            logging.getLogger(__name__).warning(
+                "remat_policy=%r is only consumed by the %s families; "
+                "ignored for %s", v, _REMAT_MODULES, model_name)
     dcr = kwargs.pop("drop_connect_rate", None)
     if dcr is not None and "drop_path_rate" not in kwargs:
         kwargs["drop_path_rate"] = dcr

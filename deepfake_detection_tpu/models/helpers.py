@@ -26,7 +26,8 @@ from flax.core import freeze, unfreeze
 
 _logger = logging.getLogger(__name__)
 
-__all__ = ["save_model_checkpoint", "load_state_dict", "load_checkpoint",
+__all__ = ["maybe_remat",
+           "save_model_checkpoint", "load_state_dict", "load_checkpoint",
            "resume_checkpoint", "load_pretrained", "filter_shape_mismatch",
            "adapt_input_params"]
 
@@ -195,3 +196,21 @@ def load_pretrained(init_variables, checkpoint_path: str, num_classes: int,
                 strict = False
     merged, _ = filter_shape_mismatch(init_variables, loaded)
     return merged
+
+
+def maybe_remat(block_cls, policy: str):
+    """Wrap a block Module class for rematerialization (shared policy
+    surface of EfficientNet/ViT/TimeSformer; TrainConfig.checkpoint_policy).
+
+    'none' — save all activations; 'full' — recompute the whole block in
+    the backward pass; 'dots' — save only matmul/conv outputs.  Blocks must
+    take ``training`` as their second positional argument (static).
+    """
+    import flax.linen as nn
+    assert policy in ("none", "full", "dots"), \
+        f"remat policy must be none|full|dots, got {policy!r}"
+    if policy == "none":
+        return block_cls
+    jpolicy = None if policy == "full" \
+        else jax.checkpoint_policies.checkpoint_dots
+    return nn.remat(block_cls, policy=jpolicy, static_argnums=(2,))

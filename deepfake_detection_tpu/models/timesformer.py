@@ -30,6 +30,7 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 
 from ..ops.drop import DropPath
 from ..registry import register_model
@@ -111,6 +112,9 @@ class TimeSformer(nn.Module):
     attn_impl: str = "full"
     sp_mesh: Any = None
     seq_axis: str = "data"
+    # remat at block boundaries: none | full | dots (same policy surface as
+    # EfficientNet / ViT)
+    remat_policy: str = "none"
     dtype: Any = None
     default_cfg: Any = None
 
@@ -140,13 +144,15 @@ class TimeSformer(nn.Module):
                          (1, frames, 1, self.embed_dim))
         x = x + pos.astype(x.dtype) + tim.astype(x.dtype)
 
+        from .helpers import maybe_remat
+        block_cls = maybe_remat(_DividedBlock, self.remat_policy)
         feats = []
         for i in range(self.depth):
             dpr = self.drop_path_rate * i / max(self.depth - 1, 1)
-            x = _DividedBlock(self.num_heads, self.mlp_ratio, dpr,
-                              self.attn_impl, self.sp_mesh, self.seq_axis,
-                              dtype=self.dtype,
-                              name=f"blocks_{i}")(x, training=training)
+            x = block_cls(self.num_heads, self.mlp_ratio, dpr,
+                          self.attn_impl, self.sp_mesh, self.seq_axis,
+                          dtype=self.dtype,
+                          name=f"blocks_{i}")(x, training)
             feats.append(x)
         x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
         if features_only:

@@ -135,6 +135,9 @@ class VisionTransformer(nn.Module):
     attn_impl: str = "full"
     sp_mesh: Any = None
     seq_axis: str = "data"
+    # remat at block boundaries (same policy surface as EfficientNet's
+    # TrainConfig.checkpoint_policy): none | full | dots
+    remat_policy: str = "none"
     dtype: Any = None
     default_cfg: Any = None
 
@@ -160,14 +163,16 @@ class VisionTransformer(nn.Module):
         x = x + pos.astype(x.dtype)
         if self.drop_rate:
             x = nn.Dropout(self.drop_rate, deterministic=not training)(x)
+        from .helpers import maybe_remat
+        block_cls = maybe_remat(_Block, self.remat_policy)
         feats = []
         for i in range(self.depth):
             # stochastic depth scales linearly over blocks (timm convention)
             dpr = self.drop_path_rate * i / max(self.depth - 1, 1)
-            x = _Block(self.num_heads, self.mlp_ratio, self.qkv_bias,
-                       self.drop_rate, dpr, self.attn_impl, self.sp_mesh,
-                       self.seq_axis, dtype=self.dtype,
-                       name=f"blocks_{i}")(x, training=training)
+            x = block_cls(self.num_heads, self.mlp_ratio, self.qkv_bias,
+                          self.drop_rate, dpr, self.attn_impl, self.sp_mesh,
+                          self.seq_axis, dtype=self.dtype,
+                          name=f"blocks_{i}")(x, training)
             feats.append(x)
         x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
         if features_only:

@@ -87,3 +87,20 @@ def test_train_step_on_mesh(mesh8):
     y = shard_batch(np.arange(8) % 2, mesh8)
     state, metrics = step(state, x, y, jax.random.PRNGKey(2))
     assert np.isfinite(float(metrics["loss"]))
+
+
+@pytest.mark.parametrize("policy", ["full", "dots"])
+def test_remat_matches_baseline(policy):
+    base = create_model("timesformer_tiny_patch16_224", num_classes=2,
+                        in_chans=12)
+    rem = create_model("timesformer_tiny_patch16_224", num_classes=2,
+                       in_chans=12, remat_policy=policy)
+    v = init_model(base, jax.random.PRNGKey(0), (1, 64, 64, 12))
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 64, 64, 12))
+    np.testing.assert_allclose(
+        np.asarray(base.apply(v, x)), np.asarray(rem.apply(v, x)), atol=5e-6)
+    g0 = jax.grad(lambda p: base.apply({"params": p}, x).sum())(v["params"])
+    g1 = jax.jit(jax.grad(
+        lambda p: rem.apply({"params": p}, x).sum()))(v["params"])
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)

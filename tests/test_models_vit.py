@@ -101,3 +101,23 @@ def test_vit_synthetic_e2e_train(tmp_path, devices):
         "--workers", "1", "--compute-dtype", "float32",
         "--output", str(tmp_path / "out")])
     assert out["best_metric"] is not None
+
+
+@pytest.mark.parametrize("policy", ["full", "dots"])
+def test_vit_remat_matches_baseline(policy):
+    """remat changes the backward schedule, not the math."""
+    base = create_model("vit_tiny_patch16_224", num_classes=2)
+    rem = create_model("vit_tiny_patch16_224", num_classes=2,
+                       remat_policy=policy)
+    v = init_model(base, jax.random.PRNGKey(0), (1, 64, 64, 3))
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 64, 64, 3))
+
+    def loss(model):
+        return lambda p: model.apply({"params": p}, x).sum()
+
+    np.testing.assert_allclose(
+        np.asarray(base.apply(v, x)), np.asarray(rem.apply(v, x)), atol=5e-6)
+    g0 = jax.grad(loss(base))(v["params"])
+    g1 = jax.jit(jax.grad(loss(rem)))(v["params"])
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
