@@ -238,3 +238,22 @@ class TestCodeReviewRegressions:
         kept = sorted(f for f in os.listdir(tmp_path / "o")
                       if f.startswith("checkpoint-"))
         assert kept == ["checkpoint-1.ckpt", "checkpoint-2.ckpt"]
+
+
+def test_profile_flag_writes_trace(tmp_path, devices):
+    """--profile N produces a jax.profiler trace directory (SURVEY §5)."""
+    from deepfake_detection_tpu.runners.train import launch_main
+    out = launch_main([
+        "--dataset", "synthetic", "--model", "mnasnet_small",
+        "--model-version", "", "--input-size-v2", "3,32,32",
+        "--batch-size", "2", "--epochs", "1", "--opt", "sgd", "--lr", "0.01",
+        "--sched", "step", "--log-interval", "10", "--workers", "1",
+        "--compute-dtype", "float32", "--profile", "2",
+        "--output", str(tmp_path / "out")])
+    assert out["best_metric"] is not None
+    run = next((tmp_path / "out").iterdir())
+    prof = run / "profile"
+    assert prof.is_dir()
+    # the trace lands as plugins/profile/<ts>/*.trace.json.gz (+ pb)
+    traced = [p for p in prof.rglob("*") if p.is_file()]
+    assert traced, "profiler produced no trace files"
