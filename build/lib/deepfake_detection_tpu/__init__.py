@@ -1,0 +1,14 @@
+"""deepfake_detection_tpu — TPU-native deepfake-detection training framework.
+
+A ground-up JAX/XLA/Flax re-design with the capabilities of the reference
+PyTorch stack at ``/root/reference`` (TARTRL/Deepfake_Detection): the timm-style
+model zoo + factory/registry, the 4-frame deepfake data pipeline, TF-parity
+optimizers/schedulers, and a pjit/mesh distributed training runtime replacing
+apex-DDP/NCCL.
+"""
+
+__version__ = "0.1.0"
+
+from . import registry
+from .config import ClusterConfig, ServerSpec, TrainConfig
+from .registry import list_models, model_entrypoint, register_model

@@ -220,9 +220,6 @@ class TrainConfig:
     mesh_shape: Optional[Tuple[int, ...]] = None   # default: (n_devices,)
     mesh_axes: Tuple[str, ...] = ("data",)
     fsdp: bool = False                   # shard params over 'data' axis
-    tp_size: int = 1     # model-axis extent for transformer tensor
-    # parallelism: builds a (data, model) 2-D mesh and applies the
-    # Megatron-paired shardings from parallel/tp.py (ViT/TimeSformer)
     checkpoint_policy: str = "none"      # remat policy: none|full|dots
 
     # ------------------------------------------------------------------

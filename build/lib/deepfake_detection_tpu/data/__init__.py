@@ -1,0 +1,23 @@
+"""Data pipeline: host decode/augment → uint8 NHWC → jitted device prologue.
+
+TPU-native re-design of ``/root/reference/dfd/timm/data/`` (SURVEY.md §2.4):
+deterministic index-space sampling replaces stateful datasets/samplers, NHWC
+uint8 host batches replace CHW float tensors, and the CUDA-stream prefetcher
+becomes a jitted normalize/cast/erase prologue with async dispatch.
+"""
+
+from .config import resolve_data_config
+from .constants import (DEFAULT_CROP_PCT, IMAGENET_DEFAULT_MEAN,
+                        IMAGENET_DEFAULT_STD, IMAGENET_INCEPTION_MEAN,
+                        IMAGENET_INCEPTION_STD)
+from .dataset import (DeepFakeClipDataset, FolderDataset, SyntheticDataset,
+                      read_clip_list, split_clips)
+from .loader import (DeviceLoader, HostLoader, create_deepfake_loader_v3,
+                     fast_collate)
+from .mixup import FastCollateMixup, mixup_batch
+from .random_erasing import RandomErasing, random_erasing
+from .samplers import OrderedShardedSampler, ShardedTrainSampler
+from .transforms_factory import (create_transform, transforms_deepfake_eval_v3,
+                                 transforms_deepfake_train_v3,
+                                 transforms_imagenet_eval,
+                                 transforms_imagenet_train)

@@ -1,0 +1,120 @@
+"""Data-config resolution.
+
+Parity with ``/root/reference/dfd/timm/data/config.py:5-101``: layered
+defaulting CLI args > model ``default_cfg`` > constants for input_size /
+interpolation / mean / std / crop_pct, the ``input_size_v2`` string parse
+(:17-21), and the per-model-family mean/std overrides (``get_mean_by_model``
+:84-101 — Inception-family models use 0.5 mean/std, DPN uses its own).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from .constants import (DEFAULT_CROP_PCT, IMAGENET_DEFAULT_MEAN,
+                        IMAGENET_DEFAULT_STD, IMAGENET_DPN_MEAN,
+                        IMAGENET_DPN_STD, IMAGENET_INCEPTION_MEAN,
+                        IMAGENET_INCEPTION_STD)
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["resolve_data_config", "get_mean_by_model", "get_std_by_model"]
+
+
+def get_mean_by_model(model_name: str):
+    model_name = model_name.lower()
+    if "dpn" in model_name:
+        return IMAGENET_DPN_MEAN
+    if "ception" in model_name or ("nasnet" in model_name
+                                   and "mnasnet" not in model_name):
+        return IMAGENET_INCEPTION_MEAN
+    return IMAGENET_DEFAULT_MEAN
+
+
+def get_std_by_model(model_name: str):
+    model_name = model_name.lower()
+    if "dpn" in model_name:
+        return IMAGENET_DPN_STD
+    if "ception" in model_name or ("nasnet" in model_name
+                                   and "mnasnet" not in model_name):
+        return IMAGENET_INCEPTION_STD
+    return IMAGENET_DEFAULT_STD
+
+
+def resolve_data_config(args: Dict[str, Any],
+                        default_cfg: Optional[Dict[str, Any]] = None,
+                        model=None, verbose: bool = True) -> Dict[str, Any]:
+    """Merge CLI args over model cfg over defaults (reference :5-81).
+
+    ``args`` is a plain dict (e.g. ``TrainConfig.to_dict()``).  Note the
+    reference resolves ``input_size`` in (C, H, W) order; that convention is
+    kept — convert to NHWC at the batch boundary.
+    """
+    new_config: Dict[str, Any] = {}
+    default_cfg = default_cfg or {}
+    if not default_cfg and model is not None and \
+            getattr(model, "default_cfg", None):
+        default_cfg = model.default_cfg
+
+    in_chans = 3
+    if args.get("chans") is not None:
+        in_chans = args["chans"]
+
+    input_size = (in_chans, 224, 224)
+    if args.get("input_size_v2") is not None:
+        v2 = args["input_size_v2"]
+        if isinstance(v2, str):
+            v2 = tuple(int(i) for i in v2.split(","))
+        input_size = tuple(v2)
+        assert len(input_size) == 3
+        in_chans = input_size[0]
+    elif args.get("input_size") is not None:
+        assert len(args["input_size"]) == 3
+        input_size = tuple(args["input_size"])
+        in_chans = input_size[0]
+    elif args.get("img_size") is not None:
+        input_size = (in_chans, args["img_size"], args["img_size"])
+    elif "input_size" in default_cfg:
+        input_size = tuple(default_cfg["input_size"])
+    new_config["input_size"] = input_size
+
+    new_config["interpolation"] = "bicubic"
+    if args.get("interpolation"):
+        new_config["interpolation"] = args["interpolation"]
+    elif default_cfg.get("interpolation"):
+        new_config["interpolation"] = default_cfg["interpolation"]
+
+    new_config["mean"] = IMAGENET_DEFAULT_MEAN
+    if "model" in args:
+        new_config["mean"] = get_mean_by_model(args["model"])
+    if args.get("mean") is not None:
+        mean = tuple(args["mean"])
+        if len(mean) == 1:
+            mean = mean * in_chans
+        new_config["mean"] = mean
+    elif "mean" in default_cfg and "model" not in args:
+        new_config["mean"] = default_cfg["mean"]
+
+    new_config["std"] = IMAGENET_DEFAULT_STD
+    if "model" in args:
+        new_config["std"] = get_std_by_model(args["model"])
+    if args.get("std") is not None:
+        std = tuple(args["std"])
+        if len(std) == 1:
+            std = std * in_chans
+        new_config["std"] = std
+    elif "std" in default_cfg and "model" not in args:
+        new_config["std"] = default_cfg["std"]
+
+    new_config["crop_pct"] = DEFAULT_CROP_PCT
+    if args.get("crop_pct") is not None:
+        new_config["crop_pct"] = args["crop_pct"]
+    elif default_cfg.get("crop_pct"):
+        new_config["crop_pct"] = default_cfg["crop_pct"]
+
+    if verbose:
+        _logger.info("Data processing configuration:")
+        for n, v in new_config.items():
+            _logger.info("\t%s: %s", n, v)
+    return new_config

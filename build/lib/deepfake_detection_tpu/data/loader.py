@@ -1,0 +1,316 @@
+"""Batch loading: host pipeline + device prologue.
+
+Re-design of ``/root/reference/dfd/timm/data/loader.py``:
+
+* ``fast_collate`` (:12-46) → :func:`fast_collate` — numpy uint8 stacking,
+  NHWC.
+* worker-process decode + transform → :class:`HostLoader` — a thread pool
+  (PIL/numpy release the GIL for decode/resize) with a bounded prefetch
+  queue; per-sample RNG derived from ``(seed, epoch, index)`` so output is
+  identical for any worker count.
+* ``PrefetchLoader_v3`` (:213-289 — CUDA-stream double buffering, fp16 cast,
+  mean/std tiled ×img_num, GPU RandomErasing) → :class:`DeviceLoader` — a
+  jitted prologue (uint8 → compute dtype, normalize, RandomErasing per frame
+  slice) dispatched asynchronously; JAX's async dispatch + donated buffers
+  replace the explicit CUDA stream dance.
+* ``create_deepfake_loader_v3`` (:724-830) → :func:`create_deepfake_loader_v3`
+  with the same knob surface.
+
+Normalization parity: mean/std are ×255 (uint8 domain) tiled to all
+``3*img_num`` channels (loader.py:228-229); casting happens *on device*, so
+host→TPU transfers stay uint8 — 4× less PCIe/DMA traffic than shipping
+floats.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .constants import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
+from .mixup import FastCollateMixup
+from .random_erasing import RandomErasing
+from .samplers import OrderedShardedSampler, ShardedTrainSampler
+from .transforms_factory import (transforms_deepfake_eval_v3,
+                                 transforms_deepfake_train_v3)
+
+__all__ = ["fast_collate", "HostLoader", "DeviceLoader",
+           "create_deepfake_loader_v3"]
+
+
+def fast_collate(samples: Sequence[Tuple[np.ndarray, int]]
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Stack uint8 NHWC samples + int labels (reference :12-46).
+
+    AugMix multi-view samples — ``(S, H, W, C)`` per sample — collate
+    split-major: ``[view0 of all samples, view1 of all samples, ...]`` with
+    labels tiled, the layout ``jsd_cross_entropy`` splits back apart
+    (reference fast_collate tuple branch, loader.py:15-27).
+    """
+    images = np.stack([s[0] for s in samples]).astype(np.uint8, copy=False)
+    targets = np.asarray([s[1] for s in samples], dtype=np.int64)
+    if images.ndim == 5:                       # (B, S, H, W, C)
+        b, s = images.shape[:2]
+        images = np.transpose(images, (1, 0, 2, 3, 4)).reshape(
+            b * s, *images.shape[2:])
+        targets = np.tile(targets, s)
+    return images, targets
+
+
+class HostLoader:
+    """Decode + transform + collate on host threads with prefetch.
+
+    Yields ``(images_uint8 (B,H,W,C), targets)`` numpy batches (targets are
+    int64, or float32 soft targets when ``collate_mixup`` is set).  A batch's
+    content is a pure function of ``(seed, epoch, batch_index)``.
+    """
+
+    def __init__(self, dataset, sampler, batch_size: int, seed: int = 42,
+                 num_workers: int = 8, prefetch_depth: int = 2,
+                 collate_mixup: Optional[FastCollateMixup] = None,
+                 valid_mask: bool = False):
+        self.dataset = dataset
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.seed = seed
+        self.num_workers = max(1, num_workers)
+        self.prefetch_depth = max(1, prefetch_depth)
+        self.collate_mixup = collate_mixup
+        self.valid_mask = valid_mask
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        self.sampler.set_epoch(epoch)
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.sampler) // self.batch_size
+
+    def _load_one(self, index: int) -> Tuple[np.ndarray, int]:
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, self.epoch, int(index)]))
+        img, target = self.dataset.__getitem__(int(index), rng=rng)
+        return np.asarray(img, dtype=np.uint8), target
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        indices = list(iter(self.sampler))
+        valid = None
+        if self.valid_mask and hasattr(self.sampler, "local_indices"):
+            out = self.sampler.local_indices()
+            if isinstance(out, tuple):
+                indices, valid = out[0].tolist(), out[1]
+        nb = len(indices) // self.batch_size
+        batches = [indices[i * self.batch_size:(i + 1) * self.batch_size]
+                   for i in range(nb)]
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            """Bounded put that keeps observing ``stop`` (an abandoned
+            consumer otherwise deadlocks the producer on the full queue)."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            with ThreadPoolExecutor(self.num_workers) as pool:
+                for bi, batch_idx in enumerate(batches):
+                    if stop.is_set():
+                        return
+                    samples = list(pool.map(self._load_one, batch_idx))
+                    images, targets = fast_collate(samples)
+                    if self.collate_mixup is not None:
+                        mrng = np.random.default_rng(np.random.SeedSequence(
+                            [self.seed, self.epoch, bi, 0x77]))
+                        images, targets = self.collate_mixup(images, targets,
+                                                             mrng)
+                    if valid is not None:
+                        vm = valid[bi * self.batch_size:(bi + 1) * self.batch_size]
+                        item: Any = (images, targets, np.asarray(vm))
+                    else:
+                        item = (images, targets)
+                    if not put(item):
+                        return
+                put(None)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            stop.set()
+
+
+class DeviceLoader:
+    """Device-side prologue with async double buffering.
+
+    The jitted prologue does uint8→``dtype`` cast, mean/std normalize (×255,
+    tiled per frame), and train-time RandomErasing — the body of the
+    reference's ``PrefetchLoader_v3.__iter__`` (loader.py:242-266) as one
+    compiled function.  Because JAX dispatch is asynchronous, iterating one
+    batch ahead gives the same copy/compute overlap the reference builds from
+    CUDA streams.
+    """
+
+    def __init__(self, loader: HostLoader,
+                 mean=IMAGENET_DEFAULT_MEAN, std=IMAGENET_DEFAULT_STD,
+                 dtype: Any = jnp.bfloat16, re_prob: float = 0.0,
+                 re_mode: str = "const", re_count: int = 1,
+                 re_num_splits: int = 0, re_max: float = 0.1,
+                 img_num: int = 4, seed: int = 0,
+                 sharding: Optional[Any] = None):
+        self.loader = loader
+        self.img_num = img_num
+        self.dtype = dtype
+        self.sharding = sharding
+        self.seed = seed
+        mean = np.tile(np.asarray(mean, np.float32) * 255.0, img_num)
+        std = np.tile(np.asarray(std, np.float32) * 255.0, img_num)
+        self._mean = mean.reshape(1, 1, 1, -1)
+        self._std = std.reshape(1, 1, 1, -1)
+        self.random_erasing = RandomErasing(
+            probability=re_prob, max_area=re_max, mode=re_mode,
+            max_count=re_count, num_splits=re_num_splits,
+            img_num=img_num) if re_prob > 0.0 else None
+        self._step = 0
+
+        mean_j = jnp.asarray(self._mean)
+        std_j = jnp.asarray(self._std)
+        erasing = self.random_erasing
+
+        def prologue(images, key):
+            x = images.astype(dtype)
+            x = (x - mean_j.astype(dtype)) / std_j.astype(dtype)
+            if erasing is not None:
+                x = erasing(key, x).astype(dtype)
+            return x
+
+        self._prologue = jax.jit(prologue)
+
+    # pass-throughs (reference :274-289)
+    @property
+    def sampler(self):
+        return self.loader.sampler
+
+    @property
+    def dataset(self):
+        return self.loader.dataset
+
+    @property
+    def mixup_enabled(self) -> bool:
+        cm = self.loader.collate_mixup
+        return bool(cm and cm.mixup_enabled)
+
+    @mixup_enabled.setter
+    def mixup_enabled(self, x: bool) -> None:
+        if self.loader.collate_mixup is not None:
+            self.loader.collate_mixup.mixup_enabled = x
+
+    def set_epoch(self, epoch: int) -> None:
+        self.loader.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def _put(self, arr: np.ndarray):
+        if self.sharding is not None:
+            from ..parallel.sharding import put_process_local
+            return put_process_local(arr, self.sharding)
+        return jax.device_put(arr)
+
+    def __iter__(self):
+        base_key = jax.random.PRNGKey(self.seed)
+        for item in self.loader:
+            images, targets = item[0], item[1]
+            key = jax.random.fold_in(base_key, self._step)
+            self._step += 1
+            x = self._prologue(self._put(images), key)
+            y = self._put(np.asarray(targets))
+            if len(item) == 3:
+                yield x, y, self._put(np.asarray(item[2]))
+            else:
+                yield x, y
+
+
+def create_deepfake_loader_v3(
+        dataset, input_size, batch_size: int, is_training: bool = False,
+        re_prob: float = 0.0, re_mode: str = "const", re_count: int = 1,
+        re_split: bool = False, re_max: float = 0.02,
+        color_jitter: Any = 0.4, num_aug_splits: int = 0,
+        mean=IMAGENET_DEFAULT_MEAN, std=IMAGENET_DEFAULT_STD,
+        num_workers: int = 1, distributed: bool = False,
+        num_shards: int = 1, shard_index: int = 0,
+        collate_mixup: Optional[FastCollateMixup] = None,
+        dtype: Any = jnp.bfloat16, flicker: float = 0.0,
+        rotate_range: float = 0, blur_radiu: float = 0,
+        blur_prob: float = 0.0, seed: int = 42, prefetch_depth: int = 2,
+        sharding: Optional[Any] = None, valid_mask: Optional[bool] = None,
+        ) -> DeviceLoader:
+    """Loader factory (reference loader.py:724-830): builds the v3 transform,
+    picks the train/eval sharded sampler, wires collate mixup and the device
+    prologue."""
+    re_num_splits = 0
+    if re_split:
+        re_num_splits = num_aug_splits or 2
+    img_size = input_size[-2:] if isinstance(input_size, (tuple, list)) \
+        else input_size
+    if isinstance(img_size, (tuple, list)) and len(img_size) == 2:
+        img_size = img_size[0] if img_size[0] == img_size[1] else tuple(img_size)
+
+    if is_training:
+        transform = transforms_deepfake_train_v3(
+            img_size, color_jitter=color_jitter, flicker=flicker,
+            rotate_range=rotate_range, blur_radiu=blur_radiu,
+            blur_prob=blur_prob)
+    else:
+        transform = transforms_deepfake_eval_v3(img_size)
+    if is_training and num_aug_splits > 1:
+        # clean + (num_aug_splits-1) AugMix views per sample, feeding the
+        # JSD consistency loss (reference dataset.py:633-670)
+        assert collate_mixup is None, \
+            "aug_splits and mixup are mutually exclusive (reference " \
+            "train.py:446 asserts num_aug_splits precludes the mixup collate)"
+        from .dataset import AugMixDataset
+        dataset = AugMixDataset(dataset, num_splits=num_aug_splits)
+    dataset.set_transform(transform)
+
+    if not distributed:
+        num_shards, shard_index = 1, 0
+    if is_training:
+        sampler: Any = ShardedTrainSampler(
+            len(dataset), num_shards=num_shards, shard_index=shard_index,
+            batch_size=batch_size, seed=seed, drop_last=True)
+    else:
+        sampler = OrderedShardedSampler(
+            len(dataset), num_shards=num_shards, shard_index=shard_index,
+            batch_size=batch_size)
+    if valid_mask is None:
+        valid_mask = not is_training
+
+    host = HostLoader(dataset, sampler, batch_size, seed=seed,
+                      num_workers=num_workers, prefetch_depth=prefetch_depth,
+                      collate_mixup=collate_mixup if is_training else None,
+                      valid_mask=valid_mask)
+    img_num = int(input_size[0] / 3) if isinstance(input_size, (tuple, list)) \
+        else 1
+    return DeviceLoader(
+        host, mean=mean, std=std, dtype=dtype,
+        re_prob=re_prob if is_training else 0.0, re_mode=re_mode,
+        re_count=re_count, re_num_splits=re_num_splits, re_max=re_max,
+        img_num=max(1, img_num), seed=seed, sharding=sharding)

@@ -1,0 +1,138 @@
+"""Device-side batched RandomErasing (jit-safe, static shapes).
+
+Re-design of ``/root/reference/dfd/timm/data/random_erasing.py:18-101``
+('Random Erasing Data Augmentation', Zhong et al.).  The reference runs a
+Python loop over batch elements on the GPU with data-dependent rectangle
+shapes; under XLA every shape must be static, so the rectangle is realised as
+a boolean mask built from ``iota`` comparisons and the erase is a ``where`` —
+one fused elementwise op over the batch, vmapped over samples and frames.
+
+Semantics parity:
+
+* modes ``const`` (zeros), ``rand`` (per-channel normal), ``pixel``
+  (per-pixel normal) (:6-15);
+* per-sample erase probability, count ∈ [min_count, max_count], area
+  fraction ∈ [min_area, max_area] / count, log-uniform aspect (:64-80);
+* the reference's 10-attempt rejection loop (:70-80) becomes 10 *parallel*
+  candidates with first-valid selection — identical acceptance distribution,
+  no data-dependent control flow;
+* multi-frame: each 3-channel frame slice of the 12-channel clip is erased
+  independently (:96-100);
+* ``num_splits``: the first ``B // num_splits`` samples (the clean aug split)
+  are skipped (:91).
+
+Layout is NHWC: ``(B, H, W, 3*img_num)``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["random_erasing", "RandomErasing"]
+
+_NUM_ATTEMPTS = 10
+
+
+def _one_erase(key: jax.Array, frame: jnp.ndarray, probability: float,
+               min_area: float, max_area: float, log_aspect_min: float,
+               log_aspect_max: float, mode: str, min_count: int,
+               max_count: int, enabled) -> jnp.ndarray:
+    """Erase one (H, W, C) frame. ``enabled`` is a traced bool (aug-split)."""
+    h_img, w_img, chans = frame.shape
+    area = h_img * w_img
+    k_gate, k_count, k_boxes, k_fill = jax.random.split(key, 4)
+
+    do_erase = (jax.random.uniform(k_gate) < probability) & enabled
+    count = jax.random.randint(k_count, (), min_count, max_count + 1)
+
+    out = frame
+    for c in range(max_count):
+        k_box = jax.random.fold_in(k_boxes, c)
+        ka, kr, kt, kl = jax.random.split(k_box, 4)
+        # 10 parallel candidates, take the first whose rect fits (:70-80)
+        target_area = jax.random.uniform(
+            ka, (_NUM_ATTEMPTS,), minval=min_area, maxval=max_area
+        ) * area / count
+        aspect = jnp.exp(jax.random.uniform(
+            kr, (_NUM_ATTEMPTS,), minval=log_aspect_min, maxval=log_aspect_max))
+        hh = jnp.round(jnp.sqrt(target_area * aspect)).astype(jnp.int32)
+        ww = jnp.round(jnp.sqrt(target_area / aspect)).astype(jnp.int32)
+        valid = (ww < w_img) & (hh < h_img)
+        pick = jnp.argmax(valid)  # first True (argmax of bools)
+        h = hh[pick]
+        w = ww[pick]
+        ok = valid[pick] & (c < count) & do_erase
+        top = jnp.floor(jax.random.uniform(kt) * (h_img - h + 1)).astype(jnp.int32)
+        left = jnp.floor(jax.random.uniform(kl) * (w_img - w + 1)).astype(jnp.int32)
+        rows = jnp.arange(h_img)[:, None]
+        cols = jnp.arange(w_img)[None, :]
+        mask = ((rows >= top) & (rows < top + h) &
+                (cols >= left) & (cols < left + w) & ok)[..., None]
+        k_f = jax.random.fold_in(k_fill, c)
+        if mode == "pixel":
+            fill = jax.random.normal(k_f, frame.shape, frame.dtype)
+        elif mode == "rand":
+            fill = jnp.broadcast_to(
+                jax.random.normal(k_f, (1, 1, chans), frame.dtype), frame.shape)
+        else:  # const
+            fill = jnp.zeros_like(frame)
+        out = jnp.where(mask, fill, out)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "probability", "min_area", "max_area", "min_aspect", "max_aspect", "mode",
+    "min_count", "max_count", "num_splits", "img_num"))
+def random_erasing(key: jax.Array, images: jnp.ndarray,
+                   probability: float = 0.5, min_area: float = 0.02,
+                   max_area: float = 1 / 3, min_aspect: float = 0.3,
+                   max_aspect: Optional[float] = None, mode: str = "const",
+                   min_count: int = 1, max_count: Optional[int] = None,
+                   num_splits: int = 0, img_num: int = 1) -> jnp.ndarray:
+    """Erase random rectangles from a normalized NHWC batch."""
+    import math
+    b, h, w, c = images.shape
+    max_aspect = max_aspect or 1.0 / min_aspect
+    max_count = max_count or min_count
+    la_min, la_max = math.log(min_aspect), math.log(max_aspect)
+    assert c % img_num == 0, (c, img_num)
+    cpf = c // img_num
+    batch_start = b // num_splits if num_splits > 1 else 0
+
+    frames = images.reshape(b, h, w, img_num, cpf)
+    frames = jnp.moveaxis(frames, 3, 1)          # (B, img_num, H, W, cpf)
+    keys = jax.random.split(key, b * img_num).reshape(b, img_num, 2)
+    enabled = (jnp.arange(b) >= batch_start)[:, None].repeat(img_num, 1)
+
+    erase = functools.partial(
+        _one_erase, probability=probability, min_area=min_area,
+        max_area=max_area, log_aspect_min=la_min, log_aspect_max=la_max,
+        mode=mode, min_count=min_count, max_count=max_count)
+    out = jax.vmap(jax.vmap(lambda k, f, e: erase(k, f, enabled=e)))(
+        keys, frames, enabled)
+    return jnp.moveaxis(out, 1, 3).reshape(b, h, w, c)
+
+
+class RandomErasing:
+    """Stateful-looking wrapper mirroring the reference constructor signature
+    (random_erasing.py:38-60); holds only static config."""
+
+    def __init__(self, probability: float = 0.5, min_area: float = 0.02,
+                 max_area: float = 1 / 3, min_aspect: float = 0.3,
+                 max_aspect: Optional[float] = None, mode: str = "const",
+                 min_count: int = 1, max_count: Optional[int] = None,
+                 num_splits: int = 0, img_num: int = 1):
+        mode = (mode or "const").lower()
+        assert mode in ("const", "rand", "pixel"), mode
+        self.kwargs = dict(
+            probability=probability, min_area=min_area, max_area=max_area,
+            min_aspect=min_aspect, max_aspect=max_aspect, mode=mode,
+            min_count=min_count, max_count=max_count, num_splits=num_splits,
+            img_num=img_num)
+
+    def __call__(self, key: jax.Array, images: jnp.ndarray) -> jnp.ndarray:
+        return random_erasing(key, images, **self.kwargs)

@@ -1,0 +1,105 @@
+"""Sharded samplers — deterministic index-space sharding across hosts.
+
+Replaces the reference's ``torch.utils.data.distributed.DistributedSampler``
+(train) and ``OrderedDistributedSampler`` (eval,
+``/root/reference/dfd/timm/data/distributed_sampler.py:7-51``).  On TPU one
+*process per host* feeds all local devices, so the shard unit is
+``jax.process_index()`` rather than one process per accelerator; the index
+arithmetic is identical.
+
+Static shapes rule everything (SURVEY.md §7 "hard parts" #5): both samplers
+pad the index list to an exact multiple of ``num_shards * batch_size``.  The
+eval sampler additionally reports a per-index validity flag so padded
+duplicates can be masked out of the metrics — the reference instead lets the
+duplicates "slightly alter validation results" (loader.py:794-796); with the
+mask we are exact.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ShardedTrainSampler", "OrderedShardedSampler"]
+
+
+class ShardedTrainSampler:
+    """Shuffling train sampler: seeded per-epoch permutation, wrap-padded to a
+    multiple of ``num_shards * batch_size``, strided subsample per shard.
+
+    Every shard sees the same permutation, so the global batch order is a
+    pure function of ``(seed, epoch)`` regardless of host count.
+    """
+
+    def __init__(self, dataset_len: int, num_shards: int = 1,
+                 shard_index: int = 0, batch_size: int = 1, seed: int = 42,
+                 drop_last: bool = True):
+        assert 0 <= shard_index < num_shards
+        self.dataset_len = dataset_len
+        self.num_shards = num_shards
+        self.shard_index = shard_index
+        self.batch_size = batch_size
+        self.seed = seed
+        self.epoch = 0
+        chunk = num_shards * batch_size
+        if drop_last:
+            self.total_size = (dataset_len // chunk) * chunk
+        else:
+            self.total_size = int(math.ceil(dataset_len / chunk)) * chunk
+        self.num_samples = self.total_size // num_shards
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def local_indices(self) -> np.ndarray:
+        perm = np.random.default_rng(
+            np.random.SeedSequence([self.seed, self.epoch])
+        ).permutation(self.dataset_len)
+        if self.total_size <= self.dataset_len:
+            perm = perm[:self.total_size]
+        else:
+            reps = int(math.ceil(self.total_size / self.dataset_len))
+            perm = np.tile(perm, reps)[:self.total_size]
+        return perm[self.shard_index::self.num_shards]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.local_indices().tolist())
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+
+class OrderedShardedSampler:
+    """Non-shuffling eval sampler with wrap-padding and validity flags
+    (reference distributed_sampler.py:37-48 plus exact-eval masking)."""
+
+    def __init__(self, dataset_len: int, num_shards: int = 1,
+                 shard_index: int = 0, batch_size: int = 1):
+        assert 0 <= shard_index < num_shards
+        self.dataset_len = dataset_len
+        self.num_shards = num_shards
+        self.shard_index = shard_index
+        self.batch_size = batch_size
+        chunk = num_shards * batch_size
+        self.total_size = int(math.ceil(dataset_len / chunk)) * chunk
+        self.num_samples = self.total_size // num_shards
+
+    def set_epoch(self, epoch: int) -> None:  # interface parity
+        pass
+
+    def local_indices(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(indices, valid) for this shard; padding wraps from index 0 and is
+        flagged invalid."""
+        idx = np.arange(self.total_size)
+        valid = idx < self.dataset_len
+        idx = idx % self.dataset_len
+        sl = slice(self.shard_index, self.total_size, self.num_shards)
+        return idx[sl], valid[sl]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.local_indices()[0].tolist())
+
+    def __len__(self) -> int:
+        return self.num_samples

@@ -1,0 +1,393 @@
+"""Host-side image transforms (PIL/numpy) with explicit RNG.
+
+Re-design of ``/root/reference/dfd/timm/data/transforms.py``: the single-image
+ImageNet transforms plus the ``Multi*`` family — transforms over a *list* of
+PIL frames that share one random parameter draw across the 4 frames of a clip
+(MultiRotate :261, MultiRandomHorizontalFlip :217, MultiRandomResize :281,
+MultiRandomCrop :311, MultiBlur :243, MultiColorJitter :332, MultiFlicker
+:346, MultiToNumpy :20, MultiConcate :29).
+
+Two deliberate departures from the reference, both TPU-motivated:
+
+* **Explicit RNG.** Every transform is called as ``t(img, rng)`` where ``rng``
+  is a ``numpy.random.Generator``; ``Compose`` threads it through.  The
+  reference uses the global ``random`` module, which is per-dataloader-worker
+  state and irreproducible across worker counts.  Here the loader derives the
+  generator from ``(seed, epoch, sample_index)`` so any (host, worker-count)
+  layout produces identical batches.
+* **NHWC output.** ``MultiToNumpy``/``MultiConcate`` emit ``(H, W, 3)`` frames
+  concatenated to ``(H, W, 3*img_num)`` — channels-last, the TPU-native
+  layout — instead of the reference's CHW/(12,H,W).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+from PIL import Image, ImageEnhance, ImageFilter
+
+__all__ = [
+    "Compose", "ToNumpy", "RandomResizedCropAndInterpolation", "RandomResize",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "CenterCrop", "Resize",
+    "RandomCrop", "ColorJitter",
+    "MultiToNumpy", "MultiConcate", "MultiRandomHorizontalFlip", "MultiBlur",
+    "MultiRotate", "MultiRandomResize", "MultiRandomCrop", "MultiColorJitter",
+    "MultiFlicker",
+]
+
+_PIL_INTERP = {
+    "nearest": Image.NEAREST,
+    "bilinear": Image.BILINEAR,
+    "bicubic": Image.BICUBIC,
+    "lanczos": Image.LANCZOS,
+}
+_RANDOM_INTERPOLATION = (Image.BILINEAR, Image.BICUBIC)
+
+
+def pil_interp(method: str):
+    return _PIL_INTERP.get(method, Image.BILINEAR)
+
+
+def _resolve_interp(interpolation, rng: np.random.Generator):
+    if isinstance(interpolation, (tuple, list)):
+        return interpolation[rng.integers(len(interpolation))]
+    return interpolation
+
+
+class Compose:
+    """Chains transforms, threading the RNG through each."""
+
+    def __init__(self, transforms: Sequence[Callable]):
+        self.transforms = list(transforms)
+
+    def __call__(self, img, rng: np.random.Generator):
+        for t in self.transforms:
+            img = t(img, rng)
+        return img
+
+    def __repr__(self):
+        return f"Compose({self.transforms!r})"
+
+
+# ---------------------------------------------------------------------------
+# Single-image transforms
+# ---------------------------------------------------------------------------
+
+class ToNumpy:
+    """PIL → (H, W, C) uint8 (reference emits CHW; we keep NHWC)."""
+
+    def __call__(self, pil_img, rng=None):
+        np_img = np.asarray(pil_img, dtype=np.uint8)
+        if np_img.ndim < 3:
+            np_img = np.expand_dims(np_img, axis=-1)
+        return np_img
+
+
+class Resize:
+    def __init__(self, size: Union[int, Tuple[int, int]],
+                 interpolation: str = "bilinear"):
+        self.size = size
+        self.interpolation = pil_interp(interpolation)
+
+    def __call__(self, img, rng=None):
+        if isinstance(self.size, int):
+            w, h = img.size
+            short = min(w, h)
+            scale = self.size / short
+            tw, th = int(round(w * scale)), int(round(h * scale))
+        else:
+            th, tw = self.size
+        return img.resize((tw, th), self.interpolation)
+
+
+class CenterCrop:
+    def __init__(self, size: Union[int, Tuple[int, int]]):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img, rng=None):
+        th, tw = self.size
+        w, h = img.size
+        left = int(round((w - tw) / 2.0))
+        top = int(round((h - th) / 2.0))
+        return img.crop((left, top, left + tw, top + th))
+
+
+def _pad_to(img: Image.Image, tw: int, th: int, fill=0) -> Image.Image:
+    """Pad the right/bottom only when needed (torchvision RandomCrop
+    ``pad_if_needed`` pads symmetric-ish via (delta, 0); we center-pad)."""
+    w, h = img.size
+    if w >= tw and h >= th:
+        return img
+    nw, nh = max(w, tw), max(h, th)
+    out = Image.new(img.mode, (nw, nh),
+                    fill if not isinstance(fill, int) else tuple(
+                        [fill] * len(img.getbands())) if len(
+                        img.getbands()) > 1 else fill)
+    out.paste(img, ((nw - w) // 2, (nh - h) // 2))
+    return out
+
+
+class RandomCrop:
+    """Random crop with ``pad_if_needed`` (torchvision semantics used by the
+    reference at transforms.py:311-330)."""
+
+    def __init__(self, size: Union[int, Tuple[int, int]],
+                 pad_if_needed: bool = False, fill: int = 0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+
+    def get_params(self, img, rng: np.random.Generator) -> Tuple[int, int]:
+        th, tw = self.size
+        w, h = img.size
+        top = int(rng.integers(0, h - th + 1)) if h > th else 0
+        left = int(rng.integers(0, w - tw + 1)) if w > tw else 0
+        return top, left
+
+    def __call__(self, img, rng: np.random.Generator):
+        if self.pad_if_needed:
+            img = _pad_to(img, self.size[1], self.size[0], self.fill)
+        top, left = self.get_params(img, rng)
+        th, tw = self.size
+        return img.crop((left, top, left + tw, top + th))
+
+
+class RandomHorizontalFlip:
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def __call__(self, img, rng: np.random.Generator):
+        if rng.random() < self.p:
+            return img.transpose(Image.FLIP_LEFT_RIGHT)
+        return img
+
+
+class RandomVerticalFlip:
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def __call__(self, img, rng: np.random.Generator):
+        if rng.random() < self.p:
+            return img.transpose(Image.FLIP_TOP_BOTTOM)
+        return img
+
+
+class RandomResizedCropAndInterpolation:
+    """Random scale/aspect crop then resize (reference transforms.py:73-170):
+    10 area/ratio attempts, fallback to a center crop at the clamped ratio."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4., 4. / 3.),
+                 interpolation: str = "bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        if interpolation == "random":
+            self.interpolation: Any = _RANDOM_INTERPOLATION
+        else:
+            self.interpolation = pil_interp(interpolation)
+
+    def get_params(self, img, rng: np.random.Generator):
+        w, h = img.size
+        area = w * h
+        for _ in range(10):
+            target_area = rng.uniform(*self.scale) * area
+            log_ratio = (math.log(self.ratio[0]), math.log(self.ratio[1]))
+            aspect_ratio = math.exp(rng.uniform(*log_ratio))
+            cw = int(round(math.sqrt(target_area * aspect_ratio)))
+            ch = int(round(math.sqrt(target_area / aspect_ratio)))
+            if cw <= w and ch <= h:
+                top = int(rng.integers(0, h - ch + 1))
+                left = int(rng.integers(0, w - cw + 1))
+                return top, left, ch, cw
+        # fallback: center crop at clamped aspect
+        in_ratio = w / h
+        if in_ratio < min(self.ratio):
+            cw = w
+            ch = int(round(cw / min(self.ratio)))
+        elif in_ratio > max(self.ratio):
+            ch = h
+            cw = int(round(ch * max(self.ratio)))
+        else:
+            cw, ch = w, h
+        top = (h - ch) // 2
+        left = (w - cw) // 2
+        return top, left, ch, cw
+
+    def __call__(self, img, rng: np.random.Generator):
+        top, left, ch, cw = self.get_params(img, rng)
+        interp = _resolve_interp(self.interpolation, rng)
+        img = img.crop((left, top, left + cw, top + ch))
+        return img.resize((self.size[1], self.size[0]), interp)
+
+
+class RandomResize:
+    """Uniform random rescale (reference transforms.py:173-211)."""
+
+    def __init__(self, scale=(0.9, 1.1), interpolation: str = "bilinear"):
+        if interpolation == "random":
+            self.interpolation: Any = _RANDOM_INTERPOLATION
+        else:
+            self.interpolation = pil_interp(interpolation)
+        self.scale = scale
+
+    def _target_size(self, img, rng: np.random.Generator) -> Tuple[int, int]:
+        s = rng.uniform(self.scale[0], self.scale[1])
+        w, h = img.size
+        return int(w * s), int(h * s)
+
+    def __call__(self, img, rng: np.random.Generator):
+        interp = _resolve_interp(self.interpolation, rng)
+        tw, th = self._target_size(img, rng)
+        return img.resize((tw, th), interp)
+
+
+class ColorJitter:
+    """Brightness/contrast/saturation/hue jitter, applied in a shuffled order
+    with shared factors (torchvision semantics the reference relies on at
+    transforms.py:332-343)."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0, hue=0.0):
+        self.brightness = self._range(brightness)
+        self.contrast = self._range(contrast)
+        self.saturation = self._range(saturation)
+        self.hue = (-hue, hue) if not isinstance(hue, (tuple, list)) else tuple(hue)
+
+    @staticmethod
+    def _range(v):
+        if isinstance(v, (tuple, list)):
+            return tuple(v)
+        return (max(0.0, 1.0 - v), 1.0 + v)
+
+    def get_params(self, rng: np.random.Generator):
+        order = rng.permutation(4)
+        b = rng.uniform(*self.brightness) if self.brightness != (1.0, 1.0) else None
+        c = rng.uniform(*self.contrast) if self.contrast != (1.0, 1.0) else None
+        s = rng.uniform(*self.saturation) if self.saturation != (1.0, 1.0) else None
+        h = rng.uniform(*self.hue) if self.hue != (0.0, 0.0) else None
+        return order, b, c, s, h
+
+    @staticmethod
+    def _apply(img, order, b, c, s, h):
+        for idx in order:
+            if idx == 0 and b is not None:
+                img = ImageEnhance.Brightness(img).enhance(b)
+            elif idx == 1 and c is not None:
+                img = ImageEnhance.Contrast(img).enhance(c)
+            elif idx == 2 and s is not None:
+                img = ImageEnhance.Color(img).enhance(s)
+            elif idx == 3 and h is not None:
+                hsv = np.array(img.convert("HSV"), dtype=np.int16)
+                hsv[..., 0] = (hsv[..., 0] + int(h * 255)) % 256
+                img = Image.fromarray(hsv.astype(np.uint8), "HSV").convert("RGB")
+        return img
+
+    def __call__(self, img, rng: np.random.Generator):
+        return self._apply(img, *self.get_params(rng))
+
+
+# ---------------------------------------------------------------------------
+# Multi-frame (clip) transforms — shared random params across frames
+# ---------------------------------------------------------------------------
+
+class MultiToNumpy:
+    """List of PIL frames → list of (H, W, 3) uint8 arrays (NHWC)."""
+
+    def __call__(self, pil_imgs, rng=None) -> List[np.ndarray]:
+        out = []
+        for pil_img in pil_imgs:
+            a = np.asarray(pil_img, dtype=np.uint8)
+            if a.ndim < 3:
+                a = np.expand_dims(a, axis=-1)
+            out.append(a)
+        return out
+
+
+class MultiConcate:
+    """Concatenate frames on the channel axis → (H, W, 3*img_num)."""
+
+    def __call__(self, np_imgs, rng=None) -> np.ndarray:
+        return np.concatenate(np_imgs, axis=-1)
+
+
+class MultiRandomHorizontalFlip:
+    """One coin flip shared by all frames (reference :217-240)."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def __call__(self, imgs, rng: np.random.Generator):
+        if rng.random() < self.p:
+            return [img.transpose(Image.FLIP_LEFT_RIGHT) for img in imgs]
+        return imgs
+
+
+class MultiRotate:
+    """One integer angle in ±rotate_range shared by all frames, expand=True
+    (reference :261-278 — note ``expand`` changes the canvas size; the fixed
+    crop downstream restores static shapes)."""
+
+    def __init__(self, rotate_range: float):
+        self.rotate_range = int(rotate_range)
+
+    def __call__(self, imgs, rng: np.random.Generator):
+        deg = int(rng.integers(-self.rotate_range, self.rotate_range + 1))
+        return [img.rotate(deg, expand=True) for img in imgs]
+
+
+class MultiRandomResize(RandomResize):
+    """One random scale shared by all frames (reference :281-308)."""
+
+    def __call__(self, imgs, rng: np.random.Generator):
+        interp = _resolve_interp(self.interpolation, rng)
+        tw, th = self._target_size(imgs[0], rng)
+        return [img.resize((tw, th), interp) for img in imgs]
+
+
+class MultiRandomCrop(RandomCrop):
+    """One crop window shared by all frames, pad_if_needed (reference
+    :311-330)."""
+
+    def __call__(self, imgs, rng: np.random.Generator):
+        if self.pad_if_needed:
+            imgs = [_pad_to(img, self.size[1], self.size[0], self.fill)
+                    for img in imgs]
+        top, left = self.get_params(imgs[0], rng)
+        th, tw = self.size
+        return [img.crop((left, top, left + tw, top + th)) for img in imgs]
+
+
+class MultiColorJitter(ColorJitter):
+    """One jitter parameter draw shared by all frames (reference :332-343)."""
+
+    def __call__(self, imgs, rng: np.random.Generator):
+        params = self.get_params(rng)
+        return [self._apply(img, *params) for img in imgs]
+
+
+class MultiBlur:
+    """Independent per-frame Gaussian blur with probability p (reference
+    :243-258 — deliberately *not* shared across frames)."""
+
+    def __init__(self, p: float, blur_radiu: float = 1.0):
+        self.p = p
+        self.blur_radiu = blur_radiu
+
+    def __call__(self, imgs, rng: np.random.Generator):
+        return [img.filter(ImageFilter.GaussianBlur(radius=self.blur_radiu))
+                if rng.random() < self.p else img for img in imgs]
+
+
+class MultiFlicker:
+    """Random frame blackout — temporal-inconsistency augmentation
+    (reference :346-350): each frame independently replaced by a black image
+    with probability p."""
+
+    def __init__(self, probability: float):
+        self.probability = probability
+
+    def __call__(self, imgs, rng: np.random.Generator):
+        size = imgs[0].size
+        return [Image.new("RGB", size) if rng.random() < self.probability
+                else img for img in imgs]

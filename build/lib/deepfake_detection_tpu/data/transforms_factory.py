@@ -1,0 +1,147 @@
+"""Transform pipeline factories.
+
+Parity with ``/root/reference/dfd/timm/data/transforms_factory.py``:
+
+* ``transforms_deepfake_train_v3`` (:137-183) — the active 4-frame train
+  pipeline: MultiRotate → MultiRandomHorizontalFlip → MultiRandomResize
+  (scale 2/3–3/2) → MultiRandomCrop(600², pad_if_needed) → [MultiBlur] →
+  MultiColorJitter → [MultiFlicker] → MultiToNumpy → MultiConcate.
+* ``transforms_deepfake_eval_v3`` (:225-236) — random-crop only (the
+  reference evaluates with a *random* crop, not center crop; kept for parity).
+* ``transforms_imagenet_train`` / ``transforms_imagenet_eval`` (:239-355) —
+  the single-frame ImageNet pipelines with AutoAugment/RandAugment/AugMix
+  hooks.
+* ``create_transform`` dispatcher (:358+).
+
+Normalization and RandomErasing are *not* part of these pipelines: the host
+emits uint8 NHWC and the device prologue (loader.DeviceLoader) normalizes —
+the reference's prefetcher split, which is exactly the right split on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence, Tuple, Union
+
+from .auto_augment import (augment_and_mix_transform, auto_augment_transform,
+                           rand_augment_transform)
+from .constants import (DEFAULT_CROP_PCT, IMAGENET_DEFAULT_MEAN,
+                        IMAGENET_DEFAULT_STD)
+from .transforms import (CenterCrop, ColorJitter, Compose, MultiBlur,
+                         MultiColorJitter, MultiConcate, MultiFlicker,
+                         MultiRandomCrop, MultiRandomHorizontalFlip,
+                         MultiRandomResize, MultiRotate, MultiToNumpy,
+                         RandomHorizontalFlip,
+                         RandomResizedCropAndInterpolation, RandomVerticalFlip,
+                         Resize, ToNumpy)
+
+__all__ = ["transforms_deepfake_train_v3", "transforms_deepfake_eval_v3",
+           "transforms_imagenet_train", "transforms_imagenet_eval",
+           "create_transform"]
+
+
+def transforms_deepfake_train_v3(
+        img_size: Union[int, Tuple[int, int]] = 600,
+        color_jitter: Any = 0.4, flicker: float = 0.0,
+        rotate_range: float = 0, blur_radiu: float = 0,
+        blur_prob: float = 0.0, **unused) -> Compose:
+    """The active 4-frame train pipeline (reference :137-183)."""
+    primary = [
+        MultiRotate(rotate_range),
+        MultiRandomHorizontalFlip(),
+        MultiRandomResize(scale=(2.0 / 3, 3.0 / 2.0)),
+        MultiRandomCrop(img_size, pad_if_needed=True),
+    ]
+    if blur_prob > 0.0:
+        primary.append(MultiBlur(blur_prob, blur_radiu))
+    secondary = []
+    if color_jitter is not None:
+        if isinstance(color_jitter, (list, tuple)):
+            assert len(color_jitter) in (3, 4)
+        else:
+            color_jitter = (float(color_jitter),) * 3
+        secondary.append(MultiColorJitter(*color_jitter))
+    if flicker > 0.0:
+        secondary.append(MultiFlicker(flicker))
+    final = [MultiToNumpy(), MultiConcate()]
+    return Compose(primary + secondary + final)
+
+
+def transforms_deepfake_eval_v3(img_size: Union[int, Tuple[int, int]] = 224
+                                ) -> Compose:
+    """Eval pipeline — random crop only, per the reference (:225-236)."""
+    return Compose([MultiRandomCrop(img_size, pad_if_needed=True),
+                    MultiToNumpy(), MultiConcate()])
+
+
+def transforms_imagenet_train(
+        img_size: Union[int, Tuple[int, int]] = 224,
+        scale=(0.08, 1.0), ratio=(3. / 4., 4. / 3.),
+        hflip: float = 0.5, vflip: float = 0.0, color_jitter: Any = 0.4,
+        auto_augment: Optional[str] = None,
+        interpolation: str = "random",
+        mean=IMAGENET_DEFAULT_MEAN) -> Compose:
+    """Single-frame ImageNet train pipeline (reference :239-318)."""
+    tfl: list = [RandomResizedCropAndInterpolation(
+        img_size, scale=scale, ratio=ratio, interpolation=interpolation)]
+    if hflip > 0.0:
+        tfl.append(RandomHorizontalFlip(p=hflip))
+    if vflip > 0.0:
+        tfl.append(RandomVerticalFlip(p=vflip))
+    if auto_augment:
+        assert isinstance(auto_augment, str)
+        sz = img_size if isinstance(img_size, int) else min(img_size)
+        aa_params = dict(
+            translate_const=int(sz * 0.45),
+            img_mean=tuple(min(255, round(255 * x)) for x in mean),
+        )
+        if interpolation and interpolation != "random":
+            aa_params["interpolation"] = interpolation
+        if auto_augment.startswith("rand"):
+            tfl.append(rand_augment_transform(auto_augment, aa_params))
+        elif auto_augment.startswith("augmix"):
+            tfl.append(augment_and_mix_transform(auto_augment, aa_params))
+        else:
+            tfl.append(auto_augment_transform(auto_augment, aa_params))
+    elif color_jitter is not None:
+        if isinstance(color_jitter, (list, tuple)):
+            assert len(color_jitter) in (3, 4)
+        else:
+            color_jitter = (float(color_jitter),) * 3
+        tfl.append(ColorJitter(*color_jitter))
+    tfl.append(ToNumpy())
+    return Compose(tfl)
+
+
+def transforms_imagenet_eval(img_size: Union[int, Tuple[int, int]] = 224,
+                             crop_pct: Optional[float] = None,
+                             interpolation: str = "bilinear") -> Compose:
+    """Resize-shorter-side + center crop (reference :321-355)."""
+    crop_pct = crop_pct or DEFAULT_CROP_PCT
+    if isinstance(img_size, (tuple, list)):
+        assert len(img_size) == 2
+        if img_size[-1] == img_size[-2]:
+            scale_size: Any = int(math.floor(img_size[0] / crop_pct))
+        else:
+            scale_size = tuple(int(x / crop_pct) for x in img_size)
+    else:
+        scale_size = int(math.floor(img_size / crop_pct))
+    return Compose([Resize(scale_size, interpolation), CenterCrop(img_size),
+                    ToNumpy()])
+
+
+def create_transform(input_size, is_training: bool = False, **kwargs
+                     ) -> Compose:
+    """Dispatch to train or eval ImageNet pipeline (reference :358+)."""
+    img_size = input_size[-2:] if isinstance(input_size, (tuple, list)) \
+        else input_size
+    if isinstance(img_size, (tuple, list)) and img_size[0] == img_size[1]:
+        img_size = img_size[0]
+    if is_training:
+        keys = ("scale", "ratio", "hflip", "vflip", "color_jitter",
+                "auto_augment", "interpolation", "mean")
+        return transforms_imagenet_train(
+            img_size, **{k: v for k, v in kwargs.items() if k in keys})
+    keys = ("crop_pct", "interpolation")
+    return transforms_imagenet_eval(
+        img_size, **{k: v for k, v in kwargs.items() if k in keys})

@@ -1,0 +1,132 @@
+"""DenseNet-BC family (Flax/NHWC).
+
+Re-design of ``/root/reference/dfd/timm/models/densenet.py`` (214 LoC):
+``_DenseLayer`` pre-activation BN→ReLU→1×1→BN→ReLU→3×3 with channel concat
+(:37-54), ``_Transition`` halving (:65-72), :class:`DenseNet` (:75-160), and
+the 4 entrypoints (:168-214).
+
+TPU note: the growing concat chain is memory-unfriendly; XLA keeps each
+block's concat buffer alive only within the fused region, and NHWC concat on
+the channel axis is layout-free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.conv import Conv2d
+from ..ops.norm import BatchNorm2d
+from ..ops.pool import SelectAdaptivePool2d
+from ..registry import register_model
+from .efficientnet import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
+
+__all__ = ["DenseNet"]
+
+
+def _cfg(**kwargs):
+    cfg = dict(num_classes=1000, input_size=(3, 224, 224), pool_size=(7, 7),
+               crop_pct=0.875, interpolation="bicubic",
+               mean=IMAGENET_DEFAULT_MEAN, std=IMAGENET_DEFAULT_STD,
+               first_conv="features.conv0", classifier="classifier")
+    cfg.update(kwargs)
+    return cfg
+
+
+class DenseNet(nn.Module):
+    """Densenet-BC (reference densenet.py:75-160)."""
+    growth_rate: int = 32
+    block_config: Sequence[int] = (6, 12, 24, 16)
+    num_init_features: int = 64
+    bn_size: int = 4
+    num_classes: int = 1000
+    in_chans: int = 3
+    drop_rate: float = 0.0
+    global_pool: str = "avg"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+    default_cfg: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False, features_only: bool = False,
+                 pool: bool = True):
+        assert x.shape[-1] == self.in_chans, (x.shape, self.in_chans)
+        bn = dict(momentum=self.bn_momentum, eps=self.bn_eps,
+                  axis_name=self.bn_axis_name, dtype=self.dtype)
+        # stem (:97-103)
+        x = Conv2d(self.num_init_features, 7, stride=2, dtype=self.dtype,
+                   name="conv0")(x)
+        x = BatchNorm2d(**bn, name="norm0")(x, training=training)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        stage_feats = []
+        for bi, num_layers in enumerate(self.block_config):
+            # dense block (:57-62): each layer sees everything before it
+            for li in range(num_layers):
+                y = BatchNorm2d(**bn, name=f"block{bi}_l{li}_norm1")(
+                    x, training=training)
+                y = nn.relu(y)
+                y = Conv2d(self.bn_size * self.growth_rate, 1,
+                           dtype=self.dtype, name=f"block{bi}_l{li}_conv1")(y)
+                y = BatchNorm2d(**bn, name=f"block{bi}_l{li}_norm2")(
+                    y, training=training)
+                y = nn.relu(y)
+                y = Conv2d(self.growth_rate, 3, dtype=self.dtype,
+                           name=f"block{bi}_l{li}_conv2")(y)
+                if self.drop_rate > 0:
+                    y = nn.Dropout(rate=self.drop_rate,
+                                   deterministic=not training)(y)
+                x = jnp.concatenate([x, y], axis=-1)
+            stage_feats.append(x)
+            if bi != len(self.block_config) - 1:
+                # transition (:65-72): BN→ReLU→1×1 half→avgpool 2
+                x = BatchNorm2d(**bn, name=f"transition{bi}_norm")(
+                    x, training=training)
+                x = nn.relu(x)
+                x = Conv2d(x.shape[-1] // 2, 1, dtype=self.dtype,
+                           name=f"transition{bi}_conv")(x)
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = BatchNorm2d(**bn, name="norm5")(x, training=training)
+        x = nn.relu(x)
+        if features_only:
+            stage_feats[-1] = x
+            return stage_feats
+        if not pool:
+            return x
+        x = SelectAdaptivePool2d(self.global_pool, name="global_pool")(x)
+        if self.num_classes <= 0:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="classifier")(x)
+
+
+# name: (growth_rate, block_config, num_init_features)  (reference :168-214)
+_DENSENET_DEFS = {
+    "densenet121": (32, (6, 12, 24, 16), 64),
+    "densenet169": (32, (6, 12, 32, 32), 64),
+    "densenet201": (32, (6, 12, 48, 32), 64),
+    "densenet161": (48, (6, 12, 36, 24), 96),
+}
+
+
+def _register():
+    for name, (gr, blocks, init_f) in _DENSENET_DEFS.items():
+        def fn(pretrained=False, *, _gr=gr, _blocks=blocks, _init=init_f,
+               **kwargs):
+            kwargs.pop("pretrained", None)
+            kwargs.setdefault("default_cfg", _cfg())
+            return DenseNet(growth_rate=_gr, block_config=tuple(_blocks),
+                            num_init_features=_init, **kwargs)
+        fn.__name__ = name
+        fn.__qualname__ = name
+        fn.__module__ = __name__
+        fn.__doc__ = f"{name} (reference densenet.py entrypoint)."
+        register_model(fn)
+
+
+_register()

@@ -1,0 +1,203 @@
+"""DPN — Dual-Path Networks (Flax/NHWC).
+
+Re-design of ``/root/reference/dfd/timm/models/dpn.py`` (323 LoC): the
+``DualPathBlock`` (:90-154) carrying a residual stream and a dense
+(concat-growing) stream in parallel, pre-activation ``BnActConv2d`` (:62-70),
+the :class:`DPN` assembly (:157-246), and the 6 entrypoints (:249-323).
+
+TPU notes: the dual streams are an explicit ``(resid, dense)`` pair —
+functional JAX makes the reference's tuple-threading natural; the channel
+slices/concats are NHWC layout no-ops under XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.conv import Conv2d
+from ..ops.norm import BatchNorm2d
+from ..ops.pool import SelectAdaptivePool2d
+from ..registry import register_model
+from .efficientnet import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
+
+__all__ = ["DPN"]
+
+
+def _cfg(**kwargs):
+    cfg = dict(num_classes=1000, input_size=(3, 224, 224), pool_size=(7, 7),
+               crop_pct=0.875, interpolation="bicubic",
+               mean=(124 / 255, 117 / 255, 104 / 255),
+               std=(1 / (0.0167 * 255),) * 3,
+               first_conv="conv1_conv", classifier="classifier")
+    cfg.update(kwargs)
+    return cfg
+
+
+class _BnActConv(nn.Module):
+    """Pre-activation conv (reference BnActConv2d, dpn.py:62-70)."""
+    out_chs: int
+    kernel_size: int = 1
+    stride: int = 1
+    groups: int = 1
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = BatchNorm2d(**dict(self.bn or {}, dtype=self.dtype),
+                        name="bn")(x, training=training)
+        x = nn.relu(x)
+        return Conv2d(self.out_chs, self.kernel_size, stride=self.stride,
+                      groups=self.groups, dtype=self.dtype, name="conv")(x)
+
+
+class _DualPathBlock(nn.Module):
+    """Reference DualPathBlock (dpn.py:90-154)."""
+    num_1x1_a: int
+    num_3x3_b: int
+    num_1x1_c: int
+    inc: int
+    groups: int
+    block_type: str = "normal"     # 'proj' | 'down' | 'normal'
+    b: bool = False
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, resid, dense, training: bool = False):
+        k = dict(bn=self.bn, dtype=self.dtype)
+        x_in = jnp.concatenate([resid, dense], axis=-1)
+        stride = 2 if self.block_type == "down" else 1
+        if self.block_type in ("proj", "down"):
+            x_s = _BnActConv(self.num_1x1_c + 2 * self.inc, 1, stride, **k,
+                             name=f"c1x1_w_s{stride}")(x_in,
+                                                       training=training)
+            x_s1 = x_s[..., :self.num_1x1_c]
+            x_s2 = x_s[..., self.num_1x1_c:]
+        else:
+            x_s1, x_s2 = resid, dense
+        y = _BnActConv(self.num_1x1_a, 1, 1, **k, name="c1x1_a")(
+            x_in, training=training)
+        y = _BnActConv(self.num_3x3_b, 3, stride, groups=self.groups,
+                       **dict(k), name="c3x3_b")(y, training=training)
+        if self.b:
+            # 'b' variants: BN-act then two separate 1×1 heads (:122-125)
+            y = BatchNorm2d(**dict(self.bn or {}, dtype=self.dtype),
+                            name="c1x1_c_bn")(y, training=training)
+            y = nn.relu(y)
+            out1 = Conv2d(self.num_1x1_c, 1, dtype=self.dtype,
+                          name="c1x1_c1")(y)
+            out2 = Conv2d(self.inc, 1, dtype=self.dtype, name="c1x1_c2")(y)
+        else:
+            y = _BnActConv(self.num_1x1_c + self.inc, 1, 1, **k,
+                           name="c1x1_c")(y, training=training)
+            out1 = y[..., :self.num_1x1_c]
+            out2 = y[..., self.num_1x1_c:]
+        return x_s1 + out1, jnp.concatenate([x_s2, out2], axis=-1)
+
+
+class DPN(nn.Module):
+    """Generic DPN (reference dpn.py:157-246)."""
+    small: bool = False
+    num_init_features: int = 64
+    k_r: int = 96
+    groups: int = 32
+    b: bool = False
+    k_sec: Sequence[int] = (3, 4, 20, 3)
+    inc_sec: Sequence[int] = (16, 32, 24, 128)
+    num_classes: int = 1000
+    in_chans: int = 3
+    drop_rate: float = 0.0
+    global_pool: str = "avg"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-3              # reference hardcodes eps=0.001
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+    default_cfg: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False, features_only: bool = False,
+                 pool: bool = True):
+        assert x.shape[-1] == self.in_chans, (x.shape, self.in_chans)
+        bn = dict(momentum=self.bn_momentum, eps=self.bn_eps,
+                  axis_name=self.bn_axis_name)
+        # input block (:72-88): 3×3 stem for 'small', 7×7 otherwise
+        x = Conv2d(self.num_init_features, 3 if self.small else 7, stride=2,
+                   dtype=self.dtype, name="conv1_conv")(x)
+        x = BatchNorm2d(**dict(bn, dtype=self.dtype), name="conv1_bn")(
+            x, training=training)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        bw_factor = 1 if self.small else 4
+        resid, dense = x, x[..., :0]       # dense stream starts empty
+        stage_feats = []
+        for si, (n_blocks, inc) in enumerate(zip(self.k_sec, self.inc_sec)):
+            bw = (64 << si) * bw_factor
+            r = (self.k_r * bw) // (64 * bw_factor)
+            for bi in range(n_blocks):
+                btype = ("proj" if si == 0 else "down") if bi == 0 \
+                    else "normal"
+                resid, dense = _DualPathBlock(
+                    r, r, bw, inc, self.groups, btype, self.b, bn=bn,
+                    dtype=self.dtype,
+                    name=f"conv{si + 2}_{bi + 1}")(resid, dense,
+                                                   training=training)
+            stage_feats.append(jnp.concatenate([resid, dense], axis=-1))
+        # conv5_bn_ac (:215): final BN-act over the concatenated streams
+        x = jnp.concatenate([resid, dense], axis=-1)
+        x = BatchNorm2d(**dict(bn, dtype=self.dtype), name="conv5_bn_ac")(
+            x, training=training)
+        x = nn.elu(x)            # fc_act = ELU (reference :160)
+        if features_only:
+            stage_feats[-1] = x
+            return stage_feats
+        if not pool:
+            return x
+        x = SelectAdaptivePool2d(self.global_pool, flatten=False,
+                                 name="global_pool")(x)
+        if self.drop_rate > 0.0:
+            x = nn.Dropout(rate=self.drop_rate,
+                           deterministic=not training)(x)
+        if self.num_classes <= 0:
+            return x[:, 0, 0, :]
+        # classifier is a 1×1 conv (reference :223-225)
+        x = Conv2d(self.num_classes, 1, use_bias=True, dtype=self.dtype,
+                   name="classifier")(x)
+        return x[:, 0, 0, :]
+
+
+# name: DPN kwargs (reference :249-323)
+_DPN_DEFS = {
+    "dpn68": dict(small=True, num_init_features=10, k_r=128, groups=32,
+                  k_sec=(3, 4, 12, 3), inc_sec=(16, 32, 32, 64)),
+    "dpn68b": dict(small=True, num_init_features=10, k_r=128, groups=32,
+                   b=True, k_sec=(3, 4, 12, 3), inc_sec=(16, 32, 32, 64)),
+    "dpn92": dict(num_init_features=64, k_r=96, groups=32,
+                  k_sec=(3, 4, 20, 3), inc_sec=(16, 32, 24, 128)),
+    "dpn98": dict(num_init_features=96, k_r=160, groups=40,
+                  k_sec=(3, 6, 20, 3), inc_sec=(16, 32, 32, 128)),
+    "dpn131": dict(num_init_features=128, k_r=160, groups=40,
+                   k_sec=(4, 8, 28, 3), inc_sec=(16, 32, 32, 128)),
+    "dpn107": dict(num_init_features=128, k_r=200, groups=50,
+                   k_sec=(4, 8, 20, 3), inc_sec=(20, 64, 64, 128)),
+}
+
+
+def _register():
+    for name, defs in _DPN_DEFS.items():
+        def fn(pretrained=False, *, _defs=defs, **kwargs):
+            kwargs.pop("pretrained", None)
+            kwargs.setdefault("default_cfg", _cfg())
+            return DPN(**{**_defs, **kwargs})
+        fn.__name__ = name
+        fn.__qualname__ = name
+        fn.__module__ = __name__
+        fn.__doc__ = f"{name} (reference dpn.py entrypoint)."
+        register_model(fn)
+
+
+_register()

@@ -1,0 +1,338 @@
+"""EfficientNet building blocks (Flax/NHWC).
+
+TPU-native re-design of ``/root/reference/dfd/timm/models/efficientnet_blocks.py``:
+``ConvBnAct`` (:113), ``DepthwiseSeparableConv`` (:136), ``InvertedResidual``
+(MBConv, :260), ``CondConvResidual`` (:431), ``EdgeResidual`` (:484),
+``SqueezeExcite`` (:93), channel rounding helpers (:55-69).
+
+Every block is a single fused region under XLA: pw-expand → BN → Swish →
+dw → BN → Swish → SE → pw-linear → BN → drop_path+residual compiles to a
+handful of MXU convs with elementwise epilogues fused in — no reason for the
+reference's module-per-op granularity to survive at runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.activations import get_act_fn
+from ..ops.conv import CondConv2d, Conv2d, MixedConv2d, create_conv2d
+from ..ops.drop import DropPath
+from ..ops.norm import BatchNorm2d, GroupNorm, Identity
+
+
+def make_divisible(v, divisor: int = 8, min_value: Optional[int] = None) -> int:
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def round_channels(channels, multiplier: float = 1.0, divisor: int = 8,
+                   channel_min: Optional[int] = None) -> int:
+    """Scale + round channel count (efficientnet_blocks.py:64-69)."""
+    if not multiplier:
+        return channels
+    return make_divisible(channels * multiplier, divisor, channel_min)
+
+
+def _norm(norm_layer: str, momentum, eps, axis_name, dtype, name):
+    if norm_layer == "none":
+        return Identity(name=name)
+    if norm_layer == "gn":
+        return GroupNorm(eps=eps, dtype=dtype, name=name)
+    return BatchNorm2d(momentum=momentum, eps=eps, axis_name=axis_name,
+                       dtype=dtype, name=name)
+
+
+class SqueezeExcite(nn.Module):
+    """EfficientNet-style SE (efficientnet_blocks.py:93-110): reduction is
+    computed from ``reduced_base_chs`` (the block *input* chs), not the
+    expanded chs."""
+    se_ratio: float = 0.25
+    reduced_base_chs: Optional[int] = None
+    act: Any = "relu"
+    gate_fn: Any = "sigmoid"
+    divisor: int = 1
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        chs = x.shape[-1]
+        base = self.reduced_base_chs or chs
+        reduced_chs = make_divisible(base * self.se_ratio, self.divisor)
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = Conv2d(reduced_chs, 1, use_bias=True, dtype=self.dtype,
+                   name="conv_reduce")(s)
+        s = get_act_fn(self.act)(s)
+        s = Conv2d(chs, 1, use_bias=True, dtype=self.dtype,
+                   name="conv_expand")(s)
+        return x * get_act_fn(self.gate_fn)(s)
+
+
+class ConvBnAct(nn.Module):
+    """conv → norm → act (efficientnet_blocks.py:113-133 / layers/conv_bn_act.py:10)."""
+    out_chs: int
+    kernel_size: Union[int, Sequence[int]] = 3
+    stride: int = 1
+    dilation: int = 1
+    pad_type: str = ""
+    act: Any = "relu"
+    norm_layer: str = "bn"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = create_conv2d(self.out_chs, self.kernel_size, stride=self.stride,
+                          dilation=self.dilation, padding=self.pad_type,
+                          dtype=self.dtype, name="conv")(x)
+        x = _norm(self.norm_layer, self.bn_momentum, self.bn_eps,
+                  self.bn_axis_name, self.dtype, "bn1")(x, training=training)
+        return get_act_fn(self.act)(x)
+
+
+class DepthwiseSeparableConv(nn.Module):
+    """dw conv → SE → pw conv; used where the MBConv expansion is 1
+    (efficientnet_blocks.py:136-194)."""
+    out_chs: int
+    dw_kernel_size: Union[int, Sequence[int]] = 3
+    stride: int = 1
+    dilation: int = 1
+    pad_type: str = ""
+    act: Any = "relu"
+    noskip: bool = False
+    pw_kernel_size: int = 1
+    pw_act: bool = False
+    se_ratio: float = 0.0
+    se_gate_fn: Any = "sigmoid"
+    se_kwargs: Any = None    # {'act','gate_fn','reduce_mid','divisor'} overrides
+    drop_path_rate: float = 0.0
+    norm_layer: str = "bn"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        in_chs = x.shape[-1]
+        has_residual = (self.stride == 1 and in_chs == self.out_chs
+                        and not self.noskip)
+        act = get_act_fn(self.act)
+        shortcut = x
+        x = create_conv2d(in_chs, self.dw_kernel_size, stride=self.stride,
+                          dilation=self.dilation, padding=self.pad_type,
+                          depthwise=True, dtype=self.dtype, name="conv_dw")(x)
+        x = _norm(self.norm_layer, self.bn_momentum, self.bn_eps,
+                  self.bn_axis_name, self.dtype, "bn1")(x, training=training)
+        x = act(x)
+        if self.se_ratio > 0.0:
+            sek = dict(self.se_kwargs or {})
+            sek.pop("reduce_mid", None)   # dw block: mid == in chs
+            x = SqueezeExcite(self.se_ratio, reduced_base_chs=in_chs,
+                              act=sek.pop("act", self.act),
+                              gate_fn=sek.pop("gate_fn", self.se_gate_fn),
+                              divisor=sek.pop("divisor", 1),
+                              dtype=self.dtype, name="se")(x)
+        x = create_conv2d(self.out_chs, self.pw_kernel_size,
+                          padding=self.pad_type, dtype=self.dtype,
+                          name="conv_pw")(x)
+        x = _norm(self.norm_layer, self.bn_momentum, self.bn_eps,
+                  self.bn_axis_name, self.dtype, "bn2")(x, training=training)
+        if self.pw_act:
+            x = act(x)
+        if has_residual:
+            x = DropPath(self.drop_path_rate, name="drop_path")(x, training=training)
+            x = x + shortcut
+        return x
+
+
+class InvertedResidual(nn.Module):
+    """MBConv (efficientnet_blocks.py:260-348)."""
+    out_chs: int
+    dw_kernel_size: Union[int, Sequence[int]] = 3
+    stride: int = 1
+    dilation: int = 1
+    pad_type: str = ""
+    act: Any = "relu"
+    noskip: bool = False
+    exp_ratio: float = 1.0
+    exp_kernel_size: int = 1
+    pw_kernel_size: int = 1
+    se_ratio: float = 0.0
+    se_gate_fn: Any = "sigmoid"
+    se_kwargs: Any = None    # {'act','gate_fn','reduce_mid','divisor'} overrides
+    drop_path_rate: float = 0.0
+    norm_layer: str = "bn"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+
+    def _mid_chs(self, in_chs: int) -> int:
+        return make_divisible(in_chs * self.exp_ratio)
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        in_chs = x.shape[-1]
+        mid_chs = self._mid_chs(in_chs)
+        has_residual = (in_chs == self.out_chs and self.stride == 1
+                        and not self.noskip)
+        act = get_act_fn(self.act)
+        shortcut = x
+        # point-wise expansion
+        x = create_conv2d(mid_chs, self.exp_kernel_size, padding=self.pad_type,
+                          dtype=self.dtype, name="conv_pw")(x)
+        x = _norm(self.norm_layer, self.bn_momentum, self.bn_eps,
+                  self.bn_axis_name, self.dtype, "bn1")(x, training=training)
+        x = act(x)
+        # depth-wise
+        x = create_conv2d(mid_chs, self.dw_kernel_size, stride=self.stride,
+                          dilation=self.dilation, padding=self.pad_type,
+                          depthwise=True, dtype=self.dtype, name="conv_dw")(x)
+        x = _norm(self.norm_layer, self.bn_momentum, self.bn_eps,
+                  self.bn_axis_name, self.dtype, "bn2")(x, training=training)
+        x = act(x)
+        if self.se_ratio > 0.0:
+            sek = dict(self.se_kwargs or {})
+            base = mid_chs if sek.pop("reduce_mid", False) else in_chs
+            x = SqueezeExcite(self.se_ratio, reduced_base_chs=base,
+                              act=sek.pop("act", self.act),
+                              gate_fn=sek.pop("gate_fn", self.se_gate_fn),
+                              divisor=sek.pop("divisor", 1),
+                              dtype=self.dtype, name="se")(x)
+        # point-wise linear projection
+        x = create_conv2d(self.out_chs, self.pw_kernel_size,
+                          padding=self.pad_type, dtype=self.dtype,
+                          name="conv_pwl")(x)
+        x = _norm(self.norm_layer, self.bn_momentum, self.bn_eps,
+                  self.bn_axis_name, self.dtype, "bn3")(x, training=training)
+        if has_residual:
+            x = DropPath(self.drop_path_rate, name="drop_path")(x, training=training)
+            x = x + shortcut
+        return x
+
+
+class CondConvResidual(nn.Module):
+    """MBConv with conditionally-parameterized convs (efficientnet_blocks.py:431-481):
+    routing = sigmoid(Linear(global_avg_pool(x))) shared by all three convs."""
+    out_chs: int
+    num_experts: int = 4
+    dw_kernel_size: int = 3
+    stride: int = 1
+    dilation: int = 1
+    pad_type: str = ""
+    act: Any = "relu"
+    noskip: bool = False
+    exp_ratio: float = 1.0
+    exp_kernel_size: int = 1
+    pw_kernel_size: int = 1
+    se_ratio: float = 0.0
+    se_gate_fn: Any = "sigmoid"
+    se_kwargs: Any = None    # {'act','gate_fn','reduce_mid','divisor'} overrides
+    drop_path_rate: float = 0.0
+    norm_layer: str = "bn"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        in_chs = x.shape[-1]
+        mid_chs = make_divisible(in_chs * self.exp_ratio)
+        has_residual = (in_chs == self.out_chs and self.stride == 1
+                        and not self.noskip)
+        act = get_act_fn(self.act)
+        shortcut = x
+        pooled = jnp.mean(x, axis=(1, 2))
+        routing = jax.nn.sigmoid(
+            nn.Dense(self.num_experts, dtype=self.dtype,
+                     name="routing_fn")(pooled))
+        x = CondConv2d(mid_chs, self.exp_kernel_size,
+                       num_experts=self.num_experts, padding=self.pad_type,
+                       dtype=self.dtype, name="conv_pw")(x, routing)
+        x = _norm(self.norm_layer, self.bn_momentum, self.bn_eps,
+                  self.bn_axis_name, self.dtype, "bn1")(x, training=training)
+        x = act(x)
+        x = CondConv2d(mid_chs, self.dw_kernel_size, stride=self.stride,
+                       dilation=self.dilation, groups=mid_chs,
+                       num_experts=self.num_experts, padding=self.pad_type,
+                       dtype=self.dtype, name="conv_dw")(x, routing)
+        x = _norm(self.norm_layer, self.bn_momentum, self.bn_eps,
+                  self.bn_axis_name, self.dtype, "bn2")(x, training=training)
+        x = act(x)
+        if self.se_ratio > 0.0:
+            x = SqueezeExcite(self.se_ratio, reduced_base_chs=in_chs,
+                              act=self.act, gate_fn=self.se_gate_fn,
+                              dtype=self.dtype, name="se")(x)
+        x = CondConv2d(self.out_chs, self.pw_kernel_size,
+                       num_experts=self.num_experts, padding=self.pad_type,
+                       dtype=self.dtype, name="conv_pwl")(x, routing)
+        x = _norm(self.norm_layer, self.bn_momentum, self.bn_eps,
+                  self.bn_axis_name, self.dtype, "bn3")(x, training=training)
+        if has_residual:
+            x = DropPath(self.drop_path_rate, name="drop_path")(x, training=training)
+            x = x + shortcut
+        return x
+
+
+class EdgeResidual(nn.Module):
+    """EdgeTPU FusedMBConv: full kxk expansion conv instead of pw+dw
+    (efficientnet_blocks.py:484-549)."""
+    out_chs: int
+    exp_kernel_size: int = 3
+    stride: int = 1
+    dilation: int = 1
+    pad_type: str = ""
+    act: Any = "relu"
+    noskip: bool = False
+    exp_ratio: float = 1.0
+    fake_in_chs: int = 0
+    pw_kernel_size: int = 1
+    se_ratio: float = 0.0
+    se_gate_fn: Any = "sigmoid"
+    se_kwargs: Any = None    # {'act','gate_fn','reduce_mid','divisor'} overrides
+    drop_path_rate: float = 0.0
+    norm_layer: str = "bn"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        in_chs = x.shape[-1]
+        base = self.fake_in_chs if self.fake_in_chs > 0 else in_chs
+        mid_chs = make_divisible(base * self.exp_ratio)
+        has_residual = (in_chs == self.out_chs and self.stride == 1
+                        and not self.noskip)
+        act = get_act_fn(self.act)
+        shortcut = x
+        x = create_conv2d(mid_chs, self.exp_kernel_size, stride=self.stride,
+                          dilation=self.dilation, padding=self.pad_type,
+                          dtype=self.dtype, name="conv_exp")(x)
+        x = _norm(self.norm_layer, self.bn_momentum, self.bn_eps,
+                  self.bn_axis_name, self.dtype, "bn1")(x, training=training)
+        x = act(x)
+        if self.se_ratio > 0.0:
+            x = SqueezeExcite(self.se_ratio, reduced_base_chs=in_chs,
+                              act=self.act, gate_fn=self.se_gate_fn,
+                              dtype=self.dtype, name="se")(x)
+        x = create_conv2d(self.out_chs, self.pw_kernel_size,
+                          padding=self.pad_type, dtype=self.dtype,
+                          name="conv_pwl")(x)
+        x = _norm(self.norm_layer, self.bn_momentum, self.bn_eps,
+                  self.bn_axis_name, self.dtype, "bn2")(x, training=training)
+        if has_residual:
+            x = DropPath(self.drop_path_rate, name="drop_path")(x, training=training)
+            x = x + shortcut
+        return x

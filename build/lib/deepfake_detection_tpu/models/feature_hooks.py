@@ -1,0 +1,47 @@
+"""Generic intermediate-feature extraction (reference ``feature_hooks.py:5``).
+
+The reference registers torch forward hooks on named modules and harvests
+their outputs.  The functional flax equivalent is
+``capture_intermediates``: every module's outputs are recorded into an
+``intermediates`` collection during ``apply``, no mutation or registration
+required — and unlike torch hooks it composes with ``jit``.
+
+This generalizes the per-model ``features_only=True`` paths (which return
+the stage pyramid) to ANY named submodule.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+from flax.traverse_util import flatten_dict
+
+__all__ = ["extract_features"]
+
+
+def extract_features(model, variables: Dict[str, Any], x,
+                     names: Sequence[str] = (),
+                     filter_fn: Callable[[str], bool] = None,
+                     **apply_kwargs) -> Tuple[Any, Dict[str, Any]]:
+    """Run ``model.apply`` capturing named submodule outputs.
+
+    ``names`` are module-path prefixes (e.g. ``"blocks_2_1"`` or
+    ``"conv_stem"``); ``filter_fn`` receives the dotted path for custom
+    selection.  Returns ``(output, {path: feature})``.
+    """
+    match = filter_fn or (
+        (lambda p: any(p == n or p.startswith(n + ".") for n in names))
+        if names else (lambda p: True))
+
+    out, mods = model.apply(
+        variables, x, training=False,
+        capture_intermediates=lambda mdl, _:
+            match("/".join(mdl.path).replace("/", ".")),
+        mutable=["intermediates"], **apply_kwargs)
+    flat = flatten_dict(mods["intermediates"], sep=".")
+    feats = {}
+    for key, value in flat.items():
+        path = key[: -len(".__call__")] if key.endswith(".__call__") else key
+        # flax stores a tuple of outputs per call
+        feats[path] = value[0] if isinstance(value, tuple) else value
+    return out, feats

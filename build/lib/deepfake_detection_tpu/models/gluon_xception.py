@@ -1,0 +1,209 @@
+"""Gluon Modified-Aligned Xception 65/71 (Flax/NHWC).
+
+Re-design of ``/root/reference/dfd/timm/models/gluon_xception.py`` (468 LoC):
+``SeparableConv2d`` dw→BN→pw (:84-113), the flexible ``Block`` (:116-177:
+grow_first / start_with_relu / is_last variants), ``Xception65`` (:179-307:
+entry 3 blocks, 16 middle blocks, exit block20 + 3 separable convs to 2048)
+and ``Xception71`` (:309-445: deeper entry flow), with output_stride 8/16/32
+dilation plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+
+from ..ops.conv import Conv2d
+from ..ops.norm import BatchNorm2d
+from ..ops.pool import SelectAdaptivePool2d
+from ..registry import register_model
+from .efficientnet import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
+
+__all__ = ["GluonXception"]
+
+
+def _cfg(**kwargs):
+    cfg = dict(num_classes=1000, input_size=(3, 299, 299), pool_size=(10, 10),
+               crop_pct=0.875, interpolation="bicubic",
+               mean=IMAGENET_DEFAULT_MEAN, std=IMAGENET_DEFAULT_STD,
+               first_conv="conv1", classifier="fc")
+    cfg.update(kwargs)
+    return cfg
+
+
+class _SepConv(nn.Module):
+    """SeparableConv2d: depthwise → BN → pointwise (:84-113)."""
+    out_chs: int
+    kernel_size: int = 3
+    stride: int = 1
+    dilation: int = 1
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        in_chs = x.shape[-1]
+        x = Conv2d(in_chs, self.kernel_size, stride=self.stride,
+                   dilation=self.dilation, groups=in_chs, dtype=self.dtype,
+                   name="conv_dw")(x)
+        x = BatchNorm2d(**dict(self.bn or {}, dtype=self.dtype),
+                        name="bn")(x, training=training)
+        return Conv2d(self.out_chs, 1, dtype=self.dtype, name="conv_pw")(x)
+
+
+class _Block(nn.Module):
+    """Reference Block (:116-177)."""
+    planes: int
+    num_reps: int
+    stride: int = 1
+    dilation: int = 1
+    start_with_relu: bool = True
+    grow_first: bool = True
+    is_last: bool = False
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        bn = dict(self.bn or {}, dtype=self.dtype)
+        inplanes = x.shape[-1]
+        if self.planes != inplanes or self.stride != 1:
+            skip = Conv2d(self.planes, 1, stride=self.stride,
+                          dtype=self.dtype, name="skip_conv")(x)
+            skip = BatchNorm2d(**bn, name="skip_bn")(skip, training=training)
+        else:
+            skip = x
+        y = x
+        idx = 1
+        filters = inplanes
+        if self.grow_first:
+            if self.start_with_relu:
+                y = nn.relu(y)
+            y = _SepConv(self.planes, 3, 1, self.dilation, bn=self.bn,
+                         dtype=self.dtype, name=f"conv{idx}")(
+                y, training=training)
+            y = BatchNorm2d(**bn, name=f"bn{idx}")(y, training=training)
+            filters = self.planes
+            idx += 1
+        for _ in range(self.num_reps - 1):
+            if self.grow_first or self.start_with_relu:
+                y = nn.relu(y)
+            y = _SepConv(filters, 3, 1, self.dilation, bn=self.bn,
+                         dtype=self.dtype, name=f"conv{idx}")(
+                y, training=training)
+            y = BatchNorm2d(**bn, name=f"bn{idx}")(y, training=training)
+            idx += 1
+        if not self.grow_first:
+            y = nn.relu(y)
+            y = _SepConv(self.planes, 3, 1, self.dilation, bn=self.bn,
+                         dtype=self.dtype, name=f"conv{idx}")(
+                y, training=training)
+            y = BatchNorm2d(**bn, name=f"bn{idx}")(y, training=training)
+            idx += 1
+        if self.stride != 1 or self.is_last:
+            y = nn.relu(y)
+            y = _SepConv(self.planes, 3,
+                         self.stride if self.stride != 1 else 1,
+                         1 if self.stride != 1 else self.dilation,
+                         bn=self.bn, dtype=self.dtype,
+                         name=f"conv{idx}")(y, training=training)
+            y = BatchNorm2d(**bn, name=f"bn{idx}")(y, training=training)
+        return y + skip
+
+
+class GluonXception(nn.Module):
+    """Xception65/71 (reference :179-307, :309-445); ``deep_entry`` selects
+    the 71 variant's 3-block entry flow at stride 1/2/2."""
+    deep_entry: bool = False
+    output_stride: int = 32
+    num_classes: int = 1000
+    in_chans: int = 3
+    drop_rate: float = 0.0
+    global_pool: str = "avg"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+    default_cfg: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False, features_only: bool = False,
+                 pool: bool = True):
+        assert x.shape[-1] == self.in_chans, (x.shape, self.in_chans)
+        bn = dict(momentum=self.bn_momentum, eps=self.bn_eps,
+                  axis_name=self.bn_axis_name)
+        bnd = dict(bn, dtype=self.dtype)
+        if self.output_stride == 32:
+            b3_stride, b20_stride, mid_d, exit_d = 2, 2, 1, (1, 1)
+        elif self.output_stride == 16:
+            b3_stride, b20_stride, mid_d, exit_d = 2, 1, 1, (1, 2)
+        else:
+            assert self.output_stride == 8
+            b3_stride, b20_stride, mid_d, exit_d = 1, 1, 2, (2, 4)
+        blk = dict(bn=bn, dtype=self.dtype)
+        feats = []
+        x = Conv2d(32, 3, stride=2, dtype=self.dtype, name="conv1")(x)
+        x = BatchNorm2d(**bnd, name="bn1")(x, training=training)
+        x = nn.relu(x)
+        x = Conv2d(64, 3, dtype=self.dtype, name="conv2")(x)
+        x = BatchNorm2d(**bnd, name="bn2")(x, training=training)
+        x = nn.relu(x)
+        x = _Block(128, 2, stride=2, start_with_relu=False, **blk,
+                   name="block1")(x, training=training)
+        x = nn.relu(x)      # "add relu here" (:281)
+        feats.append(x)
+        if self.deep_entry:    # Xception71 (:348-357)
+            x = _Block(256, 2, stride=1, start_with_relu=False, **blk,
+                       name="block2_0")(x, training=training)
+            x = _Block(256, 2, stride=2, start_with_relu=False, **blk,
+                       name="block2_1")(x, training=training)
+            x = _Block(728, 2, stride=2, start_with_relu=False, **blk,
+                       name="block2_2")(x, training=training)
+        else:                  # Xception65 (:219-221)
+            x = _Block(256, 2, stride=2, start_with_relu=False, **blk,
+                       name="block2")(x, training=training)
+        feats.append(x)
+        x = _Block(728, 2, stride=b3_stride, is_last=True, **blk,
+                   name="block3")(x, training=training)
+        for i in range(4, 20):     # middle flow (:226-230)
+            x = _Block(728, 3, dilation=mid_d, **blk,
+                       name=f"block{i}")(x, training=training)
+        feats.append(x)
+        x = _Block(1024, 2, stride=b20_stride, dilation=exit_d[0],
+                   grow_first=False, is_last=True, **blk,
+                   name="block20")(x, training=training)
+        x = nn.relu(x)
+        for i, chs in [(3, 1536), (4, 1536), (5, 2048)]:
+            x = _SepConv(chs, 3, 1, exit_d[1], bn=bn, dtype=self.dtype,
+                         name=f"conv{i}")(x, training=training)
+            x = BatchNorm2d(**bnd, name=f"bn{i}")(x, training=training)
+            x = nn.relu(x)
+        feats.append(x)
+        if features_only:
+            return feats
+        if not pool:
+            return x
+        x = SelectAdaptivePool2d(self.global_pool, name="global_pool")(x)
+        if self.drop_rate > 0:
+            x = nn.Dropout(rate=self.drop_rate,
+                           deterministic=not training)(x)
+        if self.num_classes <= 0:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+
+
+def _register():
+    for name, deep in (("gluon_xception65", False), ("gluon_xception71", True)):
+        def fn(pretrained=False, *, _deep=deep, **kwargs):
+            kwargs.pop("pretrained", None)
+            kwargs.setdefault("default_cfg", _cfg())
+            return GluonXception(deep_entry=_deep, **kwargs)
+        fn.__name__ = name
+        fn.__qualname__ = name
+        fn.__module__ = __name__
+        fn.__doc__ = f"{name} (reference gluon_xception.py entrypoint)."
+        register_model(fn)
+
+
+_register()

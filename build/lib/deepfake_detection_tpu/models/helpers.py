@@ -1,0 +1,216 @@
+"""Model checkpoint helpers.
+
+Re-design of ``/root/reference/dfd/timm/models/helpers.py``: EMA-stream
+selection (:13), ``module.``-prefix handling (:19 — a DDP artifact with no JAX
+analog, kept only in the torch converter), non-strict shape-mismatch dropping
+(:39-43), resume with optimizer/epoch state (:47-73), and pretrained load with
+in_chans / classifier surgery (:76-109).
+
+Format: a single msgpack file holding ``{"variables": ..., "meta": {...}}``
+(flax.serialization); the training-loop checkpointer (orbax, top-K/best/
+recovery) lives in ``train/checkpoint.py``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import flax
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+from flax.core import freeze, unfreeze
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["maybe_remat",
+           "save_model_checkpoint", "load_state_dict", "load_checkpoint",
+           "resume_checkpoint", "load_pretrained", "filter_shape_mismatch",
+           "adapt_input_params"]
+
+
+def save_model_checkpoint(path: str, variables: Dict[str, Any],
+                          meta: Optional[Dict[str, Any]] = None) -> None:
+    payload = {"variables": unfreeze(variables) if isinstance(
+        variables, flax.core.FrozenDict) else variables,
+        "meta": meta or {}}
+    blob = serialization.msgpack_serialize(
+        jax.tree.map(np.asarray, payload))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+
+
+def load_state_dict(checkpoint_path: str, use_ema: bool = False) -> Dict[str, Any]:
+    """Read a checkpoint file; prefer the EMA stream when asked and present
+    (helpers.py:13-28)."""
+    if not checkpoint_path or not os.path.isfile(checkpoint_path):
+        raise FileNotFoundError(f"No checkpoint at {checkpoint_path!r}")
+    with open(checkpoint_path, "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    meta = payload.get("meta", {})
+    if "state" in payload and "variables" not in payload:
+        # trainer checkpoint (train/checkpoint.py): TrainState state-dict
+        # {step, params, batch_stats, opt_state, ema}
+        st = payload["state"]
+        ema = st.get("ema") or None
+        if use_ema and ema:
+            _logger.info("Loaded EMA stream from %s", checkpoint_path)
+            return {"params": ema["params"],
+                    "batch_stats": ema.get("batch_stats", {})}
+        return {"params": st["params"],
+                "batch_stats": st.get("batch_stats", {})}
+    if use_ema and "variables_ema" in payload:
+        _logger.info("Loaded state_dict_ema from %s", checkpoint_path)
+        return payload["variables_ema"]
+    if use_ema and meta.get("has_ema"):
+        _logger.info("Loaded EMA stream from %s", checkpoint_path)
+        return payload.get("variables_ema", payload["variables"])
+    return payload["variables"]
+
+
+def _flatten(tree, prefix=()):
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            out.update(_flatten(v, prefix + (k,)))
+        else:
+            out[prefix + (k,)] = v
+    return out
+
+
+def filter_shape_mismatch(init_vars: Dict[str, Any],
+                          loaded_vars: Dict[str, Any]) -> Tuple[Dict[str, Any], int]:
+    """Non-strict load: keep the freshly-initialized value wherever the loaded
+    tensor's shape disagrees or the key is missing (helpers.py:39-43)."""
+    init_flat = _flatten(unfreeze(init_vars) if hasattr(init_vars, "items") else init_vars)
+    loaded_flat = _flatten(loaded_vars)
+    dropped = 0
+    merged = {}
+    for k, v in init_flat.items():
+        lv = loaded_flat.get(k)
+        if lv is not None and tuple(np.shape(lv)) == tuple(np.shape(v)):
+            merged[k] = jnp.asarray(lv)
+        else:
+            if lv is not None:
+                _logger.warning("shape mismatch at %s: ckpt %s vs model %s — dropped",
+                                "/".join(k), np.shape(lv), np.shape(v))
+                dropped += 1
+            merged[k] = v
+    # unflatten
+    tree: Dict[str, Any] = {}
+    for k, v in merged.items():
+        node = tree
+        for part in k[:-1]:
+            node = node.setdefault(part, {})
+        node[k[-1]] = v
+    return tree, dropped
+
+
+def load_checkpoint(init_variables: Dict[str, Any], checkpoint_path: str,
+                    use_ema: bool = False, strict: bool = True) -> Dict[str, Any]:
+    """Load weights into an initialized variable tree (helpers.py:31-44)."""
+    loaded = load_state_dict(checkpoint_path, use_ema)
+    if strict:
+        restored = serialization.from_state_dict(init_variables, loaded) \
+            if not isinstance(loaded, dict) else loaded
+        # validate structure matches
+        init_flat = _flatten(unfreeze(init_variables)
+                             if hasattr(init_variables, "items") else init_variables)
+        loaded_flat = _flatten(restored)
+        missing = set(init_flat) - set(loaded_flat)
+        if missing:
+            raise KeyError(f"strict load: missing keys {sorted(missing)[:5]} ...")
+        merged, dropped = filter_shape_mismatch(init_variables, restored)
+        if dropped:
+            raise ValueError(f"strict load: {dropped} shape mismatches")
+        return merged
+    merged, _ = filter_shape_mismatch(init_variables, loaded)
+    return merged
+
+
+def resume_checkpoint(init_variables: Dict[str, Any],
+                      checkpoint_path: str) -> Tuple[Dict[str, Any], Dict[str, Any], int]:
+    """Full resume (helpers.py:47-73): returns (variables, meta, start_epoch).
+
+    ``meta`` carries optimizer state / epoch / metric written by the training
+    checkpointer; start_epoch = saved epoch + 1 (helpers.py:64).
+    """
+    with open(checkpoint_path, "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    variables, _ = filter_shape_mismatch(init_variables, payload["variables"])
+    meta = payload.get("meta", {})
+    start_epoch = int(meta.get("epoch", -1)) + 1
+    _logger.info("Resumed from %s (epoch %d)", checkpoint_path, start_epoch - 1)
+    return variables, meta, start_epoch
+
+
+def adapt_input_params(params: Dict[str, Any], in_chans: int,
+                       first_conv: str = "conv_stem") -> Dict[str, Any]:
+    """Input-channel surgery for pretrained weights (helpers.py:83-103):
+    3→1 chans = sum RGB; 3→N = tile + renormalize.  Kernels are HWIO."""
+    params = unfreeze(params) if hasattr(params, "items") else dict(params)
+
+    def visit(node):
+        for k, v in node.items():
+            if isinstance(v, dict):
+                if k == first_conv and "conv" in v and "kernel" in v["conv"]:
+                    kern = np.asarray(v["conv"]["kernel"])
+                    kh, kw, ci, co = kern.shape
+                    if ci == in_chans:
+                        continue
+                    if in_chans == 1:
+                        new = kern.sum(axis=2, keepdims=True)
+                    else:
+                        reps = int(np.ceil(in_chans / ci))
+                        new = np.tile(kern, (1, 1, reps, 1))[:, :, :in_chans]
+                        new *= ci / in_chans
+                    v["conv"]["kernel"] = jnp.asarray(new)
+                else:
+                    visit(v)
+    visit(params)
+    return params
+
+
+def load_pretrained(init_variables, checkpoint_path: str, num_classes: int,
+                    in_chans: int = 3, first_conv: str = "conv_stem",
+                    classifier: str = "classifier", strict: bool = True):
+    """Pretrained load with input/classifier surgery (helpers.py:76-109).
+
+    The reference pulls from model-zoo URLs; this framework is zero-egress so
+    pretrained weights come from a local path.
+    """
+    loaded = load_state_dict(checkpoint_path)
+    if "params" in loaded:
+        loaded["params"] = adapt_input_params(loaded["params"], in_chans,
+                                              first_conv)
+        cls = loaded["params"].get(classifier)
+        if cls is not None and "kernel" in cls:
+            if np.shape(cls["kernel"])[-1] != num_classes:
+                _logger.info("classifier size mismatch — re-initializing head")
+                loaded["params"].pop(classifier)
+                strict = False
+    merged, _ = filter_shape_mismatch(init_variables, loaded)
+    return merged
+
+
+def maybe_remat(block_cls, policy: str):
+    """Wrap a block Module class for rematerialization (shared policy
+    surface of EfficientNet/ViT/TimeSformer; TrainConfig.checkpoint_policy).
+
+    'none' — save all activations; 'full' — recompute the whole block in
+    the backward pass; 'dots' — save only matmul/conv outputs.  Blocks must
+    take ``training`` as their second positional argument (static).
+    """
+    import flax.linen as nn
+    assert policy in ("none", "full", "dots"), \
+        f"remat policy must be none|full|dots, got {policy!r}"
+    if policy == "none":
+        return block_cls
+    jpolicy = None if policy == "full" \
+        else jax.checkpoint_policies.checkpoint_dots
+    return nn.remat(block_cls, policy=jpolicy, static_argnums=(2,))

@@ -1,0 +1,250 @@
+"""HRNet — High-Resolution Network (Flax/NHWC).
+
+Re-design of ``/root/reference/dfd/timm/models/hrnet.py`` (804 LoC): parallel
+multi-resolution branches with repeated cross-resolution fusion
+(``HighResolutionModule`` :394-516), transition layers that widen/deepen the
+branch set (:609-634), the classification head that re-expands C/2C/4C/8C to
+128/256/512/1024 then 2048 (:572-607), and the 9 ``hrnet_w*`` entrypoints.
+Branch blocks are this package's ResNet Basic/Bottleneck blocks, exactly as
+the reference reuses its resnet.py blocks (:25).
+
+TPU notes: branch lists are static Python lists of arrays (one trace per
+resolution); nearest-neighbour upsampling in the fuse step is a free
+``jnp.repeat``; the whole multi-branch graph fuses into one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.conv import Conv2d
+from ..ops.norm import BatchNorm2d
+from ..ops.pool import SelectAdaptivePool2d
+from ..registry import register_model
+from .efficientnet import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
+from .resnet import BasicBlock, Bottleneck
+
+__all__ = ["HighResolutionNet"]
+
+
+def _cfg(**kwargs):
+    cfg = dict(num_classes=1000, input_size=(3, 224, 224), pool_size=(7, 7),
+               crop_pct=0.875, interpolation="bilinear",
+               mean=IMAGENET_DEFAULT_MEAN, std=IMAGENET_DEFAULT_STD,
+               first_conv="conv1", classifier="classifier")
+    cfg.update(kwargs)
+    return cfg
+
+
+def _upsample_nearest(x, factor: int):
+    return jnp.repeat(jnp.repeat(x, factor, axis=1), factor, axis=2)
+
+
+class _ConvBnRelu(nn.Module):
+    out_chs: int
+    kernel_size: int = 3
+    stride: int = 1
+    relu: bool = True
+    use_bias: bool = False
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = Conv2d(self.out_chs, self.kernel_size, stride=self.stride,
+                   use_bias=self.use_bias, dtype=self.dtype, name="conv")(x)
+        x = BatchNorm2d(**dict(self.bn or {}, dtype=self.dtype),
+                        name="bn")(x, training=training)
+        return nn.relu(x) if self.relu else x
+
+
+class _HRModule(nn.Module):
+    """HighResolutionModule (reference :394-516): per-branch residual blocks
+    then all-to-all fusion (upsample high→low index, strided-conv chains
+    low→high index, SUM)."""
+    num_branches: int
+    block: str                       # 'basic' | 'bottleneck'
+    num_blocks: Sequence[int]
+    num_channels: Sequence[int]      # post-expansion channels per branch
+    multi_scale_output: bool = True
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, xs, training: bool = False):
+        block_cls = BasicBlock if self.block == "basic" else Bottleneck
+        planes = [c // block_cls.expansion for c in self.num_channels]
+        ys = []
+        for bi in range(self.num_branches):
+            x = xs[bi]
+            for li in range(self.num_blocks[bi]):
+                need_ds = li == 0 and x.shape[-1] != self.num_channels[bi]
+                x = block_cls(planes=planes[bi], has_downsample=need_ds,
+                              zero_init_last_bn=False, bn=self.bn,
+                              dtype=self.dtype,
+                              name=f"branch{bi}_{li}")(x, training=training)
+            ys.append(x)
+        if self.num_branches == 1:
+            return ys
+        out = []
+        n_out = self.num_branches if self.multi_scale_output else 1
+        for i in range(n_out):
+            y = None
+            for j in range(self.num_branches):
+                if j == i:
+                    t = ys[j]
+                elif j > i:
+                    # 1×1 to target chs, BN, nearest ×2^(j-i) (:470-474)
+                    t = _ConvBnRelu(self.num_channels[i], 1, relu=False,
+                                    bn=self.bn, dtype=self.dtype,
+                                    name=f"fuse{i}_{j}")(ys[j],
+                                                         training=training)
+                    t = _upsample_nearest(t, 2 ** (j - i))
+                else:
+                    # chain of stride-2 3×3s (:476-489)
+                    t = ys[j]
+                    for k in range(i - j):
+                        last = k == i - j - 1
+                        chs = self.num_channels[i] if last \
+                            else self.num_channels[j]
+                        t = _ConvBnRelu(chs, 3, 2, relu=not last, bn=self.bn,
+                                        dtype=self.dtype,
+                                        name=f"fuse{i}_{j}_{k}")(
+                            t, training=training)
+                y = t if y is None else y + t
+            out.append(nn.relu(y))
+        return out
+
+
+class HighResolutionNet(nn.Module):
+    """Generic HRNet classifier (reference :522-744)."""
+    stage1: Tuple[int, int] = (4, 64)        # (blocks, channels), BOTTLENECK
+    channels: Sequence[int] = (18, 36, 72, 144)   # BASIC branch widths
+    num_blocks: int = 4                       # per branch, stages 2-4
+    modules: Sequence[int] = (1, 4, 3)        # HR modules in stages 2/3/4
+    stem_width: int = 64
+    num_classes: int = 1000
+    in_chans: int = 3
+    drop_rate: float = 0.0
+    global_pool: str = "avg"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+    default_cfg: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False, features_only: bool = False,
+                 pool: bool = True):
+        assert x.shape[-1] == self.in_chans, (x.shape, self.in_chans)
+        bn = dict(momentum=self.bn_momentum, eps=self.bn_eps,
+                  axis_name=self.bn_axis_name)
+        # stem: two stride-2 3×3s (:529-534)
+        x = _ConvBnRelu(self.stem_width, 3, 2, bn=bn, dtype=self.dtype,
+                        name="conv1")(x, training=training)
+        x = _ConvBnRelu(64, 3, 2, bn=bn, dtype=self.dtype,
+                        name="conv2")(x, training=training)
+        # layer1: Bottleneck stack (:536-541)
+        s1_blocks, s1_chs = self.stage1
+        for li in range(s1_blocks):
+            need_ds = li == 0 and x.shape[-1] != s1_chs * 4
+            x = Bottleneck(planes=s1_chs, has_downsample=need_ds,
+                           zero_init_last_bn=False, bn=bn, dtype=self.dtype,
+                           name=f"layer1_{li}")(x, training=training)
+
+        xs = [x]
+        for si in range(3):                       # stages 2, 3, 4
+            n_br = si + 2
+            chs = list(self.channels[:n_br])      # BASIC expansion = 1
+            # transition (:609-634): adapt existing branches, spawn new ones
+            new_xs = []
+            for bi in range(n_br):
+                if bi < len(xs):
+                    if xs[bi].shape[-1] != chs[bi]:
+                        new_xs.append(_ConvBnRelu(
+                            chs[bi], 3, bn=bn, dtype=self.dtype,
+                            name=f"transition{si + 1}_{bi}")(
+                            xs[bi], training=training))
+                    else:
+                        new_xs.append(xs[bi])
+                else:
+                    t = xs[-1]
+                    for j in range(bi + 1 - len(xs)):
+                        out_c = chs[bi] if j == bi - len(xs) else t.shape[-1]
+                        t = _ConvBnRelu(out_c, 3, 2, bn=bn, dtype=self.dtype,
+                                        name=f"transition{si + 1}_{bi}_{j}")(
+                            t, training=training)
+                    new_xs.append(t)
+            xs = new_xs
+            for mi in range(self.modules[si]):
+                xs = _HRModule(n_br, "basic", (self.num_blocks,) * n_br,
+                               tuple(chs), bn=bn, dtype=self.dtype,
+                               name=f"stage{si + 2}_{mi}")(
+                    xs, training=training)
+        if features_only:
+            return xs
+        # classification head (:572-607): incre to 128/256/512/1024,
+        # stride-2 downsample chain with SUM, final 1×1 to 2048
+        head_chs = (32, 64, 128, 256)
+        y = None
+        for bi, t in enumerate(xs):
+            need_ds = t.shape[-1] != head_chs[bi] * 4
+            t = Bottleneck(planes=head_chs[bi], has_downsample=need_ds,
+                           zero_init_last_bn=False, bn=bn, dtype=self.dtype,
+                           name=f"incre{bi}")(t, training=training)
+            if bi > 0:
+                y = t + _ConvBnRelu(head_chs[bi] * 4, 3, 2, use_bias=True,
+                                    bn=bn, dtype=self.dtype,
+                                    name=f"downsamp{bi - 1}")(
+                    y, training=training)
+            else:
+                y = t
+        y = _ConvBnRelu(2048, 1, use_bias=True, bn=bn, dtype=self.dtype,
+                        name="final_layer")(y, training=training)
+        if not pool:
+            return y
+        y = SelectAdaptivePool2d(self.global_pool, name="global_pool")(y)
+        if self.drop_rate > 0.0:
+            y = nn.Dropout(rate=self.drop_rate,
+                           deterministic=not training)(y)
+        if self.num_classes <= 0:
+            return y
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="classifier")(y)
+
+
+# name: (stage1 (blocks, chs), base width, per-branch blocks, modules/stage)
+# extracted from the reference cfg_cls tables (hrnet.py:80-390)
+_HRNET_DEFS = {
+    "hrnet_w18_small": ((1, 32), 16, 2, (1, 1, 1)),
+    "hrnet_w18_small_v2": ((2, 64), 18, 2, (1, 3, 2)),
+    "hrnet_w18": ((4, 64), 18, 4, (1, 4, 3)),
+    "hrnet_w30": ((4, 64), 30, 4, (1, 4, 3)),
+    "hrnet_w32": ((4, 64), 32, 4, (1, 4, 3)),
+    "hrnet_w40": ((4, 64), 40, 4, (1, 4, 3)),
+    "hrnet_w44": ((4, 64), 44, 4, (1, 4, 3)),
+    "hrnet_w48": ((4, 64), 48, 4, (1, 4, 3)),
+    "hrnet_w64": ((4, 64), 64, 4, (1, 4, 3)),
+}
+
+
+def _register():
+    for name, (s1, w, nb, mods) in _HRNET_DEFS.items():
+        def fn(pretrained=False, *, _s1=s1, _w=w, _nb=nb, _mods=mods,
+               **kwargs):
+            kwargs.pop("pretrained", None)
+            kwargs.setdefault("default_cfg", _cfg())
+            return HighResolutionNet(
+                stage1=_s1, channels=(_w, _w * 2, _w * 4, _w * 8),
+                num_blocks=_nb, modules=_mods, **kwargs)
+        fn.__name__ = name
+        fn.__qualname__ = name
+        fn.__module__ = __name__
+        fn.__doc__ = f"{name} (reference hrnet.py entrypoint)."
+        register_model(fn)
+
+
+_register()

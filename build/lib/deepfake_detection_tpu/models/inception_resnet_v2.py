@@ -1,0 +1,209 @@
+"""Inception-ResNet-V2 (Flax/NHWC).
+
+Re-design of ``/root/reference/dfd/timm/models/inception_resnet_v2.py``
+(355 LoC): stem (:185-195), Mixed_5b (:46-77), 10× Block35 scale .17
+(:80-113), Mixed_6a (:116-135), 20× Block17 scale .10 (:138-164),
+Mixed_7a (:167-195), 9× Block8 scale .20 + final no-relu Block8 (:198-230),
+1536-dim head (:288-291), and the two entrypoints (:330-355).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.conv import Conv2d
+from ..ops.norm import BatchNorm2d
+from ..ops.pool import SelectAdaptivePool2d, avg_pool2d_same
+from ..registry import register_model
+from .efficientnet import IMAGENET_INCEPTION_MEAN, IMAGENET_INCEPTION_STD
+
+__all__ = ["InceptionResnetV2"]
+
+_H = [(0, 0), (3, 3)]
+_V = [(3, 3), (0, 0)]
+_H3 = [(0, 0), (1, 1)]
+_V3 = [(1, 1), (0, 0)]
+
+
+def _cfg(**kwargs):
+    cfg = dict(num_classes=1000, input_size=(3, 299, 299), pool_size=(8, 8),
+               crop_pct=0.8975, interpolation="bicubic",
+               mean=IMAGENET_INCEPTION_MEAN, std=IMAGENET_INCEPTION_STD,
+               first_conv="conv2d_1a", classifier="classif")
+    cfg.update(kwargs)
+    return cfg
+
+
+class _CB(nn.Module):
+    """BasicConv2d (:34-45)."""
+    out_chs: int
+    kernel_size: Any = 3
+    stride: int = 1
+    padding: Any = "valid"
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = Conv2d(self.out_chs, self.kernel_size, stride=self.stride,
+                   padding=self.padding, dtype=self.dtype, name="conv")(x)
+        x = BatchNorm2d(**dict(self.bn or {}, dtype=self.dtype),
+                        name="bn")(x, training=training)
+        return nn.relu(x)
+
+
+class InceptionResnetV2(nn.Module):
+    """Reference InceptionResnetV2 (:233-327)."""
+    num_classes: int = 1000
+    in_chans: int = 3
+    drop_rate: float = 0.0
+    global_pool: str = "avg"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-3
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+    default_cfg: Any = None
+
+    def _block35(self, x, bn, training, name, scale=0.17):
+        cb = dict(bn=bn, dtype=self.dtype)
+        b0 = _CB(32, 1, **cb, name=f"{name}_b0")(x, training=training)
+        b1 = _CB(32, 1, **cb, name=f"{name}_b1_0")(x, training=training)
+        b1 = _CB(32, 3, padding=1, **cb, name=f"{name}_b1_1")(
+            b1, training=training)
+        b2 = _CB(32, 1, **cb, name=f"{name}_b2_0")(x, training=training)
+        b2 = _CB(48, 3, padding=1, **cb, name=f"{name}_b2_1")(
+            b2, training=training)
+        b2 = _CB(64, 3, padding=1, **cb, name=f"{name}_b2_2")(
+            b2, training=training)
+        out = Conv2d(320, 1, use_bias=True, dtype=self.dtype,
+                     name=f"{name}_conv2d")(
+            jnp.concatenate([b0, b1, b2], axis=-1))
+        return nn.relu(out * scale + x)
+
+    def _block17(self, x, bn, training, name, scale=0.10):
+        cb = dict(bn=bn, dtype=self.dtype)
+        b0 = _CB(192, 1, **cb, name=f"{name}_b0")(x, training=training)
+        b1 = _CB(128, 1, **cb, name=f"{name}_b1_0")(x, training=training)
+        b1 = _CB(160, (1, 7), padding=_H, **cb, name=f"{name}_b1_1")(
+            b1, training=training)
+        b1 = _CB(192, (7, 1), padding=_V, **cb, name=f"{name}_b1_2")(
+            b1, training=training)
+        out = Conv2d(1088, 1, use_bias=True, dtype=self.dtype,
+                     name=f"{name}_conv2d")(
+            jnp.concatenate([b0, b1], axis=-1))
+        return nn.relu(out * scale + x)
+
+    def _block8(self, x, bn, training, name, scale=0.20, relu=True):
+        cb = dict(bn=bn, dtype=self.dtype)
+        b0 = _CB(192, 1, **cb, name=f"{name}_b0")(x, training=training)
+        b1 = _CB(192, 1, **cb, name=f"{name}_b1_0")(x, training=training)
+        b1 = _CB(224, (1, 3), padding=_H3, **cb, name=f"{name}_b1_1")(
+            b1, training=training)
+        b1 = _CB(256, (3, 1), padding=_V3, **cb, name=f"{name}_b1_2")(
+            b1, training=training)
+        out = Conv2d(2080, 1, use_bias=True, dtype=self.dtype,
+                     name=f"{name}_conv2d")(
+            jnp.concatenate([b0, b1], axis=-1))
+        out = out * scale + x
+        return nn.relu(out) if relu else out
+
+    @nn.compact
+    def __call__(self, x, training: bool = False, features_only: bool = False,
+                 pool: bool = True):
+        assert x.shape[-1] == self.in_chans, (x.shape, self.in_chans)
+        bn = dict(momentum=self.bn_momentum, eps=self.bn_eps,
+                  axis_name=self.bn_axis_name)
+        cb = dict(bn=bn, dtype=self.dtype)
+        feats = []
+        x = _CB(32, 3, 2, **cb, name="conv2d_1a")(x, training=training)
+        x = _CB(32, 3, **cb, name="conv2d_2a")(x, training=training)
+        x = _CB(64, 3, padding=1, **cb, name="conv2d_2b")(x,
+                                                          training=training)
+        feats.append(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = _CB(80, 1, **cb, name="conv2d_3b")(x, training=training)
+        x = _CB(192, 3, **cb, name="conv2d_4a")(x, training=training)
+        feats.append(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # Mixed_5b (:46-77)
+        b0 = _CB(96, 1, **cb, name="mixed_5b_b0")(x, training=training)
+        b1 = _CB(48, 1, **cb, name="mixed_5b_b1_0")(x, training=training)
+        b1 = _CB(64, 5, padding=2, **cb, name="mixed_5b_b1_1")(
+            b1, training=training)
+        b2 = _CB(64, 1, **cb, name="mixed_5b_b2_0")(x, training=training)
+        b2 = _CB(96, 3, padding=1, **cb, name="mixed_5b_b2_1")(
+            b2, training=training)
+        b2 = _CB(96, 3, padding=1, **cb, name="mixed_5b_b2_2")(
+            b2, training=training)
+        b3 = _CB(64, 1, **cb, name="mixed_5b_b3")(
+            avg_pool2d_same(x, (3, 3), (1, 1), count_include_pad=False),
+            training=training)
+        x = jnp.concatenate([b0, b1, b2, b3], axis=-1)
+        for i in range(10):
+            x = self._block35(x, bn, training, f"block35_{i}")
+        feats.append(x)
+        # Mixed_6a (:116-135)
+        b0 = _CB(384, 3, 2, **cb, name="mixed_6a_b0")(x, training=training)
+        b1 = _CB(256, 1, **cb, name="mixed_6a_b1_0")(x, training=training)
+        b1 = _CB(256, 3, padding=1, **cb, name="mixed_6a_b1_1")(
+            b1, training=training)
+        b1 = _CB(384, 3, 2, **cb, name="mixed_6a_b1_2")(b1,
+                                                        training=training)
+        x = jnp.concatenate([
+            b0, b1, nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")],
+            axis=-1)
+        for i in range(20):
+            x = self._block17(x, bn, training, f"block17_{i}")
+        feats.append(x)
+        # Mixed_7a (:167-195)
+        b0 = _CB(256, 1, **cb, name="mixed_7a_b0_0")(x, training=training)
+        b0 = _CB(384, 3, 2, **cb, name="mixed_7a_b0_1")(b0,
+                                                        training=training)
+        b1 = _CB(256, 1, **cb, name="mixed_7a_b1_0")(x, training=training)
+        b1 = _CB(288, 3, 2, **cb, name="mixed_7a_b1_1")(b1,
+                                                        training=training)
+        b2 = _CB(256, 1, **cb, name="mixed_7a_b2_0")(x, training=training)
+        b2 = _CB(288, 3, padding=1, **cb, name="mixed_7a_b2_1")(
+            b2, training=training)
+        b2 = _CB(320, 3, 2, **cb, name="mixed_7a_b2_2")(b2,
+                                                        training=training)
+        x = jnp.concatenate([
+            b0, b1, b2,
+            nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")], axis=-1)
+        for i in range(9):
+            x = self._block8(x, bn, training, f"block8_{i}")
+        x = self._block8(x, bn, training, "block8_final", scale=1.0,
+                         relu=False)
+        x = _CB(1536, 1, **cb, name="conv2d_7b")(x, training=training)
+        feats.append(x)
+        if features_only:
+            return feats
+        if not pool:
+            return x
+        x = SelectAdaptivePool2d(self.global_pool, name="global_pool")(x)
+        if self.drop_rate > 0:
+            x = nn.Dropout(rate=self.drop_rate,
+                           deterministic=not training)(x)
+        if self.num_classes <= 0:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="classif")(x)
+
+
+def _register():
+    for name in ("inception_resnet_v2", "ens_adv_inception_resnet_v2"):
+        def fn(pretrained=False, *, _n=name, **kwargs):
+            kwargs.pop("pretrained", None)
+            kwargs.setdefault("default_cfg", _cfg())
+            return InceptionResnetV2(**kwargs)
+        fn.__name__ = name
+        fn.__qualname__ = name
+        fn.__module__ = __name__
+        fn.__doc__ = f"{name} (reference inception_resnet_v2.py entrypoint)."
+        register_model(fn)
+
+
+_register()

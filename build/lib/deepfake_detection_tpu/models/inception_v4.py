@@ -1,0 +1,222 @@
+"""Inception-V4 (Flax/NHWC).
+
+Re-design of ``/root/reference/dfd/timm/models/inception_v4.py`` (308 LoC):
+stem (Mixed_3a/4a/5a, :42-88), 4× Inception_A (:91-118), Reduction_A
+(:121-139), 7× Inception_B (:142-177), Reduction_B (:180-202),
+3× Inception_C (:205-249), 1536-dim head (:252-303).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.conv import Conv2d
+from ..ops.norm import BatchNorm2d
+from ..ops.pool import SelectAdaptivePool2d, avg_pool2d_same
+from ..registry import register_model
+from .efficientnet import IMAGENET_INCEPTION_MEAN, IMAGENET_INCEPTION_STD
+
+__all__ = ["InceptionV4"]
+
+_H = [(0, 0), (3, 3)]       # 1×7 padding
+_V = [(3, 3), (0, 0)]       # 7×1 padding
+_H3 = [(0, 0), (1, 1)]      # 1×3
+_V3 = [(1, 1), (0, 0)]      # 3×1
+
+
+def _cfg(**kwargs):
+    cfg = dict(num_classes=1000, input_size=(3, 299, 299), pool_size=(8, 8),
+               crop_pct=0.875, interpolation="bicubic",
+               mean=IMAGENET_INCEPTION_MEAN, std=IMAGENET_INCEPTION_STD,
+               first_conv="features_0", classifier="last_linear")
+    cfg.update(kwargs)
+    return cfg
+
+
+def _avgpool3(x):
+    # count_include_pad=False (reference :108 etc.)
+    return avg_pool2d_same(x, (3, 3), (1, 1), count_include_pad=False)
+
+
+class _CB(nn.Module):
+    """BasicConv2d: conv(bias=False) → BN(eps=1e-3) → ReLU (:27-39)."""
+    out_chs: int
+    kernel_size: Any = 3
+    stride: int = 1
+    padding: Any = "valid"
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = Conv2d(self.out_chs, self.kernel_size, stride=self.stride,
+                   padding=self.padding, dtype=self.dtype, name="conv")(x)
+        x = BatchNorm2d(**dict(self.bn or {}, dtype=self.dtype),
+                        name="bn")(x, training=training)
+        return nn.relu(x)
+
+
+class InceptionV4(nn.Module):
+    """Reference InceptionV4 (:252-303)."""
+    num_classes: int = 1000
+    in_chans: int = 3
+    drop_rate: float = 0.0
+    global_pool: str = "avg"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-3
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+    default_cfg: Any = None
+
+    def _ia(self, x, bn, training, name):
+        """Inception_A (:91-118)."""
+        cb = dict(bn=bn, dtype=self.dtype)
+        b0 = _CB(96, 1, **cb, name=f"{name}_b0")(x, training=training)
+        b1 = _CB(96, 3, padding=1, **cb, name=f"{name}_b1_1")(
+            _CB(64, 1, **cb, name=f"{name}_b1_0")(x, training=training),
+            training=training)
+        b2 = _CB(64, 1, **cb, name=f"{name}_b2_0")(x, training=training)
+        b2 = _CB(96, 3, padding=1, **cb, name=f"{name}_b2_1")(
+            b2, training=training)
+        b2 = _CB(96, 3, padding=1, **cb, name=f"{name}_b2_2")(
+            b2, training=training)
+        b3 = _CB(96, 1, **cb, name=f"{name}_b3")(_avgpool3(x),
+                                                 training=training)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+    def _ib(self, x, bn, training, name):
+        """Inception_B (:142-177)."""
+        cb = dict(bn=bn, dtype=self.dtype)
+        b0 = _CB(384, 1, **cb, name=f"{name}_b0")(x, training=training)
+        b1 = _CB(192, 1, **cb, name=f"{name}_b1_0")(x, training=training)
+        b1 = _CB(224, (1, 7), padding=_H, **cb, name=f"{name}_b1_1")(
+            b1, training=training)
+        b1 = _CB(256, (7, 1), padding=_V, **cb, name=f"{name}_b1_2")(
+            b1, training=training)
+        b2 = _CB(192, 1, **cb, name=f"{name}_b2_0")(x, training=training)
+        b2 = _CB(192, (7, 1), padding=_V, **cb, name=f"{name}_b2_1")(
+            b2, training=training)
+        b2 = _CB(224, (1, 7), padding=_H, **cb, name=f"{name}_b2_2")(
+            b2, training=training)
+        b2 = _CB(224, (7, 1), padding=_V, **cb, name=f"{name}_b2_3")(
+            b2, training=training)
+        b2 = _CB(256, (1, 7), padding=_H, **cb, name=f"{name}_b2_4")(
+            b2, training=training)
+        b3 = _CB(128, 1, **cb, name=f"{name}_b3")(_avgpool3(x),
+                                                  training=training)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+    def _ic(self, x, bn, training, name):
+        """Inception_C (:205-249)."""
+        cb = dict(bn=bn, dtype=self.dtype)
+        b0 = _CB(256, 1, **cb, name=f"{name}_b0")(x, training=training)
+        b1 = _CB(384, 1, **cb, name=f"{name}_b1_0")(x, training=training)
+        b1 = jnp.concatenate([
+            _CB(256, (1, 3), padding=_H3, **cb, name=f"{name}_b1_1a")(
+                b1, training=training),
+            _CB(256, (3, 1), padding=_V3, **cb, name=f"{name}_b1_1b")(
+                b1, training=training)], axis=-1)
+        b2 = _CB(384, 1, **cb, name=f"{name}_b2_0")(x, training=training)
+        b2 = _CB(448, (3, 1), padding=_V3, **cb, name=f"{name}_b2_1")(
+            b2, training=training)
+        b2 = _CB(512, (1, 3), padding=_H3, **cb, name=f"{name}_b2_2")(
+            b2, training=training)
+        b2 = jnp.concatenate([
+            _CB(256, (1, 3), padding=_H3, **cb, name=f"{name}_b2_3a")(
+                b2, training=training),
+            _CB(256, (3, 1), padding=_V3, **cb, name=f"{name}_b2_3b")(
+                b2, training=training)], axis=-1)
+        b3 = _CB(256, 1, **cb, name=f"{name}_b3")(_avgpool3(x),
+                                                  training=training)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+    @nn.compact
+    def __call__(self, x, training: bool = False, features_only: bool = False,
+                 pool: bool = True):
+        assert x.shape[-1] == self.in_chans, (x.shape, self.in_chans)
+        bn = dict(momentum=self.bn_momentum, eps=self.bn_eps,
+                  axis_name=self.bn_axis_name)
+        cb = dict(bn=bn, dtype=self.dtype)
+        feats = []
+        x = _CB(32, 3, 2, **cb, name="features_0")(x, training=training)
+        x = _CB(32, 3, **cb, name="features_1")(x, training=training)
+        x = _CB(64, 3, padding=1, **cb, name="features_2")(x,
+                                                           training=training)
+        feats.append(x)
+        # Mixed_3a (:42-52)
+        x = jnp.concatenate([
+            nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID"),
+            _CB(96, 3, 2, **cb, name="mixed_3a_conv")(x, training=training),
+        ], axis=-1)
+        # Mixed_4a (:55-75)
+        b0 = _CB(64, 1, **cb, name="mixed_4a_b0_0")(x, training=training)
+        b0 = _CB(96, 3, **cb, name="mixed_4a_b0_1")(b0, training=training)
+        b1 = _CB(64, 1, **cb, name="mixed_4a_b1_0")(x, training=training)
+        b1 = _CB(64, (1, 7), padding=_H, **cb, name="mixed_4a_b1_1")(
+            b1, training=training)
+        b1 = _CB(64, (7, 1), padding=_V, **cb, name="mixed_4a_b1_2")(
+            b1, training=training)
+        b1 = _CB(96, 3, **cb, name="mixed_4a_b1_3")(b1, training=training)
+        x = jnp.concatenate([b0, b1], axis=-1)
+        feats.append(x)
+        # Mixed_5a (:78-88)
+        x = jnp.concatenate([
+            _CB(192, 3, 2, **cb, name="mixed_5a_conv")(x, training=training),
+            nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID"),
+        ], axis=-1)
+        for i in range(4):
+            x = self._ia(x, bn, training, f"inception_a_{i}")
+        feats.append(x)
+        # Reduction_A (:121-139)
+        b0 = _CB(384, 3, 2, **cb, name="reduction_a_b0")(x, training=training)
+        b1 = _CB(192, 1, **cb, name="reduction_a_b1_0")(x, training=training)
+        b1 = _CB(224, 3, padding=1, **cb, name="reduction_a_b1_1")(
+            b1, training=training)
+        b1 = _CB(256, 3, 2, **cb, name="reduction_a_b1_2")(
+            b1, training=training)
+        x = jnp.concatenate([
+            b0, b1, nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")],
+            axis=-1)
+        for i in range(7):
+            x = self._ib(x, bn, training, f"inception_b_{i}")
+        feats.append(x)
+        # Reduction_B (:180-202)
+        b0 = _CB(192, 1, **cb, name="reduction_b_b0_0")(x, training=training)
+        b0 = _CB(192, 3, 2, **cb, name="reduction_b_b0_1")(
+            b0, training=training)
+        b1 = _CB(256, 1, **cb, name="reduction_b_b1_0")(x, training=training)
+        b1 = _CB(256, (1, 7), padding=_H, **cb, name="reduction_b_b1_1")(
+            b1, training=training)
+        b1 = _CB(320, (7, 1), padding=_V, **cb, name="reduction_b_b1_2")(
+            b1, training=training)
+        b1 = _CB(320, 3, 2, **cb, name="reduction_b_b1_3")(
+            b1, training=training)
+        x = jnp.concatenate([
+            b0, b1, nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")],
+            axis=-1)
+        for i in range(3):
+            x = self._ic(x, bn, training, f"inception_c_{i}")
+        feats.append(x)
+        if features_only:
+            return feats
+        if not pool:
+            return x
+        x = SelectAdaptivePool2d(self.global_pool, name="global_pool")(x)
+        if self.drop_rate > 0:
+            x = nn.Dropout(rate=self.drop_rate,
+                           deterministic=not training)(x)
+        if self.num_classes <= 0:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="last_linear")(x)
+
+
+@register_model
+def inception_v4(pretrained=False, **kwargs):
+    """inception_v4 (reference inception_v4.py:306-308)."""
+    kwargs.pop("pretrained", None)
+    kwargs.setdefault("default_cfg", _cfg())
+    return InceptionV4(**kwargs)

@@ -1,0 +1,271 @@
+"""NASNet-A-Large (Flax/NHWC).
+
+Re-design of ``/root/reference/dfd/timm/models/nasnet.py`` (620 LoC): the six
+cell types — CellStem0 (:132-179), CellStem1 with factorized-reduction path
+(:182-253), FirstCell (:255-322), NormalCell (:324-375), ReductionCell0 with
+zero-pad-shifted branches (:377-431), ReductionCell1 (:432-485) — and the
+6-@-4032 ``NASNetALarge`` assembly (:487-608).
+
+Pooling matches torch semantics exactly: explicit (1,1) padding (−inf for
+max, masked mean for ``count_include_pad=False`` avg), and the Pad variants'
+zero-pad-then-crop shift is reproduced verbatim.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.conv import Conv2d
+from ..ops.norm import BatchNorm2d
+from ..ops.pool import SelectAdaptivePool2d
+from ..registry import register_model
+
+__all__ = ["NASNetALarge"]
+
+_P1 = ((1, 1), (1, 1))
+
+
+def _cfg(**kwargs):
+    cfg = dict(num_classes=1000, input_size=(3, 331, 331),
+               pool_size=(11, 11), crop_pct=0.875, interpolation="bicubic",
+               mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5),
+               first_conv="conv0", classifier="last_linear")
+    cfg.update(kwargs)
+    return cfg
+
+
+def _max_pool(x, stride=2, pad_shift=False):
+    """MaxPool2d(3, stride, padding=1) (+ MaxPoolPad shift, :28-39)."""
+    if pad_shift:
+        x = jnp.pad(x, ((0, 0), (1, 0), (1, 0), (0, 0)))
+    x = nn.max_pool(x, (3, 3), strides=(stride, stride), padding=_P1)
+    return x[:, 1:, 1:, :] if pad_shift else x
+
+
+def _avg_pool(x, stride=1, pad_shift=False):
+    """AvgPool2d(3, stride, padding=1, count_include_pad=False)
+    (+ AvgPoolPad shift, :42-53)."""
+    if pad_shift:
+        x = jnp.pad(x, ((0, 0), (1, 0), (1, 0), (0, 0)))
+    s = nn.avg_pool(x, (3, 3), strides=(stride, stride), padding=_P1)
+    ones = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+    c = nn.avg_pool(ones, (3, 3), strides=(stride, stride), padding=_P1)
+    out = s / c
+    return out[:, 1:, 1:, :] if pad_shift else out
+
+
+class _BranchSep(nn.Module):
+    """BranchSeparables (:72-129): relu → sep(stride) → BN → relu → sep → BN.
+    ``stem`` maps in→out in the first separable; ``pad_shift`` is the
+    BranchSeparablesReduction zero-pad/crop variant."""
+    out_chs: int
+    kernel_size: int
+    stride: int = 1
+    stem: bool = False
+    pad_shift: bool = False
+    bn: dict = None
+    dtype: Any = None
+
+    def _sep(self, x, out_chs, stride, name):
+        in_chs = x.shape[-1]
+        pad = self.kernel_size // 2
+        x = Conv2d(in_chs, self.kernel_size, stride=stride, padding=pad,
+                   groups=in_chs, dtype=self.dtype,
+                   name=f"{name}_dw")(x)
+        return Conv2d(out_chs, 1, dtype=self.dtype, name=f"{name}_pw")(x)
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        bn = dict(self.bn or {}, dtype=self.dtype)
+        mid = self.out_chs if self.stem else x.shape[-1]
+        x = nn.relu(x)
+        if self.pad_shift:
+            x = jnp.pad(x, ((0, 0), (1, 0), (1, 0), (0, 0)))
+        x = self._sep(x, mid, self.stride, "separable_1")
+        if self.pad_shift:
+            x = x[:, 1:, 1:, :]
+        x = BatchNorm2d(**bn, name="bn_sep_1")(x, training=training)
+        x = nn.relu(x)
+        x = self._sep(x, self.out_chs, 1, "separable_2")
+        return BatchNorm2d(**bn, name="bn_sep_2")(x, training=training)
+
+
+class _ReluConvBn(nn.Module):
+    """relu → 1×1 conv → BN (the cells' conv_1x1 blocks)."""
+    out_chs: int
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = nn.relu(x)
+        x = Conv2d(self.out_chs, 1, dtype=self.dtype, name="conv")(x)
+        return BatchNorm2d(**dict(self.bn or {}, dtype=self.dtype),
+                           name="bn")(x, training=training)
+
+
+class _Factorized(nn.Module):
+    """relu → two offset stride-2 1×1 paths → concat → BN (:193-201)."""
+    out_chs_half: int
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = nn.relu(x)
+        p1 = Conv2d(self.out_chs_half, 1, dtype=self.dtype,
+                    name="path_1_conv")(x[:, ::2, ::2, :])
+        x2 = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))[:, 1:, 1:, :]
+        p2 = Conv2d(self.out_chs_half, 1, dtype=self.dtype,
+                    name="path_2_conv")(x2[:, ::2, ::2, :])
+        out = jnp.concatenate([p1, p2], axis=-1)
+        return BatchNorm2d(**dict(self.bn or {}, dtype=self.dtype),
+                           name="final_path_bn")(out, training=training)
+
+
+class NASNetALarge(nn.Module):
+    """Reference NASNetALarge (6 @ 4032) (:487-608)."""
+    num_classes: int = 1000
+    in_chans: int = 3
+    stem_size: int = 96
+    num_features: int = 4032
+    channel_multiplier: int = 2
+    drop_rate: float = 0.0
+    global_pool: str = "avg"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-3
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+    default_cfg: Any = None
+
+    def _stem0(self, x, chs, bn, training, name):
+        k = dict(bn=bn, dtype=self.dtype)
+        x1 = _ReluConvBn(chs, **k, name=f"{name}_conv_1x1")(
+            x, training=training)
+        c0 = _BranchSep(chs, 5, 2, **k, name=f"{name}_c0l")(
+            x1, training=training) + \
+            _BranchSep(chs, 7, 2, stem=True, **k, name=f"{name}_c0r")(
+                x, training=training)
+        c1 = _max_pool(x1) + _BranchSep(chs, 7, 2, stem=True, **k,
+                                        name=f"{name}_c1r")(
+            x, training=training)
+        c2 = _avg_pool(x1, 2) + _BranchSep(chs, 5, 2, stem=True, **k,
+                                           name=f"{name}_c2r")(
+            x, training=training)
+        c3 = _avg_pool(c0) + c1
+        c4 = _BranchSep(chs, 3, 1, **k, name=f"{name}_c4l")(
+            c0, training=training) + _max_pool(x1)
+        return jnp.concatenate([c1, c2, c3, c4], axis=-1)
+
+    def _cell(self, x_left, x_right, out_l, out_r, bn, training, name,
+              kind="normal"):
+        """stem1/first/normal/reduction0/reduction1 common 5-branch plan."""
+        k = dict(bn=bn, dtype=self.dtype)
+        red = kind in ("stem1", "reduction0", "reduction1")
+        stride = 2 if red else 1
+        shift = kind == "reduction0"
+        if kind in ("first", "stem1"):
+            # left input goes through the factorized-reduction path
+            x_left = _Factorized(out_l, **k, name=f"{name}_prev")(
+                x_left, training=training)
+        else:
+            x_left = _ReluConvBn(out_l, **k, name=f"{name}_conv_prev_1x1")(
+                x_left, training=training)
+        x_right = _ReluConvBn(out_r, **k, name=f"{name}_conv_1x1")(
+            x_right, training=training)
+        if red:
+            # reduction plan (:405-430, stem1 :218-252 with left/right roles
+            # swapped relative to the naming here — see call sites)
+            c0 = _BranchSep(out_r, 5, 2, pad_shift=shift, **k,
+                            name=f"{name}_c0l")(x_right, training=training) \
+                + _BranchSep(out_r, 7, 2, pad_shift=shift, **k,
+                             name=f"{name}_c0r")(x_left, training=training)
+            c1 = _max_pool(x_right, 2, shift) + \
+                _BranchSep(out_r, 7, 2, pad_shift=shift, **k,
+                           name=f"{name}_c1r")(x_left, training=training)
+            c2 = _avg_pool(x_right, 2, shift) + \
+                _BranchSep(out_r, 5, 2, pad_shift=shift, **k,
+                           name=f"{name}_c2r")(x_left, training=training)
+            c3 = _avg_pool(c0) + c1
+            c4 = _BranchSep(out_r, 3, 1, pad_shift=shift, **k,
+                            name=f"{name}_c4l")(c0, training=training) + \
+                _max_pool(x_right, 2, shift)
+            return jnp.concatenate([c1, c2, c3, c4], axis=-1)
+        # normal/first plan (:288-322, :351-375)
+        c0 = _BranchSep(out_r, 5, 1, **k, name=f"{name}_c0l")(
+            x_right, training=training) + \
+            _BranchSep(out_r if kind == "first" else out_l, 3, 1, **k,
+                       name=f"{name}_c0r")(x_left, training=training)
+        c1 = _BranchSep(out_r if kind == "first" else out_l, 5, 1, **k,
+                        name=f"{name}_c1l")(x_left, training=training) + \
+            _BranchSep(out_r if kind == "first" else out_l, 3, 1, **k,
+                       name=f"{name}_c1r")(x_left, training=training)
+        c2 = _avg_pool(x_right) + x_left
+        c3 = _avg_pool(x_left) + _avg_pool(x_left)
+        c4 = _BranchSep(out_r, 3, 1, **k, name=f"{name}_c4l")(
+            x_right, training=training) + x_right
+        return jnp.concatenate([x_left, c0, c1, c2, c3, c4], axis=-1)
+
+    @nn.compact
+    def __call__(self, x, training: bool = False, features_only: bool = False,
+                 pool: bool = True):
+        assert x.shape[-1] == self.in_chans, (x.shape, self.in_chans)
+        bn = dict(momentum=self.bn_momentum, eps=self.bn_eps,
+                  axis_name=self.bn_axis_name)
+        ch = self.num_features // 24
+        cm = self.channel_multiplier
+        conv0 = Conv2d(self.stem_size, 3, stride=2, padding="valid",
+                       dtype=self.dtype, name="conv0_conv")(x)
+        conv0 = BatchNorm2d(**dict(bn, dtype=self.dtype),
+                            name="conv0_bn")(conv0, training=training)
+        stem0 = self._stem0(conv0, ch // cm ** 2, bn, training,
+                            "cell_stem_0")
+        # stem1: left = factorized(conv0), right = conv_1x1(stem0); the
+        # reference names them right/left respectively (:218-229) — branch
+        # roles below match its forward exactly
+        stem1 = self._cell(conv0, stem0, ch // cm // 2, ch // cm, bn,
+                           training, "cell_stem_1", kind="stem1")
+        prev, cur = stem0, stem1
+        feats = []
+        for si in range(3):
+            mult = cm ** si
+            for ci in range(6):
+                kind = "first" if ci == 0 else "normal"
+                ol = (ch * mult // 2) if ci == 0 else ch * mult
+                nxt = self._cell(prev, cur, ol, ch * mult, bn, training,
+                                 f"cell_{si * 6 + ci}", kind=kind)
+                prev, cur = cur, nxt
+            feats.append(cur)
+            if si < 2:
+                # the FirstCell after a reduction skips back to the cell
+                # BEFORE the reduction's own input (reference :577-581:
+                # cell_6(x_reduction_cell_0, x_cell_4)) — prev is unchanged
+                red = self._cell(
+                    prev, cur, ch * mult * 2, ch * mult * 2, bn, training,
+                    f"reduction_cell_{si}", kind=f"reduction{si}")
+                cur = red
+        x = nn.relu(cur)
+        feats[-1] = x
+        if features_only:
+            return feats
+        if not pool:
+            return x
+        x = SelectAdaptivePool2d(self.global_pool, name="global_pool")(x)
+        if self.drop_rate > 0:
+            x = nn.Dropout(rate=self.drop_rate,
+                           deterministic=not training)(x)
+        if self.num_classes <= 0:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="last_linear")(x)
+
+
+@register_model
+def nasnetalarge(pretrained=False, **kwargs):
+    """nasnetalarge (reference nasnet.py:611-620)."""
+    kwargs.pop("pretrained", None)
+    kwargs.setdefault("default_cfg", _cfg())
+    return NASNetALarge(**kwargs)

@@ -1,0 +1,279 @@
+"""PNASNet-5-Large (Flax/NHWC).
+
+Re-design of ``/root/reference/dfd/timm/models/pnasnet.py`` (398 LoC): the
+5-branch progressive cell (``CellBase.cell_forward`` :155-183), stem cell
+(:186-229), regular/reduction cells with optional factorized left-input
+reduction (:230-293), and the 12-cell PNASNet5Large assembly (:296-380).
+
+Pooling/padding notes: torch ``MaxPool2d(padding=1)`` pads −inf (XLA explicit
+pool padding matches); the ``zero_pad`` shift pads literal zeros then crops,
+reproduced verbatim; ``FactorizedReduction``'s stride-2 1×1 avg-pools are
+plain ::2 subsampling.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.conv import Conv2d
+from ..ops.norm import BatchNorm2d
+from ..ops.pool import SelectAdaptivePool2d
+from ..registry import register_model
+
+__all__ = ["PNASNet5Large"]
+
+
+def _cfg(**kwargs):
+    cfg = dict(num_classes=1000, input_size=(3, 331, 331),
+               pool_size=(11, 11), crop_pct=0.875, interpolation="bicubic",
+               mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5),
+               first_conv="conv_0", classifier="last_linear")
+    cfg.update(kwargs)
+    return cfg
+
+
+def _max_pool(x, stride: int, zero_pad: bool):
+    """MaxPool(3, stride, padding=1[, zero_pad]) (reference :38-51)."""
+    if zero_pad:
+        x = jnp.pad(x, ((0, 0), (1, 0), (1, 0), (0, 0)))
+    x = nn.max_pool(x, (3, 3), strides=(stride, stride),
+                    padding=((1, 1), (1, 1)))
+    if zero_pad:
+        x = x[:, 1:, 1:, :]
+    return x
+
+
+class _SepConv(nn.Module):
+    """SeparableConv2d dw→pw, no norm (:54-69)."""
+    out_chs: int
+    kernel_size: int
+    stride: int = 1
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        in_chs = x.shape[-1]
+        pad = self.kernel_size // 2
+        x = Conv2d(in_chs, self.kernel_size, stride=self.stride, padding=pad,
+                   groups=in_chs, dtype=self.dtype,
+                   name="depthwise_conv2d")(x)
+        return Conv2d(self.out_chs, 1, dtype=self.dtype,
+                      name="pointwise_conv2d")(x)
+
+
+class _BranchSeparables(nn.Module):
+    """relu → sep(stride) → BN → relu → sep → BN (:72-101)."""
+    out_chs: int
+    kernel_size: int
+    stride: int = 1
+    stem_cell: bool = False
+    zero_pad: bool = False
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        bn = dict(self.bn or {}, dtype=self.dtype)
+        mid = self.out_chs if self.stem_cell else x.shape[-1]
+        x = nn.relu(x)
+        if self.zero_pad:
+            x = jnp.pad(x, ((0, 0), (1, 0), (1, 0), (0, 0)))
+        x = _SepConv(mid, self.kernel_size, self.stride, dtype=self.dtype,
+                     name="separable_1")(x)
+        if self.zero_pad:
+            x = x[:, 1:, 1:, :]
+        x = BatchNorm2d(**bn, name="bn_sep_1")(x, training=training)
+        x = nn.relu(x)
+        x = _SepConv(self.out_chs, self.kernel_size, 1, dtype=self.dtype,
+                     name="separable_2")(x)
+        return BatchNorm2d(**bn, name="bn_sep_2")(x, training=training)
+
+
+class _ReluConvBn(nn.Module):
+    """relu → conv(VALID) → BN (:104-117)."""
+    out_chs: int
+    kernel_size: int = 1
+    stride: int = 1
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = nn.relu(x)
+        x = Conv2d(self.out_chs, self.kernel_size, stride=self.stride,
+                   padding="valid", dtype=self.dtype, name="conv")(x)
+        return BatchNorm2d(**dict(self.bn or {}, dtype=self.dtype),
+                           name="bn")(x, training=training)
+
+
+class _FactorizedReduction(nn.Module):
+    """Two offset stride-2 1×1 paths, concat, BN (:120-146)."""
+    out_chs: int
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = nn.relu(x)
+        p1 = Conv2d(self.out_chs // 2, 1, dtype=self.dtype,
+                    name="path_1_conv")(x[:, ::2, ::2, :])
+        x2 = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))[:, 1:, 1:, :]
+        p2 = Conv2d(self.out_chs // 2, 1, dtype=self.dtype,
+                    name="path_2_conv")(x2[:, ::2, ::2, :])
+        out = jnp.concatenate([p1, p2], axis=-1)
+        return BatchNorm2d(**dict(self.bn or {}, dtype=self.dtype),
+                           name="final_path_bn")(out, training=training)
+
+
+class _Cell(nn.Module):
+    """Stem / regular / reduction cell (:186-293).  ``stem0`` selects the
+    CellStem0 branch plan (left input is the raw stem conv)."""
+    out_chs_left: int
+    out_chs_right: int
+    stem0: bool = False
+    is_reduction: bool = False
+    zero_pad: bool = False
+    match_prev: bool = False
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x_left, x_right, training: bool = False):
+        k = dict(bn=self.bn, dtype=self.dtype)
+        stride = 2 if (self.is_reduction or self.stem0) else 1
+        zp = self.zero_pad
+        if self.stem0:
+            raw_left = x_left
+            x_right = _ReluConvBn(self.out_chs_right, **k, name="conv_1x1")(
+                x_right, training=training)
+            # comb0 operates on the RAW left input (:190-202)
+            c0l = _BranchSeparables(self.out_chs_left, 5, 2, stem_cell=True,
+                                    **k, name="comb_iter_0_left")(
+                raw_left, training=training)
+            c0r = _max_pool(raw_left, 2, False)
+            c0r = Conv2d(self.out_chs_left, 1, dtype=self.dtype,
+                         name="comb_iter_0_right_conv")(c0r)
+            c0r = BatchNorm2d(**dict(self.bn or {}, dtype=self.dtype),
+                              name="comb_iter_0_right_bn")(
+                c0r, training=training)
+            c4l = _BranchSeparables(self.out_chs_right, 3, 2, stem_cell=True,
+                                    **k, name="comb_iter_4_left")(
+                raw_left, training=training)
+        else:
+            if self.match_prev:
+                x_left = _FactorizedReduction(
+                    self.out_chs_left, **k, name="conv_prev_1x1")(
+                    x_left, training=training)
+            else:
+                x_left = _ReluConvBn(self.out_chs_left, **k,
+                                     name="conv_prev_1x1")(
+                    x_left, training=training)
+            x_right = _ReluConvBn(self.out_chs_right, **k, name="conv_1x1")(
+                x_right, training=training)
+            c0l = _BranchSeparables(self.out_chs_left, 5, stride,
+                                    zero_pad=zp, **k,
+                                    name="comb_iter_0_left")(
+                x_left, training=training)
+            c0r = _max_pool(x_left, stride, zp)
+            c4l = _BranchSeparables(self.out_chs_left, 3, stride,
+                                    zero_pad=zp, **k,
+                                    name="comb_iter_4_left")(
+                x_left, training=training)
+        c0 = c0l + c0r
+        c1l = _BranchSeparables(self.out_chs_right, 7, stride, zero_pad=zp,
+                                **k, name="comb_iter_1_left")(
+            x_right, training=training)
+        c1r = _max_pool(x_right, stride, zp)
+        c1 = c1l + c1r
+        c2l = _BranchSeparables(self.out_chs_right, 5, stride, zero_pad=zp,
+                                **k, name="comb_iter_2_left")(
+            x_right, training=training)
+        c2r = _BranchSeparables(self.out_chs_right, 3, stride, zero_pad=zp,
+                                **k, name="comb_iter_2_right")(
+            x_right, training=training)
+        c2 = c2l + c2r
+        c3l = _BranchSeparables(self.out_chs_right, 3, 1, **k,
+                                name="comb_iter_3_left")(
+            c2, training=training)
+        c3 = c3l + _max_pool(x_right, stride, zp)
+        if self.is_reduction or self.stem0:
+            c4r = _ReluConvBn(self.out_chs_right, 1, stride, **k,
+                              name="comb_iter_4_right")(
+                x_right, training=training)
+        else:
+            c4r = x_right
+        c4 = c4l + c4r
+        return jnp.concatenate([c0, c1, c2, c3, c4], axis=-1)
+
+
+class PNASNet5Large(nn.Module):
+    """Reference PNASNet5Large (:296-380)."""
+    num_classes: int = 1000
+    in_chans: int = 3
+    drop_rate: float = 0.5
+    global_pool: str = "avg"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-3
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+    default_cfg: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False, features_only: bool = False,
+                 pool: bool = True):
+        assert x.shape[-1] == self.in_chans, (x.shape, self.in_chans)
+        bn = dict(momentum=self.bn_momentum, eps=self.bn_eps,
+                  axis_name=self.bn_axis_name)
+        k = dict(bn=bn, dtype=self.dtype)
+        conv0 = Conv2d(96, 3, stride=2, padding="valid", dtype=self.dtype,
+                       name="conv_0_conv")(x)
+        conv0 = BatchNorm2d(**dict(bn, dtype=self.dtype),
+                            name="conv_0_bn")(conv0, training=training)
+        stem0 = _Cell(54, 54, stem0=True, **k,
+                      name="cell_stem_0")(conv0, conv0, training=training)
+        stem1 = _Cell(108, 108, is_reduction=True, match_prev=True, **k,
+                      name="cell_stem_1")(conv0, stem0, training=training)
+        c0 = _Cell(216, 216, match_prev=True, **k,
+                   name="cell_0")(stem0, stem1, training=training)
+        c1 = _Cell(216, 216, **k, name="cell_1")(stem1, c0,
+                                                 training=training)
+        c2 = _Cell(216, 216, **k, name="cell_2")(c0, c1, training=training)
+        c3 = _Cell(216, 216, **k, name="cell_3")(c1, c2, training=training)
+        c4 = _Cell(432, 432, is_reduction=True, zero_pad=True, **k,
+                   name="cell_4")(c2, c3, training=training)
+        c5 = _Cell(432, 432, match_prev=True, **k,
+                   name="cell_5")(c3, c4, training=training)
+        c6 = _Cell(432, 432, **k, name="cell_6")(c4, c5, training=training)
+        c7 = _Cell(432, 432, **k, name="cell_7")(c5, c6, training=training)
+        c8 = _Cell(864, 864, is_reduction=True, **k,
+                   name="cell_8")(c6, c7, training=training)
+        c9 = _Cell(864, 864, match_prev=True, **k,
+                   name="cell_9")(c7, c8, training=training)
+        c10 = _Cell(864, 864, **k, name="cell_10")(c8, c9, training=training)
+        c11 = _Cell(864, 864, **k, name="cell_11")(c9, c10,
+                                                   training=training)
+        x = nn.relu(c11)
+        if features_only:
+            return [stem0, c3, c7, c11, x]
+        if not pool:
+            return x
+        x = SelectAdaptivePool2d(self.global_pool, name="global_pool")(x)
+        if self.drop_rate > 0:
+            x = nn.Dropout(rate=self.drop_rate,
+                           deterministic=not training)(x)
+        if self.num_classes <= 0:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="last_linear")(x)
+
+
+@register_model
+def pnasnet5large(pretrained=False, **kwargs):
+    """pnasnet5large (reference pnasnet.py:383-397)."""
+    kwargs.pop("pretrained", None)
+    kwargs.setdefault("default_cfg", _cfg())
+    kwargs.setdefault("drop_rate", 0.5)
+    return PNASNet5Large(**kwargs)

@@ -1,0 +1,143 @@
+"""Res2Net / Res2NeXt (Flax/NHWC).
+
+Re-design of ``/root/reference/dfd/timm/models/res2net.py`` (236 LoC): the
+``Bottle2neck`` multi-scale residual block (:50-125) plugged into the generic
+:class:`~.resnet.ResNet`, and the 7 entrypoints (:128-236).
+
+TPU notes: the hierarchical split-conv chain is a static Python loop over
+``scale`` branches — XLA sees ``scale`` small convs per block and fuses the
+adds; channel split/concat are free layout ops in NHWC.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.activations import get_act_fn
+from ..ops.attention import create_attn
+from ..ops.conv import Conv2d
+from ..ops.drop import DropPath
+from ..ops.norm import BatchNorm2d
+from ..ops.pool import avg_pool2d_same
+from ..registry import register_model
+from .resnet import _Downsample, _cfg, register_block, ResNet
+
+__all__ = ["Bottle2neck"]
+
+
+class Bottle2neck(nn.Module):
+    """Res2Net bottleneck (reference res2net.py:50-125): 1×1 expand to
+    ``width*scale``, hierarchical 3×3 convs over ``scale-1`` channel groups
+    (each fed the previous group's output plus its own split), 1×1 project."""
+    planes: int
+    stride: int = 1
+    has_downsample: bool = False
+    cardinality: int = 1
+    base_width: int = 26
+    scale: int = 4
+    reduce_first: int = 1
+    dilation: int = 1
+    first_dilation: Optional[int] = None
+    act: str = "relu"
+    attn_layer: Optional[str] = None
+    avg_down: bool = False
+    down_kernel_size: int = 1
+    drop_block_rate: float = 0.0      # unused by reference Bottle2neck (**_)
+    drop_block_gamma: float = 1.0
+    drop_path_rate: float = 0.0
+    zero_init_last_bn: bool = True
+    bn: dict = None
+    dtype: Any = None
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        act = get_act_fn(self.act)
+        bn = dict(self.bn or {}, dtype=self.dtype)
+        width = int(math.floor(
+            self.planes * (self.base_width / 64.0))) * self.cardinality
+        outplanes = self.planes * self.expansion
+        num_scales = max(1, self.scale - 1)
+        is_first = self.stride > 1 or self.has_downsample
+        fd = self.first_dilation or self.dilation
+
+        residual = x
+        y = Conv2d(width * self.scale, 1, dtype=self.dtype, name="conv1")(x)
+        y = BatchNorm2d(**bn, name="bn1")(y, training=training)
+        y = act(y)
+
+        spx = jnp.split(y, self.scale, axis=-1)
+        spo = []
+        sp = None
+        for i in range(num_scales):
+            sp = spx[i] if i == 0 or is_first else sp + spx[i]
+            sp = Conv2d(width, 3, stride=self.stride, dilation=fd,
+                        groups=self.cardinality, dtype=self.dtype,
+                        name=f"convs_{i}")(sp)
+            sp = BatchNorm2d(**bn, name=f"bns_{i}")(sp, training=training)
+            sp = act(sp)
+            spo.append(sp)
+        if self.scale > 1:
+            # last split passes through (pooled when the block downsamples;
+            # count_include_pad=True matches the reference's AvgPool2d)
+            spo.append(avg_pool2d_same(
+                spx[-1], (3, 3), (self.stride, self.stride),
+                count_include_pad=True) if is_first else spx[-1])
+        y = jnp.concatenate(spo, axis=-1)
+
+        y = Conv2d(outplanes, 1, dtype=self.dtype, name="conv3")(y)
+        y = BatchNorm2d(**bn, name="bn3",
+                        scale_init=nn.initializers.zeros
+                        if self.zero_init_last_bn else None)(
+            y, training=training)
+        attn = create_attn(self.attn_layer, dtype=self.dtype, name="se")
+        if attn is not None:
+            y = attn(y)
+        if self.drop_path_rate:
+            y = DropPath(self.drop_path_rate, name="drop_path")(
+                y, training=training)
+        if self.has_downsample:
+            residual = _Downsample(
+                outplanes, self.down_kernel_size, self.stride, self.dilation,
+                self.first_dilation, avg=self.avg_down, bn=self.bn,
+                dtype=self.dtype, name="downsample")(x, training=training)
+        return act(y + residual)
+
+
+register_block("bottle2neck", Bottle2neck)
+
+
+# name: (layers, base_width, extra ResNet kwargs, block_args)
+_RES2NET_DEFS = {
+    "res2net50_26w_4s": ((3, 4, 6, 3), 26, {}, dict(scale=4)),
+    "res2net101_26w_4s": ((3, 4, 23, 3), 26, {}, dict(scale=4)),
+    "res2net50_26w_6s": ((3, 4, 6, 3), 26, {}, dict(scale=6)),
+    "res2net50_26w_8s": ((3, 4, 6, 3), 26, {}, dict(scale=8)),
+    "res2net50_48w_2s": ((3, 4, 6, 3), 48, {}, dict(scale=2)),
+    "res2net50_14w_8s": ((3, 4, 6, 3), 14, {}, dict(scale=8)),
+    "res2next50": ((3, 4, 6, 3), 4, dict(cardinality=8), dict(scale=4)),
+}
+
+
+def _register():
+    for name, (layers, bw, extra, block_args) in _RES2NET_DEFS.items():
+        def fn(pretrained=False, *, _layers=layers, _bw=bw, _extra=extra,
+               _ba=block_args, **kwargs):
+            kwargs.pop("pretrained", None)
+            ba = {**_ba, **kwargs.pop("block_args", {})}
+            kwargs.setdefault("default_cfg", _cfg())
+            return ResNet(block="bottle2neck", layers=tuple(_layers),
+                          base_width=_bw, block_args=ba,
+                          **{**_extra, **kwargs})
+        fn.__name__ = name
+        fn.__qualname__ = name
+        fn.__module__ = __name__
+        fn.__doc__ = f"{name} (reference res2net.py entrypoint)."
+        register_model(fn)
+
+
+_register()

@@ -1,0 +1,181 @@
+"""SelecSLS (Flax/NHWC).
+
+Re-design of ``/root/reference/dfd/timm/models/selecsls.py`` (294 LoC): the
+``SelecSLSBlock`` (:66-93) — three conv pairs whose intermediate outputs are
+concatenated, with a cross-block skip feature threaded alongside the main
+stream — the :class:`SelecSLS` net (:96-157), per-variant feature/head config
+tables (:160-260), and the 5 entrypoints (:262-294).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.conv import Conv2d
+from ..ops.norm import BatchNorm2d
+from ..ops.pool import SelectAdaptivePool2d
+from ..registry import register_model
+from .efficientnet import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
+
+__all__ = ["SelecSLS"]
+
+
+def _cfg(**kwargs):
+    cfg = dict(num_classes=1000, input_size=(3, 224, 224), pool_size=(4, 4),
+               crop_pct=0.875, interpolation="bilinear",
+               mean=IMAGENET_DEFAULT_MEAN, std=IMAGENET_DEFAULT_STD,
+               first_conv="stem", classifier="fc")
+    cfg.update(kwargs)
+    return cfg
+
+
+class _ConvBn(nn.Module):
+    """conv → BN → ReLU (reference conv_bn, selecsls.py:55-63)."""
+    out_chs: int
+    kernel_size: int = 3
+    stride: int = 1
+    dilation: int = 1
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = Conv2d(self.out_chs, self.kernel_size, stride=self.stride,
+                   dilation=self.dilation, dtype=self.dtype, name="conv")(x)
+        x = BatchNorm2d(**dict(self.bn or {}, dtype=self.dtype),
+                        name="bn")(x, training=training)
+        return nn.relu(x)
+
+
+class _SelecSLSBlock(nn.Module):
+    """Reference SelecSLSBlock (:66-93): d1=3×3(s), d2=1×1·3×3, d3=1×1·3×3;
+    concat [d1,d2,d3(,skip)] → 1×1.  First block of a stage starts a new skip
+    stream; later blocks carry it through."""
+    skip_chs: int
+    mid_chs: int
+    out_chs: int
+    is_first: bool
+    stride: int
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, skip, training: bool = False):
+        k = dict(bn=self.bn, dtype=self.dtype)
+        d1 = _ConvBn(self.mid_chs, 3, self.stride, **k, name="conv1")(
+            x, training=training)
+        d2 = _ConvBn(self.mid_chs // 2, 3, **k, name="conv3")(
+            _ConvBn(self.mid_chs, 1, **k, name="conv2")(
+                d1, training=training), training=training)
+        d3 = _ConvBn(self.mid_chs // 2, 3, **k, name="conv5")(
+            _ConvBn(self.mid_chs, 1, **k, name="conv4")(
+                d2, training=training), training=training)
+        if self.is_first:
+            out = _ConvBn(self.out_chs, 1, **k, name="conv6")(
+                jnp.concatenate([d1, d2, d3], axis=-1), training=training)
+            return out, out
+        out = _ConvBn(self.out_chs, 1, **k, name="conv6")(
+            jnp.concatenate([d1, d2, d3, skip], axis=-1), training=training)
+        return out, skip
+
+
+# variant → (features, head, num_features); rows are
+# (skip_chs, mid_chs, out_chs, is_first, stride) / (out_chs, k, stride)
+# (reference selecsls.py:160-247; in_chs is implicit in NHWC)
+_FEATS_42 = [(0, 64, 64, True, 2), (64, 64, 128, False, 1),
+             (0, 144, 144, True, 2), (144, 144, 288, False, 1),
+             (0, 304, 304, True, 2), (304, 304, 480, False, 1)]
+_FEATS_60 = [(0, 64, 64, True, 2), (64, 64, 128, False, 1),
+             (0, 128, 128, True, 2), (128, 128, 128, False, 1),
+             (128, 128, 288, False, 1), (0, 288, 288, True, 2),
+             (288, 288, 288, False, 1), (288, 288, 288, False, 1),
+             (288, 288, 416, False, 1)]
+_FEATS_84 = [(0, 64, 64, True, 2), (64, 64, 144, False, 1),
+             (0, 144, 144, True, 2), (144, 144, 144, False, 1),
+             (144, 144, 144, False, 1), (144, 144, 144, False, 1),
+             (144, 144, 304, False, 1), (0, 304, 304, True, 2),
+             (304, 304, 304, False, 1), (304, 304, 304, False, 1),
+             (304, 304, 304, False, 1), (304, 304, 304, False, 1),
+             (304, 304, 512, False, 1)]
+
+_VARIANTS = {
+    "selecsls42": (_FEATS_42, [(960, 3, 2), (1024, 3, 1), (1024, 3, 2),
+                               (1280, 1, 1)], 1280),
+    "selecsls42b": (_FEATS_42, [(960, 3, 2), (1024, 3, 1), (1280, 3, 2),
+                                (1024, 1, 1)], 1024),
+    "selecsls60": (_FEATS_60, [(756, 3, 2), (1024, 3, 1), (1024, 3, 2),
+                               (1280, 1, 1)], 1280),
+    "selecsls60b": (_FEATS_60, [(756, 3, 2), (1024, 3, 1), (1280, 3, 2),
+                                (1024, 1, 1)], 1024),
+    "selecsls84": (_FEATS_84, [(960, 3, 2), (1024, 3, 1), (1024, 3, 2),
+                               (1280, 3, 1)], 1280),
+}
+
+
+class SelecSLS(nn.Module):
+    """Generic SelecSLS net (reference :96-157)."""
+    features: Sequence[Tuple]
+    head: Sequence[Tuple]
+    num_features: int = 1280
+    num_classes: int = 1000
+    in_chans: int = 3
+    drop_rate: float = 0.0
+    global_pool: str = "avg"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+    default_cfg: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False, features_only: bool = False,
+                 pool: bool = True):
+        assert x.shape[-1] == self.in_chans, (x.shape, self.in_chans)
+        bn = dict(momentum=self.bn_momentum, eps=self.bn_eps,
+                  axis_name=self.bn_axis_name)
+        x = _ConvBn(32, 3, 2, bn=bn, dtype=self.dtype, name="stem")(
+            x, training=training)
+        skip = x
+        stage_feats = []
+        for i, (skip_chs, mid, out, first, stride) in enumerate(
+                self.features):
+            x, skip = _SelecSLSBlock(
+                skip_chs, mid, out, first, stride, bn=bn, dtype=self.dtype,
+                name=f"features_{i}")(x, skip, training=training)
+            stage_feats.append(x)
+        for i, (out, k, stride) in enumerate(self.head):
+            x = _ConvBn(out, k, stride, bn=bn, dtype=self.dtype,
+                        name=f"head_{i}")(x, training=training)
+        stage_feats.append(x)
+        if features_only:
+            return stage_feats
+        if not pool:
+            return x
+        x = SelectAdaptivePool2d(self.global_pool, name="global_pool")(x)
+        if self.drop_rate > 0.0:
+            x = nn.Dropout(rate=self.drop_rate,
+                           deterministic=not training)(x)
+        if self.num_classes <= 0:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+
+
+def _register():
+    for name, (feats, head, num_features) in _VARIANTS.items():
+        def fn(pretrained=False, *, _f=feats, _h=head, _nf=num_features,
+               **kwargs):
+            kwargs.pop("pretrained", None)
+            kwargs.setdefault("default_cfg", _cfg())
+            return SelecSLS(features=tuple(_f), head=tuple(_h),
+                            num_features=_nf, **kwargs)
+        fn.__name__ = name
+        fn.__qualname__ = name
+        fn.__module__ = __name__
+        fn.__doc__ = f"{name} (reference selecsls.py entrypoint)."
+        register_model(fn)
+
+
+_register()

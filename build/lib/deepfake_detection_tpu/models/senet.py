@@ -1,0 +1,229 @@
+"""Legacy SENet / SE-ResNet / SE-ResNeXt family (Flax/NHWC).
+
+Re-design of ``/root/reference/dfd/timm/models/senet.py`` (511 LoC, Cadene
+lineage): the standalone :class:`SENet` with its four block flavours —
+``SEBottleneck`` (SENet154: 1×1 to 2×planes then grouped 3×3 to 4×planes,
+:117-137), ``SEResNetBottleneck`` (Caffe-style stride on the 1×1, :140-162),
+``SEResNeXtBottleneck`` (width = planes×base_width/64×groups, :165-186),
+``SEResNetBlock`` (basic, :189-218) — and the 9 entrypoints (:399-511).
+
+Distinct from the ResNet-with-SE variants (resnet.py / gluon_resnet.py): the
+residual add here is ``se(out) + residual`` with no BN zero-init, the stem is
+either 3×3×3 (SENet154) or 7×7, and layer1's downsample always uses a 1×1.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.conv import Conv2d
+from ..ops.norm import BatchNorm2d
+from ..ops.pool import SelectAdaptivePool2d
+from ..registry import register_model
+from .efficientnet import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
+
+__all__ = ["SENet"]
+
+
+def _cfg(**kwargs):
+    cfg = dict(num_classes=1000, input_size=(3, 224, 224), pool_size=(7, 7),
+               crop_pct=0.875, interpolation="bilinear",
+               mean=IMAGENET_DEFAULT_MEAN, std=IMAGENET_DEFAULT_STD,
+               first_conv="layer0.conv1", classifier="last_linear")
+    cfg.update(kwargs)
+    return cfg
+
+
+class _SEModule(nn.Module):
+    """Squeeze-excite with biased 1×1 convs (reference senet.py:67-87)."""
+    channels: int
+    reduction: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = Conv2d(self.channels // self.reduction, 1, use_bias=True,
+                   dtype=self.dtype, name="fc1")(s)
+        s = nn.relu(s)
+        s = Conv2d(self.channels, 1, use_bias=True, dtype=self.dtype,
+                   name="fc2")(s)
+        return x * nn.sigmoid(s)
+
+
+class _SENetBlock(nn.Module):
+    """One residual block; ``kind`` selects the conv plan (see module doc)."""
+    kind: str                 # 'se' | 'se_resnet' | 'se_resnext' | 'basic'
+    planes: int
+    groups: int
+    reduction: int
+    stride: int = 1
+    has_downsample: bool = False
+    down_kernel_size: int = 1
+    base_width: int = 4       # SEResNeXt only
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        bn = dict(self.bn or {}, dtype=self.dtype)
+        residual = x
+        if self.kind == "basic":
+            out_chs = self.planes
+            y = Conv2d(self.planes, 3, stride=self.stride, dtype=self.dtype,
+                       name="conv1")(x)
+            y = BatchNorm2d(**bn, name="bn1")(y, training=training)
+            y = nn.relu(y)
+            y = Conv2d(self.planes, 3, groups=self.groups, dtype=self.dtype,
+                       name="conv2")(y)
+            y = BatchNorm2d(**bn, name="bn2")(y, training=training)
+            y = nn.relu(y)
+        else:
+            out_chs = self.planes * 4
+            if self.kind == "se":              # SENet154 (:117-137)
+                c1, s1 = self.planes * 2, 1
+                c2, s2, g = self.planes * 4, self.stride, self.groups
+            elif self.kind == "se_resnet":     # Caffe stride-on-1×1 (:140-162)
+                c1, s1 = self.planes, self.stride
+                c2, s2, g = self.planes, 1, self.groups
+            else:                              # se_resnext (:165-186)
+                width = math.floor(self.planes * (self.base_width / 64)) \
+                    * self.groups
+                c1, s1 = width, 1
+                c2, s2, g = width, self.stride, self.groups
+            y = Conv2d(c1, 1, stride=s1, dtype=self.dtype, name="conv1")(x)
+            y = BatchNorm2d(**bn, name="bn1")(y, training=training)
+            y = nn.relu(y)
+            y = Conv2d(c2, 3, stride=s2, groups=g, dtype=self.dtype,
+                       name="conv2")(y)
+            y = BatchNorm2d(**bn, name="bn2")(y, training=training)
+            y = nn.relu(y)
+            y = Conv2d(out_chs, 1, dtype=self.dtype, name="conv3")(y)
+            y = BatchNorm2d(**bn, name="bn3")(y, training=training)
+        if self.has_downsample:
+            residual = Conv2d(out_chs, self.down_kernel_size,
+                              stride=self.stride, dtype=self.dtype,
+                              name="downsample_conv")(x)
+            residual = BatchNorm2d(**bn, name="downsample_bn")(
+                residual, training=training)
+        y = _SEModule(out_chs, self.reduction, dtype=self.dtype,
+                      name="se_module")(y) + residual
+        return nn.relu(y)
+
+
+_EXPANSION = {"se": 4, "se_resnet": 4, "se_resnext": 4, "basic": 1}
+
+
+class SENet(nn.Module):
+    """Generic SENet (reference senet.py:229-397)."""
+    block: str = "se_resnet"
+    layers: Sequence[int] = (3, 4, 6, 3)
+    groups: int = 1
+    reduction: int = 16
+    num_classes: int = 1000
+    in_chans: int = 3
+    inplanes: int = 128
+    input_3x3: bool = True
+    down_kernel_size: int = 3
+    drop_rate: float = 0.2
+    global_pool: str = "avg"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+    default_cfg: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False, features_only: bool = False,
+                 pool: bool = True):
+        assert x.shape[-1] == self.in_chans, (x.shape, self.in_chans)
+        bn = dict(momentum=self.bn_momentum, eps=self.bn_eps,
+                  axis_name=self.bn_axis_name)
+        bnd = dict(bn, dtype=self.dtype)
+        # layer0 (:278-300): 3× 3×3 convs (SENet154) or one 7×7
+        if self.input_3x3:
+            x = Conv2d(64, 3, stride=2, dtype=self.dtype, name="conv1")(x)
+            x = BatchNorm2d(**bnd, name="bn1")(x, training=training)
+            x = nn.relu(x)
+            x = Conv2d(64, 3, dtype=self.dtype, name="conv2")(x)
+            x = BatchNorm2d(**bnd, name="bn2")(x, training=training)
+            x = nn.relu(x)
+            x = Conv2d(self.inplanes, 3, dtype=self.dtype, name="conv3")(x)
+            x = BatchNorm2d(**bnd, name="bn3")(x, training=training)
+            x = nn.relu(x)
+        else:
+            x = Conv2d(self.inplanes, 7, stride=2, dtype=self.dtype,
+                       name="conv1")(x)
+            x = BatchNorm2d(**bnd, name="bn1")(x, training=training)
+            x = nn.relu(x)
+        # ceil_mode max-pool (:301-302) == XLA SAME padding
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        exp = _EXPANSION[self.block]
+        in_expanded = self.inplanes
+        stage_feats = []
+        for si, (planes, n_blocks) in enumerate(
+                zip((64, 128, 256, 512), self.layers)):
+            stride = 1 if si == 0 else 2
+            # layer1 always downsamples with a 1×1 (:304-312)
+            dks = 1 if si == 0 else self.down_kernel_size
+            for bi in range(n_blocks):
+                s = stride if bi == 0 else 1
+                need_ds = bi == 0 and (s != 1 or in_expanded != planes * exp)
+                x = _SENetBlock(
+                    kind=self.block, planes=planes, groups=self.groups,
+                    reduction=self.reduction, stride=s, has_downsample=need_ds,
+                    down_kernel_size=dks, bn=bn, dtype=self.dtype,
+                    name=f"layer{si + 1}_{bi}")(x, training=training)
+                in_expanded = planes * exp
+            stage_feats.append(x)
+        if features_only:
+            return stage_feats
+        if not pool:
+            return x
+        x = SelectAdaptivePool2d(self.global_pool, name="avg_pool")(x)
+        if self.drop_rate > 0.0:
+            x = nn.Dropout(rate=self.drop_rate,
+                           deterministic=not training)(x)
+        if self.num_classes <= 0:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="last_linear")(x)
+
+
+# name: (block, layers, groups, extra kwargs); all non-154 nets use the 7×7
+# stem, inplanes 64, 1×1 downsamples, and no dropout (reference :399-511)
+_SMALL = dict(inplanes=64, input_3x3=False, down_kernel_size=1, drop_rate=0.0)
+_SENET_DEFS = {
+    "seresnet18": ("basic", (2, 2, 2, 2), 1, _SMALL),
+    "seresnet34": ("basic", (3, 4, 6, 3), 1, _SMALL),
+    "seresnet50": ("se_resnet", (3, 4, 6, 3), 1, _SMALL),
+    "seresnet101": ("se_resnet", (3, 4, 23, 3), 1, _SMALL),
+    "seresnet152": ("se_resnet", (3, 8, 36, 3), 1, _SMALL),
+    "senet154": ("se", (3, 8, 36, 3), 64, {}),
+    "seresnext26_32x4d": ("se_resnext", (2, 2, 2, 2), 32, _SMALL),
+    "seresnext50_32x4d": ("se_resnext", (3, 4, 6, 3), 32, _SMALL),
+    "seresnext101_32x4d": ("se_resnext", (3, 4, 23, 3), 32, _SMALL),
+}
+
+
+def _register():
+    for name, (block, layers, groups, extra) in _SENET_DEFS.items():
+        def fn(pretrained=False, *, _block=block, _layers=layers,
+               _groups=groups, _extra=extra, **kwargs):
+            kwargs.pop("pretrained", None)
+            kwargs.setdefault("default_cfg", _cfg())
+            return SENet(block=_block, layers=tuple(_layers), groups=_groups,
+                         **{**_extra, **kwargs})
+        fn.__name__ = name
+        fn.__qualname__ = name
+        fn.__module__ = __name__
+        fn.__doc__ = f"{name} (reference senet.py entrypoint)."
+        register_model(fn)
+
+
+_register()

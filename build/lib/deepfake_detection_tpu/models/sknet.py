@@ -1,0 +1,184 @@
+"""Selective-Kernel networks SKResNet / SKResNeXt (Flax/NHWC).
+
+Re-design of ``/root/reference/dfd/timm/models/sknet.py`` (237 LoC): the
+``SelectiveKernelBasic`` (:44-90) and ``SelectiveKernelBottleneck`` (:92-140)
+blocks plugged into the generic :class:`~.resnet.ResNet`, plus the 5
+entrypoints (:143-237).  The SK conv itself lives in
+``ops/attention.py:SelectiveKernelConv`` (reference selective_kernel.py:51).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+
+from ..ops.activations import get_act_fn
+from ..ops.attention import SelectiveKernelConv, create_attn
+from ..ops.conv import Conv2d
+from ..ops.drop import DropPath
+from ..ops.norm import BatchNorm2d
+from ..registry import register_model
+from .resnet import _Downsample, _cfg, register_block, ResNet
+
+__all__ = ["SelectiveKernelBasic", "SelectiveKernelBottleneck"]
+
+
+class SelectiveKernelBasic(nn.Module):
+    """SK basic block (reference sknet.py:44-90): SK-conv 3×3 → plain 3×3."""
+    planes: int
+    stride: int = 1
+    has_downsample: bool = False
+    cardinality: int = 1
+    base_width: int = 64
+    sk_kwargs: Any = None
+    reduce_first: int = 1
+    dilation: int = 1
+    first_dilation: Optional[int] = None
+    act: str = "relu"
+    attn_layer: Optional[str] = None
+    avg_down: bool = False
+    down_kernel_size: int = 1
+    drop_block_rate: float = 0.0
+    drop_block_gamma: float = 1.0
+    drop_path_rate: float = 0.0
+    zero_init_last_bn: bool = True
+    bn: dict = None
+    dtype: Any = None
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        assert self.cardinality == 1 and self.base_width == 64
+        act = get_act_fn(self.act)
+        bn = dict(self.bn or {}, dtype=self.dtype)
+        first_planes = self.planes // self.reduce_first
+        outplanes = self.planes * self.expansion
+        fd = self.first_dilation or self.dilation
+        residual = x
+        y = SelectiveKernelConv(first_planes, stride=self.stride,
+                                dilation=fd, act=self.act, dtype=self.dtype,
+                                **(self.sk_kwargs or {}),
+                                name="conv1")(x, training=training)
+        y = Conv2d(outplanes, 3, dilation=self.dilation, dtype=self.dtype,
+                   name="conv2")(y)
+        y = BatchNorm2d(**bn, name="bn2",
+                        scale_init=nn.initializers.zeros
+                        if self.zero_init_last_bn else None)(
+            y, training=training)
+        attn = create_attn(self.attn_layer, dtype=self.dtype, name="se")
+        if attn is not None:
+            y = attn(y)
+        if self.drop_path_rate:
+            y = DropPath(self.drop_path_rate, name="drop_path")(
+                y, training=training)
+        if self.has_downsample:
+            residual = _Downsample(
+                outplanes, self.down_kernel_size, self.stride, self.dilation,
+                self.first_dilation, avg=self.avg_down, bn=self.bn,
+                dtype=self.dtype, name="downsample")(x, training=training)
+        return act(y + residual)
+
+
+class SelectiveKernelBottleneck(nn.Module):
+    """SK bottleneck (reference sknet.py:92-140): 1×1 → SK-conv → 1×1."""
+    planes: int
+    stride: int = 1
+    has_downsample: bool = False
+    cardinality: int = 1
+    base_width: int = 64
+    sk_kwargs: Any = None
+    reduce_first: int = 1
+    dilation: int = 1
+    first_dilation: Optional[int] = None
+    act: str = "relu"
+    attn_layer: Optional[str] = None
+    avg_down: bool = False
+    down_kernel_size: int = 1
+    drop_block_rate: float = 0.0
+    drop_block_gamma: float = 1.0
+    drop_path_rate: float = 0.0
+    zero_init_last_bn: bool = True
+    bn: dict = None
+    dtype: Any = None
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        act = get_act_fn(self.act)
+        bn = dict(self.bn or {}, dtype=self.dtype)
+        width = int(math.floor(self.planes * (self.base_width / 64))
+                    * self.cardinality)
+        first_planes = width // self.reduce_first
+        outplanes = self.planes * self.expansion
+        residual = x
+        y = Conv2d(first_planes, 1, dtype=self.dtype, name="conv1")(x)
+        y = BatchNorm2d(**bn, name="bn1")(y, training=training)
+        y = act(y)
+        y = SelectiveKernelConv(width, stride=self.stride,
+                                dilation=self.first_dilation or self.dilation,
+                                groups=self.cardinality, act=self.act,
+                                dtype=self.dtype, **(self.sk_kwargs or {}),
+                                name="conv2")(y, training=training)
+        y = Conv2d(outplanes, 1, dtype=self.dtype, name="conv3")(y)
+        y = BatchNorm2d(**bn, name="bn3",
+                        scale_init=nn.initializers.zeros
+                        if self.zero_init_last_bn else None)(
+            y, training=training)
+        attn = create_attn(self.attn_layer, dtype=self.dtype, name="se")
+        if attn is not None:
+            y = attn(y)
+        if self.drop_path_rate:
+            y = DropPath(self.drop_path_rate, name="drop_path")(
+                y, training=training)
+        if self.has_downsample:
+            residual = _Downsample(
+                outplanes, self.down_kernel_size, self.stride, self.dilation,
+                self.first_dilation, avg=self.avg_down, bn=self.bn,
+                dtype=self.dtype, name="downsample")(x, training=training)
+        return act(y + residual)
+
+
+register_block("sk_basic", SelectiveKernelBasic)
+register_block("sk_bottleneck", SelectiveKernelBottleneck)
+
+# the 18/34 variants split input channels across branches to keep params
+# down (reference sknet.py:149-152)
+_SK_SMALL = dict(min_attn_channels=16, attn_reduction=8, split_input=True)
+
+# name: (block, layers, extra ResNet kwargs, sk_kwargs)
+_SKNET_DEFS = {
+    "skresnet18": ("sk_basic", (2, 2, 2, 2), {}, _SK_SMALL),
+    "skresnet34": ("sk_basic", (3, 4, 6, 3), {}, _SK_SMALL),
+    "skresnet50": ("sk_bottleneck", (3, 4, 6, 3), {},
+                   dict(split_input=True)),
+    "skresnet50d": ("sk_bottleneck", (3, 4, 6, 3),
+                    dict(stem_width=32, stem_type="deep", avg_down=True),
+                    dict(split_input=True)),
+    "skresnext50_32x4d": ("sk_bottleneck", (3, 4, 6, 3),
+                          dict(cardinality=32, base_width=4), None),
+}
+
+
+def _register():
+    for name, (block, layers, extra, skk) in _SKNET_DEFS.items():
+        def fn(pretrained=False, *, _block=block, _layers=layers,
+               _extra=extra, _skk=skk, **kwargs):
+            kwargs.pop("pretrained", None)
+            ba = kwargs.pop("block_args", {})
+            if _skk is not None:
+                ba = {"sk_kwargs": dict(_skk), **ba}
+            kwargs.setdefault("default_cfg", _cfg())
+            # reference passes zero_init_last_bn=False for all SK nets
+            kwargs.setdefault("zero_init_last_bn", False)
+            return ResNet(block=_block, layers=tuple(_layers), block_args=ba,
+                          **{**_extra, **kwargs})
+        fn.__name__ = name
+        fn.__qualname__ = name
+        fn.__module__ = __name__
+        fn.__doc__ = f"{name} (reference sknet.py entrypoint)."
+        register_model(fn)
+
+
+_register()

@@ -1,0 +1,68 @@
+"""Test-time pooling (reference ``layers/test_time_pool.py:12-35``).
+
+At inference sizes larger than the train size, classify every ``pool×pool``
+window and avg+max-pool the per-window logits instead of pooling features
+once.  Functional re-design: rather than mutating the model (the reference
+deletes the fc and grafts a 1×1 conv), :func:`test_time_pool_apply` runs the
+unpooled feature forward and applies the classifier kernel as a 1×1
+convolution — numerically identical, no surgery.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.pool import global_pool_nhwc
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["test_time_pool_apply", "apply_test_time_pool"]
+
+
+def test_time_pool_apply(model, variables: Dict[str, Any], x,
+                         original_pool: int = 7,
+                         classifier: str = "classifier") -> jnp.ndarray:
+    """Forward with test-time pooling (reference TestTimePoolHead.forward).
+
+    ``classifier`` names the head params (``default_cfg['classifier']``);
+    a Dense (features, classes) kernel is used as a 1×1 conv over the
+    window-pooled feature map.
+    """
+    feat = model.apply(variables, x, training=False, pool=False)
+    p = original_pool
+    feat = lax.reduce_window(
+        feat, 0.0, lax.add, (1, p, p, 1), (1, 1, 1, 1), "VALID") / (p * p)
+    head = variables["params"][classifier]
+    kernel, bias = head["kernel"], head.get("bias")
+    if kernel.ndim == 2:                       # Dense → 1×1 conv
+        kernel = kernel[None, None]
+    logits = lax.conv_general_dilated(
+        feat, kernel.astype(feat.dtype), (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if bias is not None:
+        logits = logits + bias.astype(logits.dtype)
+    return global_pool_nhwc(logits, "avgmax")
+
+
+def apply_test_time_pool(model, config: Dict[str, Any],
+                         no_test_pool: bool = False) -> Tuple[Any, bool]:
+    """Decide whether TTA pooling applies (reference :35-45): input larger
+    than the model's default train size in both dims.  Returns
+    ``(original_pool, enabled)`` for use with :func:`test_time_pool_apply`."""
+    cfg = getattr(model, "default_cfg", None) or {}
+    if no_test_pool or not cfg:
+        return None, False
+    want = config.get("input_size", ())
+    have = cfg.get("input_size", ())
+    if len(want) == 3 and len(have) == 3 and \
+            want[-1] > have[-1] and want[-2] > have[-2]:
+        pool = cfg.get("pool_size", (7, 7))
+        pool = pool[0] if isinstance(pool, (tuple, list)) else pool
+        _logger.info("Target input size %s > pretrained default %s, "
+                     "using test time pooling", want[-2:], have[-2:])
+        return pool, True
+    return None, False

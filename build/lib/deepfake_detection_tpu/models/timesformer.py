@@ -1,0 +1,199 @@
+"""TimeSformer: divided space-time attention over frame clips (TPU-native).
+
+The reference flattens its 4-frame clips into a 12-channel image and feeds a
+2-D CNN (reference params.py:31, dataset.py:496-512 — "temporal" handled as
+channel concat).  This model treats time as a real axis instead: per-frame
+patch embedding, then alternating temporal attention (each spatial patch
+attends across frames) and spatial attention (patches attend within their
+frame) — the "divided space-time" scheme of TimeSformer (Bertasius et al.
+2021; PAPERS.md), which is O(F²·N + N²·F) instead of joint attention's
+O((N·F)²).
+
+Input stays the pipeline's channel-concat layout ``(B, H, W, 3·F)`` so every
+existing dataset/loader/augmentation path (4-frame clips → 12 channels)
+feeds it unchanged; the model splits frames back out internally.
+
+TPU notes:
+* both attentions run as batched GEMMs on the MXU — temporal attention
+  reshapes to (B·N, F, heads, d) (F is tiny: one fused matmul), spatial to
+  (B·F, N, heads, d);
+* spatial attention is pluggable like ViT's (``attn_impl`` ∈ full | flash |
+  ring | ring_flash | ulysses), so long-token regimes (larger inputs /
+  finer patches) ride the Pallas flash kernels or the sequence-parallel
+  ring over a mesh axis;
+* everything is static-shaped; frames derive from ``in_chans // 3`` at
+  construction time.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+
+from ..ops.drop import DropPath
+from ..registry import register_model
+from .vit import _Attention
+
+__all__ = ["TimeSformer"]
+
+
+def _cfg(**kwargs):
+    cfg = dict(num_classes=2, input_size=(12, 224, 224), pool_size=None,
+               crop_pct=0.9, interpolation="bicubic",
+               mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225),
+               first_conv="patch_embed", classifier="head")
+    cfg.update(kwargs)
+    return cfg
+
+
+class _DividedBlock(nn.Module):
+    """Pre-LN block: temporal attention → spatial attention → MLP."""
+    num_heads: int
+    mlp_ratio: float = 4.0
+    drop_path_rate: float = 0.0
+    attn_impl: str = "full"
+    sp_mesh: Any = None
+    seq_axis: str = "data"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        # x: (B, F, N, C)
+        B, F, N, C = x.shape
+
+        def droppath(name, y):
+            if self.drop_path_rate:
+                return DropPath(self.drop_path_rate, name=name)(
+                    y, training=training)
+            return y
+
+        # temporal: each spatial location attends across its F frames
+        y = nn.LayerNorm(dtype=self.dtype, name="norm_t")(x)
+        y = y.transpose(0, 2, 1, 3).reshape(B * N, F, C)
+        # F is tiny (4): always the dense kernel — one fused batched GEMM
+        y = _Attention(self.num_heads, attn_impl="full", dtype=self.dtype,
+                       name="attn_t")(y)
+        y = y.reshape(B, N, F, C).transpose(0, 2, 1, 3)
+        x = x + droppath("dp_t", y)
+
+        # spatial: patches attend within their own frame
+        y = nn.LayerNorm(dtype=self.dtype, name="norm_s")(x)
+        y = _Attention(self.num_heads, attn_impl=self.attn_impl,
+                       sp_mesh=self.sp_mesh, seq_axis=self.seq_axis,
+                       dtype=self.dtype,
+                       name="attn_s")(y.reshape(B * F, N, C))
+        y = y.reshape(B, F, N, C)
+        x = x + droppath("dp_s", y)
+
+        y = nn.LayerNorm(dtype=self.dtype, name="norm_mlp")(x)
+        y = nn.Dense(int(C * self.mlp_ratio), dtype=self.dtype,
+                     name="mlp_fc1")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(C, dtype=self.dtype, name="mlp_fc2")(y)
+        return x + droppath("dp_mlp", y)
+
+
+class TimeSformer(nn.Module):
+    """Divided space-time transformer over channel-concat clips.
+
+    ``in_chans`` must be ``3 · frames`` (the pipeline's clip layout); mean
+    pooling over all frame-patch tokens feeds the classifier head.
+    """
+    patch_size: int = 16
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_ratio: float = 4.0
+    num_classes: int = 2
+    in_chans: int = 12
+    drop_path_rate: float = 0.0
+    attn_impl: str = "full"
+    sp_mesh: Any = None
+    seq_axis: str = "data"
+    # remat at block boundaries: none | full | dots (same policy surface as
+    # EfficientNet / ViT)
+    remat_policy: str = "none"
+    dtype: Any = None
+    default_cfg: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False,
+                 features_only: bool = False):
+        assert x.shape[-1] == self.in_chans, (x.shape, self.in_chans)
+        assert self.in_chans % 3 == 0, \
+            f"in_chans must be 3·frames, got {self.in_chans}"
+        frames = self.in_chans // 3
+        B, H, W, _ = x.shape
+        p = self.patch_size
+        assert H % p == 0 and W % p == 0, (x.shape, p)
+
+        # split frames out of the channel axis: (B, H, W, 3F) -> (B·F, H, W, 3)
+        x = x.reshape(B, H, W, frames, 3).transpose(0, 3, 1, 2, 4)
+        x = x.reshape(B * frames, H, W, 3)
+        # shared per-frame patch embed
+        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, name="patch_embed")(x)
+        n = (H // p) * (W // p)
+        x = x.reshape(B, frames, n, self.embed_dim)
+
+        pos = self.param("pos_embed", nn.initializers.normal(stddev=0.02),
+                         (1, 1, n, self.embed_dim))
+        tim = self.param("time_embed", nn.initializers.normal(stddev=0.02),
+                         (1, frames, 1, self.embed_dim))
+        x = x + pos.astype(x.dtype) + tim.astype(x.dtype)
+
+        from .helpers import maybe_remat
+        block_cls = maybe_remat(_DividedBlock, self.remat_policy)
+        feats = []
+        for i in range(self.depth):
+            dpr = self.drop_path_rate * i / max(self.depth - 1, 1)
+            x = block_cls(self.num_heads, self.mlp_ratio, dpr,
+                          self.attn_impl, self.sp_mesh, self.seq_axis,
+                          dtype=self.dtype,
+                          name=f"blocks_{i}")(x, training)
+            feats.append(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        if features_only:
+            feats[-1] = x
+            return feats
+        feat = x.mean(axis=(1, 2))                      # frames and patches
+        if self.num_classes <= 0:
+            return feat
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="head")(feat)
+
+
+# name: (patch, dim, depth, heads)
+_TSF_DEFS = {
+    "timesformer_tiny_patch16_224": (16, 192, 12, 3),
+    "timesformer_base_patch16_224": (16, 768, 12, 12),
+    # flagship 600² clips: 600 = 24·25 → patch 25, 576 tokens/frame
+    "timesformer_base_patch25_600": (25, 768, 12, 12),
+}
+
+
+def _register():
+    for name, (p, dim, depth, heads) in _TSF_DEFS.items():
+        size = int(name.rsplit("_", 1)[-1])
+
+        def fn(pretrained=False, *, _p=p, _dim=dim, _depth=depth,
+               _heads=heads, _size=size, **kwargs):
+            kwargs.pop("pretrained", None)
+            # default_cfg channels must track the constructed in_chans
+            # (create_model always passes one, default 3 ⇒ single frame)
+            in_chans = kwargs.get("in_chans", 12)
+            kwargs.setdefault("default_cfg",
+                              _cfg(input_size=(in_chans, _size, _size)))
+            return TimeSformer(patch_size=_p, embed_dim=_dim, depth=_depth,
+                               num_heads=_heads, **kwargs)
+        fn.__name__ = name
+        fn.__qualname__ = name
+        fn.__module__ = __name__
+        fn.__doc__ = (f"{name}: divided space-time attention over "
+                      f"{name.split('_')[1]}-scale ViT dims.")
+        register_model(fn)
+
+
+_register()

@@ -1,0 +1,224 @@
+"""Vision Transformer (Flax/NHWC, TPU-native).
+
+The reference has no transformer backbone (SURVEY.md §5: the temporal dim is
+channel-concat); ViT-B/16 and ViT-L/16 are the BASELINE.json stretch configs
+("stress the XLA attention path") and the customer for the sequence-parallel
+machinery in ``parallel/ring_attention.py``.
+
+TPU notes:
+* Attention is pluggable: ``attn_impl='full'`` is single-device dense
+  attention; ``'ring'``/``'ulysses'`` shard the token axis over a mesh axis
+  via shard_map (``sp_mesh`` + ``seq_axis``), so a 12-block ViT-L forward at
+  long sequence runs with O(L/n) activation memory per chip and K/V blocks
+  riding ICI neighbor-to-neighbor.
+* All matmuls are (B·L, D)×(D, ·) GEMMs on the MXU; LayerNorm and GELU fuse
+  into the surrounding dots under XLA.
+* Architectural layout (pre-LN, learned pos-embed, optional class token)
+  follows the ViT paper / timm conventions so torch ViT checkpoints map
+  mechanically (tools/convert_torch_checkpoint.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.drop import DropPath
+from ..ops.flash_attention import flash_attention
+from ..parallel.ring_attention import full_attention, ring_self_attention
+from ..registry import register_model
+
+__all__ = ["VisionTransformer"]
+
+
+def _cfg(**kwargs):
+    cfg = dict(num_classes=1000, input_size=(3, 224, 224), pool_size=None,
+               crop_pct=0.9, interpolation="bicubic",
+               mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5),
+               first_conv="patch_embed", classifier="head")
+    cfg.update(kwargs)
+    return cfg
+
+
+class _Attention(nn.Module):
+    """Multi-head self-attention with a pluggable kernel."""
+    num_heads: int
+    qkv_bias: bool = True
+    attn_impl: str = "full"  # 'full'|'flash'|'ring'|'ring_flash'|'ulysses'
+    sp_mesh: Any = None           # jax.sharding.Mesh for ring/ulysses
+    seq_axis: str = "data"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        B, L, C = x.shape
+        H = self.num_heads
+        qkv = nn.Dense(3 * C, use_bias=self.qkv_bias, dtype=self.dtype,
+                       name="qkv")(x)
+        q, k, v = jnp.split(qkv.reshape(B, L, 3, H, C // H), 3, axis=2)
+        q, k, v = (t[:, :, 0] for t in (q, k, v))      # (B, L, H, D)
+        if self.attn_impl == "flash":
+            # fused Pallas kernel: scores stay in VMEM, O(L) HBM traffic
+            out = flash_attention(q, k, v)
+        elif self.attn_impl == "full" or self.sp_mesh is None:
+            out = full_attention(q, k, v)
+        else:
+            out = ring_self_attention(q, k, v, self.sp_mesh,
+                                      seq_axis=self.seq_axis,
+                                      impl=self.attn_impl)
+        out = out.reshape(B, L, C)
+        return nn.Dense(C, dtype=self.dtype, name="proj")(out)
+
+
+class _Block(nn.Module):
+    """Pre-LN transformer block."""
+    num_heads: int
+    mlp_ratio: float = 4.0
+    qkv_bias: bool = True
+    drop_rate: float = 0.0
+    drop_path_rate: float = 0.0
+    attn_impl: str = "full"
+    sp_mesh: Any = None
+    seq_axis: str = "data"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        C = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
+        y = _Attention(self.num_heads, self.qkv_bias, self.attn_impl,
+                       self.sp_mesh, self.seq_axis, dtype=self.dtype,
+                       name="attn")(y)
+        if self.drop_rate:
+            y = nn.Dropout(self.drop_rate, deterministic=not training)(y)
+        if self.drop_path_rate:
+            y = DropPath(self.drop_path_rate, name="drop_path1")(
+                y, training=training)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype, name="norm2")(x)
+        y = nn.Dense(int(C * self.mlp_ratio), dtype=self.dtype,
+                     name="mlp_fc1")(y)
+        y = nn.gelu(y)
+        if self.drop_rate:
+            y = nn.Dropout(self.drop_rate, deterministic=not training)(y)
+        y = nn.Dense(C, dtype=self.dtype, name="mlp_fc2")(y)
+        if self.drop_rate:
+            y = nn.Dropout(self.drop_rate, deterministic=not training)(y)
+        if self.drop_path_rate:
+            y = DropPath(self.drop_path_rate, name="drop_path2")(
+                y, training=training)
+        return x + y
+
+
+class VisionTransformer(nn.Module):
+    """ViT classifier; token or mean pooling, optional sequence parallelism.
+
+    With ``class_token=False`` + ``global_pool='avg'`` the token count is
+    exactly (H/p)·(W/p), which keeps the sequence axis divisible by the mesh
+    for ring/ulysses sharding.
+    """
+    patch_size: int = 16
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_ratio: float = 4.0
+    qkv_bias: bool = True
+    class_token: bool = True
+    global_pool: str = "token"     # 'token' | 'avg'
+    num_classes: int = 1000
+    in_chans: int = 3
+    drop_rate: float = 0.0
+    drop_path_rate: float = 0.0
+    attn_impl: str = "full"
+    sp_mesh: Any = None
+    seq_axis: str = "data"
+    # remat at block boundaries (same policy surface as EfficientNet's
+    # TrainConfig.checkpoint_policy): none | full | dots
+    remat_policy: str = "none"
+    dtype: Any = None
+    default_cfg: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False, features_only: bool = False,
+                 pool: bool = True):
+        assert x.shape[-1] == self.in_chans, (x.shape, self.in_chans)
+        B, H, W, _ = x.shape
+        p = self.patch_size
+        assert H % p == 0 and W % p == 0, (x.shape, p)
+        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, name="patch_embed")(x)
+        x = x.reshape(B, -1, self.embed_dim)           # (B, N, C)
+        n_tokens = x.shape[1] + (1 if self.class_token else 0)
+        if self.class_token:
+            cls = self.param("cls_token", nn.initializers.zeros,
+                             (1, 1, self.embed_dim))
+            x = jnp.concatenate([jnp.broadcast_to(
+                cls, (B, 1, self.embed_dim)).astype(x.dtype), x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, n_tokens, self.embed_dim))
+        x = x + pos.astype(x.dtype)
+        if self.drop_rate:
+            x = nn.Dropout(self.drop_rate, deterministic=not training)(x)
+        from .helpers import maybe_remat
+        block_cls = maybe_remat(_Block, self.remat_policy)
+        feats = []
+        for i in range(self.depth):
+            # stochastic depth scales linearly over blocks (timm convention)
+            dpr = self.drop_path_rate * i / max(self.depth - 1, 1)
+            x = block_cls(self.num_heads, self.mlp_ratio, self.qkv_bias,
+                          self.drop_rate, dpr, self.attn_impl, self.sp_mesh,
+                          self.seq_axis, dtype=self.dtype,
+                          name=f"blocks_{i}")(x, training)
+            feats.append(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        if features_only:
+            feats[-1] = x
+            return feats
+        if not pool:
+            return x
+        if self.global_pool == "avg":
+            start = 1 if self.class_token else 0
+            feat = x[:, start:].mean(axis=1)
+        else:
+            assert self.class_token, "token pooling needs a class token"
+            feat = x[:, 0]
+        if self.num_classes <= 0:
+            return feat
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="head")(feat)
+
+
+# name: (patch, dim, depth, heads)
+_VIT_DEFS = {
+    "vit_tiny_patch16_224": (16, 192, 12, 3),
+    "vit_small_patch16_224": (16, 384, 12, 6),
+    "vit_base_patch16_224": (16, 768, 12, 12),
+    "vit_base_patch16_384": (16, 768, 12, 12),
+    "vit_base_patch32_224": (32, 768, 12, 12),
+    "vit_large_patch16_224": (16, 1024, 24, 16),
+    "vit_large_patch16_384": (16, 1024, 24, 16),
+}
+
+
+def _register():
+    for name, (p, dim, depth, heads) in _VIT_DEFS.items():
+        size = 384 if name.endswith("_384") else 224
+
+        def fn(pretrained=False, *, _p=p, _dim=dim, _depth=depth,
+               _heads=heads, _size=size, **kwargs):
+            kwargs.pop("pretrained", None)
+            kwargs.setdefault("default_cfg",
+                              _cfg(input_size=(3, _size, _size)))
+            return VisionTransformer(patch_size=_p, embed_dim=_dim,
+                                     depth=_depth, num_heads=_heads, **kwargs)
+        fn.__name__ = name
+        fn.__qualname__ = name
+        fn.__module__ = __name__
+        fn.__doc__ = f"{name} (BASELINE.json stretch config)."
+        register_model(fn)
+
+
+_register()

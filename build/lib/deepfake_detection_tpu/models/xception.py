@@ -1,0 +1,154 @@
+"""Xception (Flax/NHWC) — the FaceForensics++ deepfake baseline backbone.
+
+Re-design of ``/root/reference/dfd/timm/models/xception.py`` (Chollet 2017):
+entry flow (conv 32 s2 VALID-padded, conv 64, blocks 128/256/728 s2), middle
+flow (8 × 728 blocks of 3 separable convs), exit flow (1024 block,
+separable 1536 + 2048 head).  Block semantics follow the reference exactly:
+pre-activation ReLU (skipped on block1), ``grow_first``, residual via 1×1
+strided conv+BN when shape changes, max-pool for striding (:66-116).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.conv import Conv2d
+from ..ops.norm import BatchNorm2d
+from ..ops.pool import SelectAdaptivePool2d
+from ..registry import register_model
+from .efficientnet import IMAGENET_INCEPTION_MEAN, IMAGENET_INCEPTION_STD
+
+__all__ = ["Xception"]
+
+_XCEPTION_CFG = dict(
+    num_classes=1000, input_size=(3, 299, 299), pool_size=(10, 10),
+    crop_pct=0.8975, interpolation="bicubic",
+    mean=IMAGENET_INCEPTION_MEAN, std=IMAGENET_INCEPTION_STD,
+    first_conv="conv1", classifier="fc")
+
+
+class SeparableConv2d(nn.Module):
+    """Depthwise 3×3 + pointwise 1×1, no intermediate act (:52-63)."""
+    out_chs: int
+    kernel_size: int = 3
+    stride: int = 1
+    dilation: int = 1
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        in_chs = x.shape[-1]
+        x = Conv2d(in_chs, self.kernel_size, stride=self.stride,
+                   dilation=self.dilation, groups=in_chs, dtype=self.dtype,
+                   name="conv1")(x)
+        return Conv2d(self.out_chs, 1, dtype=self.dtype, name="pointwise")(x)
+
+
+class XceptionBlock(nn.Module):
+    """Residual separable-conv stack (:66-116)."""
+    out_filters: int
+    reps: int
+    strides: int = 1
+    start_with_relu: bool = True
+    grow_first: bool = True
+    bn: dict = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        in_filters = x.shape[-1]
+        bn = dict(self.bn or {}, dtype=self.dtype)
+        inp = x
+        ops = []                      # (sepconv out_chs) sequence
+        if self.grow_first:
+            ops.append(self.out_filters)
+            ops.extend([self.out_filters] * (self.reps - 1))
+        else:
+            ops.extend([in_filters] * (self.reps - 1))
+            ops.append(self.out_filters)
+        for i, out_chs in enumerate(ops):
+            if i > 0 or self.start_with_relu:
+                x = nn.relu(x)
+            x = SeparableConv2d(out_chs, 3, dtype=self.dtype,
+                                name=f"sep{i + 1}")(x)
+            x = BatchNorm2d(**bn, name=f"bn{i + 1}")(x, training=training)
+        if self.strides != 1:
+            x = nn.max_pool(x, (3, 3), strides=(self.strides,) * 2,
+                            padding="SAME")
+        if self.out_filters != in_filters or self.strides != 1:
+            skip = Conv2d(self.out_filters, 1, stride=self.strides,
+                          dtype=self.dtype, name="skip")(inp)
+            skip = BatchNorm2d(**bn, name="skipbn")(skip, training=training)
+        else:
+            skip = inp
+        return x + skip
+
+
+class Xception(nn.Module):
+    """Reference ``Xception`` (:118-223)."""
+    num_classes: int = 1000
+    in_chans: int = 3
+    drop_rate: float = 0.0
+    global_pool: str = "avg"
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    bn_axis_name: Optional[str] = None
+    dtype: Any = None
+    default_cfg: Any = None
+    num_features = 2048
+
+    @nn.compact
+    def __call__(self, x, training: bool = False, pool: bool = True):
+        assert x.shape[-1] == self.in_chans
+        bn = dict(momentum=self.bn_momentum, eps=self.bn_eps,
+                  axis_name=self.bn_axis_name)
+        blk = dict(bn=bn, dtype=self.dtype)
+        # entry flow stem: VALID padding like the torch padding=0 convs
+        x = Conv2d(32, 3, stride=2, padding=0, dtype=self.dtype,
+                   name="conv1")(x)
+        x = BatchNorm2d(**bn, dtype=self.dtype, name="bn1")(
+            x, training=training)
+        x = nn.relu(x)
+        x = Conv2d(64, 3, padding=0, dtype=self.dtype, name="conv2")(x)
+        x = BatchNorm2d(**bn, dtype=self.dtype, name="bn2")(
+            x, training=training)
+        x = nn.relu(x)
+
+        x = XceptionBlock(128, 2, 2, start_with_relu=False, **blk,
+                          name="block1")(x, training=training)
+        x = XceptionBlock(256, 2, 2, **blk, name="block2")(x, training=training)
+        x = XceptionBlock(728, 2, 2, **blk, name="block3")(x, training=training)
+        for i in range(4, 12):
+            x = XceptionBlock(728, 3, 1, **blk, name=f"block{i}")(
+                x, training=training)
+        x = XceptionBlock(1024, 2, 2, grow_first=False, **blk,
+                          name="block12")(x, training=training)
+
+        x = SeparableConv2d(1536, 3, dtype=self.dtype, name="conv3")(x)
+        x = BatchNorm2d(**bn, dtype=self.dtype, name="bn3")(
+            x, training=training)
+        x = nn.relu(x)
+        x = SeparableConv2d(2048, 3, dtype=self.dtype, name="conv4")(x)
+        x = BatchNorm2d(**bn, dtype=self.dtype, name="bn4")(
+            x, training=training)
+        x = nn.relu(x)
+        if not pool:
+            return x
+        x = SelectAdaptivePool2d(self.global_pool, name="global_pool")(x)
+        if self.drop_rate:
+            x = nn.Dropout(rate=self.drop_rate,
+                           deterministic=not training)(x)
+        if self.num_classes <= 0:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+
+
+@register_model
+def xception(pretrained=False, num_classes=1000, in_chans=3, **kwargs):
+    """Reference xception.py:226-237."""
+    kwargs.pop("pretrained", None)
+    return Xception(num_classes=num_classes, in_chans=in_chans,
+                    default_cfg=dict(_XCEPTION_CFG), **kwargs)

@@ -1,0 +1,1 @@
+"""Native (C++) runtime components; Python bindings live in data/native.py."""

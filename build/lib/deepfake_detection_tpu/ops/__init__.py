@@ -1,0 +1,14 @@
+"""Ops/layers library (reference layer L1, ``dfd/timm/models/layers/``)."""
+
+from .activations import ACT_FNS, get_act_fn, hard_mish, hard_sigmoid, hard_swish, mish, swish
+from .attention import (CbamModule, CecaModule, ChannelAttn, EcaModule,
+                        LightCbamModule, SEModule, SelectiveKernelConv,
+                        SpatialAttn, create_attn, make_divisible)
+from .conv import (CondConv2d, Conv2d, MixedConv2d, conv_kernel_init_goog,
+                   create_conv2d, dense_init_goog, resolve_padding)
+from .drop import DropBlock2d, DropPath, Dropout, drop_block_2d, drop_path
+from .flash_attention import flash_attention
+from .norm import (BN_EPS_TF_DEFAULT, BN_MOMENTUM_TF_DEFAULT, BatchNorm2d,
+                   GroupNorm, Identity, SplitBatchNorm2d, resolve_bn_args)
+from .pool import (MedianPool2d, SelectAdaptivePool2d, adaptive_pool_feat_mult,
+                   avg_pool2d_same, global_pool_nhwc, median_pool2d)

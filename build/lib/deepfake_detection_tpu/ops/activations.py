@@ -1,0 +1,75 @@
+"""Activation functions + name resolver.
+
+Replaces the reference's activation zoo (``/root/reference/dfd/timm/models/layers/
+activations.py``).  The reference implements memory-efficient Swish/Mish via
+custom autograd + TorchScript (activations.py:16-75); under XLA that machinery
+is unnecessary — fusion and rematerialisation make ``jax.nn.silu`` exactly as
+cheap — so everything here is a plain function the compiler fuses into the
+surrounding matmul/conv.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["get_act_fn", "swish", "mish", "hard_swish", "hard_sigmoid",
+           "hard_mish", "sigmoid", "ACT_FNS"]
+
+
+def swish(x):
+    """SiLU / Swish: x * sigmoid(x) (activations.py:16-40)."""
+    return jax.nn.silu(x)
+
+
+def mish(x):
+    """x * tanh(softplus(x)) (activations.py:43-75)."""
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def hard_swish(x):
+    """x * relu6(x+3)/6 (activations.py:141-154)."""
+    return x * jax.nn.relu6(x + 3.0) / 6.0
+
+
+def hard_sigmoid(x):
+    """relu6(x+3)/6 (activations.py:157-164)."""
+    return jax.nn.relu6(x + 3.0) / 6.0
+
+
+def hard_mish(x):
+    return 0.5 * x * jnp.clip(x + 2.0, 0.0, 2.0)
+
+
+ACT_FNS = {
+    "swish": swish,
+    "silu": swish,
+    "mish": mish,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "elu": jax.nn.elu,
+    "gelu": jax.nn.gelu,
+    "leaky_relu": jax.nn.leaky_relu,
+    "sigmoid": sigmoid,
+    "tanh": jnp.tanh,
+    "hard_swish": hard_swish,
+    "hard_sigmoid": hard_sigmoid,
+    "hard_mish": hard_mish,
+    "identity": lambda x: x,
+    None: lambda x: x,
+}
+
+
+def get_act_fn(name) -> Callable:
+    """Resolve an activation by name; callables pass through unchanged."""
+    if callable(name):
+        return name
+    if name in ACT_FNS:
+        return ACT_FNS[name]
+    raise KeyError(f"Unknown activation {name!r}; known: {sorted(k for k in ACT_FNS if k)}")

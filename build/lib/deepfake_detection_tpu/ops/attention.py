@@ -1,0 +1,250 @@
+"""Channel/spatial attention modules: SE, ECA, CBAM, Selective-Kernel.
+
+Replaces ``layers/{se,eca,cbam,selective_kernel,create_attn}.py``.  All operate
+on NHWC; the squeeze path is a global mean (one HBM pass) and the excite path
+is tiny matmuls XLA fuses with the surrounding scale.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .activations import get_act_fn
+from .conv import Conv2d
+from .norm import BatchNorm2d
+
+
+def make_divisible(v: int, divisor: int = 8, min_value: Optional[int] = None) -> int:
+    """Round channels to hardware-friendly multiples (efficientnet_blocks.py:55)."""
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class SEModule(nn.Module):
+    """Classic squeeze-and-excitation (se.py:4-25)."""
+    reduction: int = 16
+    act: str = "relu"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        chs = x.shape[-1]
+        rd = max(chs // self.reduction, 8)
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = Conv2d(rd, 1, use_bias=True, dtype=self.dtype, name="fc1")(s)
+        s = get_act_fn(self.act)(s)
+        s = Conv2d(chs, 1, use_bias=True, dtype=self.dtype, name="fc2")(s)
+        return x * jax.nn.sigmoid(s)
+
+
+class EcaModule(nn.Module):
+    """Efficient channel attention (eca.py:41-73): 1-D conv over the channel
+    descriptor instead of a bottleneck MLP."""
+    kernel_size: Optional[int] = None
+    gamma: int = 2
+    beta: int = 1
+    dtype: Any = None
+
+    def _ksize(self, chs: int) -> int:
+        if self.kernel_size is not None:
+            return self.kernel_size
+        t = int(abs(math.log(chs, 2) + self.beta) / self.gamma)
+        k = max(t if t % 2 else t + 1, 3)
+        return k
+
+    @nn.compact
+    def __call__(self, x):
+        chs = x.shape[-1]
+        k = self._ksize(chs)
+        s = jnp.mean(x, axis=(1, 2))            # (B, C)
+        s = nn.Conv(features=1, kernel_size=(k,), padding="SAME",
+                    use_bias=False, dtype=self.dtype,
+                    name="conv")(s[..., None])   # (B, C, 1)
+        s = jax.nn.sigmoid(s[..., 0])
+        return x * s[:, None, None, :]
+
+
+class CecaModule(nn.Module):
+    """ECA with circular channel padding (eca.py:75-108)."""
+    kernel_size: Optional[int] = None
+    gamma: int = 2
+    beta: int = 1
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        chs = x.shape[-1]
+        k = EcaModule._ksize(self, chs)
+        s = jnp.mean(x, axis=(1, 2))[..., None]      # (B, C, 1)
+        pad = (k - 1) // 2
+        s = jnp.concatenate([s[:, -pad:], s, s[:, :pad]], axis=1)
+        s = nn.Conv(features=1, kernel_size=(k,), padding="VALID",
+                    use_bias=False, dtype=self.dtype, name="conv")(s)
+        s = jax.nn.sigmoid(s[..., 0])
+        return x * s[:, None, None, :]
+
+
+class ChannelAttn(nn.Module):
+    """CBAM channel gate (cbam.py:16-39): shared MLP over avg- and max-pooled
+    descriptors, summed, sigmoid."""
+    reduction: int = 16
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        chs = x.shape[-1]
+        rd = chs // self.reduction
+        fc1 = Conv2d(rd, 1, use_bias=False, dtype=self.dtype, name="fc1")
+        fc2 = Conv2d(chs, 1, use_bias=False, dtype=self.dtype, name="fc2")
+        avg = jnp.mean(x, axis=(1, 2), keepdims=True)
+        mx = jnp.max(x, axis=(1, 2), keepdims=True)
+        attn = fc2(jax.nn.relu(fc1(avg))) + fc2(jax.nn.relu(fc1(mx)))
+        return x * jax.nn.sigmoid(attn)
+
+
+class LightChannelAttn(ChannelAttn):
+    """Light CBAM channel gate (cbam.py:42-55): 50/50 avg+max pooled input."""
+
+    @nn.compact
+    def __call__(self, x):
+        chs = x.shape[-1]
+        rd = chs // self.reduction
+        pooled = 0.5 * jnp.mean(x, axis=(1, 2), keepdims=True) \
+            + 0.5 * jnp.max(x, axis=(1, 2), keepdims=True)
+        attn = Conv2d(chs, 1, use_bias=False, dtype=self.dtype, name="fc2")(
+            jax.nn.relu(Conv2d(rd, 1, use_bias=False, dtype=self.dtype,
+                               name="fc1")(pooled)))
+        return x * jax.nn.sigmoid(attn)
+
+
+class SpatialAttn(nn.Module):
+    """CBAM spatial gate (cbam.py:58-72): [mean_c, max_c] → 7×7 conv → sigmoid."""
+    kernel_size: int = 7
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        stat = jnp.concatenate([jnp.mean(x, axis=-1, keepdims=True),
+                                jnp.max(x, axis=-1, keepdims=True)], axis=-1)
+        attn = Conv2d(1, self.kernel_size, use_bias=False, dtype=self.dtype,
+                      name="conv")(stat)
+        return x * jax.nn.sigmoid(attn)
+
+
+class LightSpatialAttn(nn.Module):
+    """Light CBAM spatial gate (cbam.py:75-87): 50/50 mean+max single map."""
+    kernel_size: int = 7
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        stat = 0.5 * jnp.mean(x, axis=-1, keepdims=True) \
+            + 0.5 * jnp.max(x, axis=-1, keepdims=True)
+        attn = Conv2d(1, self.kernel_size, use_bias=False, dtype=self.dtype,
+                      name="conv")(stat)
+        return x * jax.nn.sigmoid(attn)
+
+
+class CbamModule(nn.Module):
+    """Channel then spatial attention (cbam.py:90-100)."""
+    reduction: int = 16
+    spatial_kernel: int = 7
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = ChannelAttn(self.reduction, dtype=self.dtype, name="channel")(x)
+        return SpatialAttn(self.spatial_kernel, dtype=self.dtype, name="spatial")(x)
+
+
+class LightCbamModule(nn.Module):
+    reduction: int = 16
+    spatial_kernel: int = 7
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = LightChannelAttn(self.reduction, dtype=self.dtype, name="channel")(x)
+        return LightSpatialAttn(self.spatial_kernel, dtype=self.dtype,
+                                name="spatial")(x)
+
+
+class SelectiveKernelConv(nn.Module):
+    """SK conv (selective_kernel.py:51-118): parallel branches with different
+    kernels/dilations, branch-wise attention over a shared descriptor."""
+    out_chs: int
+    kernel_size: Sequence[int] = (3, 5)
+    stride: int = 1
+    dilation: int = 1
+    groups: int = 1
+    attn_reduction: int = 16
+    min_attn_channels: int = 32
+    keep_3x3: bool = True
+    split_input: bool = False
+    act: str = "relu"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        act = get_act_fn(self.act)
+        kernel_sizes = list(self.kernel_size)
+        dilations = [self.dilation] * len(kernel_sizes)
+        if self.keep_3x3:
+            # larger kernels become dilated 3x3s (selective_kernel.py:63-69)
+            dilations = [max(self.dilation * (k - 1) // 2, 1)
+                         for k in kernel_sizes]
+            kernel_sizes = [3] * len(kernel_sizes)
+        n = len(kernel_sizes)
+        in_chs = x.shape[-1]
+        if self.split_input:
+            assert in_chs % n == 0
+            splits = jnp.split(x, n, axis=-1)
+        else:
+            splits = [x] * n
+        feats = []
+        for i, (ks, dil, xi) in enumerate(zip(kernel_sizes, dilations, splits)):
+            g = self.groups if self.groups > 0 else 1
+            y = Conv2d(self.out_chs, ks, self.stride, dilation=dil,
+                       groups=min(g, self.out_chs), dtype=self.dtype,
+                       name=f"path_{i}_conv")(xi)
+            y = BatchNorm2d(dtype=self.dtype, name=f"path_{i}_bn")(y, training=training)
+            feats.append(act(y))
+        stacked = jnp.stack(feats, axis=1)          # (B, n, H, W, C)
+        summed = jnp.sum(stacked, axis=1)
+        attn_chs = max(self.out_chs // self.attn_reduction, self.min_attn_channels)
+        s = jnp.mean(summed, axis=(1, 2), keepdims=True)
+        s = Conv2d(attn_chs, 1, use_bias=False, dtype=self.dtype, name="attn_fc")(s)
+        s = act(BatchNorm2d(dtype=self.dtype, name="attn_bn")(s, training=training))
+        s = Conv2d(self.out_chs * n, 1, use_bias=False, dtype=self.dtype,
+                   name="attn_sel")(s)              # (B,1,1,C*n)
+        B = x.shape[0]
+        s = s.reshape(B, 1, 1, n, self.out_chs).transpose(0, 3, 1, 2, 4)
+        attn = jax.nn.softmax(s, axis=1)
+        return jnp.sum(stacked * attn, axis=1)
+
+
+def create_attn(attn_type, **kwargs) -> Optional[nn.Module]:
+    """Name → module dispatch (create_attn.py:11-35)."""
+    if attn_type is None or attn_type == "":
+        return None
+    if callable(attn_type) and not isinstance(attn_type, str):
+        return attn_type(**kwargs)
+    table = {
+        "se": SEModule,
+        "eca": EcaModule,
+        "ceca": CecaModule,
+        "cbam": CbamModule,
+        "lcbam": LightCbamModule,
+    }
+    name = attn_type.lower()
+    if name not in table:
+        raise KeyError(f"Unknown attention {attn_type!r}")
+    return table[name](**kwargs)

@@ -1,0 +1,191 @@
+"""Convolution factory: plain / depthwise / mixed / conditional.
+
+Replaces ``layers/create_conv2d.py`` (:11), ``layers/conv2d_same.py``,
+``layers/mixed_conv2d.py`` (:20) and ``layers/cond_conv2d.py`` (:83-121).
+
+TPU notes:
+* TF-"SAME" padding is native to XLA (``padding='SAME'``) — the reference's
+  static-vs-dynamic ``get_padding_value`` decision and ``Conv2dSame`` shim
+  vanish entirely.
+* CondConv's per-sample expert mixing is an einsum + a vmapped conv; XLA
+  lowers the vmap to one batched/grouped convolution on the MXU — same trick
+  as the reference's grouped-conv reshape, minus the manual reshapes.
+
+Layout is NHWC, kernels HWIO (XLA/TPU native).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _to_tuple(v) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def resolve_padding(padding: Union[str, int, None], kernel_size, dilation=1):
+    """Map reference pad_type strings onto XLA padding specs.
+
+    '' or 'same' → 'SAME'; 'valid' → 'VALID'; int → explicit symmetric.
+    """
+    if padding is None or padding == "" or str(padding).lower() == "same":
+        return "SAME"
+    if str(padding).lower() == "valid":
+        return "VALID"
+    if isinstance(padding, int):
+        return [(padding, padding), (padding, padding)]
+    return padding
+
+
+def conv_kernel_init_goog(key, shape, dtype=jnp.float32):
+    """TF/EfficientNet conv init: N(0, sqrt(2/fan_out)), fan_out = kh*kw*out
+    (efficientnet_builder.py:537-575)."""
+    fan_out = shape[0] * shape[1] * shape[-1]
+    return jax.random.normal(key, shape, dtype) * np.sqrt(2.0 / fan_out)
+
+
+def dense_init_goog(key, shape, dtype=jnp.float32):
+    """TF head init: U(-1/sqrt(out), 1/sqrt(out)) (efficientnet_builder.py:566-571)."""
+    fan_out = shape[-1]
+    init_range = 1.0 / np.sqrt(fan_out)
+    return jax.random.uniform(key, shape, dtype, -init_range, init_range)
+
+
+class Conv2d(nn.Module):
+    """NHWC conv; depthwise via ``groups == in_chs`` like the reference factory."""
+    out_chs: int
+    kernel_size: Union[int, Tuple[int, int]] = 3
+    stride: Union[int, Tuple[int, int]] = 1
+    dilation: Union[int, Tuple[int, int]] = 1
+    groups: int = 1
+    padding: Union[str, int, None] = ""
+    use_bias: bool = False
+    kernel_init: Callable = conv_kernel_init_goog
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        ks = _to_tuple(self.kernel_size)
+        return nn.Conv(
+            features=self.out_chs,
+            kernel_size=ks,
+            strides=_to_tuple(self.stride),
+            kernel_dilation=_to_tuple(self.dilation),
+            feature_group_count=self.groups,
+            padding=resolve_padding(self.padding, ks, self.dilation),
+            use_bias=self.use_bias,
+            kernel_init=self.kernel_init,
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+
+
+class MixedConv2d(nn.Module):
+    """Channel-split multi-kernel conv (MixNet; mixed_conv2d.py:20-50).
+
+    Channels are split as equally as possible across kernel sizes (first split
+    absorbs the remainder, matching the reference's np.array_split behavior).
+    """
+    out_chs: int
+    kernel_size: Sequence[int] = (3, 5)
+    stride: int = 1
+    dilation: int = 1
+    depthwise: bool = False
+    padding: Union[str, int, None] = ""
+    use_bias: bool = False
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        in_chs = x.shape[-1]
+        n = len(self.kernel_size)
+        in_splits = np.array_split(np.arange(in_chs), n)
+        out_sizes = [len(a) for a in np.array_split(np.arange(self.out_chs), n)]
+        outs = []
+        start = 0
+        for i, (ks, idx, out_c) in enumerate(zip(self.kernel_size, in_splits, out_sizes)):
+            chunk = x[..., start:start + len(idx)]
+            start += len(idx)
+            groups = out_c if self.depthwise else 1
+            outs.append(Conv2d(out_c, ks, self.stride, self.dilation,
+                               groups=groups, padding=self.padding,
+                               use_bias=self.use_bias, dtype=self.dtype,
+                               name=f"conv_{i}")(chunk))
+        return jnp.concatenate(outs, axis=-1)
+
+
+class CondConv2d(nn.Module):
+    """Conditionally-parameterized conv (cond_conv2d.py:83-121).
+
+    Holds ``num_experts`` kernels; ``__call__`` takes per-sample routing
+    weights (B, E), mixes kernels with an einsum, then applies one conv per
+    sample via vmap (XLA batches it onto the MXU).
+    """
+    out_chs: int
+    kernel_size: Union[int, Tuple[int, int]] = 3
+    stride: int = 1
+    dilation: int = 1
+    groups: int = 1
+    num_experts: int = 4
+    padding: Union[str, int, None] = ""
+    use_bias: bool = False
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, routing_weights):
+        kh, kw = _to_tuple(self.kernel_size)
+        in_chs = x.shape[-1]
+        kshape = (kh, kw, in_chs // self.groups, self.out_chs)
+
+        def expert_init(key, shape, dtype=jnp.float32):
+            # per-expert goog init on the underlying kernel shape
+            # (cond_conv2d.py:20-31 get_condconv_initializer)
+            keys = jax.random.split(key, shape[0])
+            return jnp.stack([conv_kernel_init_goog(k, shape[1:], dtype)
+                              for k in keys])
+
+        weight = self.param("weight", expert_init,
+                            (self.num_experts,) + kshape)
+        # per-sample kernel: (B, kh, kw, cin/g, cout)
+        mixed = jnp.einsum("be,ehwio->bhwio",
+                           routing_weights.astype(weight.dtype), weight)
+        pad = resolve_padding(self.padding, (kh, kw), self.dilation)
+        dn = jax.lax.conv_dimension_numbers(
+            (1,) + x.shape[1:], kshape, ("NHWC", "HWIO", "NHWC"))
+
+        def one(xi, ki):
+            return jax.lax.conv_general_dilated(
+                xi[None], ki, window_strides=_to_tuple(self.stride),
+                padding=pad, rhs_dilation=_to_tuple(self.dilation),
+                dimension_numbers=dn, feature_group_count=self.groups)[0]
+
+        y = jax.vmap(one)(x.astype(mixed.dtype), mixed)
+        if self.use_bias:
+            bias = self.param("bias", lambda k, s: jnp.zeros(s),
+                              (self.num_experts, self.out_chs))
+            y = y + jnp.einsum("be,eo->bo", routing_weights, bias)[:, None, None, :]
+        return y
+
+
+def create_conv2d(out_chs: int, kernel_size, **kwargs) -> nn.Module:
+    """Dispatch like the reference factory (create_conv2d.py:11-30):
+    list kernel → MixedConv2d, num_experts>0 → CondConv2d, else Conv2d;
+    depthwise=True maps to groups=out_chs."""
+    if isinstance(kernel_size, (list, tuple)) and len(kernel_size) > 1:
+        depthwise = kwargs.pop("depthwise", False)
+        kwargs.pop("groups", None)
+        return MixedConv2d(out_chs, kernel_size, depthwise=depthwise, **kwargs)
+    if isinstance(kernel_size, (list, tuple)):
+        kernel_size = kernel_size[0]
+    depthwise = kwargs.pop("depthwise", False)
+    if depthwise:
+        kwargs["groups"] = out_chs
+    if kwargs.pop("num_experts", 0):
+        raise ValueError("use CondConv2d directly; it needs routing weights")
+    return Conv2d(out_chs, kernel_size, **kwargs)

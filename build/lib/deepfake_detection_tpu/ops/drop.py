@@ -1,0 +1,89 @@
+"""Stochastic-depth and DropBlock regularizers.
+
+Replaces ``/root/reference/dfd/timm/models/layers/drop.py`` (drop_path :84,
+DropBlock2d :24-81).  JAX version takes explicit PRNG keys — inside flax
+modules use the 'dropout' rng collection.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def drop_path(x, rng, drop_prob: float = 0.0):
+    """Per-sample stochastic depth (drop.py:84-97): zero the whole residual
+    branch for a random subset of samples, rescale survivors by 1/keep."""
+    if drop_prob <= 0.0:
+        return x
+    keep_prob = 1.0 - drop_prob
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    mask = jax.random.bernoulli(rng, keep_prob, shape).astype(x.dtype)
+    return x / keep_prob * mask
+
+
+class DropPath(nn.Module):
+    """Module wrapper so blocks can call drop path with the flax 'dropout' rng."""
+    drop_prob: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        if not training or self.drop_prob <= 0.0:
+            return x
+        return drop_path(x, self.make_rng("dropout"), self.drop_prob)
+
+
+def drop_block_2d(x, rng, drop_prob: float = 0.1, block_size: int = 7,
+                  gamma_scale: float = 1.0, with_noise: bool = False):
+    """DropBlock (drop.py:24-81) on NHWC input: bernoulli-seed valid centers,
+    dilate to block_size squares via max-pool, zero + renormalize."""
+    if drop_prob <= 0.0:
+        return x
+    B, H, W, C = x.shape
+    total = H * W
+    clipped = min(block_size, min(H, W))
+    gamma = (gamma_scale * drop_prob * total / (clipped ** 2) /
+             ((H - clipped + 1) * (W - clipped + 1)))
+    seed_rng, noise_rng = jax.random.split(rng)
+    seeds = jax.random.bernoulli(seed_rng, gamma, (B, H, W, C)).astype(x.dtype)
+    # restrict seeds to valid centers so blocks stay inside the map
+    h = jnp.arange(H)
+    w = jnp.arange(W)
+    valid_h = ((h >= clipped // 2) & (h < H - (clipped - 1) // 2)).astype(x.dtype)
+    valid_w = ((w >= clipped // 2) & (w < W - (clipped - 1) // 2)).astype(x.dtype)
+    seeds = seeds * valid_h[None, :, None, None] * valid_w[None, None, :, None]
+    # dilate seeds into blocks
+    block_mask = nn.max_pool(seeds, (clipped, clipped), strides=(1, 1),
+                             padding="SAME")
+    keep = 1.0 - block_mask
+    if with_noise:
+        noise = jax.random.normal(noise_rng, x.shape, x.dtype)
+        return x * keep + noise * block_mask
+    normalize = (keep.size / jnp.clip(keep.sum(), 1.0)).astype(x.dtype)
+    return x * keep * normalize
+
+
+class DropBlock2d(nn.Module):
+    drop_prob: float = 0.1
+    block_size: int = 7
+    gamma_scale: float = 1.0
+    with_noise: bool = False
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        if not training or self.drop_prob <= 0.0:
+            return x
+        return drop_block_2d(x, self.make_rng("dropout"), self.drop_prob,
+                             self.block_size, self.gamma_scale, self.with_noise)
+
+
+class Dropout(nn.Module):
+    """Plain dropout with the same training-flag convention as the rest of ops."""
+    rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        return nn.Dropout(rate=self.rate, deterministic=not training)(x)

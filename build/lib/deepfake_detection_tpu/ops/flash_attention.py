@@ -1,0 +1,453 @@
+"""Fused flash attention as Pallas TPU kernels (fwd + bwd, custom VJP).
+
+The reference framework has no attention op at all (its temporal axis is a
+channel concat, SURVEY.md §2.7); attention enters this framework through the
+ViT stretch configs (BASELINE.json) and the sequence-parallel machinery in
+``parallel/ring_attention.py``.  XLA's dense softmax-attention materialises
+the (L, L) score matrix in HBM — O(L²) memory traffic, which caps sequence
+length and wastes HBM bandwidth (the usual TPU bottleneck).  This module
+implements the standard blocked online-softmax formulation (FlashAttention-2
+schedule) as Pallas kernels so scores never leave VMEM.
+
+All three kernels use the canonical TPU grid structure: the *tile* axis is
+the innermost (sequential) grid dimension, so Pallas pipelines one
+``(block, d)`` tile at a time through VMEM — O(block) on-chip residency
+regardless of sequence length — while online-softmax / gradient accumulators
+live in VMEM scratch that persists across the inner grid steps:
+
+* forward:          grid (B·H, Q blocks, K tiles) — scratch (acc, m, l);
+                    emits O and the per-row logsumexp the backward reuses.
+* backward dQ:      grid (B·H, Q blocks, K tiles) — scratch dQ.
+* backward dK/dV:   grid (B·H, K blocks, Q tiles) — scratch (dK, dV);
+                    the per-(i,j) work is the FlashAttention-2 identity
+                    ``dS = P ∘ (dP − δ)`` with δ = rowsum(dO ∘ O).
+
+All matmuls run on the MXU in float32 accumulation
+(``preferred_element_type``) regardless of the bf16 inputs; masking (padded
+keys, causal) is computed from ``broadcasted_iota`` against dynamic global
+offsets held in SMEM, so the same kernels serve the standalone op (offsets
+0) and every step of ring attention (offsets = ring position, see
+``parallel/ring_attention.py``).  Fully-masked tiles are skipped with
+``pl.when``.
+
+On non-TPU backends the same kernels run under the Pallas interpreter
+(``interpret=True``), which is how the CPU test suite checks parity against
+``parallel.ring_attention.full_attention`` for values *and* gradients.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only installs; the rest of
+    # the package (and the interpreter path) must keep importing
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover - exercised only on exotic installs
+    pltpu = None
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = float("-inf")
+_LANES = 128          # scalar-per-row scratch is lane-replicated to 128
+
+
+def _vmem_spec(block_shape, index_map):
+    if pltpu is not None:
+        return pl.BlockSpec(block_shape, index_map, memory_space=pltpu.VMEM)
+    return pl.BlockSpec(block_shape, index_map)
+
+
+def _smem_scalar_spec():
+    """(1, 1) int32 scalar operand (offsets); scalars live in SMEM on TPU."""
+    if pltpu is not None:
+        return pl.BlockSpec((1, 1), lambda *_: (0, 0),
+                            memory_space=pltpu.SMEM)
+    return pl.BlockSpec((1, 1), lambda *_: (0, 0))
+
+
+def _scratch(shape):
+    """float32 VMEM scratch buffer declaration."""
+    if pltpu is not None:
+        return pltpu.VMEM(shape, jnp.float32)
+    return pl.MemoryRef(shape, jnp.float32)  # interpreter fallback
+
+
+def _as_scalar(x) -> jnp.ndarray:
+    return jnp.asarray(x, jnp.int32).reshape(1, 1)
+
+
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct whose varying-mesh-axes set matches ``like``.
+
+    Inside ``shard_map`` (ring attention) pallas outputs must declare which
+    mesh axes they vary over; inherit that from an input operand so the same
+    kernels work standalone and under any mesh.
+    """
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, seq_len, causal):
+    """One (bh, q-block, k-tile) grid cell of the online softmax.
+
+    ``q_off``/``kv_off`` are *global* sequence offsets of this Q shard / KV
+    buffer — 0 standalone; under ring attention they locate the shard in the
+    global sequence so the causal mask is right at every ring step.
+    ``seq_len`` counts the valid (un-padded) keys in the KV buffer.
+    """
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    bk = k_ref.shape[1]
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    nk = pl.num_programs(2)
+    q_off = q_off_ref[0, 0]
+    kv_off = kv_off_ref[0, 0]
+
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    relevant = jk * bk < seq_len               # tile has ≥1 un-padded key
+    if causal:
+        last_q = q_off + (iq + 1) * bq - 1
+        relevant = jnp.logical_and(relevant, kv_off + jk * bk <= last_q)
+
+    @pl.when(relevant)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_loc = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        invalid = k_loc >= seq_len
+        if causal:
+            q_pos = q_off + iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            invalid = jnp.logical_or(invalid, kv_off + k_loc > q_pos)
+        s = jnp.where(invalid, _NEG_INF, s)
+
+        m_prev = m_ref[:, :1]                                  # (BQ, 1)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # rows that have seen no valid key yet: keep exp() argument finite
+        m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(invalid, 0.0, p)
+        corr = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_safe))
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)                   # (BQ, 1)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        m = m_ref[:, :1]
+        m_safe = jnp.where(m == _NEG_INF, 0.0, m)
+        # lse is lane-replicated to the 128-wide tile (Mosaic requires the
+        # last two block dims be (8·k, 128); same layout as the reference
+        # jax.experimental.pallas TPU flash kernel's residuals)
+        lse_ref[0] = jnp.broadcast_to(m_safe + jnp.log(l),
+                                      lse_ref.shape[1:])
+
+
+def _fwd(q, k, v, scale, block_q, block_k, causal, seq_len, interpret,
+         q_off=0, kv_off=0):
+    """Padded-layout forward: (BH, Lq, D), (BH, Lk, D)² → (out, lse)."""
+    bh, lpq, d = q.shape
+    lpk = k.shape[1]
+    grid = (bh, lpq // block_q, lpk // block_k)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, seq_len=seq_len,
+                          causal=causal),
+        grid=grid,
+        in_specs=[
+            _smem_scalar_spec(),
+            _smem_scalar_spec(),
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            _out_struct((bh, lpq, d), q.dtype, q),
+            _out_struct((bh, lpq, _LANES), jnp.float32, q),
+        ],
+        scratch_shapes=[
+            _scratch((block_q, d)),
+            _scratch((block_q, _LANES)),
+            _scratch((block_q, _LANES)),
+        ],
+        interpret=interpret,
+    )(_as_scalar(q_off), _as_scalar(kv_off), q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, seq_len, causal):
+    """One (bh, k-block, q-tile) grid cell accumulating dK, dV."""
+    bk, d = k_ref.shape[1], k_ref.shape[2]
+    bq = q_ref.shape[1]
+    jk = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+    q_off = q_off_ref[0, 0]
+    kv_off = kv_off_ref[0, 0]
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    relevant = jk * bk < seq_len
+    if causal:
+        # this q tile's last global row must reach the k block's first row
+        last_q = q_off + (iq + 1) * bq - 1
+        relevant = jnp.logical_and(relevant, kv_off + jk * bk <= last_q)
+
+    @pl.when(relevant)
+    def _accumulate():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, :1]                                 # (BQ, 1)
+        delta = delta_ref[0, :, :1]
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_loc = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        invalid = k_loc >= seq_len
+        if causal:
+            q_pos = q_off + iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            invalid = jnp.logical_or(invalid, kv_off + k_loc > q_pos)
+        p = jnp.where(invalid, 0.0, jnp.exp(s - lse))           # (BQ, BK)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, dq_acc, *, scale, seq_len,
+                   causal):
+    """One (bh, q-block, k-tile) grid cell accumulating dQ."""
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    bk = k_ref.shape[1]
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    nk = pl.num_programs(2)
+    q_off = q_off_ref[0, 0]
+    kv_off = kv_off_ref[0, 0]
+
+    @pl.when(jk == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    relevant = jk * bk < seq_len
+    if causal:
+        last_q = q_off + (iq + 1) * bq - 1
+        relevant = jnp.logical_and(relevant, kv_off + jk * bk <= last_q)
+
+    @pl.when(relevant)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, :1]                                 # (BQ, 1)
+        delta = delta_ref[0, :, :1]
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_loc = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        invalid = k_loc >= seq_len
+        if causal:
+            q_pos = q_off + iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            invalid = jnp.logical_or(invalid, kv_off + k_loc > q_pos)
+        p = jnp.where(invalid, 0.0, jnp.exp(s - lse))
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv(q, k, v, do, lse, delta, scale, block_q, block_k, causal,
+             seq_len, interpret, q_off=0, kv_off=0):
+    """dK, dV for one KV buffer, streaming Q tiles.  Padded layout."""
+    bh, lpq, d = q.shape
+    lpk = k.shape[1]
+    kern = functools.partial(_bwd_dkv_kernel, scale=scale, seq_len=seq_len,
+                             causal=causal)
+    return pl.pallas_call(
+        kern,
+        grid=(bh, lpk // block_k, lpq // block_q),
+        in_specs=[
+            _smem_scalar_spec(),
+            _smem_scalar_spec(),
+            _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
+            _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # do
+            _vmem_spec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+            _vmem_spec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            _out_struct((bh, lpk, d), jnp.float32, k),
+            _out_struct((bh, lpk, d), jnp.float32, k),
+        ],
+        scratch_shapes=[
+            _scratch((block_k, d)),
+            _scratch((block_k, d)),
+        ],
+        interpret=interpret,
+    )(_as_scalar(q_off), _as_scalar(kv_off), q, k, v, do, lse, delta)
+
+
+def _bwd_dq(q, k, v, do, lse, delta, scale, block_q, block_k, causal,
+            seq_len, interpret, q_off=0, kv_off=0):
+    """dQ for this Q shard against one KV buffer, streaming K tiles."""
+    bh, lpq, d = q.shape
+    lpk = k.shape[1]
+    kern = functools.partial(_bwd_dq_kernel, scale=scale, seq_len=seq_len,
+                             causal=causal)
+    return pl.pallas_call(
+        kern,
+        grid=(bh, lpq // block_q, lpk // block_k),
+        in_specs=[
+            _smem_scalar_spec(),
+            _smem_scalar_spec(),
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # do
+            _vmem_spec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=_vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=_out_struct((bh, lpq, d), jnp.float32, q),
+        scratch_shapes=[_scratch((block_q, d))],
+        interpret=interpret,
+    )(_as_scalar(q_off), _as_scalar(kv_off), q, k, v, do, lse, delta)
+
+
+def _delta(do, out):
+    """δ = rowsum(dO ⊙ O), lane-replicated to match the lse layout."""
+    d = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    return jnp.broadcast_to(d[..., None], (*d.shape, _LANES))
+
+
+def _bwd(scale, block_q, block_k, causal, interpret, seq_len, res, g):
+    q, k, v, out, lse = res
+    do = g[0] if isinstance(g, (tuple, list)) else g
+    delta = _delta(do, out)
+    dk, dv = _bwd_dkv(q, k, v, do, lse, delta, scale, block_q, block_k,
+                      causal, seq_len, interpret)
+    dq = _bwd_dq(q, k, v, do, lse, delta, scale, block_q, block_k,
+                 causal, seq_len, interpret)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = False, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Fused O(L) -memory attention.  Shapes ``(B, L, H, D) → (B, L, H, D)``
+    (same convention as :func:`parallel.ring_attention.full_attention`).
+
+    The Q buffer pads to a ``block_q`` multiple and the KV buffer to a
+    ``block_k`` multiple (head dim to the 128-lane width); pad keys are
+    masked inside the kernel, so any static shape works.  Gradients flow
+    through a custom VJP whose backward is also Pallas.  ``interpret``
+    defaults to True off-TPU so tests run on the CPU interpreter.
+    """
+    assert q.ndim == 4, f"expected (B, L, H, D), got {q.shape}"
+    b, l, h, d = q.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = scale if scale is not None else d ** -0.5
+    block_q = min(block_q, _round_up(l, 128))
+    block_k = min(block_k, _round_up(l, 128))
+    lpq = _round_up(l, block_q)
+    lpk = _round_up(l, block_k)
+    dp = _round_up(d, 128)
+
+    def prep(x, lp):  # (B, L, H, D) -> (B*H, lp, Dp)
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
+        return jnp.pad(x, ((0, 0), (0, lp - l), (0, dp - d)))
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=())
+    def _op(qp, kp, vp):
+        out, _ = _fwd_call(qp, kp, vp)
+        return out
+
+    def _op_fwd(qp, kp, vp):
+        out, lse = _fwd_call(qp, kp, vp)
+        return out, (qp, kp, vp, out, lse)
+
+    def _fwd_call(qp, kp, vp):
+        return _fwd(qp, kp, vp, scale, block_q, block_k, causal, l,
+                    interpret)
+
+    def _op_bwd(res, g):
+        return _bwd(scale, block_q, block_k, causal, interpret, l, res, g)
+
+    _op.defvjp(_op_fwd, _op_bwd)
+
+    out = _op(prep(q, lpq), prep(k, lpk), prep(v, lpk))
+    out = out[:, :l, :d].reshape(b, h, l, d)
+    return jnp.transpose(out, (0, 2, 1, 3))

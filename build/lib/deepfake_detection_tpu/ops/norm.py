@@ -1,0 +1,133 @@
+"""Normalization layers.
+
+TPU-native replacements for the reference's BN stack:
+
+* ``BatchNorm2d`` — wraps ``flax.linen.BatchNorm``; accepts **torch-convention
+  momentum** (running = (1-m)*running + m*batch, default 0.1; the canonical
+  deepfake run uses ``--bn-momentum 0.001``) and converts to flax convention.
+  Passing ``axis_name`` turns it into cross-replica (sync) BN — the one-liner
+  that replaces both apex ``convert_syncbn_model`` (train.py:388-400) *and* the
+  epoch-boundary ``distribute_bn`` broadcast/reduce (utils.py:263-274), because
+  batch stats are then always computed over the global batch.
+* ``SplitBatchNorm2d`` — AdvProp auxiliary BN (layers/split_batchnorm.py:18-38):
+  first 1/N of the batch through the main BN, remaining chunks through aux BNs.
+* ``GroupNorm`` re-export for norm-free/group-norm model variants.
+
+Reference BN defaults: torch (momentum .1, eps 1e-5); TF-ported weights need
+``BN_MOMENTUM_TF_DEFAULT=0.01`` / ``BN_EPS_TF_DEFAULT=1e-3``
+(efficientnet_blocks.py:13-15).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+BN_MOMENTUM_TF_DEFAULT = 0.01
+BN_EPS_TF_DEFAULT = 1e-3
+BN_MOMENTUM_PT_DEFAULT = 0.1
+BN_EPS_PT_DEFAULT = 1e-5
+
+
+def resolve_bn_args(kwargs: dict) -> dict:
+    """Fold bn_tf/bn_momentum/bn_eps kwargs into explicit momentum/eps
+    (efficientnet_blocks.py:22-30); momentum stays torch-convention here."""
+    bn_args = {}
+    if kwargs.pop("bn_tf", False):
+        bn_args = dict(momentum=BN_MOMENTUM_TF_DEFAULT, eps=BN_EPS_TF_DEFAULT)
+    bn_momentum = kwargs.pop("bn_momentum", None)
+    if bn_momentum is not None:
+        bn_args["momentum"] = bn_momentum
+    bn_eps = kwargs.pop("bn_eps", None)
+    if bn_eps is not None:
+        bn_args["eps"] = bn_eps
+    return bn_args
+
+
+class BatchNorm2d(nn.Module):
+    """NHWC batch norm with torch-style momentum and optional cross-replica sync.
+
+    When ``axis_name`` is set (e.g. 'data' under shard_map/pjit with a named
+    mesh axis), batch statistics are pmean-reduced across that axis — global-
+    batch statistics, i.e. SyncBN.
+    """
+    momentum: float = BN_MOMENTUM_PT_DEFAULT   # torch convention
+    eps: float = BN_EPS_PT_DEFAULT
+    use_scale: bool = True
+    use_bias: bool = True
+    axis_name: Optional[str] = None
+    dtype: Any = None
+    scale_init: Any = None          # e.g. zeros for zero-init-last-BN blocks
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        kwargs = {}
+        if self.scale_init is not None:
+            kwargs["scale_init"] = self.scale_init
+        return nn.BatchNorm(
+            use_running_average=not training,
+            momentum=1.0 - self.momentum,
+            epsilon=self.eps,
+            use_scale=self.use_scale,
+            use_bias=self.use_bias,
+            axis_name=self.axis_name,
+            dtype=self.dtype,
+            name="bn",
+            **kwargs,
+        )(x)
+
+
+class SplitBatchNorm2d(nn.Module):
+    """AdvProp split BN (layers/split_batchnorm.py:18-38).
+
+    Training: batch is chunked into ``num_splits`` equal parts; chunk 0 uses
+    the primary BN, chunk i uses aux BN i.  Eval: everything through primary.
+    """
+    num_splits: int = 2
+    momentum: float = BN_MOMENTUM_PT_DEFAULT
+    eps: float = BN_EPS_PT_DEFAULT
+    axis_name: Optional[str] = None
+    dtype: Any = None
+
+    def setup(self):
+        assert self.num_splits >= 2
+        mk = lambda name: BatchNorm2d(momentum=self.momentum, eps=self.eps,
+                                      axis_name=self.axis_name, dtype=self.dtype,
+                                      name=name)
+        self.main_bn = mk("main")
+        self.aux_bns = [mk(f"aux{i}") for i in range(self.num_splits - 1)]
+
+    def __call__(self, x, training: bool = False):
+        if not training:
+            return self.main_bn(x, training=False)
+        split = x.shape[0] // self.num_splits
+        assert split * self.num_splits == x.shape[0], \
+            "batch size must be divisible by num_splits"
+        parts = [self.main_bn(x[:split], training=True)]
+        for i, bn in enumerate(self.aux_bns):
+            parts.append(bn(x[(i + 1) * split:(i + 2) * split], training=True))
+        return jnp.concatenate(parts, axis=0)
+
+
+class GroupNorm(nn.Module):
+    """GroupNorm for the norm-free deepfake variants (efficientnet.py:354-430)."""
+    num_groups: int = 32
+    eps: float = 1e-5
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        del training
+        return nn.GroupNorm(num_groups=self.num_groups, epsilon=self.eps,
+                            dtype=self.dtype, name="gn")(x)
+
+
+class Identity(nn.Module):
+    """No-op norm for use_norm=False paths (efficientnet.py:385)."""
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        del training
+        return x

@@ -1,0 +1,5 @@
+from .factory import create_optimizer, weight_decay_mask
+from .lookahead import lookahead
+from .rmsprop_tf import rmsprop_tf
+
+__all__ = ["create_optimizer", "weight_decay_mask", "lookahead", "rmsprop_tf"]

@@ -1,0 +1,60 @@
+"""Lookahead optimizer wrapper (k steps forward, 1 step back).
+
+Reference: ``/root/reference/dfd/timm/optim/lookahead.py:10`` — selected by the
+``lookahead_`` optimizer-name prefix (``optim_factory.py:96-98``).
+
+Unlike ``optax.lookahead`` (which requires a special two-copy parameter
+pytree), this wrapper keeps the slow weights in optimizer *state*, so it
+composes with a plain Flax ``TrainState``: every ``sync_period`` steps the
+emitted update is rewritten so the applied parameters land on
+``slow + alpha * (fast - slow)``, and the slow copy is refreshed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class LookaheadState(NamedTuple):
+    inner: Any
+    slow_params: Any
+    step: jax.Array
+
+
+def lookahead(inner: optax.GradientTransformation,
+              sync_period: int = 6,
+              alpha: float = 0.5) -> optax.GradientTransformation:
+    """Wrap ``inner`` with Lookahead slow/fast weight averaging."""
+
+    def init_fn(params):
+        return LookaheadState(
+            inner=inner.init(params),
+            slow_params=jax.tree.map(jnp.asarray, params),
+            step=jnp.zeros([], jnp.int32),
+        )
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("lookahead requires params")
+        fast_updates, inner_state = inner.update(updates, state.inner, params)
+        step = state.step + 1
+        sync = (step % sync_period) == 0
+        # On sync steps the applied params land on slow + alpha*(fast_new-slow)
+        # and the slow copy moves there too; otherwise plain inner update.
+        target = jax.tree.map(
+            lambda fu, p, slow: slow + alpha * (p + fu - slow),
+            fast_updates, params, state.slow_params)
+        new_updates = jax.tree.map(
+            lambda t, p, fu: jnp.where(sync, t - p, fu),
+            target, params, fast_updates)
+        new_slow = jax.tree.map(
+            lambda t, slow: jnp.where(sync, t, slow),
+            target, state.slow_params)
+        return new_updates, LookaheadState(inner=inner_state,
+                                           slow_params=new_slow, step=step)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,85 @@
+"""NVIDIA NovoGrad as an optax gradient transformation.
+
+The reference ships TWO distinct NovoGrads: ``optim/novograd.py:12`` (norm
+state pre-initialized from the first gradient outside the step loop) and
+NVIDIA's ``optim/nvnovograd.py:13`` — this file implements the latter
+exactly:
+
+* per-tensor scalar second moment ``exp_avg_sq`` = EMA of ‖g‖², initialized
+  to the FIRST step's ‖g‖² (reference :96-99);
+* ``g ← g / (sqrt(exp_avg_sq) + eps) + wd·p`` (coupled decay on the
+  normalized gradient, :105-111);
+* first moment ``exp_avg ← β₁·exp_avg + g`` with NO (1-β₁) factor unless
+  ``grad_averaging`` (:112-114);
+* no bias correction; ``p ← p − lr·exp_avg`` (:116).
+
+Returns final deltas (already scaled by −lr) like
+:func:`~.rmsprop_tf.rmsprop_tf`; weight decay is built in (it must apply to
+the *normalized* gradient, so it cannot be chained externally).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class NvNovoGradState(NamedTuple):
+    exp_avg: Any       # first moment, per-leaf pytree
+    exp_avg_sq: Any    # per-leaf SCALAR ‖g‖² EMA
+    step: jnp.ndarray
+
+
+def nvnovograd(
+    learning_rate: Union[float, jax.Array],
+    b1: float = 0.95,
+    b2: float = 0.98,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    grad_averaging: bool = False,
+) -> optax.GradientTransformation:
+    """NVIDIA NovoGrad (reference nvnovograd.py:13-118, sans amsgrad)."""
+
+    def init_fn(params):
+        return NvNovoGradState(
+            exp_avg=jax.tree.map(jnp.zeros_like, params),
+            exp_avg_sq=jax.tree.map(
+                lambda p: jnp.zeros((), jnp.float32), params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    def update_fn(updates, state, params=None):
+        assert params is not None or weight_decay == 0.0, \
+            "nvnovograd with weight_decay needs params"
+        lr = learning_rate
+
+        norms = jax.tree.map(
+            lambda g: jnp.sum(jnp.square(g).astype(jnp.float32)), updates)
+        # a still-zero accumulator copies ‖g‖² instead of blending — the
+        # reference checks the per-tensor value, not the step counter
+        # (:96-99), so an all-zero first gradient stays unseeded
+        exp_avg_sq = jax.tree.map(
+            lambda v, n: jnp.where(v == 0.0, n, b2 * v + (1.0 - b2) * n),
+            state.exp_avg_sq, norms)
+
+        def _normalized(g, v, p):
+            g = g / (jnp.sqrt(v) + eps).astype(g.dtype)
+            if weight_decay:
+                g = g + weight_decay * p
+            if grad_averaging:
+                g = g * (1.0 - b1)
+            return g
+
+        p_tree = params if params is not None else updates
+        normed = jax.tree.map(_normalized, updates, exp_avg_sq, p_tree)
+        exp_avg = jax.tree.map(lambda m, g: b1 * m + g,
+                               state.exp_avg, normed)
+        deltas = jax.tree.map(lambda m: -lr * m, exp_avg)
+        return deltas, NvNovoGradState(exp_avg=exp_avg,
+                                       exp_avg_sq=exp_avg_sq,
+                                       step=state.step + 1)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,15 @@
+"""Distributed runtime: mesh, sharding, collectives, sequence parallelism.
+
+TPU-native replacement for the reference's NCCL/apex-DDP layer (SURVEY.md
+§2.7) plus first-class long-context support (ring / Ulysses attention).
+"""
+
+from .collectives import distribute_bn, pmean, psum, tree_pmean
+from .mesh import (initialize_distributed, local_batch_size, make_mesh,
+                   process_count, process_index)
+from .ring_attention import (full_attention, ring_attention,
+                             ring_flash_attention, ring_self_attention,
+                             ulysses_attention)
+from .tp import transformer_tp_sharding, transformer_tp_specs
+from .sharding import (batch_sharding, fsdp_param_specs, param_sharding,
+                       put_process_local, replicated_sharding, shard_batch)

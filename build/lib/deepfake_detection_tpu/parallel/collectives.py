@@ -1,0 +1,64 @@
+"""Cross-replica collective helpers.
+
+TPU-native equivalents of the reference's explicit NCCL calls (SURVEY.md
+§2.7):
+
+* ``reduce_tensor`` (utils.py:256-260 — allreduce SUM / world) →
+  :func:`pmean` inside the jitted step; XLA emits one fused all-reduce over
+  ICI instead of a per-metric NCCL call per step.
+* ``distribute_bn`` (utils.py:263-274 — epoch-boundary broadcast/reduce of
+  BN running stats) → :func:`distribute_bn` over the batch-stats pytree.
+  Under pjit with replicated state the 'broadcast' mode is an identity (all
+  replicas already agree); 'reduce' averages, which is only meaningful when
+  per-replica stats were tracked outside pjit (kept for API parity and for
+  pmap-style runners).
+* apex SyncBN (train.py:388-400) → ``bn_axis_name='data'`` on the model's
+  BatchNorm (ops/norm.py) — a pmean inside the layer; nothing needed here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["pmean", "psum", "distribute_bn", "tree_pmean"]
+
+
+def pmean(x: Any, axis_name: str = "data") -> Any:
+    """Cross-replica mean (replaces reduce_tensor)."""
+    return lax.pmean(x, axis_name)
+
+
+def psum(x: Any, axis_name: str = "data") -> Any:
+    return lax.psum(x, axis_name)
+
+
+def tree_pmean(tree: Any, axis_name: str = "data") -> Any:
+    return jax.tree.map(lambda t: lax.pmean(t, axis_name), tree)
+
+
+def distribute_bn(batch_stats: Any, mode: str = "",
+                  axis_name: str = "data", inside_pjit: bool = False) -> Any:
+    """Synchronise BN running stats across replicas (utils.py:263-274).
+
+    ``mode``: '' (off) | 'broadcast' (rank-0 wins) | 'reduce' (average).
+    Outside a collective context with replicated pjit state both modes are
+    identities; inside pmap/shard_map pass ``inside_pjit=True`` to emit the
+    collective.
+    """
+    if not mode:
+        return batch_stats
+    assert mode in ("broadcast", "reduce"), mode
+    if not inside_pjit:
+        # replicated pjit state: every replica already holds identical stats
+        return batch_stats
+    if mode == "reduce":
+        return jax.tree.map(lambda t: lax.pmean(t, axis_name), batch_stats)
+    # broadcast: select rank 0's value on every member
+    def bcast(t):
+        full = lax.all_gather(t, axis_name)
+        return full[0]
+    return jax.tree.map(bcast, batch_stats)

@@ -1,0 +1,96 @@
+"""Device mesh construction + multi-host initialization.
+
+TPU-native replacement for the reference's NCCL process-group setup
+(``/root/reference/dfd/runners/train.py:279-282``: ``init_process_group('nccl',
+file://<shared_nfs_file>)`` with rank arithmetic from a JSON server map,
+``server_json.py:25-45``).  Here:
+
+* :func:`initialize_distributed` wraps ``jax.distributed.initialize`` — the
+  coordinator address replaces the shared-file rendezvous; on TPU pods the
+  runtime discovers topology natively and the call is a no-op-safe default.
+  The legacy server-JSON still works: hostname → process_id mapping comes
+  from :class:`~deepfake_detection_tpu.config.ClusterConfig`.
+* :func:`make_mesh` builds the ``jax.sharding.Mesh`` every sharded
+  computation runs over.  Default is a 1-D ``('data',)`` mesh (pure DP — the
+  only strategy the reference has, SURVEY.md §2.7); any shape/axis tuple
+  works for dp×fsdp×tp×sp meshes.  Axis order maps the *innermost* axis to
+  the fastest ICI links, so put model/tensor axes last.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["initialize_distributed", "make_mesh", "local_batch_size",
+           "process_count", "process_index"]
+
+
+def initialize_distributed(cluster=None, hostname: Optional[str] = None,
+                           local_rank: int = 0) -> None:
+    """Multi-host JAX runtime init (replaces NCCL file rendezvous).
+
+    ``cluster`` is a :class:`ClusterConfig` (or None).  Single-process setups
+    return immediately.  Safe to call multiple times (subsequent calls
+    no-op).
+    """
+    if cluster is None or cluster.world_size <= 1:
+        return
+    # NOTE: must run before anything touches the XLA backend (so no
+    # jax.process_count()/jax.devices() here — they'd initialize it and make
+    # the distributed init fail).
+    if jax.distributed.is_initialized():
+        return  # already initialized (e.g. by the TPU pod runtime)
+    kwargs = {}
+    if cluster.coordinator_address:
+        kwargs["coordinator_address"] = cluster.coordinator_address
+        kwargs["num_processes"] = cluster.world_size
+        kwargs["process_id"] = cluster.process_id(hostname, local_rank)
+    # no try/except: a failed init on a required multi-host setup must abort
+    # the job — swallowing it would silently train N isolated copies
+    jax.distributed.initialize(**kwargs)
+    _logger.info("jax.distributed initialized: process %d/%d",
+                 jax.process_index(), jax.process_count())
+
+
+def make_mesh(mesh_shape: Optional[Sequence[int]] = None,
+              axis_names: Sequence[str] = ("data",),
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a device mesh.
+
+    Defaults to all devices on one ``'data'`` axis.  ``mesh_shape`` must
+    multiply out to the device count; ``-1`` in one position infers it.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if mesh_shape is None:
+        mesh_shape = (n,) + (1,) * (len(axis_names) - 1)
+    shape = list(mesh_shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = n // known
+    assert int(np.prod(shape)) == n, \
+        f"mesh shape {shape} != device count {n}"
+    assert len(shape) == len(axis_names), (shape, axis_names)
+    return Mesh(np.asarray(devices).reshape(shape), tuple(axis_names))
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def local_batch_size(global_batch_size: int) -> int:
+    """Per-host batch for a data-sharded global batch."""
+    assert global_batch_size % jax.process_count() == 0, \
+        (global_batch_size, jax.process_count())
+    return global_batch_size // jax.process_count()

@@ -1,0 +1,73 @@
+"""Tensor parallelism for the transformer families (GSPMD-style).
+
+The reference has no TP (SURVEY.md §2.7: DP is its only strategy); this is
+the TPU-native scale-out extension for the ViT/TimeSformer families.  It is
+deliberately *not* a Megatron-style rewrite of the layers: on TPU the
+idiomatic mechanism is to annotate parameter shardings over a ``model`` mesh
+axis and let GSPMD partition the einsums and insert the all-reduces over ICI
+("How to Scale Your Model" recipe: pick a mesh, annotate, let XLA insert
+collectives).
+
+Sharding rules follow the Megatron pairing so each block needs exactly one
+all-reduce per attention and one per MLP:
+
+* column-parallel (output feature dim sharded): ``qkv`` and ``mlp_fc1``
+  kernels/biases — each device computes its own head/hidden shard;
+* row-parallel (input feature dim sharded): ``proj`` and ``mlp_fc2``
+  kernels — partial sums that GSPMD all-reduces; their biases replicate;
+* everything else (embeddings, norms, head) replicates.
+
+Works for any param tree whose Dense layers use the vit.py naming
+(``qkv``/``proj``/``mlp_fc1``/``mlp_fc2``) — ViT and TimeSformer both do.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["transformer_tp_specs", "transformer_tp_sharding"]
+
+# Dense-layer name → (kernel spec builder) role
+_COLUMN = ("qkv", "mlp_fc1")      # shard output features
+_ROW = ("proj", "mlp_fc2")        # shard input features
+
+
+def _leaf_spec(path, leaf, axis: str, n: int) -> P:
+    names = [getattr(k, "key", getattr(k, "name", "")) for k in path]
+    layer = names[-2] if len(names) >= 2 else ""
+    kind = names[-1]
+    if layer in _COLUMN:
+        if kind == "kernel" and leaf.shape[-1] % n == 0:
+            return P(None, axis)           # (in, out·/n)
+        if kind == "bias" and leaf.shape[-1] % n == 0:
+            return P(axis)
+    if layer in _ROW:
+        if kind == "kernel" and leaf.shape[0] % n == 0:
+            return P(axis, None)           # (in·/n, out) — partial sums
+        # row-parallel bias replicates (added once after the all-reduce)
+    return P()
+
+
+def transformer_tp_specs(params: Any, axis: str, axis_size: int) -> Any:
+    """PartitionSpec tree implementing the rules above.
+
+    ``axis_size`` (the mesh extent of ``axis``) is required: the rules only
+    shard dims divisible by it, so a wrong size silently changes layouts.
+    """
+    return jax.tree_util.tree_map_with_path(
+        lambda p, l: _leaf_spec(p, l, axis, axis_size), params)
+
+
+def transformer_tp_sharding(params: Any, mesh: Mesh,
+                            axis: str = "model") -> Any:
+    """NamedSharding tree for a ViT/TimeSformer param tree over ``mesh``.
+
+    Combine with ``batch_sharding(mesh, 'data')`` for 2-D (dp × tp) meshes:
+    batch rides the ``data`` axis, heads/hidden ride ``axis``.
+    """
+    specs = transformer_tp_specs(params, axis, mesh.shape[axis])
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))

@@ -1,0 +1,67 @@
+"""Inference constants + preprocessing helpers.
+
+Parity with ``/root/reference/dfd/params.py``: ImageNet mean/std ×255
+(:24-27), 600×600 canvas + ``img_num=4`` (:28-31), the softmax score wrapper
+``DeepFakeModel`` (:34-42), aspect-preserving :func:`resize` (:45) and center
+:func:`padding_image` (:58).  All NHWC numpy/PIL — no cv2/torch dependency.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from PIL import Image
+
+__all__ = ["img_mean", "img_std", "image_max_height", "image_max_width",
+           "img_num", "resize", "padding_image", "make_score_fn"]
+
+img_mean = np.asarray([0.485, 0.456, 0.406], np.float32) * 255.0
+img_std = np.asarray([0.229, 0.224, 0.225], np.float32) * 255.0
+image_max_height = 600
+image_max_width = 600
+image_max_w_h = (image_max_width, image_max_height)
+img_num = 4
+
+
+def resize(image: np.ndarray,
+           max_w_h: Tuple[int, int] = image_max_w_h) -> np.ndarray:
+    """Aspect-preserving downfit to ≤600×600 (reference :45-55)."""
+    height_o, width_o = image.shape[:2]
+    if float(height_o) / width_o > float(max_w_h[1]) / max_w_h[0]:
+        height_target = max_w_h[1]
+        width_target = int(width_o * float(height_target) / height_o)
+    else:
+        width_target = max_w_h[0]
+        height_target = int(height_o * float(width_target) / width_o)
+    pil = Image.fromarray(image)
+    return np.asarray(pil.resize((width_target, height_target),
+                                 Image.BILINEAR))
+
+
+def padding_image(image: np.ndarray, target_h: int = image_max_height,
+                  target_w: int = image_max_width) -> np.ndarray:
+    """Center zero-pad to the fixed canvas (reference :58-67)."""
+    height_o, width_o = image.shape[:2]
+    if height_o == target_h and width_o == target_w:
+        return image
+    top = (target_h - height_o) // 2
+    bottom = target_h - height_o - top
+    left = (target_w - width_o) // 2
+    right = target_w - width_o - left
+    return np.pad(image, ((top, bottom), (left, right), (0, 0)),
+                  "constant", constant_values=0)
+
+
+def make_score_fn(model, variables):
+    """Jitted ``image → softmax scores`` (the reference's ``DeepFakeModel``
+    nn wrapper, params.py:34-42); ``scores[:, 0]`` = P(fake)."""
+
+    @jax.jit
+    def score(x: jnp.ndarray) -> jnp.ndarray:
+        logits = model.apply(variables, x, training=False)
+        return jax.nn.softmax(logits, axis=-1)
+
+    return score

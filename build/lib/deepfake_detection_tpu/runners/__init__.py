@@ -1,0 +1,1 @@
+"""Entry-point runners (reference ``dfd/runners/``): train and test CLIs."""

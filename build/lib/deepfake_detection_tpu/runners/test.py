@@ -1,0 +1,78 @@
+"""Single-image inference runner.
+
+Parity with ``/root/reference/dfd/runners/test.py``: load the flagship
+checkpoint, preprocess each image (aspect-preserving resize + center pad to
+600×600, normalize, replicate ×4 → 12 channels, :49-58), print the softmax
+fake score (``scores[:, 0]``, :58-60).
+
+Usage::
+
+    python -m deepfake_detection_tpu.runners.test img1.png img2.jpg \
+        [--model-path PATH] [--image-size 600]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from PIL import Image
+
+from ..models import create_deepfake_model_v4, init_model
+from ..models.helpers import load_checkpoint
+from ..params import (image_max_height, image_max_width, img_mean, img_num,
+                      img_std, make_score_fn, padding_image, resize)
+
+__all__ = ["test_img", "preprocess"]
+
+
+def preprocess(img_file: str, size: int = image_max_height) -> np.ndarray:
+    """file → (1, H, W, 12) normalized float32 (reference test.py:49-56)."""
+    img = np.asarray(Image.open(img_file).convert("RGB"), np.uint8)
+    img = padding_image(resize(img, (size, size)), size, size)
+    img = (img.astype(np.float32) - img_mean) / img_std     # HWC, NHWC layout
+    img = np.concatenate([img] * img_num, axis=-1)          # replicate ×4
+    return img[None]
+
+
+def test_img(model_path: Optional[str], img_files: Sequence[str],
+             size: int = image_max_height) -> List[float]:
+    assert all(os.path.isfile(f) for f in img_files), "file not exist!"
+    print(f"To load model from {model_path}")
+    model = create_deepfake_model_v4("efficientnet_deepfake_v4",
+                                     num_classes=2, in_chans=12)
+    variables = init_model(model, jax.random.PRNGKey(0),
+                           (1, size, size, 12))
+    if model_path:
+        variables = load_checkpoint(variables, model_path, strict=False)
+    print("Model loaded!")
+    score_fn = make_score_fn(model, variables)
+    scores_out: List[float] = []
+    for img_file in img_files:
+        scores = np.asarray(score_fn(jnp.asarray(preprocess(img_file, size))))
+        fake_score = float(scores[0, 0])                    # P(fake)
+        scores_out.append(fake_score)
+        print(f"{img_file}'s fake score:{fake_score}")
+    return scores_out
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="deepfake single-image inference")
+    p.add_argument("images", nargs="*")
+    p.add_argument("--model-path", default="")
+    p.add_argument("--image-size", type=int, default=image_max_height)
+    args = p.parse_args(argv)
+    if not args.images:
+        print("Please input your images. e.g. python -m "
+              "deepfake_detection_tpu.runners.test image1 image2")
+        return
+    test_img(args.model_path or None, args.images, size=args.image_size)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

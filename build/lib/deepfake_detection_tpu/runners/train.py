@@ -46,8 +46,7 @@ from ..losses import create_loss_fn, cross_entropy
 from ..models import (create_deepfake_model, create_deepfake_model_v3,
                       create_deepfake_model_v4, create_model, init_model)
 from ..optim import create_optimizer
-from ..parallel import (batch_sharding, initialize_distributed, make_mesh,
-                        transformer_tp_sharding)
+from ..parallel import batch_sharding, initialize_distributed, make_mesh
 from ..scheduler import create_scheduler
 from ..train import (CheckpointSaver, create_train_state, make_eval_step,
                      make_train_step, restore_train_state, set_learning_rate,
@@ -121,19 +120,8 @@ def build_datasets(cfg: TrainConfig, input_size) -> Tuple[Any, Any]:
 def main(cfg: TrainConfig) -> Dict[str, float]:
     """Train to completion; returns the best eval metrics."""
     rank = jax.process_index()
-    if cfg.tp_size > 1:
-        if cfg.mesh_shape is not None or cfg.fsdp:
-            raise ValueError(
-                "--tp-size conflicts with an explicit --mesh-shape/--fsdp; "
-                "configure one parallelism layout at a time")
-        # dp×tp 2-D mesh; parameter shardings applied after init below
-        mesh = make_mesh((-1, cfg.tp_size), ("data", "model"))
-    else:
-        mesh = make_mesh(cfg.mesh_shape, cfg.mesh_axes)
+    mesh = make_mesh(cfg.mesh_shape, cfg.mesh_axes)
     n_dev = int(np.prod(list(mesh.shape.values())))
-    # the data-parallel degree: batch and linear-LR scaling follow it, not
-    # the raw device count (a tp group is ONE model replica)
-    dp_size = int(mesh.shape.get("data", n_dev))
     _logger.info("Training with %d devices, mesh %s, process %d/%d",
                  n_dev, dict(mesh.shape), rank, jax.process_count())
 
@@ -156,20 +144,8 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     n_params = sum(x.size for x in jax.tree.leaves(variables["params"]))
     _logger.info("Model %s created, param count: %d", cfg.model, n_params)
 
-    def apply_tp(params):
-        # place params under the Megatron-paired TP shardings; non-matching
-        # leaves (and non-transformer models) stay replicated
-        shardings = transformer_tp_sharding(params, mesh, axis="model")
-        return jax.device_put(params, shardings)
-
-    if cfg.tp_size > 1:
-        variables = dict(variables)
-        variables["params"] = apply_tp(variables["params"])
-        _logger.info("Tensor parallelism: params sharded over 'model' "
-                     "axis (tp_size=%d)", cfg.tp_size)
-
     # linear LR scaling: per-device batch × total devices (train.py:814)
-    lr = cfg.resolved_lr(world_size=dp_size)
+    lr = cfg.resolved_lr(world_size=n_dev)
     tx = create_optimizer(cfg, learning_rate=lr)
     state = create_train_state(variables, tx, with_ema=cfg.model_ema)
 
@@ -179,9 +155,6 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     if cfg.resume:
         state, meta = restore_train_state(cfg.resume, state,
                                           load_opt=not cfg.no_resume_opt)
-        if cfg.tp_size > 1:
-            # restore rebuilds leaves as host arrays — re-apply TP layout
-            state = state.replace(params=apply_tp(state.params))
         start_epoch = cfg.start_epoch if cfg.start_epoch is not None \
             else int(meta.get("epoch", -1)) + 1   # helpers.py:47-73
         _logger.info("Resumed from %s (epoch %d)", cfg.resume, start_epoch)
@@ -193,7 +166,7 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     sharding = batch_sharding(mesh)
     # loaders produce the *per-process* slice of the global batch; the device
     # prologue assembles the global sharded array
-    global_batch = cfg.batch_size * dp_size
+    global_batch = cfg.batch_size * n_dev
     local_batch = global_batch // jax.process_count()
     loader_kwargs = dict(
         mean=data_config["mean"], std=data_config["std"],
@@ -217,13 +190,9 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         **loader_kwargs)                          # eval bs ×2 (train.py:492)
 
     train_loss_fn = create_loss_fn(cfg)
-    # TP'd params can't ride the shard_map local-BN path (its in_specs
-    # declare params replicated); the jit path lets GSPMD honor the
-    # per-leaf shardings.  Transformers have no BN, so semantics are
-    # unchanged.
-    bn_mode = "global" if (cfg.sync_bn or cfg.tp_size > 1) else "local"
     train_step = make_train_step(
-        model, tx, train_loss_fn, mesh=mesh, bn_mode=bn_mode,
+        model, tx, train_loss_fn, mesh=mesh,
+        bn_mode="global" if cfg.sync_bn else "local",
         ema_decay=cfg.model_ema_decay if cfg.model_ema else 0.0,
         clip_grad=cfg.clip_grad)
     eval_step = make_eval_step(model, cross_entropy)

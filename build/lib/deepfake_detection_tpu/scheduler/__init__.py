@@ -1,0 +1,6 @@
+from .factory import create_scheduler
+from .schedules import (CosineSchedule, PlateauSchedule, Scheduler,
+                        StepSchedule, TanhSchedule)
+
+__all__ = ["create_scheduler", "Scheduler", "StepSchedule", "CosineSchedule",
+           "TanhSchedule", "PlateauSchedule"]

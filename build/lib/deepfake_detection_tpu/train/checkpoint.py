@@ -37,23 +37,10 @@ __all__ = ["CheckpointSaver", "save_checkpoint_file", "load_checkpoint_file",
 _EXT = ".ckpt"
 
 
-def _to_host(x: Any) -> np.ndarray:
-    """Fetch a (possibly sharded) array to host numpy.
-
-    Multi-host arrays that are model-sharded (e.g. --tp-size params) span
-    non-addressable devices; np.asarray on those raises.  Gather them first
-    — checkpoints are rare, so the extra collective is cheap.
-    """
-    if isinstance(x, jax.Array) and not x.is_fully_addressable:
-        from jax.experimental import multihost_utils
-        x = multihost_utils.process_allgather(x)
-    return np.asarray(x)
-
-
 def save_checkpoint_file(path: str, state: Any,
                          meta: Optional[Dict[str, Any]] = None) -> None:
     """Serialize {state, meta} atomically to ``path``."""
-    payload = {"state": jax.tree.map(_to_host,
+    payload = {"state": jax.tree.map(np.asarray,
                                      serialization.to_state_dict(state)),
                "meta": meta or {}}   # meta stays plain python (strs allowed)
     blob = serialization.msgpack_serialize(payload)

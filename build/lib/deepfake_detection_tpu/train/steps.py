@@ -1,0 +1,145 @@
+"""Jitted train / eval steps.
+
+The reference's per-batch hot loop (``/root/reference/dfd/runners/train.py:
+594-700``: forward → loss → accuracy → metric allreduce → backward with DDP
+grad allreduce → optimizer step → full device sync → EMA update) becomes ONE
+compiled function per step.  XLA fuses the whole thing; there is no per-step
+host sync (the runner only blocks on the scalars it logs) and no separate
+allreduce launches — gradient reduction is part of the compiled program
+riding ICI.
+
+Two BN strategies (SURVEY.md §7 hard part #2):
+
+* ``bn_mode='global'`` — plain ``jit`` over the data-sharded batch.  BN
+  statistics are computed over the *global* batch (XLA inserts the per-layer
+  reductions): semantically apex SyncBN (train.py:388-400), always on.
+* ``bn_mode='local'`` (default, matches the reference default) — the step is
+  a ``shard_map`` over the data axis: BN normalizes with the *local* shard's
+  statistics (no per-layer collectives in the forward — faster), gradients
+  and metrics are ``lax.pmean``-ed once, and the BN running stats are
+  pmean-ed once per step, keeping the state replicated.  The per-step stat
+  pmean is the reference's ``--dist-bn reduce`` (utils.py:263-274) applied
+  continuously instead of per-epoch — required because pjit state is
+  logically one copy.
+
+Both modes produce bit-identical optimizer updates given the same gradients;
+they differ only in BN normalization statistics (per-shard vs global).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..losses import cross_entropy
+from ..utils.ema import update_ema
+from ..utils.metrics import accuracy, masked_mean
+from .state import TrainState
+
+__all__ = ["make_train_step", "make_eval_step"]
+
+
+def _clip_grads(grads, clip_grad: Optional[float]):
+    if not clip_grad:
+        return grads
+    gnorm = optax.global_norm(grads)
+    scale = jnp.minimum(1.0, clip_grad / (gnorm + 1e-6))
+    return jax.tree.map(lambda g: g * scale, grads)
+
+
+def make_train_step(model, tx: optax.GradientTransformation,
+                    loss_fn: Callable = cross_entropy,
+                    mesh: Optional[Mesh] = None, axis: str = "data",
+                    bn_mode: str = "local", ema_decay: float = 0.0,
+                    clip_grad: Optional[float] = None,
+                    donate: bool = True) -> Callable:
+    """Build ``train_step(state, x, y, rng) -> (state, metrics)``.
+
+    ``x`` is the (globally) batch-sharded NHWC input, ``y`` int labels or
+    soft targets.  ``metrics`` = {'loss', 'prec1'} global-batch scalars
+    (replaces the per-step ``reduce_tensor`` calls, train.py:625-627).
+    """
+    assert bn_mode in ("local", "global"), bn_mode
+
+    def forward_backward(params, batch_stats, x, y, rng):
+        def lossf(p):
+            variables = {"params": p, "batch_stats": batch_stats}
+            out = model.apply(variables, x, training=True,
+                              mutable=["batch_stats"], rngs={"dropout": rng})
+            logits, mut = out
+            return loss_fn(logits, y), (logits, mut["batch_stats"])
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            lossf, has_aux=True)(params)
+        prec1 = accuracy(logits, y)
+        return loss, grads, new_stats, prec1
+
+    def apply_updates(state: TrainState, grads, new_stats, loss, prec1):
+        grads = _clip_grads(grads, clip_grad)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        ema = state.ema
+        if ema is not None:
+            ema = update_ema(ema, {"params": params,
+                                   "batch_stats": new_stats}, ema_decay)
+        new_state = state.replace(step=state.step + 1, params=params,
+                                  batch_stats=new_stats, opt_state=opt_state,
+                                  ema=ema)
+        return new_state, {"loss": loss, "prec1": prec1}
+
+    if bn_mode == "global" or mesh is None:
+        def step(state: TrainState, x, y, rng):
+            loss, grads, new_stats, prec1 = forward_backward(
+                state.params, state.batch_stats, x, y, rng)
+            return apply_updates(state, grads, new_stats, loss, prec1)
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    # ---- local-BN shard_map over the data axis -------------------------
+    from jax import shard_map
+
+    def local_step(state: TrainState, x, y, rng):
+        rng = jax.random.fold_in(rng, lax.axis_index(axis))
+        loss, grads, new_stats, prec1 = forward_backward(
+            state.params, state.batch_stats, x, y, rng)
+        # one fused cross-replica mean for grads + stats + metrics
+        loss, grads, new_stats, prec1 = lax.pmean(
+            (loss, grads, new_stats, prec1), axis)
+        return apply_updates(state, grads, new_stats, loss, prec1)
+
+    data_spec = P(axis)
+    sharded = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), data_spec, data_spec, P()),
+        out_specs=(P(), P()))
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(model, loss_fn: Callable = cross_entropy,
+                   use_ema: bool = False) -> Callable:
+    """Build ``eval_step(state, x, y, valid) -> metrics``.
+
+    ``valid`` masks padded duplicates from the ordered sharded sampler so
+    validation is exact (the reference accepted the duplicate error,
+    loader.py:794-796).  Returns {'loss', 'prec1', 'count'} where loss/prec1
+    are means over valid samples in this batch (reference validate,
+    train.py:703-767).
+    """
+
+    @jax.jit
+    def step(state: TrainState, x, y,
+             valid: Optional[jnp.ndarray] = None) -> Dict[str, jnp.ndarray]:
+        variables = state.ema_variables if use_ema else state.variables
+        logits = model.apply(variables, x, training=False)
+        loss = loss_fn(logits, y, weight=valid)
+        prec1 = accuracy(logits, y, weight=valid)
+        count = (valid.sum() if valid is not None
+                 else jnp.asarray(x.shape[0]))
+        return {"loss": loss, "prec1": prec1, "count": count,
+                "logits": logits}
+
+    return step

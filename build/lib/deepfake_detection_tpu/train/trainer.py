@@ -1,0 +1,221 @@
+"""Epoch-level training and validation loops.
+
+Parity with the reference runner's ``train_epoch`` (``/root/reference/dfd/
+runners/train.py:594-700``) and ``validate`` (:703-767): the same meters, the
+same log line (loss/prec1 val(avg), s/batch, s/image, LR, data time, ETA),
+``--save-images`` batch dumps, in-epoch recovery checkpoints, per-update LR
+scheduling, and mixup-off-epoch switching.  What disappears on TPU: the
+explicit ``torch.cuda.synchronize`` (the runner only blocks when it reads the
+logged scalars — JAX async dispatch keeps the device busy) and the per-step
+metric allreduce (it lives inside the compiled step).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..utils.metrics import AverageMeter, auc
+from .state import TrainState, get_learning_rate, set_learning_rate
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["train_one_epoch", "validate", "save_image_batch"]
+
+
+def save_image_batch(x, path: str, img_num: int = 4) -> None:
+    """Dump a normalized NHWC batch as a tiled jpg (reference :679-684).
+
+    Frames of each clip are laid out horizontally, batch vertically; values
+    min-max normalized like torchvision's ``save_image(normalize=True)``.
+    """
+    from PIL import Image
+    a = np.asarray(x, np.float32)
+    lo, hi = a.min(), a.max()
+    a = (a - lo) / max(hi - lo, 1e-6)
+    b, h, w, c = a.shape
+    assert c % img_num == 0
+    cpf = c // img_num
+    frames = a.reshape(b, h, w, img_num, cpf).transpose(0, 3, 1, 2, 4)
+    grid = frames.reshape(b, img_num * h, w, cpf).transpose(1, 0, 2, 3) \
+        .reshape(img_num * h, b * w, cpf)
+    if cpf == 1:
+        grid = np.repeat(grid, 3, axis=-1)
+    Image.fromarray((grid[..., :3] * 255).astype(np.uint8)).save(path)
+
+
+def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
+                    loader, cfg, rng: jax.Array,
+                    lr_scheduler=None, saver=None, output_dir: str = "",
+                    meta: Optional[Dict[str, Any]] = None,
+                    world_size: int = 1):
+    """One epoch of the hot loop.  Returns ``(state, metrics)``.
+
+    ``world_size`` is the data-parallel degree; s/image in the log line is
+    per-device (the reference's ``bs`` is the per-GPU batch, train.py:658).
+    """
+    if cfg.mixup > 0 and hasattr(loader, "mixup_enabled"):
+        if cfg.mixup_off_epoch and epoch >= cfg.mixup_off_epoch:
+            loader.mixup_enabled = False    # reference :597-599
+
+    batch_time_m, data_time_m = AverageMeter(), AverageMeter()
+    losses_m, prec1_m = AverageMeter(), AverageMeter()
+
+    end = time.time()
+    num_batches = len(loader)
+    last_idx = num_batches - 1
+    num_updates = epoch * num_batches
+    lr = get_learning_rate(state)
+
+    # jax.profiler window (SURVEY §5: the reference has no profiler; an MFU
+    # target can't be tuned blind).  Steps [start, start+N) of epoch 0 are
+    # traced into <output_dir>/profile — view with TensorBoard or Perfetto.
+    profile_n = getattr(cfg, "profile", 0) if epoch == 0 and output_dir \
+        else 0
+    profile_start = min(10, max(num_batches - profile_n, 0))
+    profiling = False
+
+    # Device-side metric scalars are buffered and only materialized at log
+    # boundaries: a float() on every step would block the host on each
+    # step's completion and serialize dispatch, forfeiting the async-
+    # dispatch overlap that replaces the reference's CUDA-stream prefetch.
+    # Consequence: batch_time_m.val at a log step absorbs the wait for the
+    # whole buffered backlog (so .avg is the accurate number); the plateau
+    # scheduler sees a loss avg that is up to log_interval steps stale.
+    pending: list = []
+
+    def _drain() -> None:
+        for m, n in pending:
+            loss_value = float(m["loss"])     # host sync, log steps only
+            if not np.isnan(loss_value):
+                losses_m.update(loss_value, n)
+            prec1_m.update(float(m["prec1"]), n)
+        pending.clear()
+
+    for batch_idx, batch in enumerate(loader):
+        x, y = batch[0], batch[1]
+        last_batch = batch_idx == last_idx
+        data_time_m.update(time.time() - end)
+
+        if profile_n and batch_idx == profile_start and not profiling:
+            jax.profiler.start_trace(os.path.join(output_dir, "profile"))
+            profiling = True
+
+        step_rng = jax.random.fold_in(rng, num_updates)
+        state, metrics = train_step(state, x, y, step_rng)
+
+        if profiling and (batch_idx + 1 >= profile_start + profile_n
+                          or last_batch):
+            jax.block_until_ready(metrics["loss"])
+            jax.profiler.stop_trace()
+            profiling = False
+            _logger.info("Profiler trace written to %s",
+                         os.path.join(output_dir, "profile"))
+
+        bs = x.shape[0]     # GLOBAL batch: the loader assembles the global
+        # sharded array even multi-host (parallel/sharding.py:69-80)
+        pending.append((metrics, bs))
+        num_updates += 1
+
+        if last_batch or batch_idx % cfg.log_interval == 0:
+            _drain()
+        batch_time_m.update(time.time() - end)
+
+        if last_batch or batch_idx % cfg.log_interval == 0:
+            lr = get_learning_rate(state) or 0.0
+            ets_time = batch_time_m.avg * (num_batches - batch_idx) / 60
+            _logger.info(
+                "Train:%d [%4d/%d] "
+                "Loss:%.5f(%.5f) Prec@1:%7.4f(%7.4f) "
+                "Time:%.3f(%.3f)s/batch %.5f(%.5f)s/image "
+                "LR:%.3e Data:%.3f(%.3f)s/batch ETS:%.3fmin",
+                epoch, batch_idx, num_batches,
+                losses_m.val, losses_m.avg, prec1_m.val, prec1_m.avg,
+                batch_time_m.val, batch_time_m.avg,
+                batch_time_m.val / max(bs // world_size, 1),
+                batch_time_m.avg / max(bs // world_size, 1),
+                lr, data_time_m.val, data_time_m.avg, ets_time)
+            if cfg.save_images and output_dir:
+                save_image_batch(
+                    x, os.path.join(output_dir,
+                                    f"train-batch-{batch_idx}.jpg"),
+                    img_num=max(1, cfg.resolved_in_chans // 3))
+
+        if saver is not None and cfg.recovery_interval and (
+                last_batch or (batch_idx + 1) % cfg.recovery_interval == 0):
+            saver.save_recovery(state, meta or {}, epoch,
+                                batch_idx=batch_idx)   # reference :686-689
+
+        if lr_scheduler is not None:
+            new_lr = lr_scheduler.step_update(num_updates=num_updates,
+                                              metric=losses_m.avg)
+            if new_lr is not None and new_lr != lr:
+                state = set_learning_rate(state, new_lr)
+        end = time.time()
+
+    return state, OrderedDict([("loss", losses_m.avg),
+                               ("prec1", prec1_m.avg),
+                               ("learning_rate", lr)])
+
+
+def validate(eval_step: Callable, state: TrainState, loader, cfg,
+             log_suffix: str = "") -> "OrderedDict[str, float]":
+    """Full-dataset eval (reference validate, train.py:703-767), exact thanks
+    to the validity mask on padded batches."""
+    batch_time_m = AverageMeter()
+    losses_m, prec1_m = AverageMeter(), AverageMeter()
+    all_scores, all_labels, all_valid = [], [], []
+    end = time.time()
+    num_batches = len(loader)
+    last_idx = num_batches - 1
+    log_name = "Test" + log_suffix
+    for batch_idx, batch in enumerate(loader):
+        x, y = batch[0], batch[1]
+        valid = batch[2] if len(batch) > 2 else None
+        metrics = eval_step(state, x, y, valid)
+        n = float(metrics["count"])
+        if n > 0:
+            losses_m.update(float(metrics["loss"]), n)
+            prec1_m.update(float(metrics["prec1"]), n)
+        logits = metrics.get("logits")
+        if logits is not None and logits.shape[-1] == 2:
+            # P(real): labels are 0=fake / 1=real, so AUC ranks real above
+            # fake (the released-checkpoint quality gate, BASELINE.md)
+            scores = jax.nn.softmax(logits, axis=-1)[:, 1]
+            y_h, v_h = y, valid
+            if jax.process_count() > 1:
+                # the global batch spans non-addressable devices; gather it
+                # before pulling to host
+                from jax.experimental import multihost_utils
+                gathered = multihost_utils.process_allgather(
+                    (scores, y) if valid is None else (scores, y, valid),
+                    tiled=True)
+                scores, y_h = gathered[0], gathered[1]
+                v_h = gathered[2] if valid is not None else None
+            scores = np.asarray(scores, np.float32).reshape(-1)
+            all_scores.append(scores)
+            all_labels.append(np.asarray(y_h).reshape(-1))
+            all_valid.append(np.ones(len(scores)) if v_h is None
+                             else np.asarray(v_h, np.float32).reshape(-1))
+        batch_time_m.update(time.time() - end)
+        if batch_idx == last_idx or batch_idx % cfg.log_interval == 0:
+            _logger.info(
+                "%s: [%4d/%d] Time:%.3f(%.3f) "
+                "Loss:%.4f(%.4f) Prec@1:%7.4f(%7.4f)",
+                log_name, batch_idx, num_batches,
+                batch_time_m.val, batch_time_m.avg,
+                losses_m.val, losses_m.avg, prec1_m.val, prec1_m.avg)
+        end = time.time()
+    out = OrderedDict([("loss", losses_m.avg), ("prec1", prec1_m.avg)])
+    if all_scores:
+        out["auc"] = float(auc(np.concatenate(all_scores),
+                               np.concatenate(all_labels),
+                               np.concatenate(all_valid)))
+        _logger.info("%s: AUC %.5f", log_name, out["auc"])
+    return out

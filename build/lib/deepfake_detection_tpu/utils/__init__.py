@@ -1,0 +1,6 @@
+"""Training utilities (SURVEY.md §2.6): metrics, EMA, reporting, logging."""
+
+from .ema import init_ema, update_ema
+from .log import FormatterNoInfo, setup_default_logging
+from .metrics import AverageMeter, accuracy, auc, masked_mean
+from .summary import get_outdir, natural_key, plot_csv, update_summary

@@ -1,0 +1,42 @@
+"""Exponential moving average of model state — a pure pytree transform.
+
+Replaces ``ModelEma`` (``/root/reference/dfd/timm/utils.py:277-340``), which
+deep-copies the torch module and mutates its state dict each step.  Here the
+EMA is just another pytree in the train state, updated functionally *inside*
+the jitted train step (so it costs one fused multiply-add over the weights,
+overlapped with the step; the reference pays a separate kernel launch per
+tensor every step).
+
+Like the reference, the EMA tracks *everything* in the model state — params
+and batch-norm running stats — with decay 0.9998 by default (train.py:208).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+__all__ = ["init_ema", "update_ema"]
+
+
+def init_ema(variables: Any) -> Any:
+    """EMA state starts as a copy of the model state (reference :306).
+
+    A *real* copy, not aliased references — the train step donates its input
+    state, and donating the same underlying buffer via both ``params`` and
+    ``ema`` is an error (and undefined behavior when it isn't caught).
+    """
+    return jax.tree.map(jax.numpy.copy, variables)
+
+
+def update_ema(ema: Any, variables: Any, decay: float = 0.9998) -> Any:
+    """``ema = decay * ema + (1 - decay) * new`` per leaf (reference :331-340).
+
+    Jit-safe; call inside the train step.
+    """
+    return jax.tree.map(
+        lambda e, v: e * decay + (1.0 - decay) * v.astype(e.dtype)
+        if hasattr(e, "dtype") and jax.numpy.issubdtype(e.dtype, jax.numpy.inexact)
+        else v,
+        ema, variables)

@@ -1,0 +1,26 @@
+"""Logging setup (reference ``dfd/timm/utils.py:343-357``)."""
+
+from __future__ import annotations
+
+import logging
+
+__all__ = ["FormatterNoInfo", "setup_default_logging"]
+
+
+class FormatterNoInfo(logging.Formatter):
+    """INFO records print bare; other levels keep 'LEVEL: msg' (:343-349)."""
+
+    def __init__(self, fmt: str = "%(levelname)s: %(message)s"):
+        super().__init__(fmt)
+
+    def format(self, record: logging.LogRecord) -> str:
+        if record.levelno == logging.INFO:
+            return str(record.getMessage())
+        return super().format(record)
+
+
+def setup_default_logging(default_level: int = logging.INFO) -> None:
+    console_handler = logging.StreamHandler()
+    console_handler.setFormatter(FormatterNoInfo())
+    logging.root.addHandler(console_handler)
+    logging.root.setLevel(default_level)

@@ -1,0 +1,94 @@
+"""Run-directory reporting: summary.csv + per-metric plots.
+
+Parity with ``/root/reference/dfd/timm/utils.py``: ``get_outdir`` (:188),
+``update_summary`` (:238-248), ``plot_csv`` (:224), ``plot_figure`` (:205),
+``natural_key`` (:251).  Plots are optional (matplotlib imported lazily, and
+failures are swallowed like the reference's bare try/except around savefig).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import re
+from collections import OrderedDict
+from typing import Dict, Optional
+
+__all__ = ["get_outdir", "update_summary", "plot_csv", "natural_key"]
+
+
+def get_outdir(path: str, *paths: str, inc: bool = False) -> str:
+    """mkdir -p with optional ``-N`` suffix increment (reference :188-202)."""
+    outdir = os.path.join(path, *paths)
+    if not os.path.exists(outdir):
+        os.makedirs(outdir)
+    elif inc:
+        count = 1
+        outdir_inc = f"{outdir}-{count}"
+        while os.path.exists(outdir_inc):
+            count += 1
+            outdir_inc = f"{outdir}-{count}"
+            assert count < 100
+        outdir = outdir_inc
+        os.makedirs(outdir)
+    return outdir
+
+
+def _plot_figure(x_data, y_data, name: str, plots_dir: str) -> None:
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    fig, ax = plt.subplots(figsize=(6, 6))
+    ax.set_title(name, color="red")
+    ax.set_xlabel("epoch", fontsize=15, color="gray")
+    ax.set_ylabel(name, fontsize=15, color="gray")
+    ax.plot(x_data, y_data, "ro-")
+    ax.grid(True)
+    try:
+        plt.savefig(os.path.join(plots_dir, f"{name}.jpg"))
+    except Exception:
+        pass
+    plt.close(fig)
+
+
+def plot_csv(filename: str, plots_dir: str) -> None:
+    """Regenerate one plot per csv column (reference :224-235)."""
+    os.makedirs(plots_dir, exist_ok=True)
+    with open(filename) as f:
+        rows = list(csv.DictReader(f))
+    if not rows:
+        return
+    x = [float(r["epoch"]) for r in rows]
+    for column in rows[0].keys():
+        if column == "epoch":
+            continue
+        try:
+            y = [float(r[column]) for r in rows]
+        except (TypeError, ValueError):
+            continue
+        _plot_figure(x, y, column, plots_dir)
+
+
+def update_summary(epoch: int, train_metrics: Dict, eval_metrics: Dict,
+                   filename: str, plots_dir: Optional[str] = None,
+                   write_header: bool = False) -> None:
+    """Append one epoch row and refresh plots (reference :238-248)."""
+    rowd = OrderedDict(epoch=epoch)
+    rowd.update([("train_" + k, v) for k, v in train_metrics.items()])
+    rowd.update([("eval_" + k, v) for k, v in eval_metrics.items()])
+    with open(filename, "a") as cf:
+        dw = csv.DictWriter(cf, fieldnames=rowd.keys())
+        if write_header:
+            dw.writeheader()
+        dw.writerow(rowd)
+    if plots_dir:
+        try:
+            plot_csv(filename, plots_dir)
+        except Exception:
+            pass
+
+
+def natural_key(string_: str):
+    """Human sort key (reference :251-253)."""
+    return [int(s) if s.isdigit() else s
+            for s in re.split(r"(\d+)", string_.lower())]
