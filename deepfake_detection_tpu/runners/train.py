@@ -177,11 +177,20 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     start_epoch = cfg.start_epoch or 0
 
     if cfg.resume:
+        # capture the fresh state's shardings (opt moments / EMA inherited
+        # them from the TP'd params via eager zeros_like) so the restored
+        # host arrays go back to the same layout, not just the params
+        from jax.sharding import NamedSharding
+        shard_tree = jax.tree.map(
+            lambda x: x.sharding if isinstance(x, jax.Array)
+            and isinstance(x.sharding, NamedSharding) else None,
+            state)
         state, meta = restore_train_state(cfg.resume, state,
                                           load_opt=not cfg.no_resume_opt)
         if cfg.tp_size > 1:
-            # restore rebuilds leaves as host arrays — re-apply TP layout
-            state = state.replace(params=apply_tp(state.params))
+            state = jax.tree.map(
+                lambda leaf, sh: jax.device_put(leaf, sh)
+                if sh is not None else leaf, state, shard_tree)
         start_epoch = cfg.start_epoch if cfg.start_epoch is not None \
             else int(meta.get("epoch", -1)) + 1   # helpers.py:47-73
         _logger.info("Resumed from %s (epoch %d)", cfg.resume, start_epoch)

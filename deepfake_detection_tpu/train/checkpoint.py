@@ -40,13 +40,19 @@ _EXT = ".ckpt"
 def _to_host(x: Any) -> np.ndarray:
     """Fetch a (possibly sharded) array to host numpy.
 
-    Multi-host arrays that are model-sharded (e.g. --tp-size params) span
-    non-addressable devices; np.asarray on those raises.  Gather them first
-    — checkpoints are rare, so the extra collective is cheap.
+    Fully-replicated and fully-addressable arrays convert directly (the
+    local replica / local shards suffice) — this covers single-host runs of
+    any sharding and multi-host pure-DP.  Multi-host *model-sharded* leaves
+    would need a collective gather that every process enters; the saver runs
+    on rank 0 only, so raise with the remedy instead of deadlocking in a
+    one-sided all-gather.
     """
-    if isinstance(x, jax.Array) and not x.is_fully_addressable:
-        from jax.experimental import multihost_utils
-        x = multihost_utils.process_allgather(x)
+    if isinstance(x, jax.Array) and not x.is_fully_addressable \
+            and not x.is_fully_replicated:
+        raise RuntimeError(
+            "checkpoint save of a multi-host model-sharded array: gather "
+            "params to a replicated sharding on ALL processes before "
+            "saving (rank-0-only saving cannot enter a collective)")
     return np.asarray(x)
 
 

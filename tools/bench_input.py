@@ -1,0 +1,114 @@
+"""Host input-pipeline throughput benchmark (SURVEY §7 hard part #4).
+
+The flagship config consumes 4-frame 600² JPEG clips; at the measured chip
+throughput the host must sustain decode+augment+collate without stalling
+device dispatch.  This tool measures exactly that path — the same
+``DeepFakeClipDataset`` → transforms → ``HostLoader`` stack the trainer
+uses — on a synthetic on-disk JPEG dataset, with and without the native
+C++ decode pool.
+
+Usage::
+
+    python tools/bench_input.py [--clips 64] [--size 600] [--frames 4]
+                                [--batch 8] [--workers 4] [--epochs 2]
+
+Prints clips/s and frames/s for (native, PIL) so the decode-pool gain on
+the current host is measurable (on 1-core CI containers expect parity; the
+pool's win is GIL-free scaling across real cores).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_dataset(root: str, n_clips: int, size: int, frames: int,
+                  seed: int = 0) -> None:
+    from PIL import Image
+    rng = np.random.default_rng(seed)
+    y, x = np.mgrid[0:size, 0:size]
+    base = np.stack([(x // 3 + y // 5) % 256, (x // 2) % 256,
+                     (y // 4) % 256], -1).astype(np.uint8)
+    names = {"fake": [], "real": []}
+    for i in range(n_clips):
+        kind = "fake" if i % 2 == 0 else "real"
+        clip = f"c{i}"
+        d = os.path.join(root, kind, clip)
+        os.makedirs(d, exist_ok=True)
+        for f in range(frames):
+            img = np.clip(base.astype(int)
+                          + rng.integers(-20, 20, base.shape), 0, 255)
+            Image.fromarray(img.astype(np.uint8)).save(
+                os.path.join(d, f"{f}.jpg"), quality=90)
+        names[kind].append(f"{clip}:{frames}")
+    for kind, lst in names.items():
+        with open(os.path.join(root, f"{kind}_list.txt"), "w") as fh:
+            fh.write("\n".join(lst) + "\n")
+
+
+def measure(root: str, args, native: bool) -> float:
+    os.environ.pop("DFD_NO_NATIVE_DECODE", None)
+    if not native:
+        os.environ["DFD_NO_NATIVE_DECODE"] = "1"
+    # import after the env var so the dataset sees the right decode path
+    from deepfake_detection_tpu.data.dataset import DeepFakeClipDataset
+    from deepfake_detection_tpu.data.loader import HostLoader
+    from deepfake_detection_tpu.data.samplers import ShardedTrainSampler
+    from deepfake_detection_tpu.data.transforms_factory import \
+        transforms_deepfake_train_v3
+
+    ds = DeepFakeClipDataset([root], frames_per_clip=args.frames)
+    ds.set_transform(transforms_deepfake_train_v3(
+        img_size=args.size, color_jitter=0.4, rotate_range=5,
+        blur_radiu=1, blur_prob=0.05, flicker=0.05))
+    sampler = ShardedTrainSampler(len(ds), batch_size=args.batch, seed=0)
+    loader = HostLoader(ds, sampler, batch_size=args.batch,
+                        num_workers=args.workers, seed=0)
+    # warmup epoch primes file cache + pool
+    for _ in loader:
+        pass
+    t0 = time.perf_counter()
+    n = 0
+    for e in range(args.epochs):
+        loader.set_epoch(e)
+        for batch in loader:
+            n += batch[0].shape[0]
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clips", type=int, default=64)
+    ap.add_argument("--size", type=int, default=600)
+    ap.add_argument("--frames", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--keep", default="", help="reuse/keep dataset dir")
+    args = ap.parse_args()
+
+    root = args.keep or tempfile.mkdtemp(prefix="dfd_input_bench_")
+    if not os.path.exists(os.path.join(root, "fake_list.txt")):
+        print(f"building {args.clips} synthetic clips under {root} ...",
+              file=sys.stderr)
+        build_dataset(root, args.clips, args.size, args.frames)
+
+    for native in (True, False):
+        cps = measure(root, args, native)
+        label = "native-pool" if native else "PIL        "
+        print(f"{label}: {cps:7.2f} clips/s  "
+              f"({cps * args.frames:8.2f} frames/s)  "
+              f"[{args.size}²×{args.frames}f, {args.workers} workers]")
+
+
+if __name__ == "__main__":
+    main()
