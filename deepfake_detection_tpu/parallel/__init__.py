@@ -10,6 +10,7 @@ from .mesh import (initialize_distributed, local_batch_size, make_mesh,
 from .ring_attention import (full_attention, ring_attention,
                              ring_flash_attention, ring_self_attention,
                              ulysses_attention)
+from .ep import condconv_ep_sharding, condconv_ep_specs
 from .tp import transformer_tp_sharding, transformer_tp_specs
 from .sharding import (batch_sharding, fsdp_param_specs, param_sharding,
                        put_process_local, replicated_sharding, shard_batch)

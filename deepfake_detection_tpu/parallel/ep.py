@@ -1,0 +1,57 @@
+"""Expert parallelism for CondConv families (GSPMD-style).
+
+The reference's only "experts" are CondConv's per-sample kernel mixtures,
+computed locally on one GPU (SURVEY.md §2.7: "not distributed MoE").  On
+TPU the expert bank is a natural shard axis: the (E, kh, kw, i, o) weight
+splits over a mesh axis so each device holds E/n experts, the routing
+einsum ``be,ehwio->bhwio`` produces per-shard partial mixtures, and GSPMD
+inserts ONE all-reduce to combine them — distributed expert storage and
+compute without touching the layer code.
+
+This pays off when the expert bank dominates parameter memory (CondConv
+multiplies every targeted conv's params by E — the cc_b1_8e bank is 8× its
+convs) while activations stay data-sharded.
+
+Identification is structural, not name-path-based: CondConv's parameters
+are the only ``weight`` leaves with a leading expert rank (ndim 5:
+(E, kh, kw, in, out)) and the only ``bias`` leaves with ndim 2 ((E, out)).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["condconv_ep_specs", "condconv_ep_sharding"]
+
+
+def _leaf_spec(path, leaf, axis: str, n: int) -> P:
+    name = getattr(path[-1], "key", getattr(path[-1], "name", "")) \
+        if path else ""
+    if name == "weight" and leaf.ndim == 5 and leaf.shape[0] % n == 0:
+        return P(axis)                       # experts sharded, rest local
+    if name == "bias" and leaf.ndim == 2 and leaf.shape[0] % n == 0:
+        return P(axis)
+    return P()
+
+
+def condconv_ep_specs(params: Any, axis: str, axis_size: int) -> Any:
+    """PartitionSpec tree: expert banks sharded over ``axis``, rest
+    replicated.  ``axis_size`` must be the mesh extent of ``axis`` (experts
+    not divisible by it stay replicated)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, l: _leaf_spec(p, l, axis, axis_size), params)
+
+
+def condconv_ep_sharding(params: Any, mesh: Mesh,
+                         axis: str = "model") -> Any:
+    """NamedSharding tree for a CondConv model's param tree over ``mesh``.
+
+    Rides the same ``model`` axis TP uses by default, so a 2-D
+    ``(data, model)`` mesh serves dp×ep exactly like dp×tp.
+    """
+    specs = condconv_ep_specs(params, axis, mesh.shape[axis])
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
