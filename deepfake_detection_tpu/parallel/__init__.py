@@ -11,6 +11,8 @@ from .ring_attention import (full_attention, ring_attention,
                              ring_flash_attention, ring_self_attention,
                              ulysses_attention)
 from .ep import condconv_ep_sharding, condconv_ep_specs
+from .pp import gpipe_apply, gpipe_transformer_tower, pipeline_sharding, \
+    stack_block_params
 from .tp import transformer_tp_sharding, transformer_tp_specs
 from .sharding import (batch_sharding, fsdp_param_specs, param_sharding,
                        put_process_local, replicated_sharding, shard_batch)

@@ -1,0 +1,98 @@
+"""GPipe pipeline parallelism over the 'stage' mesh axis.
+
+The forward schedule is a scan of ppermute hops; the backward pipeline is
+pure autodiff (ppermute's transpose is the reverse permute), so checking
+grads against the sequential tower validates the whole reverse schedule.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deepfake_detection_tpu.parallel.pp import (gpipe_transformer_tower,
+                                                pipeline_sharding,
+                                                stack_block_params)
+
+
+def _block_apply(p, h):
+    # a homogeneous residual MLP block (what transformer towers look like)
+    h2 = jax.nn.gelu(h @ p["w1"] + p["b1"])
+    return h + h2 @ p["w2"] + p["b2"]
+
+
+def _make_blocks(depth, dim, hidden, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), depth * 2)
+    blocks = []
+    for i in range(depth):
+        blocks.append({
+            "w1": jax.random.normal(ks[2 * i], (dim, hidden)) * 0.1,
+            "b1": jnp.zeros((hidden,)),
+            "w2": jax.random.normal(ks[2 * i + 1], (hidden, dim)) * 0.1,
+            "b2": jnp.zeros((dim,)),
+        })
+    return blocks
+
+
+def _sequential(blocks, x):
+    for p in blocks:
+        x = _block_apply(p, x)
+    return x
+
+
+@pytest.fixture()
+def stage_mesh(devices):
+    return Mesh(np.asarray(devices[:4]), ("stage",))
+
+
+@pytest.mark.parametrize("microbatches", [2, 4])
+def test_pipeline_matches_sequential(stage_mesh, microbatches):
+    depth, dim, hidden = 8, 16, 32          # 4 stages × 2 blocks
+    blocks = _make_blocks(depth, dim, hidden)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, dim))
+    ref = _sequential(blocks, x)
+
+    stacked = stack_block_params(blocks)
+    stacked = jax.device_put(stacked,
+                             pipeline_sharding(stacked, stage_mesh))
+    out = jax.jit(lambda p, x: gpipe_transformer_tower(
+        stage_mesh, _block_apply, p, x,
+        num_microbatches=microbatches))(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_backward_matches_sequential(stage_mesh):
+    depth, dim, hidden = 4, 8, 16
+    blocks = _make_blocks(depth, dim, hidden, seed=2)
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, dim))
+
+    def loss_seq(blocks):
+        return jnp.sum(_sequential(blocks, x) ** 2)
+
+    stacked = stack_block_params(blocks)
+    stacked_dev = jax.device_put(stacked,
+                                 pipeline_sharding(stacked, stage_mesh))
+
+    def loss_pp(p):
+        return jnp.sum(gpipe_transformer_tower(
+            stage_mesh, _block_apply, p, x, num_microbatches=2) ** 2)
+
+    g_seq = jax.grad(loss_seq)(blocks)            # list of per-block trees
+    g_pp = jax.jit(jax.grad(loss_pp))(stacked_dev)  # stacked (D, ...) tree
+    g_seq_stacked = stack_block_params(g_seq)
+    for a, b in zip(jax.tree.leaves(g_seq_stacked), jax.tree.leaves(g_pp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_pipeline_param_footprint_is_sharded(stage_mesh):
+    """Each device holds only its stage's block slice."""
+    blocks = _make_blocks(8, 16, 32)
+    stacked = stack_block_params(blocks)
+    stacked = jax.device_put(stacked,
+                             pipeline_sharding(stacked, stage_mesh))
+    w1 = stacked["w1"]                           # (8, 16, 32) over 4 stages
+    shard_shapes = {s.data.shape for s in w1.addressable_shards}
+    assert shard_shapes == {(2, 16, 32)}
