@@ -31,7 +31,7 @@ from ..ops.flash_attention import flash_attention
 from ..parallel.ring_attention import full_attention, ring_self_attention
 from ..registry import register_model
 
-__all__ = ["VisionTransformer"]
+__all__ = ["VisionTransformer", "vit_pipeline_forward"]
 
 
 def _cfg(**kwargs):
@@ -189,6 +189,61 @@ class VisionTransformer(nn.Module):
         if self.num_classes <= 0:
             return feat
         return nn.Dense(self.num_classes, dtype=self.dtype, name="head")(feat)
+
+
+def vit_pipeline_forward(model: "VisionTransformer", variables, x,
+                         mesh, num_microbatches: int = 4,
+                         axis: str = "stage"):
+    """Inference forward with the block tower pipelined over ``axis``.
+
+    Patch embed / positional embed / final norm / head run replicated on
+    every stage (tiny); the depth-D block tower runs as a GPipe schedule
+    (parallel/pp.py) with each device holding D/S blocks' params.  Output
+    matches ``model.apply(variables, x, training=False)``.
+
+    Dropout/drop-path must be inactive (inference semantics); the model's
+    ``depth`` must divide the mesh's ``axis`` extent.
+    """
+    from ..parallel.pp import (gpipe_transformer_tower, pipeline_sharding,
+                               stack_block_params)
+    p = variables["params"]
+    B = x.shape[0]
+    # --- embed (replicated) ---------------------------------------------
+    pe = nn.Conv(model.embed_dim, (model.patch_size,) * 2,
+                 strides=(model.patch_size,) * 2, padding="VALID",
+                 dtype=model.dtype)
+    h = pe.apply({"params": p["patch_embed"]}, x)
+    h = h.reshape(B, -1, model.embed_dim)
+    if model.class_token:
+        cls = jnp.broadcast_to(p["cls_token"],
+                               (B, 1, model.embed_dim)).astype(h.dtype)
+        h = jnp.concatenate([cls, h], axis=1)
+    h = h + p["pos_embed"].astype(h.dtype)
+
+    # --- pipelined tower -------------------------------------------------
+    block = _Block(model.num_heads, model.mlp_ratio, model.qkv_bias,
+                   dtype=model.dtype)
+
+    def block_apply(bp, hh):
+        return block.apply({"params": bp}, hh, False)
+
+    stacked = stack_block_params(
+        [p[f"blocks_{i}"] for i in range(model.depth)])
+    stacked = jax.device_put(stacked, pipeline_sharding(stacked, mesh, axis))
+    h = gpipe_transformer_tower(mesh, block_apply, stacked, h,
+                                num_microbatches, axis=axis)
+
+    # --- head (replicated) -----------------------------------------------
+    h = nn.LayerNorm(dtype=model.dtype).apply({"params": p["norm"]}, h)
+    if model.global_pool == "avg":
+        start = 1 if model.class_token else 0
+        feat = h[:, start:].mean(axis=1)
+    else:
+        feat = h[:, 0]
+    if model.num_classes <= 0:
+        return feat
+    return nn.Dense(model.num_classes, dtype=model.dtype).apply(
+        {"params": p["head"]}, feat)
 
 
 # name: (patch, dim, depth, heads)
