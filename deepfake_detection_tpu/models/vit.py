@@ -31,7 +31,8 @@ from ..ops.flash_attention import flash_attention
 from ..parallel.ring_attention import full_attention, ring_self_attention
 from ..registry import register_model
 
-__all__ = ["VisionTransformer", "vit_pipeline_forward"]
+__all__ = ["VisionTransformer", "prepare_vit_pipeline",
+           "vit_pipeline_forward"]
 
 
 def _cfg(**kwargs):
@@ -191,23 +192,46 @@ class VisionTransformer(nn.Module):
         return nn.Dense(self.num_classes, dtype=self.dtype, name="head")(feat)
 
 
+def prepare_vit_pipeline(model: "VisionTransformer", variables, mesh,
+                         axis: str = "stage"):
+    """One-time prep for :func:`vit_pipeline_forward`: stack the per-block
+    param trees and shard them over ``axis`` (each stage holds depth/S
+    blocks).  Do this once, not per step — it copies the whole tower."""
+    from ..parallel.pp import pipeline_sharding, stack_block_params
+    s = mesh.shape[axis]
+    assert model.depth % s == 0, \
+        f"depth {model.depth} not divisible by {s} pipeline stages"
+    stacked = stack_block_params(
+        [variables["params"][f"blocks_{i}"] for i in range(model.depth)])
+    return jax.device_put(stacked, pipeline_sharding(stacked, mesh, axis))
+
+
 def vit_pipeline_forward(model: "VisionTransformer", variables, x,
                          mesh, num_microbatches: int = 4,
-                         axis: str = "stage"):
+                         axis: str = "stage", stacked=None):
     """Inference forward with the block tower pipelined over ``axis``.
 
     Patch embed / positional embed / final norm / head run replicated on
     every stage (tiny); the depth-D block tower runs as a GPipe schedule
-    (parallel/pp.py) with each device holding D/S blocks' params.  Output
-    matches ``model.apply(variables, x, training=False)``.
+    (parallel/pp.py).  Output matches ``model.apply(variables, x,
+    training=False)`` — the parity test in tests/test_pp.py pins the two
+    paths together; KEEP THIS IN SYNC with VisionTransformer.__call__
+    (which cannot be factored into setup()-style shared methods because
+    pos_embed's shape depends on the input size).
 
-    Dropout/drop-path must be inactive (inference semantics); the model's
-    ``depth`` must divide the mesh's ``axis`` extent.
+    Per-stage attention runs ``model.attn_impl`` when it is 'full' or
+    'flash'; sequence-parallel impls (ring/ulysses) shard over their own
+    mesh axis and do not compose with this helper.  Pass ``stacked`` from
+    :func:`prepare_vit_pipeline` to avoid re-stacking the tower per call.
     """
-    from ..parallel.pp import (gpipe_transformer_tower, pipeline_sharding,
-                               stack_block_params)
+    assert model.attn_impl in ("full", "flash"), \
+        f"pipeline forward supports full/flash attention, " \
+        f"got {model.attn_impl!r}"
+    from ..parallel.pp import gpipe_transformer_tower
     p = variables["params"]
     B = x.shape[0]
+    if stacked is None:
+        stacked = prepare_vit_pipeline(model, variables, mesh, axis)
     # --- embed (replicated) ---------------------------------------------
     pe = nn.Conv(model.embed_dim, (model.patch_size,) * 2,
                  strides=(model.patch_size,) * 2, padding="VALID",
@@ -222,14 +246,11 @@ def vit_pipeline_forward(model: "VisionTransformer", variables, x,
 
     # --- pipelined tower -------------------------------------------------
     block = _Block(model.num_heads, model.mlp_ratio, model.qkv_bias,
-                   dtype=model.dtype)
+                   attn_impl=model.attn_impl, dtype=model.dtype)
 
     def block_apply(bp, hh):
         return block.apply({"params": bp}, hh, False)
 
-    stacked = stack_block_params(
-        [p[f"blocks_{i}"] for i in range(model.depth)])
-    stacked = jax.device_put(stacked, pipeline_sharding(stacked, mesh, axis))
     h = gpipe_transformer_tower(mesh, block_apply, stacked, h,
                                 num_microbatches, axis=axis)
 
@@ -239,6 +260,7 @@ def vit_pipeline_forward(model: "VisionTransformer", variables, x,
         start = 1 if model.class_token else 0
         feat = h[:, start:].mean(axis=1)
     else:
+        assert model.class_token, "token pooling needs a class token"
         feat = h[:, 0]
     if model.num_classes <= 0:
         return feat

@@ -101,11 +101,19 @@ def test_pipeline_param_footprint_is_sharded(stage_mesh):
 def test_vit_pipeline_forward_matches_apply(stage_mesh):
     """Model-level PP: ViT tower pipelined over 4 stages == plain apply."""
     from deepfake_detection_tpu.models import create_model, init_model
-    from deepfake_detection_tpu.models.vit import vit_pipeline_forward
-    m = create_model("vit_tiny_patch16_224", num_classes=2)   # depth 12 → 3/stage
+    from deepfake_detection_tpu.models.vit import (prepare_vit_pipeline,
+                                                   vit_pipeline_forward)
+    m = create_model("vit_tiny_patch16_224", num_classes=2)  # depth 12 → 3/stage
     v = init_model(m, jax.random.PRNGKey(0), (4, 64, 64, 3))
     x = jax.random.normal(jax.random.PRNGKey(1), (4, 64, 64, 3))
     ref = m.apply(v, x, training=False)
-    out = vit_pipeline_forward(m, v, x, stage_mesh, num_microbatches=2)
+    stacked = prepare_vit_pipeline(m, v, stage_mesh)   # one-time prep
+    out = vit_pipeline_forward(m, v, x, stage_mesh, num_microbatches=2,
+                               stacked=stacked)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+    # unsupported attention impls are rejected, not silently downgraded
+    m_ring = create_model("vit_tiny_patch16_224", num_classes=2,
+                          attn_impl="ring")
+    with pytest.raises(AssertionError):
+        vit_pipeline_forward(m_ring, v, x, stage_mesh)
