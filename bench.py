@@ -194,6 +194,8 @@ def main() -> None:
     extra = {}
     if os.environ.get("BENCH_ATTN"):      # ViT attention impl: full|flash
         extra["attn_impl"] = os.environ["BENCH_ATTN"]
+    if os.environ.get("BENCH_REMAT"):     # remat policy: none|full|dots
+        extra["remat_policy"] = os.environ["BENCH_REMAT"]
     model = create_model(model_name, num_classes=2, in_chans=chans,
                          dtype=dtype if dtype != jnp.float32 else None,
                          **extra)
