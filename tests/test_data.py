@@ -23,6 +23,8 @@ from deepfake_detection_tpu.data.transforms import (Compose, MultiConcate,
 from deepfake_detection_tpu.data.transforms_factory import (
     transforms_deepfake_eval_v3, transforms_deepfake_train_v3)
 
+pytestmark = pytest.mark.smoke  # fast tier: see pyproject [tool.pytest]
+
 
 def _rng(seed=0):
     return np.random.default_rng(seed)

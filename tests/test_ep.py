@@ -13,6 +13,8 @@ from deepfake_detection_tpu.parallel import (batch_sharding,
                                              condconv_ep_sharding,
                                              condconv_ep_specs)
 
+pytestmark = pytest.mark.smoke  # fast tier: see pyproject [tool.pytest]
+
 
 @pytest.fixture()
 def mesh2d(devices):

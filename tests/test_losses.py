@@ -12,6 +12,8 @@ from deepfake_detection_tpu.losses import (create_loss_fn, cross_entropy,
                                            one_hot,
                                            soft_target_cross_entropy)
 
+pytestmark = pytest.mark.smoke  # fast tier: see pyproject [tool.pytest]
+
 rng = np.random.default_rng(7)
 LOGITS = rng.normal(size=(12, 2)).astype(np.float32)
 LABELS = rng.integers(0, 2, size=12).astype(np.int32)

@@ -13,8 +13,9 @@ from PIL import Image
 
 from deepfake_detection_tpu.data import native
 
-pytestmark = pytest.mark.skipif(not native.available(),
-                                reason="native decoder unavailable")
+pytestmark = [pytest.mark.smoke,
+              pytest.mark.skipif(not native.available(),
+                                 reason="native decoder unavailable")]
 
 
 @pytest.fixture(scope="module")

@@ -9,6 +9,8 @@ import pytest
 from deepfake_detection_tpu.optim import (create_optimizer, lookahead,
                                           rmsprop_tf, weight_decay_mask)
 
+pytestmark = pytest.mark.smoke  # fast tier: see pyproject [tool.pytest]
+
 
 def _np_rmsprop_tf_steps(p0, grads, lr, alpha=0.9, eps=1e-10, momentum=0.9):
     """Independent numpy model of the TF-RMSprop semantics documented in

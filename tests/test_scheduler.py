@@ -8,6 +8,8 @@ from deepfake_detection_tpu.scheduler import (CosineSchedule, PlateauSchedule,
                                               StepSchedule, TanhSchedule,
                                               create_scheduler)
 
+pytestmark = pytest.mark.smoke  # fast tier: see pyproject [tool.pytest]
+
 
 class TestStepSchedule:
     def test_canonical_run(self):

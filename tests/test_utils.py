@@ -6,9 +6,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import pytest
+
 from deepfake_detection_tpu.utils import (AverageMeter, accuracy, get_outdir,
                                           init_ema, masked_mean,
                                           update_ema, update_summary)
+
+pytestmark = pytest.mark.smoke  # fast tier: see pyproject [tool.pytest]
 
 
 class TestAverageMeter:
