@@ -220,6 +220,7 @@ class TrainConfig:
     mesh_shape: Optional[Tuple[int, ...]] = None   # default: (n_devices,)
     mesh_axes: Tuple[str, ...] = ("data",)
     fsdp: bool = False                   # shard params over 'data' axis
+    grad_accum: int = 1  # microbatches accumulated per optimizer step
     tp_size: int = 1     # model-axis extent for transformer tensor
     # parallelism: builds a (data, model) 2-D mesh and applies the
     # Megatron-paired shardings from parallel/tp.py (ViT/TimeSformer)
@@ -236,6 +237,9 @@ class TrainConfig:
             self.scale = tuple(self.scale)
         if isinstance(self.ratio, list):
             self.ratio = tuple(self.ratio)
+        if int(self.grad_accum) < 1:
+            raise ValueError(f"--grad-accum must be >= 1, "
+                             f"got {self.grad_accum}")
         if self.checkpoint_policy not in ("none", "full", "dots"):
             raise ValueError("checkpoint_policy must be none|full|dots, got "
                              f"{self.checkpoint_policy!r}")

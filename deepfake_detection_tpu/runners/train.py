@@ -169,7 +169,10 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
                      "axis (tp_size=%d)", cfg.tp_size)
 
     # linear LR scaling: per-device batch × total devices (train.py:814)
-    lr = cfg.resolved_lr(world_size=dp_size)
+    # effective batch per optimizer step includes the accumulated
+    # microbatches — the linear rule must see it, or the flagship config
+    # trains with an LR grad_accum-times below the reference's
+    lr = cfg.resolved_lr(world_size=dp_size * cfg.grad_accum)
     tx = create_optimizer(cfg, learning_rate=lr)
     state = create_train_state(variables, tx, with_ema=cfg.model_ema)
 
@@ -202,8 +205,12 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     sharding = batch_sharding(mesh)
     # loaders produce the *per-process* slice of the global batch; the device
     # prologue assembles the global sharded array
-    global_batch = cfg.batch_size * dp_size
+    # grad_accum microbatches ride inside one compiled step: the loader
+    # assembles the full effective batch per step (train only — eval is a
+    # single forward, so it must NOT inherit the accumulation factor)
+    global_batch = cfg.batch_size * dp_size * cfg.grad_accum
     local_batch = global_batch // jax.process_count()
+    eval_local_batch = cfg.batch_size * dp_size * 2 // jax.process_count()
     loader_kwargs = dict(
         mean=data_config["mean"], std=data_config["std"],
         num_workers=cfg.workers, seed=cfg.seed,
@@ -222,7 +229,7 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         flicker=cfg.flicker, rotate_range=cfg.rotate_range,
         blur_radiu=1, blur_prob=cfg.blur_prob, **loader_kwargs)
     eval_loader = create_deepfake_loader_v3(
-        eval_ds, input_size, local_batch * 2, is_training=False,
+        eval_ds, input_size, eval_local_batch, is_training=False,
         **loader_kwargs)                          # eval bs ×2 (train.py:492)
 
     train_loss_fn = create_loss_fn(cfg)
@@ -234,7 +241,7 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     train_step = make_train_step(
         model, tx, train_loss_fn, mesh=mesh, bn_mode=bn_mode,
         ema_decay=cfg.model_ema_decay if cfg.model_ema else 0.0,
-        clip_grad=cfg.clip_grad)
+        clip_grad=cfg.clip_grad, grad_accum=cfg.grad_accum)
     eval_step = make_eval_step(model, cross_entropy)
     eval_step_ema = make_eval_step(model, cross_entropy, use_ema=True) \
         if cfg.model_ema else None

@@ -58,16 +58,26 @@ def make_train_step(model, tx: optax.GradientTransformation,
                     mesh: Optional[Mesh] = None, axis: str = "data",
                     bn_mode: str = "local", ema_decay: float = 0.0,
                     clip_grad: Optional[float] = None,
+                    grad_accum: int = 1,
                     donate: bool = True) -> Callable:
     """Build ``train_step(state, x, y, rng) -> (state, metrics)``.
 
     ``x`` is the (globally) batch-sharded NHWC input, ``y`` int labels or
     soft targets.  ``metrics`` = {'loss', 'prec1'} global-batch scalars
     (replaces the per-step ``reduce_tensor`` calls, train.py:625-627).
+
+    ``grad_accum > 1`` splits the batch into that many microbatches inside
+    the compiled step (a ``lax.scan``): gradients are averaged across
+    microbatches before ONE optimizer update, so effective batch = what the
+    reference reaches with more GPUs (no reference analog — the standard
+    TPU lever for the flagship 600²×12 config on few chips).  BN stats
+    thread through the scan (each microbatch updates the running stats,
+    like sequential smaller steps would).
     """
     assert bn_mode in ("local", "global"), bn_mode
+    assert grad_accum >= 1
 
-    def forward_backward(params, batch_stats, x, y, rng):
+    def forward_backward_one(params, batch_stats, x, y, rng):
         def lossf(p):
             variables = {"params": p, "batch_stats": batch_stats}
             out = model.apply(variables, x, training=True,
@@ -78,6 +88,43 @@ def make_train_step(model, tx: optax.GradientTransformation,
             lossf, has_aux=True)(params)
         prec1 = accuracy(logits, y)
         return loss, grads, new_stats, prec1
+
+    def forward_backward(params, batch_stats, x, y, rng, vary_axis=None):
+        if grad_accum == 1:
+            return forward_backward_one(params, batch_stats, x, y, rng)
+        b = x.shape[0]
+        assert b % grad_accum == 0, (b, grad_accum)
+        # strided split (row j of microbatch i = global row j*A + i): under
+        # a data-sharded batch each device keeps 1/A of ITS OWN rows per
+        # microbatch, so the jit/TP path needs no per-iteration reshuffle
+        # (a contiguous split would put microbatch 0 on the first dp/A
+        # devices only); gradient averaging is partition-invariant
+        xm = jnp.moveaxis(
+            x.reshape((b // grad_accum, grad_accum) + x.shape[1:]), 1, 0)
+        ym = jnp.moveaxis(
+            y.reshape((b // grad_accum, grad_accum) + y.shape[1:]), 1, 0)
+
+        def micro(carry, inp):
+            stats, gsum, lsum, psum_ = carry
+            xi, yi, i = inp
+            loss, grads, stats, prec1 = forward_backward_one(
+                params, stats, xi, yi, jax.random.fold_in(rng, i))
+            gsum = jax.tree.map(jnp.add, gsum, grads)
+            return (stats, gsum, lsum + loss, psum_ + prec1), None
+
+        g0 = jax.tree.map(jnp.zeros_like, params)
+        z = jnp.zeros((), jnp.float32)
+        carry0 = (batch_stats, g0, z, z)
+        if vary_axis is not None:
+            # inside shard_map the microbatch outputs are device-varying;
+            # the scan carry type must match from step 0
+            carry0 = jax.tree.map(
+                lambda v: lax.pcast(v, vary_axis, to="varying"), carry0)
+        (new_stats, gsum, lsum, psum_), _ = jax.lax.scan(
+            micro, carry0, (xm, ym, jnp.arange(grad_accum)))
+        inv = 1.0 / grad_accum
+        grads = jax.tree.map(lambda g: g * inv, gsum)
+        return lsum * inv, grads, new_stats, psum_ * inv
 
     def apply_updates(state: TrainState, grads, new_stats, loss, prec1):
         grads = _clip_grads(grads, clip_grad)
@@ -105,7 +152,7 @@ def make_train_step(model, tx: optax.GradientTransformation,
     def local_step(state: TrainState, x, y, rng):
         rng = jax.random.fold_in(rng, lax.axis_index(axis))
         loss, grads, new_stats, prec1 = forward_backward(
-            state.params, state.batch_stats, x, y, rng)
+            state.params, state.batch_stats, x, y, rng, vary_axis=axis)
         # one fused cross-replica mean for grads + stats + metrics
         loss, grads, new_stats, prec1 = lax.pmean(
             (loss, grads, new_stats, prec1), axis)
