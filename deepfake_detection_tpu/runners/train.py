@@ -51,7 +51,7 @@ from ..parallel import (batch_sharding, initialize_distributed, make_mesh,
 from ..scheduler import create_scheduler
 from ..train import (CheckpointSaver, create_train_state, make_eval_step,
                      make_train_step, restore_train_state, set_learning_rate,
-                     train_one_epoch, validate)
+                     train_one_epoch, validate, wait_pending_saves)
 from ..utils import get_outdir, setup_default_logging, update_summary
 
 _logger = logging.getLogger("train")
@@ -294,6 +294,7 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
                     metric=eval_metrics[cfg.eval_metric])
     except KeyboardInterrupt:                      # reference :588
         pass
+    wait_pending_saves()            # flush any in-flight recovery write
     if best_metric is not None:
         _logger.info("*** Best metric: %s (epoch %s)", best_metric,
                      best_epoch)

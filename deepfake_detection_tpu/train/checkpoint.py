@@ -23,6 +23,7 @@ import logging
 import operator
 import os
 import shutil
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -32,7 +33,7 @@ from flax import serialization
 _logger = logging.getLogger(__name__)
 
 __all__ = ["CheckpointSaver", "save_checkpoint_file", "load_checkpoint_file",
-           "restore_train_state"]
+           "restore_train_state", "wait_pending_saves"]
 
 _EXT = ".ckpt"
 
@@ -56,21 +57,61 @@ def _to_host(x: Any) -> np.ndarray:
     return np.asarray(x)
 
 
+# one background writer: at most one save in flight, joined before the next
+# (in-epoch recovery snapshots must not stall the train loop on disk IO —
+# the reference's torch.save blocked the epoch, utils.py:128-140)
+_write_pool = ThreadPoolExecutor(max_workers=1,
+                                 thread_name_prefix="ckpt-write")
+_pending: List = []
+
+
+def wait_pending_saves() -> None:
+    """Block until any in-flight async checkpoint write has completed.
+
+    Async writes are recovery snapshots — best-effort by design — so a
+    failed background write is logged against its own path, not raised
+    from whichever unrelated checkpoint call happens to join it.
+    """
+    while _pending:
+        path, fut = _pending.pop()
+        try:
+            fut.result()
+        except Exception as e:  # noqa: BLE001 — best-effort snapshot
+            _logger.error("async checkpoint write of %s failed: %r", path, e)
+
+
 def save_checkpoint_file(path: str, state: Any,
-                         meta: Optional[Dict[str, Any]] = None) -> None:
-    """Serialize {state, meta} atomically to ``path``."""
+                         meta: Optional[Dict[str, Any]] = None,
+                         async_write: bool = False) -> None:
+    """Serialize {state, meta} atomically to ``path``.
+
+    ``async_write=True`` fetches the state to host *now* (cheap; device
+    sync) but serializes + writes on a background thread so the caller
+    returns immediately.  Writes are ordered: a new save joins the
+    previous one BEFORE building its host payload (bounding host residency
+    to one state copy), and :func:`wait_pending_saves` flushes at exit.
+    """
+    wait_pending_saves()              # at most one write/payload at a time
     payload = {"state": jax.tree.map(_to_host,
                                      serialization.to_state_dict(state)),
                "meta": meta or {}}   # meta stays plain python (strs allowed)
-    blob = serialization.msgpack_serialize(payload)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-    os.replace(tmp, path)
+
+    def _write() -> None:
+        blob = serialization.msgpack_serialize(payload)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
+    if async_write:
+        _pending.append((path, _write_pool.submit(_write)))
+    else:
+        _write()
 
 
 def load_checkpoint_file(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     """Read a raw {state_dict, meta} pair."""
+    wait_pending_saves()
     with open(path, "rb") as f:
         payload = serialization.msgpack_restore(f.read())
     return payload["state"], payload.get("meta", {})
@@ -176,7 +217,8 @@ class CheckpointSaver:
             self.recovery_dir,
             f"{self.recovery_prefix}-{epoch}-{batch_idx}{_EXT}")
         save_checkpoint_file(path, state, dict(meta, epoch=epoch,
-                                               batch_idx=batch_idx))
+                                               batch_idx=batch_idx),
+                             async_write=True)
         if os.path.exists(self.last_recovery_file):
             try:
                 _logger.debug("Cleaning recovery: %s",

@@ -149,6 +149,9 @@ class TestCheckpointing:
         # recovery keeps only the current + one previous
         for b in range(3):
             saver.save_recovery(state, {}, epoch=5, batch_idx=b)
+        from deepfake_detection_tpu.train.checkpoint import \
+            wait_pending_saves
+        wait_pending_saves()        # recovery writes are async
         recs = [f for f in os.listdir(tmp_path / "out")
                 if f.startswith("recovery-")]
         assert len(recs) == 2
