@@ -190,7 +190,11 @@ class TrainConfig:
 
     # --- batch norm ---
     sync_bn: bool = False
-    dist_bn: str = ""                    # '' | 'broadcast' | 'reduce'
+    # '' | 'broadcast' | 'reduce' — accepted for launch-script parity; the
+    # TPU build pmean's BN stats inside every step (train/steps.py), which
+    # strictly supersedes the reference's per-epoch distribute_bn
+    dist_bn: str = ""
+
     split_bn: bool = False
 
     # --- EMA ---

