@@ -1,0 +1,27 @@
+"""tools/bench_input.py: the host-pipeline benchmark must keep working."""
+
+import os
+import sys
+from types import SimpleNamespace
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from tools import bench_input  # noqa: E402
+
+
+def test_build_and_measure(tmp_path, monkeypatch):
+    root = str(tmp_path / "clips")
+    os.makedirs(root)
+    bench_input.build_dataset(root, n_clips=6, size=64, frames=4)
+    assert os.path.isfile(os.path.join(root, "fake_list.txt"))
+    args = SimpleNamespace(clips=6, size=64, frames=4, batch=2, workers=1,
+                           epochs=1)
+    native_cps = bench_input.measure(root, args, native=True)
+    pil_cps = bench_input.measure(root, args, native=False)
+    assert native_cps > 0 and pil_cps > 0
+    # the toggle must be restored for later tests
+    monkeypatch.delenv("DFD_NO_NATIVE_DECODE", raising=False)
