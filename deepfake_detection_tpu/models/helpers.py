@@ -32,13 +32,47 @@ __all__ = ["maybe_remat",
            "adapt_input_params"]
 
 
+#: fused-qkv column layout of this codebase (models/vit.py): (H, 3, D)-major.
+#: Stamped into checkpoint meta so pre-layout-change checkpoints — whose
+#: param shapes are IDENTICAL but whose columns are (3, H, D)-major — are
+#: rejected at load instead of silently producing wrong logits.
+QKV_LAYOUT = "head_major"
+
+
+def has_fused_qkv(tree: Any) -> bool:
+    """True if a params (sub)tree contains a fused-qkv Dense module."""
+    if not isinstance(tree, dict):
+        return False
+    return any(k == "qkv" and isinstance(v, dict) or has_fused_qkv(v)
+               for k, v in tree.items())
+
+
+def check_qkv_layout(variables: Dict[str, Any], meta: Dict[str, Any],
+                     path: str) -> None:
+    """Reject transformer checkpoints that predate the head-major layout."""
+    if has_fused_qkv(variables.get("params", {})) \
+            and meta.get("qkv_layout") != QKV_LAYOUT:
+        raise ValueError(
+            f"{path}: ViT/TimeSformer checkpoint lacks the "
+            f"qkv_layout={QKV_LAYOUT!r} marker, i.e. it predates the "
+            f"head-major fused-qkv layout (models/vit.py). Its qkv columns "
+            f"are (3, H, D)-major and would load shape-compatibly but "
+            f"produce silently-wrong logits. Re-train, or re-convert the "
+            f"source torch checkpoint with tools/convert_torch_checkpoint.py.")
+
+
 def save_model_checkpoint(path: str, variables: Dict[str, Any],
                           meta: Optional[Dict[str, Any]] = None) -> None:
-    payload = {"variables": unfreeze(variables) if isinstance(
-        variables, flax.core.FrozenDict) else variables,
-        "meta": meta or {}}
-    blob = serialization.msgpack_serialize(
-        jax.tree.map(np.asarray, payload))
+    meta = dict(meta or {})
+    if has_fused_qkv(variables.get("params", {})):
+        meta.setdefault("qkv_layout", QKV_LAYOUT)
+    variables = unfreeze(variables) if isinstance(
+        variables, flax.core.FrozenDict) else variables
+    # np-convert only the arrays; meta stays plain python — np.asarray on a
+    # str makes a '<U*' scalar that msgpack_restore cannot round-trip
+    payload = {"variables": jax.tree.map(np.asarray, variables),
+               "meta": meta}
+    blob = serialization.msgpack_serialize(payload)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(blob)
@@ -60,17 +94,21 @@ def load_state_dict(checkpoint_path: str, use_ema: bool = False) -> Dict[str, An
         ema = st.get("ema") or None
         if use_ema and ema:
             _logger.info("Loaded EMA stream from %s", checkpoint_path)
-            return {"params": ema["params"],
-                    "batch_stats": ema.get("batch_stats", {})}
-        return {"params": st["params"],
-                "batch_stats": st.get("batch_stats", {})}
-    if use_ema and "variables_ema" in payload:
+            out = {"params": ema["params"],
+                   "batch_stats": ema.get("batch_stats", {})}
+        else:
+            out = {"params": st["params"],
+                   "batch_stats": st.get("batch_stats", {})}
+    elif use_ema and "variables_ema" in payload:
         _logger.info("Loaded state_dict_ema from %s", checkpoint_path)
-        return payload["variables_ema"]
-    if use_ema and meta.get("has_ema"):
+        out = payload["variables_ema"]
+    elif use_ema and meta.get("has_ema"):
         _logger.info("Loaded EMA stream from %s", checkpoint_path)
-        return payload.get("variables_ema", payload["variables"])
-    return payload["variables"]
+        out = payload.get("variables_ema", payload["variables"])
+    else:
+        out = payload["variables"]
+    check_qkv_layout(out, meta, checkpoint_path)
+    return out
 
 
 def _flatten(tree, prefix=()):
@@ -142,8 +180,9 @@ def resume_checkpoint(init_variables: Dict[str, Any],
     """
     with open(checkpoint_path, "rb") as f:
         payload = serialization.msgpack_restore(f.read())
-    variables, _ = filter_shape_mismatch(init_variables, payload["variables"])
     meta = payload.get("meta", {})
+    check_qkv_layout(payload["variables"], meta, checkpoint_path)
+    variables, _ = filter_shape_mismatch(init_variables, payload["variables"])
     start_epoch = int(meta.get("epoch", -1)) + 1
     _logger.info("Resumed from %s (epoch %d)", checkpoint_path, start_epoch - 1)
     return variables, meta, start_epoch

@@ -70,7 +70,7 @@ class _DividedBlock(nn.Module):
             return y
 
         # temporal: each spatial location attends across its F frames
-        y = nn.LayerNorm(dtype=self.dtype, name="norm_t")(x)
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm_t")(x)
         y = y.transpose(0, 2, 1, 3).reshape(B * N, F, C)
         # F is tiny (4): always the dense kernel — one fused batched GEMM
         y = _Attention(self.num_heads, attn_impl="full", dtype=self.dtype,
@@ -79,7 +79,7 @@ class _DividedBlock(nn.Module):
         x = x + droppath("dp_t", y)
 
         # spatial: patches attend within their own frame
-        y = nn.LayerNorm(dtype=self.dtype, name="norm_s")(x)
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm_s")(x)
         y = _Attention(self.num_heads, attn_impl=self.attn_impl,
                        sp_mesh=self.sp_mesh, seq_axis=self.seq_axis,
                        dtype=self.dtype,
@@ -87,10 +87,10 @@ class _DividedBlock(nn.Module):
         y = y.reshape(B, F, N, C)
         x = x + droppath("dp_s", y)
 
-        y = nn.LayerNorm(dtype=self.dtype, name="norm_mlp")(x)
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm_mlp")(x)
         y = nn.Dense(int(C * self.mlp_ratio), dtype=self.dtype,
                      name="mlp_fc1")(y)
-        y = nn.gelu(y)
+        y = nn.gelu(y, approximate=False)
         y = nn.Dense(C, dtype=self.dtype, name="mlp_fc2")(y)
         return x + droppath("dp_mlp", y)
 
@@ -154,7 +154,7 @@ class TimeSformer(nn.Module):
                           dtype=self.dtype,
                           name=f"blocks_{i}")(x, training)
             feats.append(x)
-        x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm")(x)
         if features_only:
             feats[-1] = x
             return feats

@@ -14,8 +14,15 @@ TPU notes:
 * All matmuls are (B·L, D)×(D, ·) GEMMs on the MXU; LayerNorm and GELU fuse
   into the surrounding dots under XLA.
 * Architectural layout (pre-LN, learned pos-embed, optional class token)
-  follows the ViT paper / timm conventions so torch ViT checkpoints map
-  mechanically (tools/convert_torch_checkpoint.py).
+  follows the ViT paper / timm conventions, EXCEPT the fused-qkv output
+  layout: the 3C columns are HEAD-MAJOR (H, 3, D), not timm's (3, H, D),
+  so tensor-parallel sharding of the qkv kernel propagates through the
+  reshape (see parallel/tp.py).  A torch ViT checkpoint import must
+  permute the qkv kernel/bias columns accordingly
+  (tools/convert_torch_checkpoint.py's ViT path does this); loading
+  timm-layout columns unpermuted yields silently-wrong logits.
+* Checkpoint-parity numerics: LayerNorm ε=1e-5 and exact (erf) GELU match
+  torch/timm — both fuse identically under XLA, so parity costs nothing.
 """
 
 from __future__ import annotations
@@ -59,8 +66,13 @@ class _Attention(nn.Module):
         H = self.num_heads
         qkv = nn.Dense(3 * C, use_bias=self.qkv_bias, dtype=self.dtype,
                        name="qkv")(x)
-        q, k, v = jnp.split(qkv.reshape(B, L, 3, H, C // H), 3, axis=2)
-        q, k, v = (t[:, :, 0] for t in (q, k, v))      # (B, L, H, D)
+        # head-major fused-qkv layout (H, 3, D), not timm's (3, H, D): under
+        # tensor parallelism the qkv kernel's 3C output dim is sharded over
+        # the 'model' axis (parallel/tp.py), and only an H-major split lets
+        # GSPMD propagate that sharding through this reshape (H % tp == 0;
+        # a leading factor 3 would force an all-gather + reshard here)
+        qkv = qkv.reshape(B, L, H, 3, C // H)
+        q, k, v = (qkv[:, :, :, i] for i in range(3))  # (B, L, H, D)
         if self.attn_impl == "flash":
             # fused Pallas kernel: scores stay in VMEM, O(L) HBM traffic
             out = flash_attention(q, k, v)
@@ -89,7 +101,7 @@ class _Block(nn.Module):
     @nn.compact
     def __call__(self, x, training: bool = False):
         C = x.shape[-1]
-        y = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm1")(x)
         y = _Attention(self.num_heads, self.qkv_bias, self.attn_impl,
                        self.sp_mesh, self.seq_axis, dtype=self.dtype,
                        name="attn")(y)
@@ -99,10 +111,10 @@ class _Block(nn.Module):
             y = DropPath(self.drop_path_rate, name="drop_path1")(
                 y, training=training)
         x = x + y
-        y = nn.LayerNorm(dtype=self.dtype, name="norm2")(x)
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm2")(x)
         y = nn.Dense(int(C * self.mlp_ratio), dtype=self.dtype,
                      name="mlp_fc1")(y)
-        y = nn.gelu(y)
+        y = nn.gelu(y, approximate=False)
         if self.drop_rate:
             y = nn.Dropout(self.drop_rate, deterministic=not training)(y)
         y = nn.Dense(C, dtype=self.dtype, name="mlp_fc2")(y)
@@ -175,7 +187,7 @@ class VisionTransformer(nn.Module):
                           self.seq_axis, dtype=self.dtype,
                           name=f"blocks_{i}")(x, training)
             feats.append(x)
-        x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm")(x)
         if features_only:
             feats[-1] = x
             return feats
@@ -255,7 +267,7 @@ def vit_pipeline_forward(model: "VisionTransformer", variables, x,
                                 num_microbatches, axis=axis)
 
     # --- head (replicated) -----------------------------------------------
-    h = nn.LayerNorm(dtype=model.dtype).apply({"params": p["norm"]}, h)
+    h = nn.LayerNorm(epsilon=1e-5, dtype=model.dtype).apply({"params": p["norm"]}, h)
     if model.global_pool == "avg":
         start = 1 if model.class_token else 0
         feat = h[:, start:].mean(axis=1)

@@ -416,6 +416,12 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     defaults to True off-TPU so tests run on the CPU interpreter.
     """
     assert q.ndim == 4, f"expected (B, L, H, D), got {q.shape}"
+    # self-attention shapes only: prep() folds (B, H) together and pads with
+    # q's L, so a cross-attention Lk != Lq would die deep inside prep with an
+    # opaque reshape error — reject it here instead
+    assert q.shape == k.shape == v.shape, (
+        f"flash_attention supports self-attention shapes only "
+        f"(q{q.shape} k{k.shape} v{v.shape} must be equal)")
     b, l, h, d = q.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

@@ -9,7 +9,12 @@ axis and let GSPMD partition the einsums and insert the all-reduces over ICI
 collectives).
 
 Sharding rules follow the Megatron pairing so each block needs exactly one
-all-reduce per attention and one per MLP:
+all-reduce per attention and one per MLP.  This relies on vit.py's
+head-major fused-qkv layout (the 3C output dim reshapes to (H, 3, D)): the
+column sharding on 3C then lands on the head dim and propagates through the
+reshape whenever ``H % tp_size == 0``; timm's (3, H, D) layout would instead
+put the sharding under a leading factor 3 and force GSPMD to insert an extra
+all-gather/reshard per attention.
 
 * column-parallel (output feature dim sharded): ``qkv`` and ``mlp_fc1``
   kernels/biases — each device computes its own head/hidden shard;

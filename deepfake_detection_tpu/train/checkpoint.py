@@ -92,9 +92,12 @@ def save_checkpoint_file(path: str, state: Any,
     to one state copy), and :func:`wait_pending_saves` flushes at exit.
     """
     wait_pending_saves()              # at most one write/payload at a time
-    payload = {"state": jax.tree.map(_to_host,
-                                     serialization.to_state_dict(state)),
-               "meta": meta or {}}   # meta stays plain python (strs allowed)
+    from ..models.helpers import QKV_LAYOUT, has_fused_qkv
+    meta = dict(meta or {})           # meta stays plain python (strs allowed)
+    sd = jax.tree.map(_to_host, serialization.to_state_dict(state))
+    if has_fused_qkv(sd.get("params", {})):
+        meta.setdefault("qkv_layout", QKV_LAYOUT)
+    payload = {"state": sd, "meta": meta}
 
     def _write() -> None:
         blob = serialization.msgpack_serialize(payload)
@@ -114,7 +117,10 @@ def load_checkpoint_file(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     wait_pending_saves()
     with open(path, "rb") as f:
         payload = serialization.msgpack_restore(f.read())
-    return payload["state"], payload.get("meta", {})
+    sd, meta = payload["state"], payload.get("meta", {})
+    from ..models.helpers import check_qkv_layout
+    check_qkv_layout(sd, meta, path)
+    return sd, meta
 
 
 def restore_train_state(path: str, target_state: Any,

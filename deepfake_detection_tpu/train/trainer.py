@@ -186,23 +186,16 @@ def validate(eval_step: Callable, state: TrainState, loader, cfg,
         logits = metrics.get("logits")
         if logits is not None and logits.shape[-1] == 2:
             # P(real): labels are 0=fake / 1=real, so AUC ranks real above
-            # fake (the released-checkpoint quality gate, BASELINE.md)
-            scores = jax.nn.softmax(logits, axis=-1)[:, 1]
-            y_h, v_h = y, valid
-            if jax.process_count() > 1:
-                # the global batch spans non-addressable devices; gather it
-                # before pulling to host
-                from jax.experimental import multihost_utils
-                gathered = multihost_utils.process_allgather(
-                    (scores, y) if valid is None else (scores, y, valid),
-                    tiled=True)
-                scores, y_h = gathered[0], gathered[1]
-                v_h = gathered[2] if valid is not None else None
-            scores = np.asarray(scores, np.float32).reshape(-1)
-            all_scores.append(scores)
-            all_labels.append(np.asarray(y_h).reshape(-1))
-            all_valid.append(np.ones(len(scores)) if v_h is None
-                             else np.asarray(v_h, np.float32).reshape(-1))
+            # fake (the released-checkpoint quality gate, BASELINE.md).
+            # Accumulate only this process's rows here; the cross-process
+            # gather happens ONCE after the loop (a per-batch allgather
+            # would force a host sync every eval batch).
+            scores = _host_local_rows(jax.nn.softmax(logits, axis=-1)[:, 1])
+            all_scores.append(scores.astype(np.float32).reshape(-1))
+            all_labels.append(_host_local_rows(y).reshape(-1))
+            all_valid.append(np.ones(len(scores), np.float32) if valid is None
+                             else _host_local_rows(valid)
+                             .astype(np.float32).reshape(-1))
         batch_time_m.update(time.time() - end)
         if batch_idx == last_idx or batch_idx % cfg.log_interval == 0:
             _logger.info(
@@ -214,8 +207,32 @@ def validate(eval_step: Callable, state: TrainState, loader, cfg,
         end = time.time()
     out = OrderedDict([("loss", losses_m.avg), ("prec1", prec1_m.avg)])
     if all_scores:
-        out["auc"] = float(auc(np.concatenate(all_scores),
-                               np.concatenate(all_labels),
-                               np.concatenate(all_valid)))
+        scores = np.concatenate(all_scores)
+        labels = np.concatenate(all_labels)
+        valids = np.concatenate(all_valid)
+        if jax.process_count() > 1:
+            # one gather for the whole epoch; AUC is a rank statistic, so
+            # cross-process row order is irrelevant
+            from jax.experimental import multihost_utils
+            scores, labels, valids = multihost_utils.process_allgather(
+                (scores, labels, valids), tiled=True)
+        out["auc"] = float(auc(scores, labels, valids))
         _logger.info("%s: AUC %.5f", log_name, out["auc"])
     return out
+
+
+def _host_local_rows(a) -> np.ndarray:
+    """This process's rows of an axis-0-sharded array, as numpy.
+
+    Single-process (and plain numpy input): the whole array.  Multi-process:
+    the addressable shards, deduplicated by row range (a replicated array has
+    one full copy per local device) and stitched in row order.
+    """
+    if isinstance(a, np.ndarray) or jax.process_count() == 1:
+        return np.asarray(a)
+    uniq = {}
+    for s in a.addressable_shards:
+        idx = s.index[0] if s.index else slice(None)
+        uniq.setdefault((idx.start, idx.stop), s)
+    shards = [uniq[k] for k in sorted(uniq, key=lambda t: t[0] or 0)]
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)

@@ -69,15 +69,19 @@ def auc(scores: jnp.ndarray, labels: jnp.ndarray,
     evaluated by AUC (README.md:35-40) and the north-star quality gate is
     "AUC ≥ the released GPU checkpoint" (BASELINE.md) — so the framework
     ships the metric.  Pure jnp, O(n log n), static-shaped (ties get the
-    usual midrank treatment), so it can run inside a jitted eval epoch;
-    ``weight`` masks padded samples from the ordered sharded eval sampler.
+    usual midrank treatment), so it can run inside a jitted eval epoch.
+
+    ``weight`` is a {0, 1} VALIDITY MASK (padded samples from the ordered
+    sharded eval sampler), not a general sample weight: midranks are
+    computed unweighted, so fractional weights would silently produce a
+    wrong AUC.  Anything > 0 is treated as valid.
 
     ``scores``: higher ⇒ more positive; ``labels``: {0, 1}.
     """
     scores = scores.astype(jnp.float32).reshape(-1)
     labels = labels.reshape(-1)
     w = (jnp.ones_like(scores) if weight is None
-         else weight.reshape(-1).astype(jnp.float32))
+         else (weight.reshape(-1) > 0).astype(jnp.float32))
     # midranks of the scores, computed without dynamic shapes: for each
     # element, rank = (#strictly-smaller) + (#equal + 1) / 2, with masked
     # entries pushed out of the comparison by ±inf on either side

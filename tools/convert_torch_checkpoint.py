@@ -19,6 +19,14 @@ model surface (``create_deepfake_model_v4``); the flax tree deliberately
 mirrors timm's module names (``blocks.{s}.{b}.conv_pw`` ↔
 ``blocks_{s}_{b}.conv_pw``) so the translation is direct.
 
+A second mapping covers the ViT family (this repo's extension backbone;
+timm-style checkpoints).  Besides the layout transposes it PERMUTES the
+fused-qkv output columns from timm's (3, H, D) order to this repo's
+head-major (H, 3, D) order (models/vit.py) — required for tensor-parallel
+sharding to propagate through the qkv reshape (parallel/tp.py); loading the
+columns unpermuted would yield silently-wrong logits.  The family is
+auto-detected from the state-dict keys.
+
 Usage::
 
     python tools/convert_torch_checkpoint.py model_half.pth.tar out.msgpack \
@@ -78,27 +86,77 @@ def map_key(torch_key: str) -> Optional[Tuple[str, str]]:
     return None
 
 
-def _transform_value(flax_path: str, v: np.ndarray) -> np.ndarray:
+def map_key_vit(torch_key: str) -> Optional[Tuple[str, str]]:
+    """timm ViT dotted key → (collection, flax dotted path); None = drop."""
+    key = torch_key
+    if key.startswith("module."):
+        key = key[len("module."):]
+    parts = key.split(".")
+    head, leaf = parts[0], parts[-1]
+    wk = "kernel" if leaf == "weight" else "bias"       # Dense/Conv leaves
+    sk = "scale" if leaf == "weight" else "bias"        # LayerNorm leaves
+    if head in ("cls_token", "pos_embed"):
+        return "params", head
+    if head == "patch_embed":                           # patch_embed.proj.*
+        return "params", f"patch_embed.{wk}"
+    if head == "norm":
+        return "params", f"norm.{sk}"
+    if head == "head":
+        return "params", f"head.{wk}"
+    if head == "blocks" and len(parts) >= 4:
+        prefix, rest = f"blocks_{parts[1]}", parts[2:]
+        if rest[0] in ("norm1", "norm2"):
+            return "params", f"{prefix}.{rest[0]}.{sk}"
+        if rest[0] == "attn" and rest[1] in ("qkv", "proj"):
+            return "params", f"{prefix}.attn.{rest[1]}.{wk}"
+        if rest[0] == "mlp" and rest[1] in ("fc1", "fc2"):
+            return "params", f"{prefix}.mlp_{rest[1]}.{wk}"
+    return None
+
+
+def _transform_value(flax_path: str, v: np.ndarray,
+                     num_heads: Optional[int] = None) -> np.ndarray:
     if v.ndim == 4:
-        return np.transpose(v, (2, 3, 1, 0))          # OIHW → HWIO
-    if v.ndim == 2 and flax_path.endswith("kernel"):
-        return np.transpose(v, (1, 0))                # (out,in) → (in,out)
+        v = np.transpose(v, (2, 3, 1, 0))             # OIHW → HWIO
+    elif v.ndim == 2 and flax_path.endswith("kernel"):
+        v = np.transpose(v, (1, 0))                   # (out,in) → (in,out)
+    if ".attn.qkv." in flax_path:
+        # timm packs the 3C output columns (3, H, D)-major; this repo's
+        # _Attention reads them (H, 3, D)-major (models/vit.py)
+        assert num_heads, "ViT qkv conversion needs num_heads"
+        d3 = v.shape[-1]
+        d = d3 // (3 * num_heads)
+        v = v.reshape(v.shape[:-1] + (3, num_heads, d))
+        v = np.moveaxis(v, -3, -2).reshape(v.shape[:-3] + (d3,))
     return v
 
 
-def convert_state_dict(sd: Dict[str, Any]) -> Dict[str, Any]:
-    """Torch state dict → {'params': tree, 'batch_stats': tree}."""
+def _is_vit_sd(sd: Dict[str, Any]) -> bool:
+    """ViT-family state dict ⇔ fused-qkv attention keys present."""
+    return any(".attn.qkv." in k for k in sd)
+
+
+def convert_state_dict(sd: Dict[str, Any],
+                       num_heads: Optional[int] = None) -> Dict[str, Any]:
+    """Torch state dict → {'params': tree, 'batch_stats': tree}.
+
+    Family auto-detected from the keys: ``attn.qkv`` anywhere ⇒ ViT mapping
+    (``num_heads`` then required for the qkv column permute), else the
+    EfficientNet mapping.
+    """
+    keymap = map_key_vit if _is_vit_sd(sd) else map_key
     out: Dict[str, Dict[str, Any]] = {"params": {}, "batch_stats": {}}
     unmapped = []
     for k, v in sd.items():
-        mapped = map_key(k)
+        mapped = keymap(k)
         if mapped is None:
             if not k.endswith("num_batches_tracked"):
                 unmapped.append(k)
             continue
         collection, path = mapped
         arr = _transform_value(path, np.asarray(
-            v.float().cpu().numpy() if hasattr(v, "cpu") else v))
+            v.float().cpu().numpy() if hasattr(v, "cpu") else v),
+            num_heads=num_heads)
         node = out[collection]
         parts = path.split(".")
         for p in parts[:-1]:
@@ -110,7 +168,8 @@ def convert_state_dict(sd: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
-def convert_checkpoint(path: str, use_ema: bool = False) -> Dict[str, Any]:
+def convert_checkpoint(path: str, use_ema: bool = False,
+                       model_name: Optional[str] = None) -> Dict[str, Any]:
     import torch
     ckpt = torch.load(path, map_location="cpu", weights_only=False)
     if isinstance(ckpt, dict) and "state_dict" in ckpt:
@@ -119,7 +178,44 @@ def convert_checkpoint(path: str, use_ema: bool = False) -> Dict[str, Any]:
         sd = ckpt[key]
     else:
         sd = ckpt
-    return convert_state_dict(sd)
+    num_heads = None
+    if _is_vit_sd(sd):
+        num_heads = _resolve_vit_num_heads(sd, model_name)
+    return convert_state_dict(sd, num_heads=num_heads)
+
+
+def _resolve_vit_num_heads(sd: Dict[str, Any],
+                           model_name: Optional[str]) -> int:
+    """num_heads for the qkv permute, cross-checked against the checkpoint.
+
+    A wrong head count permutes the columns shape-compatibly — ``--verify``
+    can't catch it — so refuse to guess: ``--model`` must name a ViT-family
+    model whose embed_dim and depth match the state dict exactly.
+    """
+    from deepfake_detection_tpu.models import create_model
+    model = create_model(model_name) if model_name else None
+    num_heads = getattr(model, "num_heads", None)
+    if not num_heads:
+        raise SystemExit(
+            f"checkpoint has fused-qkv (ViT-family) keys but --model "
+            f"{model_name!r} has no num_heads; pass the matching vit_* / "
+            f"timesformer_* model name (the qkv column permute needs the "
+            f"head count, and shapes alone cannot reveal a wrong one)")
+    qkv_key = next(k for k in sd
+                   if ".attn.qkv." in k and k.endswith("weight"))
+    embed_dim = sd[qkv_key].shape[-1]
+    stripped = [k[len("module."):] if k.startswith("module.") else k
+                for k in sd]
+    depth = 1 + max(int(k.split(".")[1]) for k in stripped
+                    if k.startswith("blocks."))
+    want = (getattr(model, "embed_dim", None), getattr(model, "depth", None))
+    if want != (embed_dim, depth):
+        raise SystemExit(
+            f"--model {model_name!r} (embed_dim={want[0]}, depth={want[1]}) "
+            f"does not match the checkpoint (embed_dim={embed_dim}, "
+            f"depth={depth}); a mismatched model would permute the qkv "
+            f"columns with the wrong head count")
+    return num_heads
 
 
 def verify_against_model(variables: Dict[str, Any], model_name: str) -> int:
@@ -165,7 +261,8 @@ def main(argv=None) -> None:
                     help="check the converted tree matches --model's "
                          "structure exactly")
     args = ap.parse_args(argv)
-    variables = convert_checkpoint(args.torch_ckpt, use_ema=args.ema)
+    variables = convert_checkpoint(args.torch_ckpt, use_ema=args.ema,
+                                   model_name=args.model)
     if args.verify and verify_against_model(variables, args.model):
         print("verification FAILED", file=sys.stderr)
         sys.exit(1)
