@@ -214,6 +214,7 @@ class TrainConfig:
     save_images: bool = False
     output: str = "./output"
     eval_metric: str = "loss"
+    eval_crop: str = "random"  # random = reference parity; center = deterministic eval
     tta: int = 0
     use_multi_epochs_loader: bool = False
     json_file: str = ""                  # cluster topology JSON

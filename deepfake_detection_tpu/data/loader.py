@@ -261,6 +261,7 @@ def create_deepfake_loader_v3(
         rotate_range: float = 0, blur_radiu: float = 0,
         blur_prob: float = 0.0, seed: int = 42, prefetch_depth: int = 2,
         sharding: Optional[Any] = None, valid_mask: Optional[bool] = None,
+        eval_crop: str = "random",
         ) -> DeviceLoader:
     """Loader factory (reference loader.py:724-830): builds the v3 transform,
     picks the train/eval sharded sampler, wires collate mixup and the device
@@ -279,7 +280,7 @@ def create_deepfake_loader_v3(
             rotate_range=rotate_range, blur_radiu=blur_radiu,
             blur_prob=blur_prob)
     else:
-        transform = transforms_deepfake_eval_v3(img_size)
+        transform = transforms_deepfake_eval_v3(img_size, crop=eval_crop)
     if is_training and num_aug_splits > 1:
         # clean + (num_aug_splits-1) AugMix views per sample, feeding the
         # JSD consistency loss (reference dataset.py:633-670)

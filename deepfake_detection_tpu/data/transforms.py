@@ -358,6 +358,23 @@ class MultiRandomCrop(RandomCrop):
         return [img.crop((left, top, left + tw, top + th)) for img in imgs]
 
 
+class MultiCenterCrop(CenterCrop):
+    """Deterministic center crop of every frame, pad_if_needed.
+
+    No reference analog — the reference evaluates with a *random* crop
+    (transforms_factory.py:225-236); this is the opt-in deterministic eval
+    (``--eval-crop center``) for clean AUC comparisons across runs."""
+
+    def __init__(self, size, fill: int = 0):
+        super().__init__(size)
+        self.fill = fill
+
+    def __call__(self, imgs, rng=None):
+        th, tw = self.size
+        imgs = [_pad_to(img, tw, th, self.fill) for img in imgs]
+        return [CenterCrop.__call__(self, img) for img in imgs]
+
+
 class MultiColorJitter(ColorJitter):
     """One jitter parameter draw shared by all frames (reference :332-343)."""
 

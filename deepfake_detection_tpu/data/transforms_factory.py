@@ -28,7 +28,8 @@ from .auto_augment import (augment_and_mix_transform, auto_augment_transform,
 from .constants import (DEFAULT_CROP_PCT, IMAGENET_DEFAULT_MEAN,
                         IMAGENET_DEFAULT_STD)
 from .transforms import (CenterCrop, ColorJitter, Compose, MultiBlur,
-                         MultiColorJitter, MultiConcate, MultiFlicker,
+                         MultiCenterCrop, MultiColorJitter, MultiConcate,
+                         MultiFlicker,
                          MultiRandomCrop, MultiRandomHorizontalFlip,
                          MultiRandomResize, MultiRotate, MultiToNumpy,
                          RandomHorizontalFlip,
@@ -67,11 +68,17 @@ def transforms_deepfake_train_v3(
     return Compose(primary + secondary + final)
 
 
-def transforms_deepfake_eval_v3(img_size: Union[int, Tuple[int, int]] = 224
-                                ) -> Compose:
-    """Eval pipeline — random crop only, per the reference (:225-236)."""
-    return Compose([MultiRandomCrop(img_size, pad_if_needed=True),
-                    MultiToNumpy(), MultiConcate()])
+def transforms_deepfake_eval_v3(img_size: Union[int, Tuple[int, int]] = 224,
+                                crop: str = "random") -> Compose:
+    """Eval pipeline (reference :225-236).
+
+    ``crop='random'`` reproduces the reference quirk (eval uses a *random*
+    crop — parity default); ``crop='center'`` is the opt-in deterministic
+    eval (``--eval-crop center``) for run-to-run comparable AUC."""
+    assert crop in ("random", "center"), crop
+    crop_t = (MultiRandomCrop(img_size, pad_if_needed=True)
+              if crop == "random" else MultiCenterCrop(img_size))
+    return Compose([crop_t, MultiToNumpy(), MultiConcate()])
 
 
 def transforms_imagenet_train(

@@ -230,6 +230,7 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         blur_radiu=1, blur_prob=cfg.blur_prob, **loader_kwargs)
     eval_loader = create_deepfake_loader_v3(
         eval_ds, input_size, eval_local_batch, is_training=False,
+        eval_crop=cfg.eval_crop,
         **loader_kwargs)                          # eval bs ×2 (train.py:492)
 
     train_loss_fn = create_loss_fn(cfg)
@@ -264,6 +265,15 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         saver = CheckpointSaver(
             checkpoint_dir=output_dir, bak_dir=os.path.join(
                 output_dir, "_bak"), decreasing=decreasing)
+
+    if jax.process_count() > 1:
+        # all host-side setup (datasets, eager init, output dir) is done —
+        # meet here so a fast rank doesn't reach the first collective while
+        # a slow one is still initializing: cross-process collective-context
+        # creation (gloo on CPU; similar rendezvous on DCN) has a short
+        # deadline that host-side skew alone can blow
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("train_start")
 
     meta = {"arch": cfg.model, "version": 2}
     best_metric, best_epoch = None, None

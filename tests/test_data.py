@@ -176,6 +176,55 @@ class TestDeepFakeClipDataset:
         img, y = ds[0]
         assert img.shape == (32, 32, 12)
 
+    def test_eval_crop_center_deterministic(self, tmp_path):
+        """--eval-crop center: identical pixels across epochs; the parity
+        default (random) draws a fresh window per (epoch, index)."""
+        from deepfake_detection_tpu.data import create_deepfake_loader_v3
+        root = str(tmp_path / "d")
+        _make_v3_tree(root, n_real=2, n_fake=2)
+        # gradient frames, larger than the 32² crop, so the window matters
+        grad = np.add.outer(np.arange(48), np.arange(48)) % 256
+        img = Image.fromarray(np.stack([grad] * 3, -1).astype(np.uint8))
+        for kind in ("real", "fake"):
+            for d in os.listdir(os.path.join(root, kind)):
+                for f in os.listdir(os.path.join(root, kind, d)):
+                    img.save(os.path.join(root, kind, d, f))
+
+        def first_batch(crop, epoch):
+            ds = DeepFakeClipDataset(root)
+            loader = create_deepfake_loader_v3(
+                ds, (12, 32, 32), 2, is_training=False, num_workers=0,
+                dtype=np.float32, eval_crop=crop)
+            loader.set_epoch(epoch)     # drives the (seed, epoch, idx) rng
+            x, *_ = next(iter(loader))
+            return np.asarray(x)
+
+        np.testing.assert_array_equal(first_batch("center", 0),
+                                      first_batch("center", 7))
+        assert not np.array_equal(first_batch("random", 0),
+                                  first_batch("random", 7))
+
+    def test_multi_root_colon_split(self, tmp_path):
+        """'rootA:rootB' concatenates both trees, every clip path resolving
+        under its own root (reference train.py:422 multi-root data-dir)."""
+        ra, rb = str(tmp_path / "a"), str(tmp_path / "b")
+        _make_v3_tree(ra, n_real=2, n_fake=3)
+        _make_v3_tree(rb, n_real=4, n_fake=1)
+        ds = DeepFakeClipDataset(f"{ra}:{rb}")
+        single = [DeepFakeClipDataset(ra), DeepFakeClipDataset(rb)]
+        assert len(ds) == len(single[0]) + len(single[1]) == (3+2) + (1+4)
+        # every sample loads, and its paths live under the right root
+        roots_seen = set()
+        for i in range(len(ds)):
+            paths, y = ds.sample_paths(i)
+            root = ra if paths[0].startswith(ra) else rb
+            assert all(p.startswith(root) for p in paths)
+            roots_seen.add(root)
+            img, _ = ds[i]                     # frames actually decode
+        assert roots_seen == {ra, rb}
+        # trailing/empty segments are tolerated
+        assert len(DeepFakeClipDataset(f"{ra}:")) == len(single[0])
+
 
 # ---------------------------------------------------------------------------
 # Samplers

@@ -1,0 +1,109 @@
+"""Live 2-process jax.distributed test (VERDICT r3 item 5).
+
+Spawns two real OS processes that rendezvous through
+``jax.distributed.initialize`` (via the runner's ``--json-file`` cluster
+path — the reference's NCCL file rendezvous analog, train.py:279-282), each
+with 4 virtual CPU devices, and train+validate the synthetic config
+end-to-end over the resulting 8-device global mesh.
+
+Covers the paths that single-process tests cannot: ClusterConfig →
+``initialize_distributed`` rank assembly, per-process batch slicing
+(``local_batch = global // process_count``), the device prologue building
+global arrays from process-local shards, and validate()'s end-of-epoch
+``process_allgather``.  Passing requires both processes to return
+*identical* eval metrics — which can only happen if the eval gather really
+assembled the global score set (each process only evaluates its own
+sampler shard).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+_WORKER = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from deepfake_detection_tpu.runners.train import launch_main
+metrics = launch_main(sys.argv[1:])
+print("METRICS_JSON=" + json.dumps(metrics), flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_train_and_validate(tmp_path):
+    cluster = {
+        "world_size": 2,
+        "coordinator_address": f"localhost:{_free_port()}",
+        "servers": [{"name": socket.gethostname(), "gpus": "",
+                     "local_size": 2, "start_rank": 0}],
+    }
+    cluster_json = tmp_path / "cluster.json"
+    cluster_json.write_text(json.dumps(cluster))
+
+    env = dict(os.environ)
+    env.update(
+        # drop the axon sitecustomize: workers must be pure local CPU
+        PYTHONPATH=_REPO,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        JAX_COMPILATION_CACHE_DIR=os.path.join(_REPO, ".jax_cache"),
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    args = ["--dataset", "synthetic", "--model", "mnasnet_small",
+            "--model-version", "", "--input-size-v2", "3,32,32",
+            "--batch-size", "1", "--epochs", "1", "--log-interval", "1",
+            "--workers", "0", "--json-file", str(cluster_json)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, *args,
+             "--local-rank", str(i), "--output", str(tmp_path / f"out{i}")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=_REPO)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=1200)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+
+    metrics = []
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {i} failed:\n{out[-4000:]}"
+        lines = [ln for ln in out.splitlines()
+                 if ln.startswith("METRICS_JSON=")]
+        assert lines, f"rank {i} printed no metrics:\n{out[-2000:]}"
+        metrics.append(json.loads(lines[-1][len("METRICS_JSON="):]))
+
+    m0, m1 = metrics
+    # identical final metrics across ranks ⇔ train steps stayed in lockstep
+    # and the eval gather assembled the same global score set on both
+    # (best_metric/best_epoch are saver-derived and the saver is rank-0-only)
+    assert m0.keys() == m1.keys() and "auc" in m0, (m0, m1)
+    for k in ("loss", "prec1", "auc"):
+        assert m0[k] == pytest.approx(m1[k], abs=1e-6), (k, m0[k], m1[k])
+    assert 0.0 <= m0["auc"] <= 1.0
+    assert m0["best_metric"] is not None
+    # rank 0 (and only rank 0) wrote checkpoints
+    ckpts0 = [f for _, _, fs in os.walk(tmp_path / "out0") for f in fs
+              if f.endswith(".ckpt")]
+    ckpts1 = [f for _, _, fs in os.walk(tmp_path / "out1") for f in fs
+              if f.endswith(".ckpt")]
+    assert ckpts0 and not ckpts1, (ckpts0, ckpts1)

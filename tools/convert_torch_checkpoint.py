@@ -163,6 +163,15 @@ def convert_state_dict(sd: Dict[str, Any],
             node = node.setdefault(p, {})
         node[parts[-1]] = arr
     if unmapped:
+        if keymap is map_key_vit:
+            # a ViT-family checkpoint whose keys don't all map (e.g. a
+            # TimeSformer, or a timm variant with extra modules) must not
+            # silently become a mostly-empty tree that a later strict=False
+            # load backfills with random init
+            raise SystemExit(
+                f"{len(unmapped)} ViT-family keys have no mapping "
+                f"(e.g. {unmapped[:5]}); refusing to write a partial "
+                f"checkpoint")
         print(f"WARNING: {len(unmapped)} unmapped keys, e.g. {unmapped[:5]}",
               file=sys.stderr)
     return out
@@ -198,9 +207,12 @@ def _resolve_vit_num_heads(sd: Dict[str, Any],
     if not num_heads:
         raise SystemExit(
             f"checkpoint has fused-qkv (ViT-family) keys but --model "
-            f"{model_name!r} has no num_heads; pass the matching vit_* / "
-            f"timesformer_* model name (the qkv column permute needs the "
-            f"head count, and shapes alone cannot reveal a wrong one)")
+            f"{model_name!r} has no num_heads; pass the matching vit_* "
+            f"model name (the qkv column permute needs the head count, and "
+            f"shapes alone cannot reveal a wrong one).  TimeSformer "
+            f"checkpoints are not convertible: this repo's divided "
+            f"space-time blocks (models/timesformer.py) have no torch "
+            f"counterpart with a mechanical key mapping.")
     qkv_key = next(k for k in sd
                    if ".attn.qkv." in k and k.endswith("weight"))
     embed_dim = sd[qkv_key].shape[-1]
