@@ -215,6 +215,10 @@ class TrainConfig:
     output: str = "./output"
     eval_metric: str = "loss"
     eval_crop: str = "random"  # random = reference parity; center = deterministic eval
+    # host-pipeline parity escape hatches (default: TPU-fast paths — one
+    # native warp for the geometric chain, jitter/flicker on device)
+    host_color_jitter: bool = False
+    host_geom: bool = False
     tta: int = 0
     use_multi_epochs_loader: bool = False
     json_file: str = ""                  # cluster topology JSON

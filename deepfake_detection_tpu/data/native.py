@@ -36,8 +36,15 @@ _build_failed = False
 
 
 def _build_library() -> bool:
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
-           "-o", _LIB, "-ljpeg", "-lpthread"]
+    # no -march=native: the .so path may be shared between heterogeneous
+    # hosts (NFS repo, baked images), and a binary tuned for the builder's
+    # CPU would SIGILL elsewhere.  The warp's inner loop is fixed-point
+    # integer math, which -O3 handles well without ISA extensions.
+    # Build to a temp path + atomic rename so a concurrent first-use build
+    # on another host can never dlopen a half-written file.
+    tmp = f"{_LIB}.build.{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           _SRC, "-o", tmp, "-ljpeg", "-lpthread"]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
@@ -46,6 +53,11 @@ def _build_library() -> bool:
         return False
     if proc.returncode != 0:
         _log.warning("native decode build failed:\n%s", proc.stderr[-2000:])
+        return False
+    try:
+        os.replace(tmp, _LIB)
+    except OSError as e:
+        _log.warning("native decode build rename failed: %s", e)
         return False
     return True
 
@@ -72,26 +84,48 @@ def _load() -> Optional[ctypes.CDLL]:
             _log.warning("native decode library failed to load: %s", e)
             _build_failed = True
             return None
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        lib.dfd_decode_jpeg_file.restype = u8p
-        lib.dfd_decode_jpeg_file.argtypes = [
-            ctypes.c_char_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
-        lib.dfd_decode_jpeg.restype = u8p
-        lib.dfd_decode_jpeg.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
-        lib.dfd_free.argtypes = [u8p]
-        lib.dfd_pool_new.restype = ctypes.c_void_p
-        lib.dfd_pool_new.argtypes = [ctypes.c_int]
-        lib.dfd_pool_free.argtypes = [ctypes.c_void_p]
-        lib.dfd_pool_decode_files.argtypes = [
-            ctypes.c_void_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
-            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_int)]
+        try:
+            _bind_symbols(lib)
+        except AttributeError as e:
+            # stale .so from an older source whose rebuild failed: missing
+            # symbols must degrade to the PIL path, not crash every decode
+            _log.warning("native library is stale and rebuild failed "
+                         "(missing symbol: %s); falling back to PIL", e)
+            _build_failed = True
+            return None
         _lib = lib
         return _lib
+
+
+def _bind_symbols(lib) -> None:
+    """Declare ctypes signatures; raises AttributeError on a stale .so."""
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.dfd_decode_jpeg_file.restype = u8p
+    lib.dfd_decode_jpeg_file.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    lib.dfd_decode_jpeg.restype = u8p
+    lib.dfd_decode_jpeg.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    lib.dfd_free.argtypes = [u8p]
+    lib.dfd_pool_new.restype = ctypes.c_void_p
+    lib.dfd_pool_new.argtypes = [ctypes.c_int]
+    lib.dfd_pool_free.argtypes = [ctypes.c_void_p]
+    lib.dfd_pool_decode_files.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.dfd_warp_affine.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int,
+        u8p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double)]
+    lib.dfd_pool_warp_affine.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(u8p),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(u8p), ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double)]
 
 
 def available() -> bool:
@@ -178,6 +212,42 @@ class DecodePool:
             self.close()
         except Exception:
             pass
+
+
+def warp_affine_batch(frames: Sequence[np.ndarray], coeffs: Sequence[float],
+                      out_size, pool: Optional["DecodePool"] = None
+                      ) -> Optional[List[np.ndarray]]:
+    """Bilinear-warp a clip's frames with one shared affine draw.
+
+    ``coeffs`` = (A, B, C, D, E, F) maps output (x, y) → source coords (PIL
+    ``Image.transform(AFFINE)`` convention); ``out_size`` = (width, height).
+    Returns (H, W, 3) uint8 arrays, or None when the native library is
+    unavailable (caller falls back to PIL).  Frames warp in parallel on the
+    shared worker pool — this is the one-pass replacement for the
+    rotate/flip/resize/crop PIL chain (transforms.py::MultiFusedGeometric).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    tw, th = int(out_size[0]), int(out_size[1])
+    n = len(frames)
+    if n == 0:
+        return []
+    frames = [np.ascontiguousarray(f, dtype=np.uint8) for f in frames]
+    outs = [np.empty((th, tw, 3), np.uint8) for _ in range(n)]
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    srcs = (u8p * n)(*[f.ctypes.data_as(u8p) for f in frames])
+    dsts = (u8p * n)(*[o.ctypes.data_as(u8p) for o in outs])
+    sws = (ctypes.c_int * n)(*[f.shape[1] for f in frames])
+    shs = (ctypes.c_int * n)(*[f.shape[0] for f in frames])
+    c = (ctypes.c_double * 6)(*[float(v) for v in coeffs])
+    p = pool or default_pool()
+    if p is not None:
+        lib.dfd_pool_warp_affine(p._pool, n, srcs, sws, shs, dsts, tw, th, c)
+    else:
+        for i in range(n):
+            lib.dfd_warp_affine(srcs[i], sws[i], shs[i], dsts[i], tw, th, c)
+    return outs
 
 
 _default_pool: Optional[DecodePool] = None

@@ -33,8 +33,8 @@ __all__ = [
     "RandomHorizontalFlip", "RandomVerticalFlip", "CenterCrop", "Resize",
     "RandomCrop", "ColorJitter",
     "MultiToNumpy", "MultiConcate", "MultiRandomHorizontalFlip", "MultiBlur",
-    "MultiRotate", "MultiRandomResize", "MultiRandomCrop", "MultiColorJitter",
-    "MultiFlicker",
+    "MultiRotate", "MultiRandomResize", "MultiRandomCrop", "MultiCenterCrop",
+    "MultiColorJitter", "MultiFlicker", "MultiFusedGeometric",
 ]
 
 _PIL_INTERP = {
@@ -380,7 +380,114 @@ class MultiColorJitter(ColorJitter):
 
     def __call__(self, imgs, rng: np.random.Generator):
         params = self.get_params(rng)
-        return [self._apply(img, *params) for img in imgs]
+        return [self._apply(_as_pil(img), *params) for img in imgs]
+
+
+class MultiFusedGeometric:
+    """rotate → hflip → random-resize → pad-if-needed → random-crop as ONE
+    affine resample per frame.
+
+    Numerically composes the exact parameter draws of the sequential
+    MultiRotate(expand) / MultiRandomHorizontalFlip / MultiRandomResize /
+    MultiRandomCrop chain (same rng call order: angle, coin, scale, top,
+    left — so the augmentation *distribution* is identical), then renders
+    the 600² output directly with ``Image.transform(AFFINE)``.  The
+    sequential chain resamples every frame three times at full canvas size
+    (~43 ms/clip at 720² source); this touches each output pixel once
+    (~15 ms/clip) — the host-side decode pipeline must outrun the chip
+    (SURVEY §7 hard part #4), and the three-pass chain was its biggest
+    term.  Pixel values differ from the sequential chain only by resampling
+    (one bilinear pass instead of nearest-rotate + bilinear-resize + copy);
+    ``transforms_deepfake_train_v3(fused_geom=False)`` restores the
+    reference-exact chain.
+    """
+
+    def __init__(self, size, rotate_range: float = 0,
+                 scale=(2.0 / 3, 3.0 / 2.0), p_flip: float = 0.5,
+                 fill: int = 0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.rotate_range = int(rotate_range)
+        self.scale = scale
+        self.p_flip = p_flip
+        self.fill = fill
+
+    @staticmethod
+    def _rot_canvas(w: int, h: int, deg: float) -> Tuple[int, int]:
+        """Canvas size of ``img.rotate(deg, expand=True)`` (PIL's corner
+        transform with the same rounding)."""
+        a = math.radians(deg)
+        c, s = math.cos(a), math.sin(a)
+        xs, ys = [], []
+        for x, y in ((0, 0), (w, 0), (w, h), (0, h)):
+            # PIL rotates about the center, CCW for positive angles
+            xs.append(c * (x - w / 2) + s * (y - h / 2))
+            ys.append(-s * (x - w / 2) + c * (y - h / 2))
+        nw = int(math.ceil(max(xs)) - math.floor(min(xs)))
+        nh = int(math.ceil(max(ys)) - math.floor(min(ys)))
+        return nw, nh
+
+    def __call__(self, imgs, rng: np.random.Generator):
+        th, tw = self.size
+        w, h = imgs[0].size
+        # identical draw order to the sequential chain
+        deg = (int(rng.integers(-self.rotate_range, self.rotate_range + 1))
+               if self.rotate_range else 0)
+        flip = rng.random() < self.p_flip
+        s = rng.uniform(self.scale[0], self.scale[1])
+        w1, h1 = self._rot_canvas(w, h, deg) if deg else (w, h)
+        w2, h2 = int(w1 * s), int(h1 * s)          # RandomResize rounding
+        ww, hh = max(w2, tw), max(h2, th)          # pad_if_needed canvas
+        px, py = (ww - w2) // 2, (hh - h2) // 2    # center pad offsets
+        top = int(rng.integers(0, hh - th + 1)) if hh > th else 0
+        left = int(rng.integers(0, ww - tw + 1)) if ww > tw else 0
+
+        # output (x, y) → source (original frame) coords, composed right to
+        # left: crop/pad shift → inverse resize → inverse flip → inverse
+        # rotate.  All half-pixel center corrections fold into the constant
+        # terms.
+        a = math.radians(deg)
+        cos, sin = math.cos(a), math.sin(a)
+
+        # crop+pad: xp = x + left - px (coords in the resized image)
+        # resize:   xr = (xp + .5) * (w1 / w2) - .5
+        sx, sy = w1 / w2, h1 / h2
+        # flip (on the rotated canvas): xf = w1 - 1 - xr
+        # linear parts
+        ax, bx = sx, 0.0
+        cx = (left - px + 0.5) * sx - 0.5
+        dy, ey = 0.0, sy
+        fy = (top - py + 0.5) * sy - 0.5
+        if flip:
+            ax, bx, cx = -ax, -bx, (w1 - 1) - cx
+        # rotate inverse (verified against PIL.rotate numerically): output→
+        # input is xi = cos·dx - sin·dy + w/2, yi = sin·dx + cos·dy + h/2
+        # with dx = xr - w1/2 + .5 etc. (half-pixel center corrections)
+        A = cos * ax - sin * dy
+        B = cos * bx - sin * ey
+        C = (cos * (cx - w1 / 2 + 0.5) - sin * (fy - h1 / 2 + 0.5)
+             + w / 2 - 0.5)
+        D = sin * ax + cos * dy
+        E = sin * bx + cos * ey
+        F = (sin * (cx - w1 / 2 + 0.5) + cos * (fy - h1 / 2 + 0.5)
+             + h / 2 - 0.5)
+        coeffs = (A, B, C, D, E, F)
+        from . import native
+        if native.available():
+            arrs = [np.asarray(im, np.uint8) if not isinstance(
+                im, np.ndarray) else im for im in imgs]
+            out = native.warp_affine_batch(arrs, coeffs, (tw, th))
+            if out is not None:
+                return out                     # (H, W, 3) uint8 arrays
+        return [img.transform((tw, th), Image.AFFINE, coeffs,
+                              resample=Image.BILINEAR,
+                              fillcolor=(self.fill,) * 3)
+                for img in imgs]
+
+
+def _as_pil(img) -> Image.Image:
+    """Frames may be PIL or uint8 ndarray (the native fused-geometric path
+    emits arrays); lift to PIL only where a PIL op is actually applied."""
+    return Image.fromarray(img) if isinstance(img, np.ndarray) else img
 
 
 class MultiBlur:
@@ -392,7 +499,8 @@ class MultiBlur:
         self.blur_radiu = blur_radiu
 
     def __call__(self, imgs, rng: np.random.Generator):
-        return [img.filter(ImageFilter.GaussianBlur(radius=self.blur_radiu))
+        return [_as_pil(img).filter(
+                    ImageFilter.GaussianBlur(radius=self.blur_radiu))
                 if rng.random() < self.p else img for img in imgs]
 
 
@@ -405,6 +513,9 @@ class MultiFlicker:
         self.probability = probability
 
     def __call__(self, imgs, rng: np.random.Generator):
-        size = imgs[0].size
-        return [Image.new("RGB", size) if rng.random() < self.probability
+        def black(img):
+            if isinstance(img, np.ndarray):
+                return np.zeros_like(img)
+            return Image.new("RGB", img.size)
+        return [black(img) if rng.random() < self.probability
                 else img for img in imgs]

@@ -29,7 +29,7 @@ from .constants import (DEFAULT_CROP_PCT, IMAGENET_DEFAULT_MEAN,
                         IMAGENET_DEFAULT_STD)
 from .transforms import (CenterCrop, ColorJitter, Compose, MultiBlur,
                          MultiCenterCrop, MultiColorJitter, MultiConcate,
-                         MultiFlicker,
+                         MultiFlicker, MultiFusedGeometric,
                          MultiRandomCrop, MultiRandomHorizontalFlip,
                          MultiRandomResize, MultiRotate, MultiToNumpy,
                          RandomHorizontalFlip,
@@ -45,14 +45,27 @@ def transforms_deepfake_train_v3(
         img_size: Union[int, Tuple[int, int]] = 600,
         color_jitter: Any = 0.4, flicker: float = 0.0,
         rotate_range: float = 0, blur_radiu: float = 0,
-        blur_prob: float = 0.0, **unused) -> Compose:
-    """The active 4-frame train pipeline (reference :137-183)."""
-    primary = [
-        MultiRotate(rotate_range),
-        MultiRandomHorizontalFlip(),
-        MultiRandomResize(scale=(2.0 / 3, 3.0 / 2.0)),
-        MultiRandomCrop(img_size, pad_if_needed=True),
-    ]
+        blur_prob: float = 0.0, fused_geom: bool = True,
+        **unused) -> Compose:
+    """The active 4-frame train pipeline (reference :137-183).
+
+    ``fused_geom=True`` (default) renders rotate/flip/resize/crop as ONE
+    native bilinear warp per frame (same parameter distribution, one
+    resample instead of three — see MultiFusedGeometric); ``False`` keeps
+    the reference-exact sequential PIL chain.  ``color_jitter=None`` /
+    ``flicker=0`` lets the loader apply those stages on-device instead
+    (loader.py DeviceLoader prologue) — host PIL jitter at 600² costs more
+    than the whole decode."""
+    if fused_geom:
+        primary: list = [MultiFusedGeometric(
+            img_size, rotate_range=rotate_range, scale=(2.0 / 3, 3.0 / 2.0))]
+    else:
+        primary = [
+            MultiRotate(rotate_range),
+            MultiRandomHorizontalFlip(),
+            MultiRandomResize(scale=(2.0 / 3, 3.0 / 2.0)),
+            MultiRandomCrop(img_size, pad_if_needed=True),
+        ]
     if blur_prob > 0.0:
         primary.append(MultiBlur(blur_prob, blur_radiu))
     secondary = []

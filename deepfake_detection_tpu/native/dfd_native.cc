@@ -25,6 +25,7 @@
 
 #include <jpeglib.h>  // requires size_t/FILE declared first
 
+#include <cmath>
 #include <csetjmp>
 #include <cstdint>
 #include <cstdlib>
@@ -130,6 +131,86 @@ uint8_t* decode_file(const char* path, int scale_denom, int* w, int* h) {
 }  // namespace
 
 void dfd_free(uint8_t* p) { std::free(p); }
+
+// ---------------------------------------------------------------------------
+// affine warp (bilinear, RGB8, black fill)
+// ---------------------------------------------------------------------------
+//
+// One-pass replacement for the host pipeline's rotate→flip→resize→crop
+// chain (data/transforms.py::MultiFusedGeometric): coef = (A,B,C,D,E,F)
+// maps output pixel (x, y) to source coords (A·x+B·y+C, D·x+E·y+F); taps
+// outside the source read as black, matching PIL's expand/pad fill.
+
+namespace {
+
+void warp_affine_rgb8(const uint8_t* src, int sw, int sh, uint8_t* dst,
+                      int dw, int dh, const double* coef) {
+  // 16.16 fixed point: source coords step by a constant per output x, so
+  // the whole inner loop is integer adds/shifts; weights use 8 fractional
+  // bits (wx*wy fits 16) — ±1 LSB vs float bilinear, invisible after the
+  // uint8 round.
+  const int64_t kOne = 1 << 16;
+  const int64_t Ai = static_cast<int64_t>(std::llround(coef[0] * kOne));
+  const int64_t Di = static_cast<int64_t>(std::llround(coef[3] * kOne));
+  for (int y = 0; y < dh; ++y) {
+    int64_t sx = static_cast<int64_t>(
+        std::llround((coef[1] * y + coef[2]) * kOne));
+    int64_t sy = static_cast<int64_t>(
+        std::llround((coef[4] * y + coef[5]) * kOne));
+    uint8_t* row = dst + static_cast<size_t>(y) * dw * 3;
+    for (int x = 0; x < dw; ++x, sx += Ai, sy += Di) {
+      const int x0 = static_cast<int>(sx >> 16);   // floor for sx >= 0 ...
+      const int y0 = static_cast<int>(sy >> 16);   // ... and for sx < 0 too
+      uint8_t* px = row + 3 * x;
+      const uint32_t wx1 = (sx >> 8) & 0xff, wx0 = 256 - wx1;
+      const uint32_t wy1 = (sy >> 8) & 0xff, wy0 = 256 - wy1;
+      const uint8_t* r0 = src + (static_cast<size_t>(y0) * sw + x0) * 3;
+      if (x0 >= 0 && y0 >= 0 && x0 + 1 < sw && y0 + 1 < sh) {
+        // fast path: all four taps in bounds (the vast majority)
+        const uint8_t* r1 = r0 + static_cast<size_t>(sw) * 3;
+        const uint32_t w00 = wx0 * wy0, w10 = wx1 * wy0;
+        const uint32_t w01 = wx0 * wy1, w11 = wx1 * wy1;
+        px[0] = static_cast<uint8_t>((w00 * r0[0] + w10 * r0[3] +
+                                      w01 * r1[0] + w11 * r1[3] +
+                                      32768) >> 16);
+        px[1] = static_cast<uint8_t>((w00 * r0[1] + w10 * r0[4] +
+                                      w01 * r1[1] + w11 * r1[4] +
+                                      32768) >> 16);
+        px[2] = static_cast<uint8_t>((w00 * r0[2] + w10 * r0[5] +
+                                      w01 * r1[2] + w11 * r1[5] +
+                                      32768) >> 16);
+        continue;
+      }
+      if (x0 < -1 || y0 < -1 || x0 >= sw || y0 >= sh) {
+        px[0] = px[1] = px[2] = 0;
+        continue;
+      }
+      // boundary: taps outside read as black
+      const bool in_x0 = x0 >= 0, in_x1 = x0 + 1 < sw;
+      const bool in_y0 = y0 >= 0, in_y1 = y0 + 1 < sh;
+      const uint8_t* r1 = r0 + static_cast<size_t>(sw) * 3;
+      for (int c = 0; c < 3; ++c) {
+        uint32_t v = 0;
+        if (in_y0) {
+          if (in_x0) v += wx0 * wy0 * r0[c];
+          if (in_x1) v += wx1 * wy0 * r0[3 + c];
+        }
+        if (in_y1) {
+          if (in_x0) v += wx0 * wy1 * r1[c];
+          if (in_x1) v += wx1 * wy1 * r1[3 + c];
+        }
+        px[c] = static_cast<uint8_t>((v + 32768) >> 16);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+void dfd_warp_affine(const uint8_t* src, int sw, int sh, uint8_t* dst,
+                     int dw, int dh, const double* coef) {
+  warp_affine_rgb8(src, sw, sh, dst, dw, dh, coef);
+}
 
 uint8_t* dfd_decode_jpeg(const uint8_t* data, size_t size, int scale_denom,
                          int* out_w, int* out_h) {
@@ -243,6 +324,22 @@ void dfd_pool_decode_buffers(void* pool, int n, const uint8_t** datas,
     p->Submit([&, i] {
       outs[i] = decode_buffer(datas[i], sizes[i], scale_denom, &ws[i],
                               &hs[i]);
+      latch.Done();
+    });
+  }
+  latch.Wait();
+}
+
+// Warp n same-coef frames in parallel (one clip's frames share the draw).
+// dsts[i] must be preallocated dw*dh*3 buffers.
+void dfd_pool_warp_affine(void* pool, int n, const uint8_t** srcs,
+                          const int* sws, const int* shs, uint8_t** dsts,
+                          int dw, int dh, const double* coef) {
+  Pool* p = static_cast<Pool*>(pool);
+  Latch latch(n);
+  for (int i = 0; i < n; ++i) {
+    p->Submit([&, i] {
+      warp_affine_rgb8(srcs[i], sws[i], shs[i], dsts[i], dw, dh, coef);
       latch.Done();
     });
   }

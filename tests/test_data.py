@@ -176,6 +176,111 @@ class TestDeepFakeClipDataset:
         img, y = ds[0]
         assert img.shape == (32, 32, 12)
 
+    def test_fused_geometric_matches_sequential_chain(self):
+        """MultiFusedGeometric (one warp) vs the reference-exact sequential
+        rotate/flip/resize/crop chain: same rng draws, same geometry — mean
+        pixel diff is resampling noise only."""
+        from deepfake_detection_tpu.data.transforms import (
+            MultiFusedGeometric, MultiRandomCrop,
+            MultiRandomHorizontalFlip, MultiRandomResize, MultiRotate)
+
+        def sequential(imgs, rng, size, rot):
+            if rot:
+                imgs = MultiRotate(rot)(imgs, rng)
+            imgs = MultiRandomHorizontalFlip()(imgs, rng)
+            imgs = MultiRandomResize(scale=(2 / 3, 3 / 2))(imgs, rng)
+            return MultiRandomCrop(size, pad_if_needed=True)(imgs, rng)
+
+        g = np.add.outer(np.arange(160), np.arange(160)) % 256
+        img = Image.fromarray(np.stack([g, g.T, (g + 80) % 256],
+                                       -1).astype(np.uint8))
+        fused = MultiFusedGeometric(96, rotate_range=5)
+        for seed in range(6):
+            a = np.asarray(
+                sequential([img], np.random.default_rng(seed), 96, 5)[0],
+                np.float32)
+            b = np.asarray(
+                fused([img], np.random.default_rng(seed))[0], np.float32)
+            assert a.shape == b.shape == (96, 96, 3)
+            # same crop geometry ⇒ only resampling noise; a wrong window
+            # or sign flip would push this to tens of gray levels
+            assert np.abs(a - b).mean() < 2.0, seed
+
+    def test_fused_geometric_identity_params_exact(self):
+        """With rotate 0 and scale pinned to 1 the fused warp degenerates to
+        flip+crop and must be pixel-exact vs the sequential chain."""
+        from deepfake_detection_tpu.data.transforms import (
+            MultiFusedGeometric, MultiRandomCrop,
+            MultiRandomHorizontalFlip, MultiRandomResize)
+        g = np.add.outer(np.arange(140), np.arange(150)) % 256
+        img = Image.fromarray(np.stack([g, g, g], -1).astype(np.uint8))
+        fused = MultiFusedGeometric(64, rotate_range=0, scale=(1.0, 1.0))
+        for seed in range(4):
+            rng = np.random.default_rng(seed)
+            a = MultiRandomHorizontalFlip()([img], rng)
+            a = MultiRandomResize(scale=(1.0, 1.0))(a, rng)
+            a = MultiRandomCrop(64, pad_if_needed=True)(a, rng)
+            b = fused([img], np.random.default_rng(seed))
+            np.testing.assert_array_equal(np.asarray(a[0]),
+                                          np.asarray(b[0]))
+
+    def test_device_color_jitter_semantics(self):
+        """Device jitter ops match PIL's ImageEnhance chain: replicate the
+        factor draw from the key, apply PIL with the same factor, compare."""
+        import jax
+        import jax.numpy as jnp
+        from PIL import ImageEnhance
+        from deepfake_detection_tpu.data.device_augment import \
+            make_device_color_jitter
+
+        rng = np.random.default_rng(0)
+        frame = rng.integers(0, 256, (24, 24, 3)).astype(np.uint8)
+        x = np.concatenate([frame] * 4, -1)[None].astype(np.float32)
+
+        # brightness-only: replicate the b draw from the split key
+        fn = make_device_color_jitter((0.4, 0.0, 0.0), 0.0, 4)
+        key = jax.random.PRNGKey(7)
+        out = np.asarray(fn(jnp.asarray(x), key))
+        skey = jax.random.split(key, 1)[0]
+        kb = jax.random.split(skey, 5)[0]
+        b = float(jax.random.uniform(kb, (), minval=0.6, maxval=1.4))
+        pil = np.asarray(ImageEnhance.Brightness(
+            Image.fromarray(frame)).enhance(b), np.float32)
+        got = out[0, :, :, :3]
+        # PIL rounds to uint8; device stays float — within 1 level
+        assert np.abs(got - pil).max() <= 1.0, np.abs(got - pil).max()
+
+        # flicker=1 blacks out every frame
+        fn = make_device_color_jitter(None, 1.0, 4)
+        out = np.asarray(fn(jnp.asarray(x), key))
+        assert np.all(out == 0)
+
+        # degenerate ranges are the identity
+        fn = make_device_color_jitter((0.0, 0.0, 0.0), 0.0, 4)
+        out = np.asarray(fn(jnp.asarray(x), key))
+        np.testing.assert_allclose(out, x, atol=1e-3)
+
+    def test_loader_device_jitter_e2e(self, tmp_path):
+        """Train loader with device jitter (default): output is finite,
+        correctly shaped, and differs from the jitter-free pipeline."""
+        from deepfake_detection_tpu.data import create_deepfake_loader_v3
+        root = str(tmp_path / "d")
+        _make_v3_tree(root, n_real=2, n_fake=2)
+
+        def batch(device_jitter, cj):
+            ds = DeepFakeClipDataset(root)
+            loader = create_deepfake_loader_v3(
+                ds, (12, 32, 32), 2, is_training=True, num_workers=0,
+                dtype=np.float32, color_jitter=cj,
+                device_color_jitter=device_jitter)
+            x, *_ = next(iter(loader))
+            return np.asarray(x)
+
+        a = batch(True, 0.4)
+        assert a.shape == (2, 32, 32, 12) and np.isfinite(a).all()
+        b = batch(True, None)
+        assert not np.array_equal(a, b)     # jitter actually applied
+
     def test_eval_crop_center_deterministic(self, tmp_path):
         """--eval-crop center: identical pixels across epochs; the parity
         default (random) draws a fresh window per (epoch, index)."""

@@ -22,6 +22,7 @@ def test_build_and_measure(tmp_path, monkeypatch):
                            epochs=1)
     native_cps = bench_input.measure(root, args, native=True)
     pil_cps = bench_input.measure(root, args, native=False)
-    assert native_cps > 0 and pil_cps > 0
+    ref_cps = bench_input.measure(root, args, native=False, fast=False)
+    assert native_cps > 0 and pil_cps > 0 and ref_cps > 0
     # the toggle must be restored for later tests
     monkeypatch.delenv("DFD_NO_NATIVE_DECODE", raising=False)

@@ -54,7 +54,13 @@ def build_dataset(root: str, n_clips: int, size: int, frames: int,
             fh.write("\n".join(lst) + "\n")
 
 
-def measure(root: str, args, native: bool) -> float:
+def measure(root: str, args, native: bool, fast: bool = True) -> float:
+    """clips/s through the host pipeline.
+
+    ``fast`` = the production split (fused native geometric warp; color
+    jitter/flicker live in the device prologue, so the host skips them);
+    ``fast=False`` = the reference-exact chain (sequential PIL geometric
+    ops + host PIL jitter)."""
     os.environ.pop("DFD_NO_NATIVE_DECODE", None)
     if not native:
         os.environ["DFD_NO_NATIVE_DECODE"] = "1"
@@ -67,8 +73,9 @@ def measure(root: str, args, native: bool) -> float:
 
     ds = DeepFakeClipDataset([root], frames_per_clip=args.frames)
     ds.set_transform(transforms_deepfake_train_v3(
-        img_size=args.size, color_jitter=0.4, rotate_range=5,
-        blur_radiu=1, blur_prob=0.05, flicker=0.05))
+        img_size=args.size, color_jitter=None if fast else 0.4,
+        rotate_range=5, blur_radiu=1, blur_prob=0.05,
+        flicker=0.0 if fast else 0.05, fused_geom=fast))
     sampler = ShardedTrainSampler(len(ds), batch_size=args.batch, seed=0)
     loader = HostLoader(ds, sampler, batch_size=args.batch,
                         num_workers=args.workers, seed=0)
@@ -89,25 +96,44 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--clips", type=int, default=64)
     ap.add_argument("--size", type=int, default=600)
+    ap.add_argument("--source-size", type=int, default=0,
+                    help="on-disk JPEG size (default: 1.2x --size, so the "
+                         "resize+crop path does real work)")
     ap.add_argument("--frames", type=int, default=4)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--workers", type=int, default=4)
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--keep", default="", help="reuse/keep dataset dir")
+    ap.add_argument("--json", default="",
+                    help="append one JSON result line per impl to this file")
     args = ap.parse_args()
 
+    src = args.source_size or int(args.size * 1.2)
     root = args.keep or tempfile.mkdtemp(prefix="dfd_input_bench_")
     if not os.path.exists(os.path.join(root, "fake_list.txt")):
-        print(f"building {args.clips} synthetic clips under {root} ...",
-              file=sys.stderr)
-        build_dataset(root, args.clips, args.size, args.frames)
+        print(f"building {args.clips} synthetic {src}² clips under {root} "
+              f"...", file=sys.stderr)
+        build_dataset(root, args.clips, src, args.frames)
 
-    for native in (True, False):
-        cps = measure(root, args, native)
-        label = "native-pool" if native else "PIL        "
-        print(f"{label}: {cps:7.2f} clips/s  "
+    # DFD_NO_NATIVE_DECODE disables the whole native library, i.e. BOTH the
+    # decode pool and the fused warp fall back to PIL — label accordingly
+    modes = [("fast/native", True, True), ("fast/no-native", False, True),
+             ("reference-exact", False, False)]
+    for label, native, fast in modes:
+        cps = measure(root, args, native, fast)
+        print(f"{label:16s}: {cps:7.2f} clips/s  "
               f"({cps * args.frames:8.2f} frames/s)  "
-              f"[{args.size}²×{args.frames}f, {args.workers} workers]")
+              f"[{src}²→{args.size}²×{args.frames}f, "
+              f"{args.workers} workers]")
+        if args.json:
+            import json
+            row = {"mode": label, "clips_per_s": round(cps, 2),
+                   "frames_per_s": round(cps * args.frames, 2),
+                   "crop_size": args.size, "source_size": src,
+                   "frames": args.frames, "workers": args.workers,
+                   "host_cpus": os.cpu_count()}
+            with open(args.json, "a") as fh:
+                fh.write(json.dumps(row) + "\n")
 
 
 if __name__ == "__main__":
