@@ -413,15 +413,20 @@ class MultiFusedGeometric:
 
     @staticmethod
     def _rot_canvas(w: int, h: int, deg: float) -> Tuple[int, int]:
-        """Canvas size of ``img.rotate(deg, expand=True)`` (PIL's corner
-        transform with the same rounding)."""
-        a = math.radians(deg)
+        """Canvas size of ``img.rotate(deg, expand=True)``, replicating
+        PIL's computation exactly — including the center-offset constant
+        INSIDE the ceil/floor, which shifts the result by 1 px for odd
+        source extents (the crop-draw bounds must match the sequential
+        chain exactly, not just approximately)."""
+        a = -math.radians(deg)                     # PIL negates the angle
         c, s = math.cos(a), math.sin(a)
+        cx, cy = w / 2.0, h / 2.0
+        m2 = cx - (c * cx + s * cy)
+        m5 = cy - (-s * cx + c * cy)
         xs, ys = [], []
         for x, y in ((0, 0), (w, 0), (w, h), (0, h)):
-            # PIL rotates about the center, CCW for positive angles
-            xs.append(c * (x - w / 2) + s * (y - h / 2))
-            ys.append(-s * (x - w / 2) + c * (y - h / 2))
+            xs.append(c * x + s * y + m2)
+            ys.append(-s * x + c * y + m5)
         nw = int(math.ceil(max(xs)) - math.floor(min(xs)))
         nh = int(math.ceil(max(ys)) - math.floor(min(ys)))
         return nw, nh

@@ -191,20 +191,26 @@ class TestDeepFakeClipDataset:
             imgs = MultiRandomResize(scale=(2 / 3, 3 / 2))(imgs, rng)
             return MultiRandomCrop(size, pad_if_needed=True)(imgs, rng)
 
-        g = np.add.outer(np.arange(160), np.arange(160)) % 256
-        img = Image.fromarray(np.stack([g, g.T, (g + 80) % 256],
-                                       -1).astype(np.uint8))
         fused = MultiFusedGeometric(96, rotate_range=5)
-        for seed in range(6):
-            a = np.asarray(
-                sequential([img], np.random.default_rng(seed), 96, 5)[0],
-                np.float32)
-            b = np.asarray(
-                fused([img], np.random.default_rng(seed))[0], np.float32)
-            assert a.shape == b.shape == (96, 96, 3)
-            # same crop geometry ⇒ only resampling noise; a wrong window
-            # or sign flip would push this to tens of gray levels
-            assert np.abs(a - b).mean() < 2.0, seed
+        # odd extents included: PIL's expand-rotate canvas math shifts by
+        # 1 px for odd sizes, and the crop-draw bounds must match exactly
+        for w, h in ((160, 160), (141, 141), (155, 133)):
+            g = np.add.outer(np.arange(h), np.arange(w)) % 256
+            img = Image.fromarray(np.stack([g, (g + 40) % 256,
+                                            (g + 80) % 256],
+                                           -1).astype(np.uint8))
+            for seed in range(6):
+                a = np.asarray(
+                    sequential([img], np.random.default_rng(seed), 96,
+                               5)[0], np.float32)
+                b = np.asarray(
+                    fused([img], np.random.default_rng(seed))[0],
+                    np.float32)
+                assert a.shape == b.shape == (96, 96, 3)
+                # same crop geometry ⇒ only resampling noise; a wrong
+                # window, canvas size, or sign flip would push this to
+                # tens of gray levels
+                assert np.abs(a - b).mean() < 2.0, (w, h, seed)
 
     def test_fused_geometric_identity_params_exact(self):
         """With rotate 0 and scale pinned to 1 the fused warp degenerates to
