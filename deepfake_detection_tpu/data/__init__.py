@@ -10,7 +10,8 @@ from .config import resolve_data_config
 from .constants import (DEFAULT_CROP_PCT, IMAGENET_DEFAULT_MEAN,
                         IMAGENET_DEFAULT_STD, IMAGENET_INCEPTION_MEAN,
                         IMAGENET_INCEPTION_STD)
-from .dataset import (DeepFakeClipDataset, FolderDataset, SyntheticDataset,
+from .dataset import (ConcatDataset, DatasetTar, DeepFakeClipDataset,
+                      FolderDataset, SyntheticDataset,
                       read_clip_list, split_clips)
 from .loader import (DeviceLoader, HostLoader, create_deepfake_loader_v3,
                      fast_collate)

@@ -40,7 +40,8 @@ from PIL import Image
 
 from . import native
 
-__all__ = ["AugMixDataset", "DeepFakeClipDataset", "FolderDataset",
+__all__ = ["AugMixDataset", "ConcatDataset", "DatasetTar",
+           "DeepFakeClipDataset", "FolderDataset",
            "SyntheticDataset", "read_clip_list", "split_clips"]
 
 _IMG_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp")
@@ -268,6 +269,112 @@ class FolderDataset:
         if self.transform is not None:
             img = self.transform(img, rng)
         return img, target
+
+
+class DatasetTar:
+    """Image dataset inside a single tar file (reference ``DatasetTar``,
+    dataset.py:602-630): class = parent directory name inside the archive,
+    classes sorted by natural key.
+
+    TPU-era changes: the tar handle is per-*thread* (``threading.local``) —
+    the HostLoader parallelizes with threads, not forked workers, and one
+    shared handle would interleave concurrent ``extractfile`` reads;
+    ``__getitem__`` takes the explicit per-sample rng like every dataset
+    here."""
+
+    def __init__(self, root: str, transform: Optional[Callable] = None,
+                 class_to_idx: Optional[dict] = None):
+        import tarfile
+        import threading
+
+        from ..utils import natural_key
+        assert os.path.isfile(root), root
+        self.root = root
+        self.transform = transform
+        with tarfile.open(root) as tf:
+            infos = [ti for ti in tf.getmembers() if ti.isfile()
+                     and ti.name.lower().endswith(_IMG_EXTENSIONS)]
+        labels = [os.path.basename(os.path.dirname(ti.name)) for ti in infos]
+        if class_to_idx is None:
+            class_to_idx = {c: i for i, c in enumerate(
+                sorted(set(labels), key=natural_key))}
+        self.class_to_idx = class_to_idx
+        pairs = sorted(zip(infos, labels), key=lambda p: natural_key(
+            p[0].name))
+        self.samples = [(ti, class_to_idx[lb]) for ti, lb in pairs]
+        self._local = threading.local()
+        self.epoch = 0
+
+    def _tar(self):
+        import tarfile
+        tf = getattr(self._local, "tf", None)
+        if tf is None:
+            tf = self._local.tf = tarfile.open(self.root)
+        return tf
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def set_transform(self, transform: Callable) -> None:
+        self.transform = transform
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __getitem__(self, index: int,
+                    rng: Optional[np.random.Generator] = None):
+        rng = rng if rng is not None else np.random.default_rng(
+            np.random.SeedSequence([self.epoch, index]))
+        tarinfo, target = self.samples[index]
+        iob = self._tar().extractfile(tarinfo)
+        data = iob.read()
+        arr = native.decode_jpeg_bytes(data) if tarinfo.name.lower(
+            ).endswith((".jpg", ".jpeg")) else None
+        if arr is not None:
+            img: Any = Image.fromarray(arr)
+        else:
+            import io
+            img = Image.open(io.BytesIO(data)).convert("RGB")
+        if self.transform is not None:
+            img = self.transform(img, rng)
+        return img, target
+
+
+class ConcatDataset:
+    """Concatenation of datasets (reference ``ConcatDataset``,
+    dataset.py:229-265): bisect over cumulative sizes; ``set_epoch`` /
+    ``set_transform`` fan out to every child."""
+
+    def __init__(self, datasets: Sequence[Any]):
+        assert datasets, "datasets should not be an empty iterable"
+        self.datasets = list(datasets)
+        self.cumulative_sizes = list(np.cumsum(
+            [len(d) for d in self.datasets]))
+
+    def set_epoch(self, epoch: int) -> None:
+        for d in self.datasets:
+            if hasattr(d, "set_epoch"):
+                d.set_epoch(epoch)
+
+    def set_transform(self, transform: Callable) -> None:
+        for d in self.datasets:
+            if hasattr(d, "set_transform"):
+                d.set_transform(transform)
+
+    def __len__(self) -> int:
+        return int(self.cumulative_sizes[-1])
+
+    def __getitem__(self, index: int,
+                    rng: Optional[np.random.Generator] = None):
+        import bisect
+        if index < 0:
+            if -index > len(self):
+                raise ValueError("index out of range")
+            index = len(self) + index
+        di = bisect.bisect_right(self.cumulative_sizes, index)
+        local = index if di == 0 else \
+            index - int(self.cumulative_sizes[di - 1])
+        return self.datasets[di].__getitem__(local, rng=rng)
 
 
 class SyntheticDataset:

@@ -418,8 +418,18 @@ class MultiFusedGeometric:
         INSIDE the ceil/floor, which shifts the result by 1 px for odd
         source extents (the crop-draw bounds must match the sequential
         chain exactly, not just approximately)."""
+        # PIL's transpose fast paths keep exact sizes at right angles (its
+        # general ceil/floor formula would pad odd extents by 1)
+        deg_n = deg % 360
+        if deg_n in (0, 180):
+            return w, h
+        if deg_n in (90, 270):
+            return h, w
         a = -math.radians(deg)                     # PIL negates the angle
-        c, s = math.cos(a), math.sin(a)
+        # PIL rounds to 15 decimals so near-axis angles produce exact 0/±1
+        # entries; raw cos/sin residue (~6e-17) would push corner coords
+        # past ceil/floor boundaries
+        c, s = round(math.cos(a), 15), round(math.sin(a), 15)
         cx, cy = w / 2.0, h / 2.0
         m2 = cx - (c * cx + s * cy)
         m5 = cy - (-s * cx + c * cy)
@@ -467,6 +477,7 @@ class MultiFusedGeometric:
         # rotate inverse (verified against PIL.rotate numerically): output→
         # input is xi = cos·dx - sin·dy + w/2, yi = sin·dx + cos·dy + h/2
         # with dx = xr - w1/2 + .5 etc. (half-pixel center corrections)
+        cos, sin = round(cos, 15), round(sin, 15)  # PIL's axis-angle exactness
         A = cos * ax - sin * dy
         B = cos * bx - sin * ey
         C = (cos * (cx - w1 / 2 + 0.5) - sin * (fy - h1 / 2 + 0.5)

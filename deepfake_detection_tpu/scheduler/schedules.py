@@ -39,6 +39,12 @@ __all__ = [
 class Scheduler:
     """Base: pure ``_get_lr(t)`` + noise + epoch/update dispatch."""
 
+    #: set True in a subclass whose ``step_update`` consumes ``metric`` —
+    #: the trainer then drains its buffered device metrics first so the
+    #: value is fresh (train/trainer.py), at the cost of a host sync per
+    #: optimizer update
+    wants_update_metric: bool = False
+
     def __init__(self, base_lr: float, t_in_epochs: bool = True,
                  noise_range_t=None, noise_type: str = "normal",
                  noise_pct: float = 0.67, noise_std: float = 1.0,

@@ -153,8 +153,16 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                                 batch_idx=batch_idx)   # reference :686-689
 
         if lr_scheduler is not None:
+            # no stock schedule consumes a per-update metric (plateau is
+            # epoch-granular and fed the FRESH eval metric by the runner);
+            # one that declares it wants one must get a fresh value, not
+            # the log-interval-stale buffered average
+            metric = None
+            if getattr(lr_scheduler, "wants_update_metric", False):
+                _drain()
+                metric = losses_m.avg
             new_lr = lr_scheduler.step_update(num_updates=num_updates,
-                                              metric=losses_m.avg)
+                                              metric=metric)
             if new_lr is not None and new_lr != lr:
                 state = set_learning_rate(state, new_lr)
         end = time.time()

@@ -176,6 +176,52 @@ class TestDeepFakeClipDataset:
         img, y = ds[0]
         assert img.shape == (32, 32, 12)
 
+    def test_dataset_tar(self, tmp_path):
+        """DatasetTar (reference dataset.py:602-630): classes from member
+        dirnames sorted naturally; thread-safe reads; transform+rng path."""
+        import tarfile
+        from concurrent.futures import ThreadPoolExecutor
+        from deepfake_detection_tpu.data import DatasetTar
+        src = tmp_path / "src"
+        for cls, color in (("class10", 10), ("class2", 200)):
+            (src / cls).mkdir(parents=True)
+            for i in range(3):
+                Image.new("RGB", (32, 32), (color, i, 0)).save(
+                    src / cls / f"{i}.jpg")
+        tar_path = str(tmp_path / "data.tar")
+        with tarfile.open(tar_path, "w") as tf:
+            tf.add(src, arcname=".")
+        ds = DatasetTar(tar_path)
+        assert len(ds) == 6
+        # natural sort: class2 before class10
+        assert ds.class_to_idx == {"class2": 0, "class10": 1}
+        img, y = ds[0]
+        assert y in (0, 1) and img.size == (32, 32)
+        # all labels present; concurrent reads from threads are safe
+        with ThreadPoolExecutor(4) as ex:
+            ys = sorted(y for _, y in ex.map(ds.__getitem__, range(6)))
+        assert ys == [0, 0, 0, 1, 1, 1]
+        # transform receives the per-sample rng
+        ds.set_transform(lambda im, rng: np.asarray(im, np.uint8))
+        img, _ = ds[1]
+        assert isinstance(img, np.ndarray)
+
+    def test_concat_dataset(self, tmp_path):
+        from deepfake_detection_tpu.data import (ConcatDataset,
+                                                 SyntheticDataset)
+        a = SyntheticDataset(3, (8, 8, 3), seed=0)
+        b = SyntheticDataset(5, (8, 8, 3), seed=1)
+        ds = ConcatDataset([a, b])
+        assert len(ds) == 8
+        xa, _ = ds[2]
+        np.testing.assert_array_equal(xa, a[2][0])
+        xb, _ = ds[3]
+        np.testing.assert_array_equal(xb, b[0][0])
+        xn, _ = ds[-1]
+        np.testing.assert_array_equal(xn, b[4][0])
+        ds.set_epoch(3)
+        assert a.epoch == b.epoch == 3
+
     def test_fused_geometric_matches_sequential_chain(self):
         """MultiFusedGeometric (one warp) vs the reference-exact sequential
         rotate/flip/resize/crop chain: same rng draws, same geometry — mean
