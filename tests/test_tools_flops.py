@@ -1,0 +1,28 @@
+"""tools/flops_breakdown.py: the MXU/VPU classification must stay honest."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def test_breakdown_classifies_depthwise_and_dots():
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "flops_breakdown.py"),
+         "mnasnet_small", "--size", "64"],
+        capture_output=True, text=True, env=env, timeout=300, check=True)
+    r = json.loads(out.stdout)
+    # mnasnet has both dense and depthwise convs; totals must be positive
+    # and percentages sum to ~100
+    assert r["total_gflops_fwd"] > 0
+    assert r["conv_depthwise_vpu"]["pct"] > 0
+    assert r["conv_dense_mxu"]["pct"] > 0
+    pct = sum(v["pct"] for k, v in r.items() if isinstance(v, dict))
+    assert abs(pct - 100.0) < 0.1

@@ -1,0 +1,95 @@
+"""MXU/VPU FLOPs breakdown of a model's forward pass (PERF.md input).
+
+Walks the jaxpr of a single-sample forward and classifies every
+``conv_general_dilated`` / ``dot_general`` by where it executes on TPU:
+
+* dense convs and matmuls tile onto the MXU (the 128×128 systolic array);
+* depthwise convs (``feature_group_count == in_channels``) cannot use the
+  MXU — each output element is a k²-tap dot over ONE channel, so they run
+  on the VPU at roughly 1-2% of MXU throughput;
+* grouped-but-not-depthwise convs tile partially (classified separately).
+
+This is the analytical half of the VERDICT r3 item 2 roofline: the
+EfficientNet family's depthwise stages bound its MFU regardless of
+scheduling, while ViT has no depthwise work at all.  Usage::
+
+    python tools/flops_breakdown.py efficientnet_b4 --size 380
+    python tools/flops_breakdown.py vit_base_patch16_224 --size 224
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def conv_flops(eqn) -> float:
+    out = eqn.outvars[0].aval
+    rhs = eqn.invars[1].aval          # kernel (H, W, Cin/g, Cout)
+    # 2 * output elements * taps per output element
+    kh, kw, cin_per_group, _ = rhs.shape
+    return 2.0 * float(np.prod(out.shape)) * kh * kw * cin_per_group
+
+
+def dot_flops(eqn) -> float:
+    lhs = eqn.invars[0].aval
+    out = eqn.outvars[0].aval
+    ((lc, _), _) = eqn.params["dimension_numbers"]
+    k = float(np.prod([lhs.shape[i] for i in lc]))
+    return 2.0 * float(np.prod(out.shape)) * k
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model")
+    ap.add_argument("--size", type=int, default=380)
+    ap.add_argument("--chans", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=1)
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from deepfake_detection_tpu.models import create_model, init_model
+
+    model = create_model(args.model, num_classes=2, in_chans=args.chans)
+    variables = init_model(model, jax.random.PRNGKey(0),
+                           (1, args.size, args.size, args.chans))
+    x = jnp.zeros((args.batch, args.size, args.size, args.chans))
+    jaxpr = jax.make_jaxpr(
+        lambda v, x: model.apply(v, x, training=False))(variables, x)
+
+    buckets = defaultdict(float)
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            for sub in (v for v in eqn.params.values()
+                        if hasattr(v, "jaxpr")):
+                walk(sub.jaxpr)
+            if eqn.primitive.name == "conv_general_dilated":
+                g = eqn.params["feature_group_count"]
+                cin = eqn.invars[0].aval.shape[-1]
+                kind = ("conv_dense_mxu" if g == 1 else
+                        "conv_depthwise_vpu" if g == cin else
+                        "conv_grouped_partial")
+                buckets[kind] += conv_flops(eqn)
+            elif eqn.primitive.name == "dot_general":
+                buckets["dot_mxu"] += dot_flops(eqn)
+
+    walk(jaxpr.jaxpr)
+    total = sum(buckets.values())
+    out = {"model": args.model, "input":
+           f"{args.size}x{args.size}x{args.chans}", "batch": args.batch,
+           "total_gflops_fwd": round(total / 1e9, 2)}
+    for k, v in sorted(buckets.items(), key=lambda kv: -kv[1]):
+        out[k] = {"gflops": round(v / 1e9, 2),
+                  "pct": round(100 * v / total, 2)}
+    print(json.dumps(out, indent=1))
