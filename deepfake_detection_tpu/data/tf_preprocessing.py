@@ -1,0 +1,153 @@
+"""TF-semantics preprocessing bridge, without TensorFlow.
+
+Re-design of ``/root/reference/dfd/timm/data/tf_preprocessing.py`` (the
+MnasNet/EfficientNet TF eval pipeline the reference exposes behind
+``--tf-preprocessing``).  The reference builds a TF1 graph + Session per
+transform and feeds raw JPEG bytes; here the same *math* runs on decoded
+arrays in pure numpy — half-pixel-center separable resampling with the
+Keys a=-0.5 bicubic (exactly ``tf.image.resize``'s default semantics,
+antialias off), so TF resize behavior comes without a TF dependency and
+without per-sample device dispatch from loader threads.
+
+Exposed as a library surface: ``create_transform(..,
+tf_preprocessing=True)`` (mirroring the reference's loader kwarg,
+loader.py:381-385) — the active deepfake clip path never uses it, same
+as the reference.
+
+Parity notes (reference :108-127, :86-105, :135-175):
+
+* eval: center crop of ``size/(size+CROP_PADDING) · min(H, W)`` (the
+  "crop padding" formula), offsets ``((dim - crop) + 1) // 2``, then
+  bicubic/bilinear resize to ``size``²;
+* train: TF's ``sample_distorted_bounding_box`` over the whole image
+  (aspect 3/4–4/3, area 8–100%, 10 attempts, center-crop fallback), then
+  resize and a coin-flip horizontal mirror;
+* output is uint8 HWC in [0, 255] — NHWC is this package's wire format
+  (the reference emits CHW for torch, :225-228).
+
+The per-sample RNG is the explicit ``numpy.random.Generator`` every
+transform here receives; TF's graph-level randomness is not reproducible
+across worker layouts, this is.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple, Union
+
+import numpy as np
+
+__all__ = ["TfPreprocessTransform", "CROP_PADDING"]
+
+CROP_PADDING = 32          # reference :25
+
+
+def _axis_weights(in_size: int, out_size: int, method: str
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-output-position tap indices and weights, TF2 semantics:
+    half-pixel centers, no antialias widening, Keys bicubic a=-0.5."""
+    scale = in_size / out_size
+    center = (np.arange(out_size) + 0.5) * scale - 0.5
+    base = np.floor(center).astype(int)
+    if method == "bilinear":
+        idx = np.stack([base, base + 1], 1)
+        frac = center - base
+        w = np.stack([1 - frac, frac], 1)
+    else:                                    # bicubic, Keys a = -0.5
+        idx = np.stack([base - 1, base, base + 1, base + 2], 1)
+        t = np.abs(center[:, None] - idx)
+        a = -0.5
+        w = np.where(
+            t <= 1, (a + 2) * t ** 3 - (a + 3) * t ** 2 + 1,
+            np.where(t < 2,
+                     a * t ** 3 - 5 * a * t ** 2 + 8 * a * t - 4 * a, 0.0))
+    # boundary: taps outside the image are dropped and the remaining
+    # weights renormalized (tf.image.resize / jax.image.resize semantics,
+    # NOT edge-clamping — the two differ by several gray levels at borders)
+    inside = (idx >= 0) & (idx < in_size)
+    w = np.where(inside, w, 0.0)
+    w = w / w.sum(axis=1, keepdims=True)
+    return np.clip(idx, 0, in_size - 1), w.astype(np.float32)
+
+
+def _resize(img: np.ndarray, size: int, interpolation: str) -> np.ndarray:
+    """Separable numpy resample — pure host work: a per-sample
+    ``jax.image.resize`` would recompile for every fresh random crop shape
+    AND dispatch to the training TPU from loader threads."""
+    method = "bicubic" if interpolation == "bicubic" else "bilinear"
+    x = img.astype(np.float32)
+    idx, w = _axis_weights(x.shape[0], size, method)
+    x = (x[idx] * w[..., None, None]).sum(axis=1)        # rows
+    idx, w = _axis_weights(x.shape[1], size, method)
+    x = (x[:, idx] * w[None, ..., None]).sum(axis=2)     # cols
+    return x
+
+
+def _center_crop(img: np.ndarray, size: int,
+                 interpolation: str) -> np.ndarray:
+    """Reference ``_decode_and_center_crop`` (:108-127)."""
+    h, w = img.shape[:2]
+    crop = int((size / (size + CROP_PADDING)) * min(h, w))
+    top = ((h - crop) + 1) // 2
+    left = ((w - crop) + 1) // 2
+    return _resize(img[top:top + crop, left:left + crop], size,
+                   interpolation)
+
+
+def _sample_distorted_box(h: int, w: int, rng: np.random.Generator,
+                          area_range=(0.08, 1.0),
+                          aspect_ratio_range=(3. / 4, 4. / 3),
+                          min_object_covered: float = 0.1,
+                          max_attempts: int = 10
+                          ) -> Optional[Tuple[int, int, int, int]]:
+    """TF ``sample_distorted_bounding_box`` over the whole-image bbox:
+    aspect ratio UNIFORM in range (not torchvision's log-uniform), crop
+    dims from the sampled area, a crop rejected unless it covers
+    ``min_object_covered`` of the bbox (= the whole image here), uniform
+    offsets; None after ``max_attempts`` failures (reference :86-105 then
+    falls back to the center crop)."""
+    area = h * w
+    for _ in range(max_attempts):
+        target_area = rng.uniform(*area_range) * area
+        aspect = rng.uniform(*aspect_ratio_range)
+        cw = int(round(math.sqrt(target_area * aspect)))
+        ch = int(round(math.sqrt(target_area / aspect)))
+        if not (0 < cw <= w and 0 < ch <= h):
+            continue
+        if ch * cw < min_object_covered * area:
+            continue            # TF rejects crops covering <10% of the bbox
+        top = int(rng.integers(0, h - ch + 1))
+        left = int(rng.integers(0, w - cw + 1))
+        return top, left, ch, cw
+    return None
+
+
+class TfPreprocessTransform:
+    """Drop-in for the reference class (:199-228), PIL/ndarray → uint8 HWC."""
+
+    def __init__(self, is_training: bool = False,
+                 size: Union[int, Tuple[int, int]] = 224,
+                 interpolation: str = "bicubic"):
+        self.is_training = is_training
+        self.size = size[0] if isinstance(size, (tuple, list)) else size
+        self.interpolation = interpolation
+
+    def __call__(self, img: Any,
+                 rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        rng = rng if rng is not None else np.random.default_rng()
+        arr = np.asarray(img, dtype=np.uint8)
+        if arr.ndim == 2:
+            arr = np.stack([arr] * 3, -1)
+        if self.is_training:
+            box = _sample_distorted_box(arr.shape[0], arr.shape[1], rng)
+            if box is None:
+                out = _center_crop(arr, self.size, self.interpolation)
+            else:
+                top, left, ch, cw = box
+                out = _resize(arr[top:top + ch, left:left + cw],
+                              self.size, self.interpolation)
+            if rng.random() < 0.5:
+                out = out[:, ::-1]
+        else:
+            out = _center_crop(arr, self.size, self.interpolation)
+        return out.round().clip(0, 255).astype(np.uint8)

@@ -150,13 +150,20 @@ def transforms_imagenet_eval(img_size: Union[int, Tuple[int, int]] = 224,
                     ToNumpy()])
 
 
-def create_transform(input_size, is_training: bool = False, **kwargs
-                     ) -> Compose:
-    """Dispatch to train or eval ImageNet pipeline (reference :358+)."""
+def create_transform(input_size, is_training: bool = False,
+                     tf_preprocessing: bool = False, **kwargs):
+    """Dispatch to train or eval ImageNet pipeline (reference :358+);
+    ``tf_preprocessing=True`` selects the TF-semantics bridge (reference
+    :381-385 — here TF-free, data/tf_preprocessing.py)."""
     img_size = input_size[-2:] if isinstance(input_size, (tuple, list)) \
         else input_size
     if isinstance(img_size, (tuple, list)) and img_size[0] == img_size[1]:
         img_size = img_size[0]
+    if tf_preprocessing:
+        from .tf_preprocessing import TfPreprocessTransform
+        return TfPreprocessTransform(
+            is_training=is_training, size=img_size,
+            interpolation=kwargs.get("interpolation", "bicubic"))
     if is_training:
         keys = ("scale", "ratio", "hflip", "vflip", "color_jitter",
                 "auto_augment", "interpolation", "mean")

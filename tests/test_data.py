@@ -176,6 +176,47 @@ class TestDeepFakeClipDataset:
         img, y = ds[0]
         assert img.shape == (32, 32, 12)
 
+    def test_tf_preprocessing_bridge(self):
+        """TF-semantics bridge without TF (reference tf_preprocessing.py):
+        eval crop-padding formula, train distorted-box sampling, uint8 HWC."""
+        from deepfake_detection_tpu.data.tf_preprocessing import (
+            CROP_PADDING, TfPreprocessTransform)
+        from deepfake_detection_tpu.data.transforms_factory import \
+            create_transform
+        rng = np.random.default_rng(0)
+        arr = rng.integers(0, 256, (300, 260, 3)).astype(np.uint8)
+
+        ev = TfPreprocessTransform(is_training=False, size=224)
+        out = ev(Image.fromarray(arr), rng)
+        assert out.shape == (224, 224, 3) and out.dtype == np.uint8
+        # deterministic and equal to the hand-computed crop window
+        crop = int((224 / (224 + CROP_PADDING)) * 260)
+        top, left = ((300 - crop) + 1) // 2, ((260 - crop) + 1) // 2
+        np.testing.assert_array_equal(out, ev(arr, rng))
+        assert crop == 227 and top == 37 and left == 17
+
+        tr = TfPreprocessTransform(is_training=True, size=96)
+        a = tr(arr, np.random.default_rng(1))
+        b = tr(arr, np.random.default_rng(2))
+        assert a.shape == b.shape == (96, 96, 3)
+        assert not np.array_equal(a, b)        # random crop/flip applied
+
+        t = create_transform(224, is_training=False, tf_preprocessing=True)
+        assert isinstance(t, TfPreprocessTransform)
+
+        # the pure-numpy resampler must match TF2 resize semantics —
+        # jax.image.resize (same half-pixel/Keys-bicubic definition) is
+        # the available oracle
+        import jax
+        from deepfake_detection_tpu.data.tf_preprocessing import _resize
+        src = rng.integers(0, 256, (57, 41, 3)).astype(np.uint8)
+        for method in ("bicubic", "bilinear"):
+            ours = _resize(src, 32, method)
+            oracle = np.asarray(jax.image.resize(
+                src.astype(np.float32), (32, 32, 3), method=method,
+                antialias=False))
+            np.testing.assert_allclose(ours, oracle, atol=1e-2)
+
     def test_dataset_tar(self, tmp_path):
         """DatasetTar (reference dataset.py:602-630): classes from member
         dirnames sorted naturally; thread-safe reads; transform+rng path."""
