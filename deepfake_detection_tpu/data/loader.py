@@ -310,6 +310,12 @@ def create_deepfake_loader_v3(
             # them; a post-blend device jitter would correlate the two
             # sources' photometrics — keep host order under mixup
             _logger.info("mixup active: color jitter stays on host")
+        elif num_aug_splits > 1:
+            # AugMix views of one sample share the base transform's single
+            # jitter draw (host chain); as separate batch rows they would
+            # get INDEPENDENT device draws, changing what the JSD
+            # consistency loss measures — keep host jitter under aug-splits
+            _logger.info("aug-splits active: color jitter stays on host")
         else:
             device_cj = tuple(float(v) for v in cj[:3]) if cj else None
             device_flicker, flicker = flicker, 0.0
