@@ -50,7 +50,8 @@ from ..parallel import (batch_sharding, initialize_distributed, make_mesh,
                         transformer_tp_sharding)
 from ..scheduler import create_scheduler
 from ..train import (CheckpointSaver, create_train_state, make_eval_step,
-                     make_train_step, restore_train_state, set_learning_rate,
+                     make_train_step, replicate_for_save,
+                     restore_train_state, set_learning_rate,
                      train_one_epoch, validate, wait_pending_saves)
 from ..utils import get_outdir, setup_default_logging, update_summary
 
@@ -305,9 +306,13 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
                                os.path.join(output_dir, "summary.csv"),
                                os.path.join(output_dir, "plots"),
                                write_header=epoch == start_epoch)
+            # multi-host TP/EP: every rank gathers model-sharded leaves
+            # (collective) so rank 0 can serialize; no-op otherwise
+            save_state = replicate_for_save(state) \
+                if jax.process_count() > 1 else state
             if saver is not None:
                 best_metric, best_epoch = saver.save_checkpoint(
-                    state, meta, epoch,
+                    save_state, meta, epoch,
                     metric=eval_metrics[cfg.eval_metric])
     except KeyboardInterrupt:                      # reference :588
         pass

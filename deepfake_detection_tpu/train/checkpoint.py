@@ -18,6 +18,7 @@ checkpoint — the reference's ``torch.save`` has no such guard.
 
 from __future__ import annotations
 
+import functools
 import glob
 import logging
 import operator
@@ -33,9 +34,16 @@ from flax import serialization
 _logger = logging.getLogger(__name__)
 
 __all__ = ["CheckpointSaver", "save_checkpoint_file", "load_checkpoint_file",
-           "restore_train_state", "wait_pending_saves"]
+           "replicate_for_save", "restore_train_state", "wait_pending_saves"]
 
 _EXT = ".ckpt"
+
+
+def _needs_gather(x: Any) -> bool:
+    """True for leaves only a cross-process collective can fetch: sharded
+    over devices this process cannot address AND not replicated."""
+    return isinstance(x, jax.Array) and not x.is_fully_addressable \
+        and not x.is_fully_replicated
 
 
 def _to_host(x: Any) -> np.ndarray:
@@ -48,13 +56,49 @@ def _to_host(x: Any) -> np.ndarray:
     on rank 0 only, so raise with the remedy instead of deadlocking in a
     one-sided all-gather.
     """
-    if isinstance(x, jax.Array) and not x.is_fully_addressable \
-            and not x.is_fully_replicated:
+    if _needs_gather(x):
         raise RuntimeError(
-            "checkpoint save of a multi-host model-sharded array: gather "
-            "params to a replicated sharding on ALL processes before "
-            "saving (rank-0-only saving cannot enter a collective)")
+            "checkpoint save of a multi-host model-sharded array: call "
+            "replicate_for_save(state) on ALL processes before saving "
+            "(rank-0-only saving cannot enter a collective)")
     return np.asarray(x)
+
+
+def replicate_for_save(state: Any) -> Any:
+    """Gather multi-host model-sharded leaves to a replicated layout.
+
+    A rank-0-only saver cannot all-gather (the other ranks never enter the
+    collective), so EVERY process calls this first; rank 0 then serializes
+    from its local replica.  The gather is a jit identity with replicated
+    ``out_shardings`` — the one mechanism that reshards across processes
+    (an eager ``device_put`` cannot move non-addressable shards and
+    deadlocks).  No-op unless tensor/expert-parallel state actually spans
+    hosts (single-host any-sharding and multi-host pure-DP pass through).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    leaves, treedef = jax.tree.flatten(state)
+    idx = [i for i, x in enumerate(leaves) if _needs_gather(x)]
+    if not idx:
+        return state
+    # gather ONLY the offending leaves: other leaves (e.g. the step counter
+    # on a single device) belong to different device sets and cannot join
+    # the same jitted computation
+    sub = [leaves[i] for i in idx]
+    out_sh = tuple(NamedSharding(x.sharding.mesh, PartitionSpec())
+                   for x in sub)
+    gathered = _gather_identity(out_sh)(*sub)
+    for i, g in zip(idx, gathered):
+        leaves[i] = g
+    return jax.tree.unflatten(treedef, leaves)
+
+
+@functools.lru_cache(maxsize=8)
+def _gather_identity(out_sh: tuple):
+    """Cached jitted identity per output-sharding tuple — a fresh lambda per
+    save would retrace + recompile the all-gather every epoch (and expose
+    every rank to compile-skew at exactly the rendezvous window)."""
+    return jax.jit(lambda *t: t, out_shardings=out_sh)
 
 
 # one background writer: at most one save in flight, joined before the next

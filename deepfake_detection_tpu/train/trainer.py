@@ -89,6 +89,7 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
     # whole buffered backlog (so .avg is the accurate number); the plateau
     # scheduler sees a loss avg that is up to log_interval steps stale.
     pending: list = []
+    step_exec = None       # multi-process: AOT executable (_compile_aligned)
 
     def _drain() -> None:
         for m, n in pending:
@@ -108,7 +109,10 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
             profiling = True
 
         step_rng = jax.random.fold_in(rng, num_updates)
-        state, metrics = train_step(state, x, y, step_rng)
+        if batch_idx == 0 and step_exec is None:
+            step_exec = _compile_aligned(train_step, "train_step",
+                                         state, x, y, step_rng)
+        state, metrics = (step_exec or train_step)(state, x, y, step_rng)
 
         if profiling and (batch_idx + 1 >= profile_start + profile_n
                           or last_batch):
@@ -147,10 +151,16 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                                     f"train-batch-{batch_idx}.jpg"),
                     img_num=max(1, cfg.resolved_in_chans // 3))
 
-        if saver is not None and cfg.recovery_interval and (
+        if cfg.recovery_interval and (
                 last_batch or (batch_idx + 1) % cfg.recovery_interval == 0):
-            saver.save_recovery(state, meta or {}, epoch,
-                                batch_idx=batch_idx)   # reference :686-689
+            # EVERY rank computes this condition and enters the gather
+            # (collective) — only rank 0 (the one holding a saver) writes
+            from .checkpoint import replicate_for_save
+            save_state = replicate_for_save(state) \
+                if jax.process_count() > 1 else state
+            if saver is not None:
+                saver.save_recovery(save_state, meta or {}, epoch,
+                                    batch_idx=batch_idx)  # reference :686-689
 
         if lr_scheduler is not None:
             # no stock schedule consumes a per-update metric (plateau is
@@ -183,10 +193,14 @@ def validate(eval_step: Callable, state: TrainState, loader, cfg,
     num_batches = len(loader)
     last_idx = num_batches - 1
     log_name = "Test" + log_suffix
+    eval_exec = None
     for batch_idx, batch in enumerate(loader):
         x, y = batch[0], batch[1]
         valid = batch[2] if len(batch) > 2 else None
-        metrics = eval_step(state, x, y, valid)
+        if batch_idx == 0:
+            eval_exec = _compile_aligned(eval_step, "eval_step",
+                                         state, x, y, valid)
+        metrics = (eval_exec or eval_step)(state, x, y, valid)
         n = float(metrics["count"])
         if n > 0:
             losses_m.update(float(metrics["loss"]), n)
@@ -227,6 +241,43 @@ def validate(eval_step: Callable, state: TrainState, loader, cfg,
         out["auc"] = float(auc(scores, labels, valids))
         _logger.info("%s: AUC %.5f", log_name, out["auc"])
     return out
+
+
+def _compile_aligned(fn, tag: str, *args):
+    """Multi-process: AOT-compile a step, barrier, return the executable.
+
+    Cross-process collective-context creation (gloo on CPU; similar
+    rendezvous elsewhere) has a short (~30 s) deadline that fires during
+    the FIRST execution if another rank is still jit-compiling — and jit
+    compilation is host-synchronous, so per-rank compile skew (minutes on
+    contended hosts) lands entirely between one rank's enqueue and the
+    other's.  Compiling ahead-of-time and meeting at a barrier puts every
+    rank's first execution within milliseconds; the returned executable is
+    then used for EVERY step (batch shapes are static), so nothing
+    compiles twice.  Returns None (caller keeps the plain jit path) for
+    single-process runs or if AOT lowering fails.
+    """
+    if jax.process_count() <= 1 or not hasattr(fn, "lower"):
+        return None
+    # memoize on the jitted-function object (built once per run): later
+    # epochs / validate calls reuse the executable with no recompile and
+    # no extra barrier
+    exe = getattr(fn, "_aligned_exec", None)
+    if exe is not None:
+        return exe
+    try:
+        exe = fn.lower(*args).compile()
+    except Exception as e:  # noqa: BLE001 — alignment must never kill a run
+        _logger.warning("%s pre-compile failed (%r); continuing on the "
+                        "plain jit path", tag, e)
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(f"{tag}_compiled")
+    if exe is not None:
+        try:
+            fn._aligned_exec = exe
+        except AttributeError:
+            pass                       # non-writable callables: recompile
+    return exe
 
 
 def _host_local_rows(a) -> np.ndarray:
