@@ -234,6 +234,10 @@ class TrainConfig:
     # parallelism: builds a (data, model) 2-D mesh and applies the
     # Megatron-paired shardings from parallel/tp.py (ViT/TimeSformer)
     checkpoint_policy: str = "none"      # remat policy: none|full|dots
+    # transformer attention kernel: "" = model default (full). 'flash' runs
+    # the Pallas kernels; 'ring'/'ring_flash'/'ulysses' are sequence-
+    # parallel and need an sp mesh — library-level for now (models/vit.py)
+    attn_impl: str = ""
 
     # ------------------------------------------------------------------
     def __post_init__(self):

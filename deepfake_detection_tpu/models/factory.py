@@ -27,6 +27,9 @@ __all__ = ["create_model", "create_deepfake_model", "create_deepfake_model_v3",
 _BN_KWARG_MODULES = ("efficientnet", "mobilenetv3")
 # modules that consume the remat policy (TrainConfig.checkpoint_policy)
 _REMAT_MODULES = _BN_KWARG_MODULES + ("vit", "timesformer")
+# modules with a pluggable attention kernel (TrainConfig.attn_impl)
+_ATTN_MODULES = ("vit", "timesformer")
+_ATTN_IMPLS = ("full", "flash", "ring", "ring_flash", "ulysses")
 
 
 def create_model(model_name: str, pretrained: bool = False,
@@ -49,6 +52,17 @@ def create_model(model_name: str, pretrained: bool = False,
             logging.getLogger(__name__).warning(
                 "remat_policy=%r is only consumed by the %s families; "
                 "ignored for %s", v, _REMAT_MODULES, model_name)
+    if (ai := kwargs.get("attn_impl")) is not None:
+        if ai not in _ATTN_IMPLS:
+            # a typo must not silently fall back to dense attention
+            raise ValueError(f"attn_impl={ai!r}: expected one of "
+                             f"{_ATTN_IMPLS}")
+        if not is_model_in_modules(model_name, _ATTN_MODULES):
+            kwargs.pop("attn_impl")
+            import logging
+            logging.getLogger(__name__).warning(
+                "attn_impl=%r is only consumed by the %s families; "
+                "ignored for %s", ai, _ATTN_MODULES, model_name)
     dcr = kwargs.pop("drop_connect_rate", None)
     if dcr is not None and "drop_path_rate" not in kwargs:
         kwargs["drop_path_rate"] = dcr

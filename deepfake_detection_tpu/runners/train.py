@@ -84,6 +84,14 @@ def build_model(cfg: TrainConfig, in_chans: int):
                                             cfg.compute_dtype != "float32")
         else None)
     kwargs = {k: v for k, v in kwargs.items() if v is not None}
+    if cfg.attn_impl:
+        if cfg.attn_impl in ("ring", "ring_flash", "ulysses"):
+            raise ValueError(
+                f"--attn-impl {cfg.attn_impl}: sequence-parallel attention "
+                f"needs an sp mesh and token-sharded inputs — construct the "
+                f"model with sp_mesh/seq_axis directly (models/vit.py); the "
+                f"CLI supports 'full' and 'flash'")
+        kwargs["attn_impl"] = cfg.attn_impl   # ViT/TimeSformer families
     if factory is create_model:
         return create_model(cfg.model, **kwargs)
     return factory(cfg.model, **kwargs)

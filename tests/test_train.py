@@ -243,6 +243,37 @@ class TestCodeReviewRegressions:
         assert kept == ["checkpoint-1.ckpt", "checkpoint-2.ckpt"]
 
 
+def test_attn_impl_cli_flag():
+    """--attn-impl reaches the transformer families; SP impls are rejected
+    with the sp-mesh remedy; CNNs are unaffected when unset."""
+    from deepfake_detection_tpu.config import TrainConfig
+    from deepfake_detection_tpu.runners.train import build_model
+    cfg = TrainConfig.from_args([
+        "--model", "vit_tiny_patch16_224", "--model-version", "",
+        "--attn-impl", "flash"])
+    m = build_model(cfg, 3)
+    assert m.attn_impl == "flash"
+    cfg = TrainConfig.from_args([
+        "--model", "vit_tiny_patch16_224", "--model-version", "",
+        "--attn-impl", "ring"])
+    with pytest.raises(ValueError, match="sp mesh"):
+        build_model(cfg, 3)
+    cfg = TrainConfig.from_args(["--model", "mnasnet_small",
+                                 "--model-version", ""])
+    build_model(cfg, 3)     # no attn kwarg leaks into CNN families
+    # CNN + --attn-impl: warn-and-ignore (factory pattern), not TypeError
+    cfg = TrainConfig.from_args(["--model", "mnasnet_small",
+                                 "--model-version", "",
+                                 "--attn-impl", "flash"])
+    build_model(cfg, 3)
+    # a typo must not silently fall back to dense attention
+    cfg = TrainConfig.from_args([
+        "--model", "vit_tiny_patch16_224", "--model-version", "",
+        "--attn-impl", "flsh"])
+    with pytest.raises(ValueError, match="expected one of"):
+        build_model(cfg, 3)
+
+
 def test_profile_flag_writes_trace(tmp_path, devices):
     """--profile N produces a jax.profiler trace directory (SURVEY §5)."""
     from deepfake_detection_tpu.runners.train import launch_main
