@@ -41,6 +41,11 @@ from types import SimpleNamespace
 
 _T0 = time.perf_counter()
 
+# persistent compilation cache: retried/fallback runs and the driver's own
+# invocation share compiles (TPU compiles through the relay are slow)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+
 
 def _log(msg: str) -> None:
     print(f"[bench +{time.perf_counter() - _T0:6.1f}s] {msg}",

@@ -353,6 +353,44 @@ class TestDeepFakeClipDataset:
         out = np.asarray(fn(jnp.asarray(x), key))
         np.testing.assert_allclose(out, x, atol=1e-3)
 
+    def test_device_color_jitter_full_chain_vs_pil(self):
+        """All three ops active: device output equals the PIL ImageEnhance
+        chain applied in the SAME (replicated) order with the SAME factors
+        — catches order-application and contrast-mean bugs the
+        brightness-only test cannot."""
+        import jax
+        import jax.numpy as jnp
+        from PIL import ImageEnhance
+        from deepfake_detection_tpu.data.device_augment import \
+            make_device_color_jitter
+
+        rng = np.random.default_rng(3)
+        frame = rng.integers(0, 256, (16, 16, 3)).astype(np.uint8)
+        x = np.concatenate([frame] * 4, -1)[None].astype(np.float32)
+        fn = make_device_color_jitter((0.4, 0.4, 0.4), 0.0, 4)
+        key = jax.random.PRNGKey(11)
+        out = np.asarray(fn(jnp.asarray(x), key))[0, :, :, :3]
+
+        # replicate the draws exactly as device_augment does
+        skey = jax.random.split(key, 1)[0]
+        kb, kc, ks, kord, _ = jax.random.split(skey, 5)
+        b = float(jax.random.uniform(kb, (), minval=0.6, maxval=1.4))
+        c = float(jax.random.uniform(kc, (), minval=0.6, maxval=1.4))
+        s = float(jax.random.uniform(ks, (), minval=0.6, maxval=1.4))
+        order = np.asarray(jax.random.permutation(kord, 3))
+        img = Image.fromarray(frame)
+        for op in order:
+            if op == 0:
+                img = ImageEnhance.Brightness(img).enhance(b)
+            elif op == 1:
+                img = ImageEnhance.Contrast(img).enhance(c)
+            else:
+                img = ImageEnhance.Color(img).enhance(s)
+        pil = np.asarray(img, np.float32)
+        # PIL rounds to uint8 after each op; device stays float between
+        # clamps — a few gray levels of accumulated rounding drift
+        assert np.abs(out - pil).max() <= 4.0, np.abs(out - pil).max()
+
     def test_loader_device_jitter_e2e(self, tmp_path):
         """Train loader with device jitter (default): output is finite,
         correctly shaped, and differs from the jitter-free pipeline."""
