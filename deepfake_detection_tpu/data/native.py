@@ -97,8 +97,19 @@ def _load() -> Optional[ctypes.CDLL]:
         return _lib
 
 
+_ABI_VERSION = 2           # must match dfd_abi_version() in dfd_native.cc
+
+
 def _bind_symbols(lib) -> None:
-    """Declare ctypes signatures; raises AttributeError on a stale .so."""
+    """Declare ctypes signatures; raises AttributeError on a stale .so
+    (missing symbol) and RuntimeError on an ABI mismatch — symbols that
+    still resolve but whose argument layout moved would otherwise be
+    called with shifted arguments and crash instead of falling back."""
+    lib.dfd_abi_version.restype = ctypes.c_int
+    got = lib.dfd_abi_version()
+    if got != _ABI_VERSION:
+        raise AttributeError(f"dfd_native ABI {got} != expected "
+                             f"{_ABI_VERSION}")
     u8p = ctypes.POINTER(ctypes.c_uint8)
     lib.dfd_decode_jpeg_file.restype = u8p
     lib.dfd_decode_jpeg_file.argtypes = [
@@ -119,12 +130,12 @@ def _bind_symbols(lib) -> None:
         ctypes.POINTER(ctypes.c_int)]
     lib.dfd_warp_affine.argtypes = [
         u8p, ctypes.c_int, ctypes.c_int,
-        u8p, ctypes.c_int, ctypes.c_int,
+        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_double)]
     lib.dfd_pool_warp_affine.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(u8p),
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
-        ctypes.POINTER(u8p), ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(u8p), ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_double)]
 
 
@@ -215,16 +226,19 @@ class DecodePool:
 
 
 def warp_affine_batch(frames: Sequence[np.ndarray], coeffs: Sequence[float],
-                      out_size, pool: Optional["DecodePool"] = None
-                      ) -> Optional[List[np.ndarray]]:
+                      out_size, pool: Optional["DecodePool"] = None,
+                      packed: bool = False):
     """Bilinear-warp a clip's frames with one shared affine draw.
 
     ``coeffs`` = (A, B, C, D, E, F) maps output (x, y) → source coords (PIL
     ``Image.transform(AFFINE)`` convention); ``out_size`` = (width, height).
-    Returns (H, W, 3) uint8 arrays, or None when the native library is
-    unavailable (caller falls back to PIL).  Frames warp in parallel on the
-    shared worker pool — this is the one-pass replacement for the
-    rotate/flip/resize/crop PIL chain (transforms.py::MultiFusedGeometric).
+    Returns (H, W, 3) uint8 arrays — or, with ``packed=True``, ONE
+    (H, W, 3·n) array each frame wrote its channel slice of (strided dst),
+    so the downstream channel-concat copy disappears.  None when the
+    native library is unavailable (caller falls back to PIL).  Frames warp
+    in parallel on the shared worker pool — this is the one-pass
+    replacement for the rotate/flip/resize/crop PIL chain
+    (transforms.py::MultiFusedGeometric).
     """
     lib = _load()
     if lib is None:
@@ -232,22 +246,32 @@ def warp_affine_batch(frames: Sequence[np.ndarray], coeffs: Sequence[float],
     tw, th = int(out_size[0]), int(out_size[1])
     n = len(frames)
     if n == 0:
-        return []
+        return np.empty((th, tw, 0), np.uint8) if packed else []
     frames = [np.ascontiguousarray(f, dtype=np.uint8) for f in frames]
-    outs = [np.empty((th, tw, 3), np.uint8) for _ in range(n)]
     u8p = ctypes.POINTER(ctypes.c_uint8)
+    if packed:
+        out = np.empty((th, tw, 3 * n), np.uint8)
+        base = out.ctypes.data
+        stride = 3 * n
+        dsts = (u8p * n)(*[ctypes.cast(base + 3 * i, u8p)
+                           for i in range(n)])
+    else:
+        outs = [np.empty((th, tw, 3), np.uint8) for _ in range(n)]
+        stride = 3
+        dsts = (u8p * n)(*[o.ctypes.data_as(u8p) for o in outs])
     srcs = (u8p * n)(*[f.ctypes.data_as(u8p) for f in frames])
-    dsts = (u8p * n)(*[o.ctypes.data_as(u8p) for o in outs])
     sws = (ctypes.c_int * n)(*[f.shape[1] for f in frames])
     shs = (ctypes.c_int * n)(*[f.shape[0] for f in frames])
     c = (ctypes.c_double * 6)(*[float(v) for v in coeffs])
     p = pool or default_pool()
     if p is not None:
-        lib.dfd_pool_warp_affine(p._pool, n, srcs, sws, shs, dsts, tw, th, c)
+        lib.dfd_pool_warp_affine(p._pool, n, srcs, sws, shs, dsts, tw, th,
+                                 stride, c)
     else:
         for i in range(n):
-            lib.dfd_warp_affine(srcs[i], sws[i], shs[i], dsts[i], tw, th, c)
-    return outs
+            lib.dfd_warp_affine(srcs[i], sws[i], shs[i], dsts[i], tw, th,
+                                stride, c)
+    return out if packed else outs
 
 
 _default_pool: Optional[DecodePool] = None

@@ -35,6 +35,7 @@ __all__ = [
     "MultiToNumpy", "MultiConcate", "MultiRandomHorizontalFlip", "MultiBlur",
     "MultiRotate", "MultiRandomResize", "MultiRandomCrop", "MultiCenterCrop",
     "MultiColorJitter", "MultiFlicker", "MultiFusedGeometric",
+    "PackedFrames",
 ]
 
 _PIL_INTERP = {
@@ -291,10 +292,31 @@ class ColorJitter:
 # Multi-frame (clip) transforms — shared random params across frames
 # ---------------------------------------------------------------------------
 
+class PackedFrames(list):
+    """Frame views into ONE pre-packed (H, W, 3·F) uint8 buffer.
+
+    The native warp writes every frame's channel slice directly into the
+    packed buffer (strided dst), so if no downstream transform replaced a
+    frame, MultiConcate can return ``base`` with zero copies.  Any
+    replaced item (a blurred PIL frame, a jittered copy) voids the
+    shortcut and the normal concatenate runs."""
+
+    def __init__(self, views, base: np.ndarray):
+        super().__init__(views)
+        self.base = base
+        self._orig = tuple(views)
+
+    def untouched(self) -> bool:
+        return len(self) == len(self._orig) and all(
+            a is b for a, b in zip(self, self._orig))
+
+
 class MultiToNumpy:
     """List of PIL frames → list of (H, W, 3) uint8 arrays (NHWC)."""
 
     def __call__(self, pil_imgs, rng=None) -> List[np.ndarray]:
+        if isinstance(pil_imgs, PackedFrames) and pil_imgs.untouched():
+            return pil_imgs                 # already uint8 ndarray views
         out = []
         for pil_img in pil_imgs:
             a = np.asarray(pil_img, dtype=np.uint8)
@@ -308,6 +330,8 @@ class MultiConcate:
     """Concatenate frames on the channel axis → (H, W, 3*img_num)."""
 
     def __call__(self, np_imgs, rng=None) -> np.ndarray:
+        if isinstance(np_imgs, PackedFrames) and np_imgs.untouched():
+            return np_imgs.base             # frames pre-packed by the warp
         return np.concatenate(np_imgs, axis=-1)
 
 
@@ -491,9 +515,14 @@ class MultiFusedGeometric:
         if native.available():
             arrs = [np.asarray(im, np.uint8) if not isinstance(
                 im, np.ndarray) else im for im in imgs]
-            out = native.warp_affine_batch(arrs, coeffs, (tw, th))
-            if out is not None:
-                return out                     # (H, W, 3) uint8 arrays
+            base = native.warp_affine_batch(arrs, coeffs, (tw, th),
+                                            packed=True)
+            if base is not None:
+                # channel-slice views; MultiConcate returns base copy-free
+                # if no later transform replaces a frame
+                n = len(imgs)
+                return PackedFrames(
+                    [base[..., 3 * i:3 * i + 3] for i in range(n)], base)
         return [img.transform((tw, th), Image.AFFINE, coeffs,
                               resample=Image.BILINEAR,
                               fillcolor=(self.fill,) * 3)
@@ -515,9 +544,13 @@ class MultiBlur:
         self.blur_radiu = blur_radiu
 
     def __call__(self, imgs, rng: np.random.Generator):
-        return [_as_pil(img).filter(
-                    ImageFilter.GaussianBlur(radius=self.blur_radiu))
-                if rng.random() < self.p else img for img in imgs]
+        out = [_as_pil(img).filter(
+                   ImageFilter.GaussianBlur(radius=self.blur_radiu))
+               if rng.random() < self.p else img for img in imgs]
+        if isinstance(imgs, PackedFrames) and all(
+                a is b for a, b in zip(out, imgs)):
+            return imgs         # keep the copy-free packed fast path alive
+        return out
 
 
 class MultiFlicker:
@@ -533,5 +566,9 @@ class MultiFlicker:
             if isinstance(img, np.ndarray):
                 return np.zeros_like(img)
             return Image.new("RGB", img.size)
-        return [black(img) if rng.random() < self.probability
-                else img for img in imgs]
+        out = [black(img) if rng.random() < self.probability
+               else img for img in imgs]
+        if isinstance(imgs, PackedFrames) and all(
+                a is b for a, b in zip(out, imgs)):
+            return imgs         # keep the copy-free packed fast path alive
+        return out

@@ -132,6 +132,11 @@ uint8_t* decode_file(const char* path, int scale_denom, int* w, int* h) {
 
 void dfd_free(uint8_t* p) { std::free(p); }
 
+// Bumped on any signature change; the python bridge refuses to drive a
+// stale .so whose symbols still resolve but whose argument layout moved
+// (extern "C" has no mangling to catch that).
+int dfd_abi_version(void) { return 2; }
+
 // ---------------------------------------------------------------------------
 // affine warp (bilinear, RGB8, black fill)
 // ---------------------------------------------------------------------------
@@ -143,8 +148,11 @@ void dfd_free(uint8_t* p) { std::free(p); }
 
 namespace {
 
+// dst_stride: bytes between consecutive output PIXELS (3 for a tight RGB
+// buffer; 3*num_frames when each frame writes its channel slice of a packed
+// (H, W, 3*F) clip so the loader never pays a concat copy).
 void warp_affine_rgb8(const uint8_t* src, int sw, int sh, uint8_t* dst,
-                      int dw, int dh, const double* coef) {
+                      int dw, int dh, int dst_stride, const double* coef) {
   // 16.16 fixed point: source coords step by a constant per output x, so
   // the whole inner loop is integer adds/shifts; weights use 8 fractional
   // bits (wx*wy fits 16) — ±1 LSB vs float bilinear, invisible after the
@@ -157,11 +165,11 @@ void warp_affine_rgb8(const uint8_t* src, int sw, int sh, uint8_t* dst,
         std::llround((coef[1] * y + coef[2]) * kOne));
     int64_t sy = static_cast<int64_t>(
         std::llround((coef[4] * y + coef[5]) * kOne));
-    uint8_t* row = dst + static_cast<size_t>(y) * dw * 3;
+    uint8_t* row = dst + static_cast<size_t>(y) * dw * dst_stride;
     for (int x = 0; x < dw; ++x, sx += Ai, sy += Di) {
       const int x0 = static_cast<int>(sx >> 16);   // floor for sx >= 0 ...
       const int y0 = static_cast<int>(sy >> 16);   // ... and for sx < 0 too
-      uint8_t* px = row + 3 * x;
+      uint8_t* px = row + static_cast<size_t>(dst_stride) * x;
       const uint32_t wx1 = (sx >> 8) & 0xff, wx0 = 256 - wx1;
       const uint32_t wy1 = (sy >> 8) & 0xff, wy0 = 256 - wy1;
       const uint8_t* r0 = src + (static_cast<size_t>(y0) * sw + x0) * 3;
@@ -208,8 +216,9 @@ void warp_affine_rgb8(const uint8_t* src, int sw, int sh, uint8_t* dst,
 }  // namespace
 
 void dfd_warp_affine(const uint8_t* src, int sw, int sh, uint8_t* dst,
-                     int dw, int dh, const double* coef) {
-  warp_affine_rgb8(src, sw, sh, dst, dw, dh, coef);
+                     int dw, int dh, int dst_stride, const double* coef) {
+  warp_affine_rgb8(src, sw, sh, dst, dw, dh,
+                   dst_stride > 0 ? dst_stride : 3, coef);
 }
 
 uint8_t* dfd_decode_jpeg(const uint8_t* data, size_t size, int scale_denom,
@@ -331,15 +340,20 @@ void dfd_pool_decode_buffers(void* pool, int n, const uint8_t** datas,
 }
 
 // Warp n same-coef frames in parallel (one clip's frames share the draw).
-// dsts[i] must be preallocated dw*dh*3 buffers.
+// dsts[i] must be preallocated writable buffers honoring dst_stride: tight
+// dw*dh*3 allocations with dst_stride=3, or interior pointers (base + 3*i)
+// into ONE dw*dh*3*n packed clip with dst_stride=3*n.
 void dfd_pool_warp_affine(void* pool, int n, const uint8_t** srcs,
                           const int* sws, const int* shs, uint8_t** dsts,
-                          int dw, int dh, const double* coef) {
+                          int dw, int dh, int dst_stride,
+                          const double* coef) {
   Pool* p = static_cast<Pool*>(pool);
+  const int stride = dst_stride > 0 ? dst_stride : 3;
   Latch latch(n);
   for (int i = 0; i < n; ++i) {
     p->Submit([&, i] {
-      warp_affine_rgb8(srcs[i], sws[i], shs[i], dsts[i], dw, dh, coef);
+      warp_affine_rgb8(srcs[i], sws[i], shs[i], dsts[i], dw, dh, stride,
+                       coef);
       latch.Done();
     });
   }

@@ -263,6 +263,31 @@ class TestDeepFakeClipDataset:
         ds.set_epoch(3)
         assert a.epoch == b.epoch == 3
 
+    def test_packed_frames_skip_concat_copy(self):
+        """The native warp pre-packs frames into one (H, W, 12) buffer;
+        MultiToNumpy/MultiConcate must pass it through copy-free unless a
+        later transform replaced a frame."""
+        from deepfake_detection_tpu.data import native
+        from deepfake_detection_tpu.data.transforms import (
+            MultiBlur, MultiConcate, MultiFusedGeometric, MultiToNumpy,
+            PackedFrames)
+        if not native.available():
+            pytest.skip("native library unavailable")
+        g = np.add.outer(np.arange(80), np.arange(80)) % 256
+        img = Image.fromarray(np.stack([g] * 3, -1).astype(np.uint8))
+        rng = np.random.default_rng(0)
+        frames = MultiFusedGeometric(64)([img] * 4, rng)
+        assert isinstance(frames, PackedFrames)
+        out = MultiConcate()(MultiToNumpy()(frames, rng), rng)
+        assert out is frames.base and out.shape == (64, 64, 12)
+        # blur that fires voids the shortcut but still yields a clip
+        blurred = MultiBlur(1.0, 1.0)(frames, rng)
+        out2 = MultiConcate()(MultiToNumpy()(blurred, rng), rng)
+        assert out2 is not frames.base and out2.shape == (64, 64, 12)
+        # blur that does NOT fire keeps the packed identity
+        same = MultiBlur(0.0, 1.0)(frames, rng)
+        assert same is frames
+
     def test_fused_geometric_matches_sequential_chain(self):
         """MultiFusedGeometric (one warp) vs the reference-exact sequential
         rotate/flip/resize/crop chain: same rng draws, same geometry — mean
