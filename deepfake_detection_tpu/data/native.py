@@ -230,8 +230,11 @@ def warp_affine_batch(frames: Sequence[np.ndarray], coeffs: Sequence[float],
                       packed: bool = False):
     """Bilinear-warp a clip's frames with one shared affine draw.
 
-    ``coeffs`` = (A, B, C, D, E, F) maps output (x, y) → source coords (PIL
-    ``Image.transform(AFFINE)`` convention); ``out_size`` = (width, height).
+    ``coeffs`` = (A, B, C, D, E, F) maps output pixel INDEX (x, y) →
+    source pixel INDEX (A·x+B·y+C, D·x+E·y+F); ``out_size`` =
+    (width, height).  NOTE this is index space, not PIL's
+    ``Image.transform`` continuous-coordinate convention (they differ by
+    (A+B)/2 − ½ in the constant terms).
     Returns (H, W, 3) uint8 arrays — or, with ``packed=True``, ONE
     (H, W, 3·n) array each frame wrote its channel slice of (strided dst),
     so the downstream channel-concat copy disappears.  None when the
@@ -262,6 +265,11 @@ def warp_affine_batch(frames: Sequence[np.ndarray], coeffs: Sequence[float],
     srcs = (u8p * n)(*[f.ctypes.data_as(u8p) for f in frames])
     sws = (ctypes.c_int * n)(*[f.shape[1] for f in frames])
     shs = (ctypes.c_int * n)(*[f.shape[0] for f in frames])
+    # INDEX-SPACE convention: output pixel index (x, y) samples source
+    # INDEX (A·x+B·y+C, D·x+E·y+F).  PIL's Image.transform differs by a
+    # half-pixel term (it maps continuous coords: index A·x+B·y+
+    # (C+(A+B)/2−½)) — callers holding PIL-convention coeffs must convert
+    # (see MultiFusedGeometric's fallback, which does the reverse).
     c = (ctypes.c_double * 6)(*[float(v) for v in coeffs])
     p = pool or default_pool()
     if p is not None:

@@ -523,7 +523,16 @@ class MultiFusedGeometric:
                 n = len(imgs)
                 return PackedFrames(
                     [base[..., 3 * i:3 * i + 3] for i in range(n)], base)
-        return [img.transform((tw, th), Image.AFFINE, coeffs,
+        # coeffs are an INDEX-space map (output pixel index → source pixel
+        # index, the native kernel's convention); PIL's Image.transform
+        # maps continuous coordinates, which shifts the constant terms by
+        # (A+B)/2 − ½ — up to a FULL pixel under a flip (A = −1/s).
+        # Unconverted, the fallback silently disagreed with the native
+        # path; tests only caught it once they ran this branch explicitly
+        # (DFD_NO_NATIVE_DECODE=1 parametrization)
+        pil_coeffs = (A, B, C - (A + B) / 2 + 0.5,
+                      D, E, F - (D + E) / 2 + 0.5)
+        return [img.transform((tw, th), Image.AFFINE, pil_coeffs,
                               resample=Image.BILINEAR,
                               fillcolor=(self.fill,) * 3)
                 for img in imgs]

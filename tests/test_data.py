@@ -288,10 +288,17 @@ class TestDeepFakeClipDataset:
         same = MultiBlur(0.0, 1.0)(frames, rng)
         assert same is frames
 
-    def test_fused_geometric_matches_sequential_chain(self):
+    @pytest.mark.parametrize("native_path", [True, False])
+    def test_fused_geometric_matches_sequential_chain(self, native_path,
+                                                      monkeypatch):
         """MultiFusedGeometric (one warp) vs the reference-exact sequential
         rotate/flip/resize/crop chain: same rng draws, same geometry — mean
-        pixel diff is resampling noise only."""
+        pixel diff is resampling noise only.  Parametrized over BOTH warp
+        backends: the C kernel and the PIL Image.transform fallback (whose
+        index→continuous coefficient conversion a native-only run would
+        never execute)."""
+        if not native_path:
+            monkeypatch.setenv("DFD_NO_NATIVE_DECODE", "1")
         from deepfake_detection_tpu.data.transforms import (
             MultiFusedGeometric, MultiRandomCrop,
             MultiRandomHorizontalFlip, MultiRandomResize, MultiRotate)
@@ -324,9 +331,14 @@ class TestDeepFakeClipDataset:
                 # tens of gray levels
                 assert np.abs(a - b).mean() < 2.0, (w, h, seed)
 
-    def test_fused_geometric_identity_params_exact(self):
+    @pytest.mark.parametrize("native_path", [True, False])
+    def test_fused_geometric_identity_params_exact(self, native_path,
+                                                   monkeypatch):
         """With rotate 0 and scale pinned to 1 the fused warp degenerates to
-        flip+crop and must be pixel-exact vs the sequential chain."""
+        flip+crop and must be pixel-exact vs the sequential chain (both
+        warp backends)."""
+        if not native_path:
+            monkeypatch.setenv("DFD_NO_NATIVE_DECODE", "1")
         from deepfake_detection_tpu.data.transforms import (
             MultiFusedGeometric, MultiRandomCrop,
             MultiRandomHorizontalFlip, MultiRandomResize)

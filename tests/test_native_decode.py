@@ -100,3 +100,51 @@ def test_dataset_uses_native_path(jpeg_dir, tmp_path, monkeypatch):
     assert target == target2
     for a, b in zip(imgs_native, imgs_pil):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_warp_boundary_fuzz():
+    """Fixed-point C warp vs PIL Image.transform across adversarial
+    geometries: tiny sources, strong down/up-scales, windows mostly
+    outside the source (black fill), mirrored and rotated maps."""
+    rng = np.random.default_rng(0)
+    cases = [
+        (5, 7, 32, (0.1, 0.0, -3.0, 0.0, 0.1, -3.0)),       # huge upscale
+        (333, 117, 16, (25.0, 0.0, 0.0, 0.0, 9.0, 0.0)),    # huge downscale
+        (64, 64, 48, (1.0, 0.0, 48.0, 0.0, 1.0, 48.0)),     # mostly outside
+        (41, 53, 40, (-1.0, 0.0, 40.5, 0.0, -1.0, 52.5)),   # mirrored
+        (97, 97, 64, (0.7, 0.21, -5.0, -0.21, 0.7, 11.0)),  # rotation-ish
+    ]
+    for sw, sh, out, coef in cases:
+        src = rng.integers(0, 256, (sh, sw, 3)).astype(np.uint8)
+        got = native.warp_affine_batch([src], coef, (out, out))[0]
+        # the kernel maps pixel INDICES; PIL transform maps continuous
+        # coords — convert the oracle's constants (see native.py)
+        A, B, C, D, E, F = coef
+        pil_coef = (A, B, C - (A + B) / 2 + 0.5,
+                    D, E, F - (D + E) / 2 + 0.5)
+        ref = np.asarray(Image.fromarray(src).transform(
+            (out, out), Image.AFFINE, pil_coef, resample=Image.BILINEAR,
+            fillcolor=(0, 0, 0)), np.float32)
+        # classify output pixels by their source position: interior (all
+        # four taps inside), fully outside, or the 1-tap frontier where
+        # PIL's fill semantics and our black-tap fade legitimately differ
+        xs = np.arange(out)
+        sx = A * xs[None, :] + B * xs[:, None] + C
+        sy = D * xs[None, :] + E * xs[:, None] + F
+        interior = (np.floor(sx) >= 0) & (np.floor(sx) + 1 <= sw - 1) \
+            & (np.floor(sy) >= 0) & (np.floor(sy) + 1 <= sh - 1)
+        outside = (np.floor(sx) < -1) | (np.floor(sx) >= sw) \
+            | (np.floor(sy) < -1) | (np.floor(sy) >= sh)
+        d = np.abs(got.astype(np.float32) - ref)
+        if interior.any():
+            # fixed-point (8-bit weights) vs float bilinear: ±1-2 levels
+            assert d[interior].max() <= 2.0, (sw, sh, coef,
+                                              d[interior].max())
+        if outside.any():
+            assert np.all(got[outside] == 0), (sw, sh, coef)
+        # packed mode writes the identical pixels through the stride
+        packed = native.warp_affine_batch([src] * 3, coef, (out, out),
+                                          packed=True)
+        for i in range(3):
+            np.testing.assert_array_equal(packed[..., 3 * i:3 * i + 3],
+                                          got)
