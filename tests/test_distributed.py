@@ -43,14 +43,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_two_process(tmp_path, extra_args, timeout=1200):
+def _run_two_process(tmp_path, extra_args, timeout=1200, tag=""):
     cluster = {
         "world_size": 2,
         "coordinator_address": f"localhost:{_free_port()}",
         "servers": [{"name": socket.gethostname(), "gpus": "",
                      "local_size": 2, "start_rank": 0}],
     }
-    cluster_json = tmp_path / "cluster.json"
+    cluster_json = tmp_path / f"cluster{tag}.json"
     cluster_json.write_text(json.dumps(cluster))
 
     env = dict(os.environ)
@@ -69,7 +69,8 @@ def _run_two_process(tmp_path, extra_args, timeout=1200):
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _WORKER, *args,
-             "--local-rank", str(i), "--output", str(tmp_path / f"out{i}")],
+             "--local-rank", str(i),
+             "--output", str(tmp_path / f"out{tag}{i}")],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=_REPO)
         for i in range(2)
@@ -120,12 +121,25 @@ def test_two_process_train_and_validate(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_tensor_parallel(tmp_path):
+def test_two_process_tensor_parallel_and_resume(tmp_path):
     """dp×tp across the process boundary: a (4, 2) (data, model) mesh over
     2 processes — the 'model'-axis collectives GSPMD inserts for the
     Megatron-paired ViT shardings (parallel/tp.py) span processes, which
-    no single-process test can exercise."""
-    metrics = _run_two_process(tmp_path, [
-        "--model", "vit_tiny_patch16_224", "--model-version", "",
-        "--input-size-v2", "3,32,32", "--tp-size", "2"])
+    no single-process test can exercise.  Then RESUME from the rank-0
+    checkpoint with a second 2-process run: covers the multi-host
+    checkpoint round-trip (replicate_for_save gather on write, host
+    arrays re-laid onto cross-process TP shardings on read)."""
+    args = ["--model", "vit_tiny_patch16_224", "--model-version", "",
+            "--input-size-v2", "3,32,32", "--tp-size", "2"]
+    metrics = _run_two_process(tmp_path, args)
     _assert_lockstep(metrics)
+
+    ckpts = sorted(
+        p for p in (tmp_path / "out0").rglob("checkpoint-*.ckpt"))
+    assert ckpts, list((tmp_path / "out0").rglob("*"))
+    metrics2 = _run_two_process(
+        tmp_path, args + ["--resume", str(ckpts[-1]), "--epochs", "2"],
+        tag="r")
+    _assert_lockstep(metrics2)
+    # the resumed run really continued from epoch 1
+    assert metrics2[0]["best_epoch"] == 1, metrics2[0]
