@@ -111,11 +111,22 @@ def init_model(model, rng: jax.Array, input_shape: Tuple[int, ...],
     """Initialize variables ({'params', 'batch_stats', ...}) for a model.
 
     ``input_shape`` is NHWC, e.g. ``(1, 600, 600, 12)``.
+
+    The init runs under ``jax.jit``: eager Flax init dispatches every
+    constituent op separately, which is pathological on high-dispatch-latency
+    backends (the axon TPU relay: ~0.5-1 s per dispatch x hundreds of ops in
+    an EfficientNet made bare ``model.init`` take >10 min); one compiled
+    program is a single dispatch, and the compile is shared through the
+    persistent compilation cache.
     """
     dummy = jnp.zeros(input_shape, dtype)
     p_rng, d_rng = jax.random.split(rng)
-    return model.init({"params": p_rng, "dropout": d_rng}, dummy,
-                      training=training)
+
+    def _init(p_rng, d_rng, dummy):
+        return model.init({"params": p_rng, "dropout": d_rng}, dummy,
+                          training=training)
+
+    return jax.jit(_init)(p_rng, d_rng, dummy)
 
 
 def create_model_and_params(model_name: str, rng: Optional[jax.Array] = None,

@@ -37,18 +37,45 @@ class TrainState(flax.struct.PyTreeNode):
         return self.ema if self.ema is not None else self.variables
 
 
+def _all_single_device(tree: Any) -> bool:
+    from jax.sharding import SingleDeviceSharding
+    for x in jax.tree.leaves(tree):
+        s = getattr(x, "sharding", None)
+        if s is not None and not isinstance(s, SingleDeviceSharding):
+            return False
+    return True
+
+
 def create_train_state(variables: Any, tx: optax.GradientTransformation,
                        with_ema: bool = False) -> TrainState:
     from ..utils.ema import init_ema
-    params = variables["params"]
-    batch_stats = variables.get("batch_stats", {})
-    return TrainState(
-        step=jnp.zeros((), jnp.int32),
-        params=params,
-        batch_stats=batch_stats,
-        opt_state=tx.init(params),
-        ema=init_ema({"params": params, "batch_stats": batch_stats})
-        if with_ema else None)
+
+    def build(variables: Any) -> TrainState:
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=tx.init(params),
+            ema=init_ema({"params": params, "batch_stats": batch_stats})
+            if with_ema else None)
+
+    # Single-device inputs run as ONE jitted program: eager ``tx.init`` plus
+    # the EMA clone dispatch O(param-leaves) ops, pathological on
+    # high-dispatch-latency backends (the axon TPU relay: >10 min for an
+    # EfficientNet).  ``variables`` is donated — the state takes ownership
+    # of the buffers like the eager path's aliasing did; without donation a
+    # full params+stats copy stays live as long as the caller's reference
+    # (flagship-scale models care).  Mesh-sharded inputs
+    # (tp/fsdp/multi-process) stay eager: ``zeros_like`` inherits each
+    # param's sharding exactly, the invariant the checkpoint-resume
+    # re-layout and the FSDP opt-state memory footprint both rely on,
+    # whereas jit output sharding is GSPMD's choice (observed: replicated
+    # opt_state on a (data, model) mesh).
+    if _all_single_device(variables):
+        return jax.jit(build, donate_argnums=0)(variables)
+    return build(variables)
 
 
 def _find_hyperparams(opt_state):

@@ -323,7 +323,16 @@ def test_grad_accum_matches_single_step(devices):
 
 
 def test_grad_accum_on_mesh(devices):
-    """A=2 inside the shard_map local-BN path runs and reduces correctly."""
+    """A=2 inside the shard_map local-BN path matches A=1 exactly.
+
+    The A=2 batch is the A=1 batch with every row doubled (``np.repeat``):
+    under the strided microbatch split each device's two microbatches are
+    then exactly its A=1 shard, so local-BN batch statistics — and hence
+    gradients — coincide microbatch-for-batch and the accumulated update
+    must equal the single-step update.  Deterministic, unlike the previous
+    loss-descent assertion, which was flipped by O(1e-8) init noise (e.g.
+    eager vs jitted ``model.init`` fuse the threefry RNG differently)
+    amplified through a fresh deep net's chaotic first steps."""
     from types import SimpleNamespace
     from jax.sharding import Mesh
     from deepfake_detection_tpu.losses import cross_entropy
@@ -331,20 +340,47 @@ def test_grad_accum_on_mesh(devices):
     from deepfake_detection_tpu.optim import create_optimizer
     from deepfake_detection_tpu.parallel import shard_batch
     mesh = Mesh(np.asarray(devices), ("data",))
-    m = create_model("mnasnet_small", num_classes=2, in_chans=3)
+    # drop_rate pinned to 0: dropout draws differ per microbatch (fold_in)
+    # and would break the A=1 vs A=2 equivalence being asserted
+    m = create_model("mnasnet_small", num_classes=2, in_chans=3,
+                     drop_rate=0.0)
     v = init_model(m, jax.random.PRNGKey(0), (2, 32, 32, 3), training=True)
     cfg = SimpleNamespace(opt="sgd", opt_eps=1e-8, momentum=0.0,
                           weight_decay=0.0, lr=0.01)
     tx = create_optimizer(cfg)
-    state = create_train_state(v, tx)
-    step = make_train_step(m, tx, cross_entropy, mesh=mesh, bn_mode="local",
-                           grad_accum=2)
-    # 8 devices × local 4 = global 32, split into 2 microbatches per device
-    x = shard_batch(np.asarray(
-        jax.random.normal(jax.random.PRNGKey(1), (32, 32, 32, 3))), mesh)
-    y = shard_batch(np.arange(32) % 2, mesh)
-    losses = []
-    for i in range(6):
-        state, metrics = step(state, x, y, jax.random.PRNGKey(3 + i))
-        losses.append(float(metrics["loss"]))
-    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # 8 devices × local 2 = global 16 for A=1; row-doubled 32 for A=2
+    x1 = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(1), (16, 32, 32, 3)))
+    y1 = np.arange(16) % 2
+    x2, y2 = np.repeat(x1, 2, axis=0), np.repeat(y1, 2, axis=0)
+    outs = {}
+    for accum, (xb, yb) in ((1, (x1, y1)), (2, (x2, y2))):
+        state = create_train_state(jax.tree.map(jnp.copy, v), tx)
+        step = make_train_step(m, tx, cross_entropy, mesh=mesh,
+                               bn_mode="local", grad_accum=accum,
+                               donate=False)
+        state, metrics = step(state, shard_batch(xb, mesh),
+                              shard_batch(yb, mesh), jax.random.PRNGKey(3))
+        outs[accum] = (state, float(metrics["loss"]))
+    assert np.isfinite(outs[1][1]) and abs(outs[1][1] - outs[2][1]) < 1e-5
+    # Tolerance is scaled by the GLOBAL update magnitude: a fresh deep net's
+    # first update is huge (~1e6 here), and block-final BN biases have a
+    # true gradient of ~0 (the next BN's mean-subtraction makes the loss
+    # invariant to them) computed as catastrophic cancellation of ~1e8
+    # summands — their absolute value is summation-order noise, so only
+    # deviations at the scale real gradients occupy are meaningful.
+    upd_scale = max(
+        float(np.abs(np.asarray(a) - np.asarray(p)).max())
+        for a, p in zip(jax.tree.leaves(outs[1][0].params),
+                        jax.tree.leaves(v["params"])))
+    assert upd_scale > 0
+    for a, b in zip(jax.tree.leaves(outs[1][0].params),
+                    jax.tree.leaves(outs[2][0].params)):
+        diff = float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        assert diff <= 1e-4 * upd_scale, (diff, upd_scale)
+    # batch_stats moved off init in both schedules (EMA applied once vs
+    # twice, so exact equality is not expected)
+    changed = [float(np.abs(np.asarray(a) - np.asarray(b)).max())
+               for a, b in zip(jax.tree.leaves(v["batch_stats"]),
+                               jax.tree.leaves(outs[2][0].batch_stats))]
+    assert max(changed) > 0
