@@ -14,7 +14,7 @@ from .dataset import (ConcatDataset, DatasetTar, DeepFakeClipDataset,
                       FolderDataset, SyntheticDataset,
                       read_clip_list, split_clips)
 from .loader import (DeviceLoader, HostLoader, create_deepfake_loader_v3,
-                     fast_collate)
+                     create_loader, fast_collate)
 from .mixup import FastCollateMixup, mixup_batch
 from .random_erasing import RandomErasing, random_erasing
 from .samplers import OrderedShardedSampler, ShardedTrainSampler

@@ -43,7 +43,7 @@ from .samplers import OrderedShardedSampler, ShardedTrainSampler
 from .transforms_factory import (transforms_deepfake_eval_v3,
                                  transforms_deepfake_train_v3)
 
-__all__ = ["fast_collate", "HostLoader", "DeviceLoader",
+__all__ = ["fast_collate", "HostLoader", "DeviceLoader", "create_loader",
            "create_deepfake_loader_v3"]
 
 
@@ -259,6 +259,93 @@ class DeviceLoader:
                 yield x, y
 
 
+def _build_loader(dataset, transform, batch_size: int, is_training: bool,
+                  num_aug_splits: int, collate_mixup, distributed: bool,
+                  num_shards: int, shard_index: int, seed: int,
+                  num_workers: int, prefetch_depth: int,
+                  valid_mask: Optional[bool],
+                  device_kwargs: dict) -> DeviceLoader:
+    """Shared factory tail: AugMix wrap, transform attach, sharded sampler
+    selection, host loader, device prologue.  Both :func:`create_loader`
+    and :func:`create_deepfake_loader_v3` end here."""
+    if is_training and num_aug_splits > 1:
+        # clean + (num_aug_splits-1) AugMix views per sample, feeding the
+        # JSD consistency loss (reference dataset.py:633-670)
+        assert collate_mixup is None, \
+            "aug_splits and the mixup collate are mutually exclusive " \
+            "(reference train.py:446)"
+        from .dataset import AugMixDataset
+        dataset = AugMixDataset(dataset, num_splits=num_aug_splits)
+    dataset.set_transform(transform)
+
+    if not distributed:
+        num_shards, shard_index = 1, 0
+    if is_training:
+        sampler: Any = ShardedTrainSampler(
+            len(dataset), num_shards=num_shards, shard_index=shard_index,
+            batch_size=batch_size, seed=seed, drop_last=True)
+    else:
+        sampler = OrderedShardedSampler(
+            len(dataset), num_shards=num_shards, shard_index=shard_index,
+            batch_size=batch_size)
+    if valid_mask is None:
+        valid_mask = not is_training
+    host = HostLoader(dataset, sampler, batch_size, seed=seed,
+                      num_workers=num_workers, prefetch_depth=prefetch_depth,
+                      collate_mixup=collate_mixup if is_training else None,
+                      valid_mask=valid_mask)
+    return DeviceLoader(host, seed=seed, **device_kwargs)
+
+
+def create_loader(
+        dataset, input_size, batch_size: int, is_training: bool = False,
+        re_prob: float = 0.0, re_mode: str = "const", re_count: int = 1,
+        re_split: bool = False, re_max: float = 0.02,
+        color_jitter: Any = 0.4,
+        auto_augment: Optional[str] = None, num_aug_splits: int = 0,
+        interpolation: str = "bilinear",
+        mean=IMAGENET_DEFAULT_MEAN, std=IMAGENET_DEFAULT_STD,
+        num_workers: int = 1, distributed: bool = False,
+        num_shards: int = 1, shard_index: int = 0,
+        crop_pct: Optional[float] = None,
+        collate_mixup: Optional[FastCollateMixup] = None,
+        dtype: Any = jnp.bfloat16, tf_preprocessing: bool = False,
+        seed: int = 42, prefetch_depth: int = 2,
+        sharding: Optional[Any] = None, valid_mask: Optional[bool] = None,
+        ) -> DeviceLoader:
+    """Generic single-image loader factory (reference loader.py:372-456).
+
+    The timm-style path for training the backbone families on folder /
+    tar / synthetic datasets — the deepfake clip path is
+    :func:`create_deepfake_loader_v3`.  Reference knobs map as: torch
+    ``DistributedSampler``/``OrderedDistributedSampler`` → the sharded
+    samplers (``distributed`` + ``num_shards``/``shard_index``);
+    ``use_prefetcher``/``fp16``/``pin_memory``/CUDA streams → the always-on
+    uint8-wire :class:`DeviceLoader` with ``dtype``; ``collate_fn`` →
+    ``collate_mixup`` (the only non-default collate the reference ever
+    passes, train.py:444).
+    """
+    from .transforms_factory import create_transform
+
+    re_num_splits = 0
+    if re_split:
+        # RE on the second half of the batch, or aligned with aug splits
+        # (reference :397-399)
+        re_num_splits = num_aug_splits or 2
+    transform = create_transform(
+        input_size, is_training=is_training, color_jitter=color_jitter,
+        auto_augment=auto_augment, interpolation=interpolation, mean=mean,
+        std=std, crop_pct=crop_pct, tf_preprocessing=tf_preprocessing)
+    return _build_loader(
+        dataset, transform, batch_size, is_training, num_aug_splits,
+        collate_mixup, distributed, num_shards, shard_index, seed,
+        num_workers, prefetch_depth, valid_mask,
+        dict(mean=mean, std=std, dtype=dtype,
+             re_prob=re_prob if is_training else 0.0, re_mode=re_mode,
+             re_count=re_count, re_num_splits=re_num_splits, re_max=re_max,
+             img_num=1, sharding=sharding))
+
+
 def create_deepfake_loader_v3(
         dataset, input_size, batch_size: int, is_training: bool = False,
         re_prob: float = 0.0, re_mode: str = "const", re_count: int = 1,
@@ -328,38 +415,14 @@ def create_deepfake_loader_v3(
             blur_prob=blur_prob, fused_geom=fused_geom)
     else:
         transform = transforms_deepfake_eval_v3(img_size, crop=eval_crop)
-    if is_training and num_aug_splits > 1:
-        # clean + (num_aug_splits-1) AugMix views per sample, feeding the
-        # JSD consistency loss (reference dataset.py:633-670)
-        assert collate_mixup is None, \
-            "aug_splits and mixup are mutually exclusive (reference " \
-            "train.py:446 asserts num_aug_splits precludes the mixup collate)"
-        from .dataset import AugMixDataset
-        dataset = AugMixDataset(dataset, num_splits=num_aug_splits)
-    dataset.set_transform(transform)
-
-    if not distributed:
-        num_shards, shard_index = 1, 0
-    if is_training:
-        sampler: Any = ShardedTrainSampler(
-            len(dataset), num_shards=num_shards, shard_index=shard_index,
-            batch_size=batch_size, seed=seed, drop_last=True)
-    else:
-        sampler = OrderedShardedSampler(
-            len(dataset), num_shards=num_shards, shard_index=shard_index,
-            batch_size=batch_size)
-    if valid_mask is None:
-        valid_mask = not is_training
-
-    host = HostLoader(dataset, sampler, batch_size, seed=seed,
-                      num_workers=num_workers, prefetch_depth=prefetch_depth,
-                      collate_mixup=collate_mixup if is_training else None,
-                      valid_mask=valid_mask)
     img_num = int(input_size[0] / 3) if isinstance(input_size, (tuple, list)) \
         else 1
-    return DeviceLoader(
-        host, mean=mean, std=std, dtype=dtype,
-        re_prob=re_prob if is_training else 0.0, re_mode=re_mode,
-        re_count=re_count, re_num_splits=re_num_splits, re_max=re_max,
-        img_num=max(1, img_num), seed=seed, sharding=sharding,
-        color_jitter=device_cj, flicker=device_flicker)
+    return _build_loader(
+        dataset, transform, batch_size, is_training, num_aug_splits,
+        collate_mixup, distributed, num_shards, shard_index, seed,
+        num_workers, prefetch_depth, valid_mask,
+        dict(mean=mean, std=std, dtype=dtype,
+             re_prob=re_prob if is_training else 0.0, re_mode=re_mode,
+             re_count=re_count, re_num_splits=re_num_splits, re_max=re_max,
+             img_num=max(1, img_num), sharding=sharding,
+             color_jitter=device_cj, flicker=device_flicker))
