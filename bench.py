@@ -171,9 +171,49 @@ def _probe_execution(devices) -> None:
     _log("device executes ok")
 
 
-# Last chip-verified TPU rows (updated whenever a live run succeeds); the
-# CPU fallback embeds these verbatim so BENCH_r*.json always carries real
-# TPU numbers even through a relay outage (VERDICT r3 item 1).
+# Committed artifact updated in place by every successful TPU run; the CPU
+# fallback embeds its rows verbatim so BENCH_r*.json always carries real TPU
+# numbers even through a relay outage (VERDICT r3 item 1).
+_TPU_ROWS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_TPU_ROWS.json")
+
+
+def _load_verified_tpu_rows() -> list:
+    try:
+        with open(_TPU_ROWS_PATH) as f:
+            rows = json.load(f)["rows"]
+        return [r for r in rows if "value" in r]
+    except (OSError, KeyError, ValueError):
+        return _LAST_VERIFIED_TPU_ROWS
+
+
+def _store_verified_tpu_rows(rows: list) -> None:
+    """Merge newly measured TPU rows into the artifact by metric name.
+
+    Merge, not replace: a custom single-config sweep or a budget-truncated
+    matrix run measures a subset of the configs, and replacing wholesale
+    would discard previously verified flagship/ViT rows from the fallback
+    set."""
+    measured = [r for r in rows if "value" in r and
+                str(r.get("device", "")).lower().startswith("tpu")]
+    if not measured:
+        return
+    merged = {r["metric"]: r for r in _load_verified_tpu_rows()}
+    for r in measured:
+        merged[r["metric"]] = dict(
+            r, source=f"chip_verified_{time.strftime('%Y-%m-%d')}")
+    try:
+        with open(_TPU_ROWS_PATH, "w") as f:
+            json.dump({"note": "last chip-verified TPU bench rows "
+                               "(auto-updated by a successful bench.py TPU "
+                               "run; embedded by the CPU fallback)",
+                       "rows": list(merged.values())}, f, indent=1)
+        _log(f"chip-verified rows stored -> {_TPU_ROWS_PATH}")
+    except OSError as e:
+        _log(f"could not store verified rows: {e!r}")
+
+
+# Fallback of the fallback: rows as of the last run that edited this file.
 _LAST_VERIFIED_TPU_ROWS = [
     {"metric": "train_throughput_efficientnet_b4_380x380x3_b64",
      "value": 3606.7, "unit": "frames/sec/chip", "mfu": 0.548,
@@ -309,7 +349,7 @@ def main() -> None:
             "CPU fallback (TPU relay unreachable at run time); "
             "'tpu_verified_rows' embeds the last chip-verified TPU row "
             "set verbatim")
-        result["tpu_verified_rows"] = _LAST_VERIFIED_TPU_ROWS
+        result["tpu_verified_rows"] = _load_verified_tpu_rows()
         print(json.dumps(result), flush=True)
         return
 
@@ -379,6 +419,10 @@ def main() -> None:
                 _log(f"config {name} failed: {e!r}")
                 rows.append({"metric": name, "error": repr(e)[:300]})
 
+    if not custom and steps >= 10:
+        # quality gate: custom sweeps and low-step debug runs must not
+        # overwrite verified headline numbers under the same metric key
+        _store_verified_tpu_rows(rows)
     headline = next((r for r in rows if "value" in r), rows[0])
     result = dict(headline)
     result["rows"] = rows
