@@ -138,7 +138,17 @@ def save_checkpoint_file(path: str, state: Any,
     wait_pending_saves()              # at most one write/payload at a time
     from ..models.helpers import QKV_LAYOUT, has_fused_qkv
     meta = dict(meta or {})           # meta stays plain python (strs allowed)
-    sd = jax.tree.map(_to_host, serialization.to_state_dict(state))
+    sd_dev = serialization.to_state_dict(state)
+    # start every device->host copy before the first blocking np.asarray:
+    # a per-leaf blocking fetch serializes O(leaves) transfer round trips
+    # (painful on high-latency backends; the async pre-pass overlaps them)
+    for x in jax.tree.leaves(sd_dev):
+        if isinstance(x, jax.Array):
+            try:
+                x.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — _to_host surfaces real errors
+                pass
+    sd = jax.tree.map(_to_host, sd_dev)
     if has_fused_qkv(sd.get("params", {})):
         meta.setdefault("qkv_layout", QKV_LAYOUT)
     payload = {"state": sd, "meta": meta}
