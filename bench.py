@@ -4,8 +4,9 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...,
 "rows": [...]} — the headline metric is EfficientNet-B4 (the north-star
 benchmark model) and ``rows`` carries the full measured config matrix
 (VERDICT r3 item 1): B4 380², the flagship ``efficientnet_deepfake_v4``
-12×600² (with an OOM ladder over batch/remat), and ViT-B/16 224² with both
-dense and Pallas-flash attention.
+12×600² (with an OOM ladder over batch/remat), ViT-B/16 224² with both
+dense and Pallas-flash attention, and a forward-only B4 inference row
+(the reference serves inference from the same backbone, test.py).
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 MFU / 0.70 — the fraction of the driver-set north-star target of ≥70% MFU
@@ -229,19 +230,21 @@ _LAST_VERIFIED_TPU_ROWS = [
 
 
 def _run_config(devices, model_name: str, batch: int, size: int, chans: int,
-                steps: int, dtype, extra=None) -> dict:
-    """Measure one train-step config; returns a result row."""
+                steps: int, dtype, extra=None, mode: str = "train") -> dict:
+    """Measure one config (train step, or forward-only ``mode='infer'``);
+    returns a result row."""
     import jax
     import numpy as np
 
     from deepfake_detection_tpu.losses import cross_entropy
     from deepfake_detection_tpu.models import create_model, init_model
     from deepfake_detection_tpu.optim import create_optimizer
-    from deepfake_detection_tpu.train import create_train_state, \
-        make_train_step
+    from deepfake_detection_tpu.train import (create_train_state,
+                                              make_eval_step,
+                                              make_train_step)
 
     tag = "/".join(f"{k}={v}" for k, v in (extra or {}).items())
-    _log(f"config: {model_name} {size}x{size}x{chans} b{batch} "
+    _log(f"config[{mode}]: {model_name} {size}x{size}x{chans} b{batch} "
          f"steps={steps} {tag} on {devices[0].device_kind}")
     _log("building + initializing model ...")
     import jax.numpy as jnp
@@ -252,11 +255,20 @@ def _run_config(devices, model_name: str, batch: int, size: int, chans: int,
                            (2, size, size, chans), training=True)
     cfg = SimpleNamespace(opt="rmsproptf", opt_eps=1e-8, momentum=0.9,
                           weight_decay=1e-5, lr=1.2e-5)
-    tx = create_optimizer(cfg)
-    state = create_train_state(variables, tx, with_ema=True)
+    # forward-only rows skip optimizer slots and the EMA duplicate (~3-4x
+    # param memory a real deployment would not hold)
+    import optax
+    tx = create_optimizer(cfg) if mode != "infer" else optax.identity()
+    state = create_train_state(variables, tx, with_ema=mode != "infer")
     # single chip → no mesh; plain jit path
-    step = make_train_step(model, tx, cross_entropy, mesh=None,
-                           bn_mode="global", ema_decay=0.9998)
+    if mode == "infer":
+        eval_step = make_eval_step(model, cross_entropy)
+
+        def step(state, x, y, key):      # key ignored: deterministic eval
+            return state, eval_step(state, x, y)
+    else:
+        step = make_train_step(model, tx, cross_entropy, mesh=None,
+                               bn_mode="global", ema_decay=0.9998)
 
     # several distinct device-resident batches, cycled during measurement —
     # a single fixed batch gets memorized within ~2 steps (loss→0 in the
@@ -271,7 +283,7 @@ def _run_config(devices, model_name: str, batch: int, size: int, chans: int,
     key = jax.random.PRNGKey(1)
 
     # FLOPs of the whole compiled step from XLA cost analysis
-    _log("lowering + compiling train step ...")
+    _log(f"lowering + compiling {mode} step ...")
     lowered = jax.jit(step.__wrapped__ if hasattr(step, "__wrapped__")
                       else step).lower(state, x, y, key)
     compiled = lowered.compile()
@@ -305,7 +317,8 @@ def _run_config(devices, model_name: str, batch: int, size: int, chans: int,
     if extra and extra.get("attn_impl"):
         name += f"_{extra['attn_impl']}"
     row = {
-        "metric": f"train_throughput_{name}",
+        "metric": f"{'infer' if mode == 'infer' else 'train'}"
+                  f"_throughput_{name}",
         "value": round(frames_per_sec, 2),
         "unit": "frames/sec/chip",
         "vs_baseline": round(mfu / 0.70, 4) if np.isfinite(mfu) else None,
@@ -398,6 +411,11 @@ def main() -> None:
                 ("vit_flash", lambda: _run_config(
                     devices, "vit_base_patch16_224", 128, 224, 3, steps,
                     jnp.bfloat16, {"attn_impl": "flash"})),
+                # deployment story: forward-only B4 (the reference serves
+                # inference from the same backbone, test.py)
+                ("b4_infer", lambda: _run_config(
+                    devices, "efficientnet_b4", 128, 380, 3, steps,
+                    jnp.bfloat16, mode="infer")),
             ]
         matrix_t0 = None
         for name, fn in matrix:
