@@ -138,7 +138,7 @@ def test_res2net_training_step_grads():
                          rngs={"dropout": jax.random.PRNGKey(1)})
         return jnp.sum(out ** 2)
 
-    grads = jax.grad(loss_fn)(v["params"])
+    grads = jax.jit(jax.grad(loss_fn))(v["params"])
     flat = jax.tree.leaves(grads)
     assert any(bool(jnp.any(g != 0)) for g in flat)
 
