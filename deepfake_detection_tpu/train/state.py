@@ -48,6 +48,13 @@ def _all_single_device(tree: Any) -> bool:
 
 def create_train_state(variables: Any, tx: optax.GradientTransformation,
                        with_ema: bool = False) -> TrainState:
+    """Build the initial :class:`TrainState` from init/loaded ``variables``.
+
+    ``variables`` is CONSUMED on the single-device path (buffers donated
+    into the state — accessing them afterwards raises a donated-buffer
+    error); pass ``jax.tree.map(jnp.copy, variables)`` to keep a live
+    copy.  Mesh-sharded inputs are not donated.
+    """
     from ..utils.ema import init_ema
 
     def build(variables: Any) -> TrainState:
