@@ -212,6 +212,9 @@ class DeviceLoader:
                 x = erasing(ekey, x).astype(dtype)
             return x
 
+        # NOTE: donating the uint8 wire buffer here would be a no-op — XLA
+        # input->output aliasing needs matching byte sizes and the output is
+        # 2-4x wider (bf16/f32); refcounting already frees the temporary
         self._prologue = jax.jit(prologue)
 
     # pass-throughs (reference :274-289)
