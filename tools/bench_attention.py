@@ -65,13 +65,23 @@ def main() -> None:
                                                interpret=None)),
         }
         for name, fn in impls.items():
-            fwd_ms = bench(fn, q, k, v)
+            # isolate each (impl, L) point: a dense-attention OOM at long L
+            # must not kill the flash measurement at the same length
+            try:
+                fwd_ms = bench(fn, q, k, v)
 
-            def loss(q, k, v, _fn=fn):
-                return _fn(q, k, v).astype(jnp.float32).sum()
+                def loss(q, k, v, _fn=fn):
+                    return _fn(q, k, v).astype(jnp.float32).sum()
 
-            grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-            bwd_ms = bench(grad, q, k, v)
+                grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                bwd_ms = bench(grad, q, k, v)
+            except Exception as e:  # noqa: BLE001 — record, continue
+                # (XlaRuntimeError covers device OOM; Ctrl+C still raises)
+                print(json.dumps({
+                    "impl": name, "seq_len": L, "batch": args.batch,
+                    "error": repr(e)[:300], "device": dev.device_kind,
+                }), flush=True)
+                continue
             # attention FLOPs: 2·(2·B·H·L²·D) matmuls fwd, ~2.5x more bwd
             flops_fwd = 4 * args.batch * args.heads * L * L * args.head_dim
             print(json.dumps({
