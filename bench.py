@@ -184,7 +184,9 @@ def _load_verified_tpu_rows() -> list:
         with open(_TPU_ROWS_PATH) as f:
             rows = json.load(f)["rows"]
         return [r for r in rows if "value" in r]
-    except (OSError, KeyError, ValueError):
+    except (OSError, KeyError, ValueError, TypeError):
+        # TypeError: valid JSON of the wrong shape (top-level list, row not
+        # a dict) must fall back too — the fallback JSON line is guaranteed
         return _LAST_VERIFIED_TPU_ROWS
 
 

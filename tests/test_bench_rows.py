@@ -1,0 +1,75 @@
+"""Unit tests for bench.py's chip-verified row artifact (BENCH_TPU_ROWS.json).
+
+The artifact is the CPU fallback's only source of real TPU numbers during a
+relay outage, so its merge semantics are load-bearing: a budget-truncated
+or partial matrix run must never clobber previously verified rows.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture()
+def bench(tmp_path, monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod._TPU_ROWS_PATH = str(tmp_path / "rows.json")
+    return mod
+
+
+def _row(metric, value, device="TPU v5 lite", **kw):
+    return dict(metric=metric, value=value, device=device, **kw)
+
+
+class TestVerifiedRowStore:
+    def test_merge_keeps_unmeasured_rows(self, bench):
+        bench._store_verified_tpu_rows([_row("a", 1.0), _row("b", 2.0)])
+        bench._store_verified_tpu_rows([_row("b", 3.0)])
+        rows = {r["metric"]: r for r in bench._load_verified_tpu_rows()}
+        assert rows["a"]["value"] == 1.0          # survived the partial run
+        assert rows["b"]["value"] == 3.0          # updated in place
+        assert rows["b"]["source"].startswith("chip_verified_")
+
+    def test_non_tpu_and_errored_rows_never_stored(self, bench):
+        bench._store_verified_tpu_rows([
+            _row("cpu_row", 1.0, device="cpu"),
+            {"metric": "failed", "error": "boom", "device": "TPU v5 lite"},
+        ])
+        assert not os.path.exists(bench._TPU_ROWS_PATH)
+
+    def test_load_falls_back_to_builtin_rows(self, bench):
+        rows = bench._load_verified_tpu_rows()   # no file at the tmp path
+        assert rows == bench._LAST_VERIFIED_TPU_ROWS
+        assert all("value" in r for r in rows)
+
+    @pytest.mark.parametrize("content", [
+        "{not json",                       # invalid JSON
+        "[1, 2, 3]",                       # valid JSON, wrong shape
+        '{"rows": [1, 2]}',                # rows not dicts
+    ])
+    def test_corrupt_file_falls_back(self, bench, content):
+        with open(bench._TPU_ROWS_PATH, "w") as f:
+            f.write(content)
+        assert bench._load_verified_tpu_rows() == \
+            bench._LAST_VERIFIED_TPU_ROWS
+
+    def test_store_then_load_round_trip(self, bench):
+        stored = [_row("m1", 10.5, mfu=0.7), _row("m2", 2.0)]
+        bench._store_verified_tpu_rows(stored)
+        loaded = {r["metric"] for r in bench._load_verified_tpu_rows()}
+        # the first store seeds from the builtin fallback rows (by design:
+        # the last-known-good set survives), then adds the new metrics
+        builtin = {r["metric"] for r in bench._LAST_VERIFIED_TPU_ROWS}
+        assert loaded == builtin | {"m1", "m2"}
+        payload = json.load(open(bench._TPU_ROWS_PATH))
+        assert "note" in payload and len(payload["rows"]) == len(loaded)
