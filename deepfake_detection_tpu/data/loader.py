@@ -335,10 +335,13 @@ def create_loader(
         # RE on the second half of the batch, or aligned with aug splits
         # (reference :397-399)
         re_num_splits = num_aug_splits or 2
+    # the host transform uses mean only (auto-augment fill color);
+    # normalization with mean AND std happens in the device prologue, so
+    # std is deliberately not forwarded here
     transform = create_transform(
         input_size, is_training=is_training, color_jitter=color_jitter,
         auto_augment=auto_augment, interpolation=interpolation, mean=mean,
-        std=std, crop_pct=crop_pct, tf_preprocessing=tf_preprocessing)
+        crop_pct=crop_pct, tf_preprocessing=tf_preprocessing)
     return _build_loader(
         dataset, transform, batch_size, is_training, num_aug_splits,
         collate_mixup, distributed, num_shards, shard_index, seed,

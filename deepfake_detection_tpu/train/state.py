@@ -47,13 +47,16 @@ def _all_single_device(tree: Any) -> bool:
 
 
 def create_train_state(variables: Any, tx: optax.GradientTransformation,
-                       with_ema: bool = False) -> TrainState:
+                       with_ema: bool = False,
+                       donate: bool = True) -> TrainState:
     """Build the initial :class:`TrainState` from init/loaded ``variables``.
 
-    ``variables`` is CONSUMED on the single-device path (buffers donated
-    into the state — accessing them afterwards raises a donated-buffer
-    error); pass ``jax.tree.map(jnp.copy, variables)`` to keep a live
-    copy.  Mesh-sharded inputs are not donated.
+    By default ``variables`` is CONSUMED on the single-device path (buffers
+    donated into the state — accessing them afterwards raises a
+    donated-buffer error); pass ``donate=False`` to keep the input tree
+    live (at the cost of one params+stats copy), e.g. for param-norm
+    logging or building a second state from the same tree.  Mesh-sharded
+    inputs are never donated.
     """
     from ..utils.ema import init_ema
 
@@ -81,7 +84,7 @@ def create_train_state(variables: Any, tx: optax.GradientTransformation,
     # whereas jit output sharding is GSPMD's choice (observed: replicated
     # opt_state on a (data, model) mesh).
     if _all_single_device(variables):
-        return jax.jit(build, donate_argnums=0)(variables)
+        return jax.jit(build, donate_argnums=0 if donate else ())(variables)
     return build(variables)
 
 

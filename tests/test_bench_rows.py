@@ -27,8 +27,8 @@ def bench(tmp_path, monkeypatch):
     return mod
 
 
-def _row(metric, value, device="TPU v5 lite", **kw):
-    return dict(metric=metric, value=value, device=device, **kw)
+def _row(metric, value, device="TPU v5 lite", steps=10, **kw):
+    return dict(metric=metric, value=value, device=device, steps=steps, **kw)
 
 
 class TestVerifiedRowStore:
@@ -46,6 +46,24 @@ class TestVerifiedRowStore:
             {"metric": "failed", "error": "boom", "device": "TPU v5 lite"},
         ])
         assert not os.path.exists(bench._TPU_ROWS_PATH)
+
+    def test_low_step_rows_gated_per_row(self, bench):
+        """A 5-step flagship debug rung must not overwrite a verified row,
+        even when other rows in the same run pass the gate (ADVICE r4)."""
+        bench._store_verified_tpu_rows([_row("flagship", 100.0, steps=20)])
+        bench._store_verified_tpu_rows([
+            _row("flagship", 1.0, steps=5),       # OOM-ladder debug rung
+            _row("b4", 2.0, steps=20),
+        ])
+        rows = {r["metric"]: r for r in bench._load_verified_tpu_rows()}
+        assert rows["flagship"]["value"] == 100.0
+        assert rows["b4"]["value"] == 2.0
+
+    def test_write_is_atomic(self, bench):
+        """No .tmp residue after a store (crash-safe replace pattern)."""
+        bench._store_verified_tpu_rows([_row("a", 1.0)])
+        assert os.path.exists(bench._TPU_ROWS_PATH)
+        assert not os.path.exists(bench._TPU_ROWS_PATH + ".tmp")
 
     def test_load_falls_back_to_builtin_rows(self, bench):
         rows = bench._load_verified_tpu_rows()   # no file at the tmp path

@@ -48,6 +48,18 @@ class TestTrainState:
         state = set_learning_rate(state, 0.01)
         assert get_learning_rate(state) == pytest.approx(0.01)
 
+    def test_donate_false_keeps_input_tree_live(self):
+        """ADVICE r4: donate=False opts out of consuming ``variables``."""
+        model = create_model("mnasnet_small", num_classes=2, in_chans=3)
+        variables = init_model(model, jax.random.PRNGKey(0), (2, 32, 32, 3),
+                               training=True)
+        tx = create_optimizer(_opt_cfg(), inject=True)
+        state = create_train_state(variables, tx, donate=False)
+        # input tree is still readable after state creation
+        leaf = jax.tree.leaves(variables["params"])[0]
+        assert jnp.isfinite(leaf).all()
+        assert jax.tree.leaves(state.params)  # state built fine too
+
 
 class TestTrainStep:
     @pytest.mark.parametrize("bn_mode", ["local", "global"])

@@ -1,0 +1,236 @@
+"""Unattended TPU bench battery: probe -> measure -> commit (VERDICT r4 item 7).
+
+Round 4's post-mortem (ROUND4.md "Continuation session"): the round's only
+live relay window (03:45-03:57) was lost to manual sequencing and an
+eager-init stall.  This script makes recovery -> bench matrix -> attention
+microbench -> profile trace -> flagship dress rehearsal -> artifact commit
+ONE unattended loop, so a 10-minute relay window cannot be wasted again.
+
+Discipline (memory: axon-relay-handling):
+  * probe with a tiny jitted matmul under ``timeout`` before anything
+    expensive — ``jax.devices()`` can succeed while execution hangs;
+  * NEVER SIGKILL a client that holds a live relay session: stage
+    timeouts send SIGTERM and are generous (the wedge risk of a kill is
+    worse than a slow stage; bench.py additionally self-recovers by
+    re-exec'ing to CPU on an internal hang);
+  * share ``.jax_cache`` so the battery, the suite, and the driver's own
+    invocation reuse compiles.
+
+Stages run as subprocesses in the strict VERDICT order; each stage's
+stdout/stderr land in ``battery_logs/``.  A bench result whose device is
+not a TPU (CPU fallback fired) aborts the harvest and returns to probing.
+After any TPU harvest — even partial — artifacts are git-committed
+immediately.
+
+Usage::
+
+    python tools/chip_battery.py            # loop forever (daemon)
+    python tools/chip_battery.py --once     # single probe+harvest attempt
+    python tools/chip_battery.py --probe    # probe only, exit 0 if chip up
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from types import SimpleNamespace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOGDIR = os.path.join(REPO, "battery_logs")
+# Seconds between probes while the relay is down.  A down-relay probe
+# typically burns its full 240 s timeout hanging, so the effective cycle
+# is ~timeout + interval; keep the interval short — round 4's only live
+# window was 12 minutes long.
+PROBE_INTERVAL = 90
+
+PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices();"
+    "assert d and ('tpu' in (d[0].platform or '').lower() or "
+    "'tpu' in getattr(d[0], 'device_kind', '').lower()), d;"
+    "jax.jit(lambda x: x @ x)(jnp.ones((256, 256))).block_until_ready();"
+    "print('PROBE_OK', d[0].device_kind)"
+)
+
+
+def _log(msg: str) -> None:
+    ts = time.strftime("%H:%M:%S")
+    print(f"[battery {ts}] {msg}", flush=True)
+
+
+def _env() -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+    return env
+
+
+def probe(timeout: int = 240) -> bool:
+    """True iff the relay answers AND executes a tiny jitted program."""
+    p = subprocess.Popen([sys.executable, "-c", PROBE_SNIPPET],
+                         cwd=REPO, env=_env(), text=True,
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        stdout, stderr = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # SIGTERM, not SIGKILL: if the probe *connected* and then hung,
+        # a hard kill would wedge the relay server-side
+        p.terminate()
+        try:
+            p.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            _log("probe: did not unwind after SIGTERM; leaving it detached")
+        _log("probe: timeout (relay down or wedged)")
+        return False
+    r = SimpleNamespace(returncode=p.returncode, stdout=stdout or "",
+                        stderr=stderr or "")
+    ok = r.returncode == 0 and "PROBE_OK" in r.stdout
+    _log(f"probe: {'UP ' + r.stdout.strip() if ok else 'down'}")
+    if not ok and r.stderr:
+        _log("probe stderr tail: " + r.stderr.strip().splitlines()[-1][:200])
+    return ok
+
+
+def _run_stage(name: str, cmd: list, timeout: int, extra_env: dict | None = None):
+    """Run one battery stage; returns (ok, stdout_path)."""
+    os.makedirs(LOGDIR, exist_ok=True)
+    out_path = os.path.join(LOGDIR, f"{name}.out")
+    err_path = os.path.join(LOGDIR, f"{name}.err")
+    env = _env()
+    if extra_env:
+        env.update(extra_env)
+    _log(f"stage {name}: {' '.join(cmd)} (timeout {timeout}s)")
+    t0 = time.time()
+    with open(out_path, "w") as out, open(err_path, "w") as err:
+        # SIGTERM + grace on timeout — subprocess.run(timeout=...) would
+        # SIGKILL, and SIGKILLing a client holding a live relay session
+        # wedges the relay server-side for hours
+        p = subprocess.Popen(cmd, cwd=REPO, env=env, stdout=out, stderr=err)
+        try:
+            ok = p.wait(timeout=timeout) == 0
+        except subprocess.TimeoutExpired:
+            _log(f"stage {name}: TIMEOUT after {timeout}s; SIGTERM + grace")
+            p.terminate()
+            try:
+                p.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                _log(f"stage {name}: did not unwind after SIGTERM; "
+                     "leaving it running DETACHED (never SIGKILL a "
+                     "connected relay client) and moving on")
+            ok = False
+    _log(f"stage {name}: {'ok' if ok else 'FAILED'} in {time.time() - t0:.0f}s")
+    return ok, out_path
+
+
+def _bench_is_tpu(out_path: str) -> bool:
+    """Parse the last JSON line of a bench run; True iff measured on TPU."""
+    try:
+        with open(out_path) as f:
+            lines = [l for l in f if l.strip().startswith("{")]
+        row = json.loads(lines[-1])
+        dev = str(row.get("device", ""))
+        return dev.lower().startswith("tpu")
+    except Exception as e:  # noqa: BLE001 - any parse failure means no TPU row
+        _log(f"bench output parse failed: {e}")
+        return False
+
+
+def _commit(tag: str) -> None:
+    """Commit harvested artifacts (best-effort; battery must not die here)."""
+    paths = ["BENCH_TPU_ROWS.json", "battery_logs", "ATTN_BENCH.jsonl",
+             "BENCH_BATTERY.json", "DRESS_REHEARSAL.json", "traces"]
+    try:
+        subprocess.run(["git", "add", "-A", "--"] +
+                       [p for p in paths if os.path.exists(os.path.join(REPO, p))],
+                       cwd=REPO, check=True, capture_output=True)
+        r = subprocess.run(["git", "diff", "--cached", "--quiet"], cwd=REPO)
+        if r.returncode == 0:
+            _log("commit: nothing staged")
+            return
+        subprocess.run(["git", "commit", "-m", f"chip battery: {tag}"],
+                       cwd=REPO, check=True, capture_output=True)
+        _log(f"commit: done ({tag})")
+    except Exception as e:  # noqa: BLE001
+        _log(f"commit failed (continuing): {e}")
+
+
+def harvest() -> bool:
+    """Run the full battery once.  Returns True if TPU rows were captured."""
+    py = sys.executable
+
+    # 1. bench matrix (internally merges verified rows -> BENCH_TPU_ROWS.json)
+    ok, out = _run_stage("bench_matrix", [py, "bench.py"], timeout=3600)
+    if not (ok and _bench_is_tpu(out)):
+        _log("bench matrix did not produce TPU rows — returning to probe loop")
+        _commit("bench attempt (no TPU rows)")
+        return False
+    # keep a copy of the matrix JSON at repo root for the judge
+    with open(out) as f:
+        lines = [l for l in f if l.strip().startswith("{")]
+    with open(os.path.join(REPO, "BENCH_BATTERY.json"), "w") as f:
+        f.write(lines[-1])
+    _commit("TPU bench matrix captured")
+
+    # 2. flash-vs-dense attention microbench (VERDICT item 3)
+    ok2, out2 = _run_stage(
+        "bench_attention", [py, "tools/bench_attention.py"], timeout=2700)
+    if ok2:
+        with open(out2) as f, \
+                open(os.path.join(REPO, "ATTN_BENCH.jsonl"), "w") as g:
+            g.writelines(l for l in f if l.strip().startswith("{"))
+        _commit("attention microbench captured")
+
+    # 3. profiler trace for MXU/VPU/infeed attribution (VERDICT item 2)
+    trace_dir = os.path.join(REPO, "traces", "b4")
+    ok3, _ = _run_stage(
+        "profile_step",
+        [py, "tools/profile_step.py", "--out", trace_dir], timeout=1800)
+    if ok3:
+        _commit("profile trace captured")
+
+    # 4. flagship dress rehearsal through the real loader (VERDICT item 6)
+    dress = os.path.join(REPO, "tools", "dress_rehearsal.py")
+    if os.path.exists(dress):
+        ok4, out4 = _run_stage("dress_rehearsal", [py, dress], timeout=3600)
+        if ok4:
+            _commit("flagship dress rehearsal captured")
+
+    _log("harvest complete")
+    return True
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--once", action="store_true",
+                    help="one probe (+harvest if up), then exit")
+    ap.add_argument("--probe", action="store_true",
+                    help="probe only; exit 0 if the chip answers")
+    ap.add_argument("--interval", type=int, default=PROBE_INTERVAL)
+    args = ap.parse_args()
+
+    if args.probe:
+        sys.exit(0 if probe() else 1)
+
+    harvested = False
+    while True:
+        if probe():
+            harvested = harvest() or harvested
+            if harvested:
+                # rows are in; keep the loop alive at a slower cadence in
+                # case a later window allows re-measurement, but don't
+                # hammer the relay
+                _log("TPU rows captured — battery idling (re-probe in 30 min)")
+                if args.once:
+                    return
+                time.sleep(1800)
+                continue
+        if args.once:
+            sys.exit(0 if harvested else 1)
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()
