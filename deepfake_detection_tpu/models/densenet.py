@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from ..ops.conv import Conv2d
 from ..ops.norm import BatchNorm2d
-from ..ops.pool import SelectAdaptivePool2d
+from ..ops.pool import SelectAdaptivePool2d, max_pool2d_torch
 from ..registry import register_model
 from .efficientnet import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
 
@@ -62,7 +62,7 @@ class DenseNet(nn.Module):
                    name="conv0")(x)
         x = BatchNorm2d(**bn, name="norm0")(x, training=training)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = max_pool2d_torch(x, (3, 3), (2, 2), padding=1)
 
         stage_feats = []
         for bi, num_layers in enumerate(self.block_config):

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from ..ops.conv import Conv2d
 from ..ops.norm import BatchNorm2d
-from ..ops.pool import SelectAdaptivePool2d, avg_pool2d_same
+from ..ops.pool import SelectAdaptivePool2d, avg_pool2d_torch
 from ..registry import register_model
 from .efficientnet import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
 
@@ -126,9 +126,9 @@ class _DlaBottle2neck(nn.Module):
             sp = BatchNorm2d(**bn, name=f"bns_{i}")(sp, training=training)
             spo.append(nn.relu(sp))
         if self.scale > 1:
-            spo.append(avg_pool2d_same(
+            spo.append(avg_pool2d_torch(
                 spx[-1], (3, 3), (self.stride, self.stride),
-                count_include_pad=True) if is_first else spx[-1])
+                padding=1) if is_first else spx[-1])
         y = jnp.concatenate(spo, axis=-1)
         y = Conv2d(self.out_chs, 1, dtype=self.dtype, name="conv3")(y)
         y = BatchNorm2d(**bn, name="bn3")(y, training=training)

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from ..ops.conv import Conv2d
 from ..ops.norm import BatchNorm2d
-from ..ops.pool import SelectAdaptivePool2d
+from ..ops.pool import SelectAdaptivePool2d, max_pool2d_torch
 from ..registry import register_model
 from .efficientnet import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
 
@@ -130,7 +130,7 @@ class DPN(nn.Module):
         x = BatchNorm2d(**dict(bn, dtype=self.dtype), name="conv1_bn")(
             x, training=training)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = max_pool2d_torch(x, (3, 3), (2, 2), padding=1)
 
         bw_factor = 1 if self.small else 4
         resid, dense = x, x[..., :0]       # dense stream starts empty

@@ -101,6 +101,9 @@ class EfficientNet(nn.Module):
     head_bias: bool = True
     se_kwargs: Any = None             # SE overrides (MobileNetV3: hard-sigmoid gate)
     norm_layer: str = "bn"
+    # '' = torch static symmetric padding (the non-tf families);
+    # 'same' = TF/XLA SAME (the tf_* weight-compat variants)
+    pad_type: str = ""
     bn_momentum: float = 0.1
     bn_eps: float = 1e-5
     bn_axis_name: Optional[str] = None
@@ -130,13 +133,16 @@ class EfficientNet(nn.Module):
         block_types = {k: maybe_remat(v, self.remat_policy)
                        for k, v in _BLOCK_TYPES.items()}
         # stem: conv 3x3 s2 (reference efficientnet.py:275-279)
-        x = ConvBnAct(self.stem_size, 3, stride=2, act=self.act, **bnk,
+        x = ConvBnAct(self.stem_size, 3, stride=2, act=self.act,
+                      pad_type=self.pad_type, **bnk,
                       name="conv_stem")(x, training=training)
         stage_feats: List[Any] = []
         for si, stage in enumerate(self.block_configs):
             for bi, cfg in enumerate(stage):
                 cfg = dict(cfg)
                 btype = cfg.pop("block_type")
+                if self.pad_type:      # tf variants: SAME everywhere
+                    cfg["pad_type"] = self.pad_type
                 block_act = cfg.pop("act", self.act)
                 if btype == "cn":
                     for k in ("noskip", "dw_kernel_size", "se_ratio",
@@ -222,6 +228,7 @@ def _make(arch_def, channel_multiplier=1.0, depth_multiplier=1.0,
                  dtype=kwargs.pop("dtype", None),
                  head_type=kwargs.pop("head_type", "efficientnet"),
                  head_bias=kwargs.pop("head_bias", True),
+                 pad_type=kwargs.pop("pad_type", ""),
                  se_kwargs=kwargs.pop("se_kwargs", None))
     kwargs.pop("strict", None)
     if kwargs:
@@ -443,7 +450,8 @@ def _register_scaled(name, gen, cm, dm=1.0, tf=False, doc=""):
     def fn(pretrained=False, *, _name=name, _cm=cm, _dm=dm, _tf=tf,
            _gen=gen, **kwargs):
         if _tf:
-            kwargs.setdefault("bn_tf", True)   # pad 'same' is XLA-native
+            kwargs.setdefault("bn_tf", True)
+            kwargs.setdefault("pad_type", "same")   # TF SAME, XLA-native
         return _gen(_name, _cm, _dm, **kwargs)
     fn.__name__ = name
     fn.__qualname__ = name

@@ -115,6 +115,7 @@ def _register():
         def fn(pretrained=False, *, _name=name, _mult=mult, **kwargs):
             if _name.startswith("tf_"):
                 kwargs.setdefault("bn_tf", True)
+                kwargs.setdefault("pad_type", "same")   # TF SAME padding
             return _gen_mobilenet_v3(_name, _mult, **kwargs)
         fn.__name__ = name
         fn.__qualname__ = name

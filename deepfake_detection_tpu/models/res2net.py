@@ -22,7 +22,7 @@ from ..ops.attention import create_attn
 from ..ops.conv import Conv2d
 from ..ops.drop import DropPath
 from ..ops.norm import BatchNorm2d
-from ..ops.pool import avg_pool2d_same
+from ..ops.pool import avg_pool2d_torch
 from ..registry import register_model
 from .resnet import _Downsample, _cfg, register_block, ResNet
 
@@ -84,9 +84,9 @@ class Bottle2neck(nn.Module):
         if self.scale > 1:
             # last split passes through (pooled when the block downsamples;
             # count_include_pad=True matches the reference's AvgPool2d)
-            spo.append(avg_pool2d_same(
+            spo.append(avg_pool2d_torch(
                 spx[-1], (3, 3), (self.stride, self.stride),
-                count_include_pad=True) if is_first else spx[-1])
+                padding=1) if is_first else spx[-1])
         y = jnp.concatenate(spo, axis=-1)
 
         y = Conv2d(outplanes, 1, dtype=self.dtype, name="conv3")(y)

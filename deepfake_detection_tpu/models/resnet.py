@@ -24,7 +24,8 @@ from ..ops.attention import create_attn
 from ..ops.conv import Conv2d
 from ..ops.drop import DropBlock2d, DropPath
 from ..ops.norm import BatchNorm2d
-from ..ops.pool import SelectAdaptivePool2d, avg_pool2d_same
+from ..ops.pool import (SelectAdaptivePool2d, avg_pool2d_same,
+                        max_pool2d_torch)
 from ..registry import register_model
 from .efficientnet import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
 
@@ -267,7 +268,7 @@ class ResNet(nn.Module):
         x = BatchNorm2d(**bn, dtype=self.dtype, name="bn1")(
             x, training=training)
         x = act(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = max_pool2d_torch(x, (3, 3), (2, 2), padding=1)
 
         # stages (:387-404)
         block_cls = _BLOCKS[self.block]

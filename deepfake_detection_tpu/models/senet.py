@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 from ..ops.conv import Conv2d
 from ..ops.norm import BatchNorm2d
-from ..ops.pool import SelectAdaptivePool2d
+from ..ops.pool import SelectAdaptivePool2d, max_pool2d_torch
 from ..registry import register_model
 from .efficientnet import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
 
@@ -160,8 +160,8 @@ class SENet(nn.Module):
                        name="conv1")(x)
             x = BatchNorm2d(**bnd, name="bn1")(x, training=training)
             x = nn.relu(x)
-        # ceil_mode max-pool (:301-302) == XLA SAME padding
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        # ceil_mode max-pool (:299) — pad-at-end windowing, torch-exact
+        x = max_pool2d_torch(x, (3, 3), (2, 2), ceil_mode=True)
 
         exp = _EXPANSION[self.block]
         in_expanded = self.inplanes

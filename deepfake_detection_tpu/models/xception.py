@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from ..ops.conv import Conv2d
 from ..ops.norm import BatchNorm2d
-from ..ops.pool import SelectAdaptivePool2d
+from ..ops.pool import SelectAdaptivePool2d, max_pool2d_torch
 from ..registry import register_model
 from .efficientnet import IMAGENET_INCEPTION_MEAN, IMAGENET_INCEPTION_STD
 
@@ -76,8 +76,7 @@ class XceptionBlock(nn.Module):
                                 name=f"sep{i + 1}")(x)
             x = BatchNorm2d(**bn, name=f"bn{i + 1}")(x, training=training)
         if self.strides != 1:
-            x = nn.max_pool(x, (3, 3), strides=(self.strides,) * 2,
-                            padding="SAME")
+            x = max_pool2d_torch(x, (3, 3), (self.strides,) * 2, padding=1)
         if self.out_filters != in_filters or self.strides != 1:
             skip = Conv2d(self.out_filters, 1, stride=self.strides,
                           dtype=self.dtype, name="skip")(inp)

@@ -11,4 +11,5 @@ from .flash_attention import flash_attention
 from .norm import (BN_EPS_TF_DEFAULT, BN_MOMENTUM_TF_DEFAULT, BatchNorm2d,
                    GroupNorm, Identity, SplitBatchNorm2d, resolve_bn_args)
 from .pool import (MedianPool2d, SelectAdaptivePool2d, adaptive_pool_feat_mult,
-                   avg_pool2d_same, global_pool_nhwc, median_pool2d)
+                   avg_pool2d_same, avg_pool2d_torch, global_pool_nhwc,
+                   max_pool2d_torch, median_pool2d)

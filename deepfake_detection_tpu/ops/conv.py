@@ -4,9 +4,12 @@ Replaces ``layers/create_conv2d.py`` (:11), ``layers/conv2d_same.py``,
 ``layers/mixed_conv2d.py`` (:20) and ``layers/cond_conv2d.py`` (:83-121).
 
 TPU notes:
-* TF-"SAME" padding is native to XLA (``padding='SAME'``) — the reference's
-  static-vs-dynamic ``get_padding_value`` decision and ``Conv2dSame`` shim
-  vanish entirely.
+* Padding carries checkpoint-parity semantics (see :func:`resolve_padding`):
+  pad_type ``''`` (non-tf families) is the reference's STATIC symmetric
+  torch padding, expressed as an explicit XLA padding config; pad_type
+  ``'same'`` (tf_* variants) is TF SAME, which XLA implements natively — so
+  only the *dynamic* ``Conv2dSame`` shim vanishes, not the static/dynamic
+  distinction itself.  Both forms lower to one conv, no separate pad op.
 * CondConv's per-sample expert mixing is an einsum + a vmapped conv; XLA
   lowers the vmap to one batched/grouped convolution on the MXU — same trick
   as the reference's grouped-conv reshape, minus the manual reshapes.
@@ -29,12 +32,28 @@ def _to_tuple(v) -> Tuple[int, int]:
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
-def resolve_padding(padding: Union[str, int, None], kernel_size, dilation=1):
+def resolve_padding(padding: Union[str, int, None], kernel_size, dilation=1,
+                    stride=1):
     """Map reference pad_type strings onto XLA padding specs.
 
-    '' or 'same' → 'SAME'; 'valid' → 'VALID'; int → explicit symmetric.
+    ``''`` (the non-tf families' default) → the reference's STATIC symmetric
+    padding ``((s-1) + d*(k-1)) // 2`` per side (conv2d_same.py
+    ``get_padding``).  This equals XLA 'SAME' at stride 1 (odd kernels) and
+    at odd input sizes, but at even input + stride>1 torch pads both sides
+    where SAME pads only the end — a one-pixel window-grid shift that
+    breaks trained-checkpoint parity at the flagship's 600² (found by the
+    trained-flagship conversion gate, round 5).
+
+    ``'same'`` → XLA 'SAME' (true TF semantics — the tf_* variants' dynamic
+    ``Conv2dSame`` shim is exactly this, natively).  ``'valid'`` → 'VALID';
+    int → explicit symmetric.
     """
-    if padding is None or padding == "" or str(padding).lower() == "same":
+    if padding is None or padding == "":
+        ks, dl, st = _to_tuple(kernel_size), _to_tuple(dilation), \
+            _to_tuple(stride)
+        return [(p, p) for p in
+                (((s - 1) + d * (k - 1)) // 2 for k, d, s in zip(ks, dl, st))]
+    if str(padding).lower() == "same":
         return "SAME"
     if str(padding).lower() == "valid":
         return "VALID"
@@ -78,7 +97,8 @@ class Conv2d(nn.Module):
             strides=_to_tuple(self.stride),
             kernel_dilation=_to_tuple(self.dilation),
             feature_group_count=self.groups,
-            padding=resolve_padding(self.padding, ks, self.dilation),
+            padding=resolve_padding(self.padding, ks, self.dilation,
+                                    self.stride),
             use_bias=self.use_bias,
             kernel_init=self.kernel_init,
             dtype=self.dtype,
@@ -155,7 +175,8 @@ class CondConv2d(nn.Module):
         # per-sample kernel: (B, kh, kw, cin/g, cout)
         mixed = jnp.einsum("be,ehwio->bhwio",
                            routing_weights.astype(weight.dtype), weight)
-        pad = resolve_padding(self.padding, (kh, kw), self.dilation)
+        pad = resolve_padding(self.padding, (kh, kw), self.dilation,
+                              self.stride)
         dn = jax.lax.conv_dimension_numbers(
             (1,) + x.shape[1:], kshape, ("NHWC", "HWIO", "NHWC"))
 

@@ -53,6 +53,45 @@ class SelectAdaptivePool2d(nn.Module):
         return out
 
 
+def max_pool2d_torch(x, window: Tuple[int, int], strides: Tuple[int, int],
+                     padding: int = 0, ceil_mode: bool = False):
+    """torch ``nn.MaxPool2d`` semantics on NHWC (static shapes under jit).
+
+    Symmetric ``padding`` on both sides; ``ceil_mode`` adds end padding so
+    a final partial window is kept — torch's rule that a window may not
+    *start* in the right padded region is applied.  XLA 'SAME' equals this
+    only at odd input sizes; at even input + stride 2 the window grids
+    differ by one pixel (same class of parity break as resolve_padding's
+    static-symmetric case — found by the trained-flagship conversion gate,
+    round 5).  ``nn.max_pool`` pads with -inf, matching torch's
+    clip-to-valid semantics for max.
+    """
+    if not ceil_mode:
+        # floor mode: flax's floor output formula already drops partial
+        # windows, so plain symmetric padding is torch-exact
+        p = ((padding, padding),) * 2
+        return nn.max_pool(x, window, strides=strides, padding=p)
+    pads = []
+    for dim, k, s in zip(x.shape[1:3], window, strides):
+        out = -((dim + 2 * padding - k) // -s) + 1
+        if (out - 1) * s >= dim + padding:
+            out -= 1
+        pads.append((padding, max(0, (out - 1) * s + k - dim - padding)))
+    return nn.max_pool(x, window, strides=strides, padding=pads)
+
+
+def avg_pool2d_torch(x, window: Tuple[int, int], strides: Tuple[int, int],
+                     padding: int = 0, count_include_pad: bool = True):
+    """torch ``nn.AvgPool2d`` (floor mode) on NHWC: symmetric zero padding,
+    pad zeros in the divisor when ``count_include_pad`` (torch's default).
+    The res2net/dla downsample pools are ``AvgPool2d(3, stride, padding=1)``
+    — at even input + stride 2 XLA 'SAME' shifts the window grid one pixel
+    (the round-5 parity class)."""
+    p = ((padding, padding),) * 2
+    return nn.avg_pool(x, window, strides=strides, padding=p,
+                       count_include_pad=count_include_pad)
+
+
 def avg_pool2d_same(x, window: Tuple[int, int], strides: Tuple[int, int],
                     count_include_pad: bool = True):
     """TF-SAME average pool — XLA-native (replaces avg_pool2d_same.py:21)."""

@@ -25,7 +25,80 @@ def test_conv2d_same_padding_shapes():
     m = ops.Conv2d(8, 3, stride=2)
     v = m.init(jax.random.PRNGKey(0), x)
     y = m.apply(v, x)
-    assert y.shape == (1, 9, 9, 8)  # TF SAME: ceil(17/2)
+    assert y.shape == (1, 9, 9, 8)  # static symmetric: (17+2-3)//2+1
+
+
+@pytest.mark.smoke
+def test_default_padding_matches_torch_static_symmetric():
+    """pad_type '' must reproduce torch's static symmetric padding
+    ((s-1)+d(k-1))//2 — NOT XLA SAME, whose window grid shifts one pixel
+    at even input + stride>1 (trained-checkpoint parity at the flagship's
+    600², round-5 find).  'same' keeps true TF/XLA SAME for tf_* models."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    for n in (8, 9):                      # even (the breaking case) + odd
+        for k, s, d in ((3, 2, 1), (5, 2, 1), (3, 2, 2), (3, 1, 1)):
+            x = rng.normal(size=(2, n, n, 3)).astype(np.float32)
+            w = rng.normal(size=(k, k, 3, 4)).astype(np.float32) * 0.1
+            out_f = ops.Conv2d(4, k, stride=s, dilation=d, padding="").apply(
+                {"params": {"conv": {"kernel": jnp.asarray(w)}}},
+                jnp.asarray(x))
+            out_t = F.conv2d(
+                torch.from_numpy(x.transpose(0, 3, 1, 2)),
+                torch.from_numpy(w.transpose(3, 2, 0, 1)), stride=s,
+                padding=((s - 1) + d * (k - 1)) // 2,
+                dilation=d).numpy().transpose(0, 2, 3, 1)
+            assert out_f.shape == out_t.shape, (n, k, s, d)
+            np.testing.assert_allclose(out_f, out_t, atol=1e-4)
+    # 'same' stays TF SAME: output ceil(n/s) even where torch would differ
+    y = ops.Conv2d(4, 3, stride=2, padding="same").apply(
+        {"params": {"conv": {"kernel": jnp.zeros((3, 3, 3, 4))}}},
+        jnp.zeros((1, 8, 8, 3)))
+    assert y.shape == (1, 4, 4, 4)
+
+
+@pytest.mark.smoke
+def test_max_pool2d_torch_matches_torch():
+    """max_pool2d_torch == torch MaxPool2d incl. ceil_mode (senet stem)."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(1)
+    for n in (8, 9, 112, 111):
+        x = rng.normal(size=(2, n, n, 3)).astype(np.float32)
+        for k, s, p, cm in ((3, 2, 1, False), (3, 2, 0, True),
+                            (2, 2, 0, True)):
+            out_f = np.asarray(ops.max_pool2d_torch(
+                jnp.asarray(x), (k, k), (s, s), padding=p, ceil_mode=cm))
+            out_t = F.max_pool2d(
+                torch.from_numpy(x.transpose(0, 3, 1, 2)), k, s, p,
+                ceil_mode=cm).numpy().transpose(0, 2, 3, 1)
+            assert out_f.shape == out_t.shape, (n, k, s, p, cm)
+            np.testing.assert_allclose(out_f, out_t, atol=1e-6)
+
+
+@pytest.mark.smoke
+def test_avg_pool2d_torch_matches_torch():
+    """avg_pool2d_torch == torch AvgPool2d(3, s, 1) (res2net/dla pools),
+    both count_include_pad settings, even + odd sizes."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(2)
+    for n in (8, 9):
+        x = rng.normal(size=(2, n, n, 3)).astype(np.float32)
+        for s in (1, 2):
+            for cip in (True, False):
+                out_f = np.asarray(ops.avg_pool2d_torch(
+                    jnp.asarray(x), (3, 3), (s, s), padding=1,
+                    count_include_pad=cip))
+                out_t = F.avg_pool2d(
+                    torch.from_numpy(x.transpose(0, 3, 1, 2)), 3, s, 1,
+                    count_include_pad=cip).numpy().transpose(0, 2, 3, 1)
+                assert out_f.shape == out_t.shape, (n, s, cip)
+                np.testing.assert_allclose(out_f, out_t, atol=1e-5)
 
 
 def test_depthwise_conv_param_shape():
