@@ -13,16 +13,46 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 from tools import bench_input  # noqa: E402
 
 
-def test_build_and_measure(tmp_path, monkeypatch):
+def test_build_and_measure(tmp_path):
     root = str(tmp_path / "clips")
     os.makedirs(root)
     bench_input.build_dataset(root, n_clips=6, size=64, frames=4)
     assert os.path.isfile(os.path.join(root, "fake_list.txt"))
     args = SimpleNamespace(clips=6, size=64, frames=4, batch=2, workers=1,
                            epochs=1)
-    native_cps = bench_input.measure(root, args, native=True)
-    pil_cps = bench_input.measure(root, args, native=False)
-    ref_cps = bench_input.measure(root, args, native=False, fast=False)
-    assert native_cps > 0 and pil_cps > 0 and ref_cps > 0
-    # the toggle must be restored for later tests
-    monkeypatch.delenv("DFD_NO_NATIVE_DECODE", raising=False)
+    # finally + plain pop, NOT monkeypatch.delenv: monkeypatch RESTORES
+    # the var at teardown (measure(native=False) set it mid-test), which
+    # silently disabled the native path for every later test; a bare pop
+    # after the asserts would leak it on failure instead
+    try:
+        native_cps = bench_input.measure(root, args, native=True)
+        pil_cps = bench_input.measure(root, args, native=False)
+        ref_cps = bench_input.measure(root, args, native=False, fast=False)
+        assert native_cps > 0 and pil_cps > 0 and ref_cps > 0
+    finally:
+        os.environ.pop("DFD_NO_NATIVE_DECODE", None)
+
+
+def test_gil_pause_methodology():
+    """tools/bench_gil.py: the PyDLL control must read as GIL-held and the
+    production CDLL decode as GIL-free — the measured basis for
+    INPUT_BENCH.md's linear thread-scaling extrapolation."""
+    from deepfake_detection_tpu.data import native
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    import json
+    import subprocess
+    r = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), os.pardir,
+                                      "tools", "bench_gil.py"),
+         "--src", "2200", "--reps", "2"],
+        capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-500:]
+    parsed = [json.loads(l) for l in r.stdout.splitlines()
+              if l.startswith("{")]
+    errors = [j for j in parsed if "error" in j]
+    assert not errors, (errors, r.stderr[-300:])
+    rows = {j["stage"]: j for j in parsed if "stage" in j}
+    assert rows["control_warp_PyDLL_gil_held"]["gil_held"] is True
+    assert rows["decode_native_CDLL"]["gil_held"] is False
+    assert rows["warp_native_CDLL"]["gil_held"] is False

@@ -1,0 +1,177 @@
+"""Measure GIL release of the host input pipeline (VERDICT r4 item 5).
+
+INPUT_BENCH.md extrapolates 1-core throughput linearly across worker
+threads on the claim that decode and the native warp "run outside the
+GIL".  This container has ONE core, so multi-worker scaling cannot be
+measured directly — and a naive spinner-rate test cannot distinguish GIL
+release either (with one core, a GIL-holding stage and a GIL-releasing
+stage both timeshare ~50/50 at the interpreter's 5 ms switch interval).
+
+The decisive 1-core experiment is PAUSE LENGTH: a spinner thread records
+the maximum gap between its iterations while the main thread performs ONE
+long native call (~100+ ms: a 3000-squared JPEG decode / warp).
+
+  * If the call HOLDS the GIL, the spinner freezes for the whole call:
+    max gap ~= call duration (hundreds of ms).
+  * If the call RELEASES the GIL, the spinner keeps running, pausing only
+    at OS scheduler quanta: max gap stays in the few-ms range regardless
+    of call length.
+
+As a positive control the same library is also loaded with
+``ctypes.PyDLL`` — identical machine code, but ctypes then keeps the GIL
+held during the call — which must reproduce the freeze, proving the
+method can detect a held GIL.  (The production loader binds via
+``ctypes.CDLL``, which drops the GIL for every foreign call.)
+
+Writes one JSON line per stage; ``--json`` appends to a JSONL artifact.
+
+Usage::
+
+    python tools/bench_gil.py [--src 3000] [--reps 5] [--json out.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class GapSpinner:
+    """Thread that spins and records the max gap between iterations."""
+
+    def __init__(self):
+        self.max_gap = 0.0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        last = time.perf_counter()
+        gap = 0.0
+        while not self._stop.is_set():
+            for _ in range(200):      # amortize the clock read
+                pass
+            now = time.perf_counter()
+            if now - last > gap:
+                gap = now - last
+                self.max_gap = gap
+            last = now
+
+    def __enter__(self):
+        self._thread.start()
+        time.sleep(0.05)              # let it reach steady state
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join()
+
+
+def max_pause_during(fn, reps: int):
+    """(max spinner gap in ms, mean call duration in ms) over reps calls."""
+    fn()                              # warm: file cache, pool, first-call
+    with GapSpinner() as sp:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        call_ms = (time.perf_counter() - t0) / reps * 1000
+        time.sleep(0.02)
+    return sp.max_gap * 1000, call_ms
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--src", type=int, default=3000,
+                    help="source JPEG side; bigger = longer single call")
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--json", default="")
+    args = ap.parse_args()
+
+    from PIL import Image
+    from deepfake_detection_tpu.data import native
+
+    if not native.available():
+        print(json.dumps({"error": "native lib unavailable"}), flush=True)
+        return
+
+    # one big gradient+noise JPEG: a single decode/warp runs 100+ ms
+    rng = np.random.default_rng(0)
+    base = np.add.outer(np.arange(args.src), np.arange(args.src))
+    img = np.clip(base * 255.0 / base.max() +
+                  rng.normal(0, 20, base.shape), 0, 255).astype(np.uint8)
+    tmp = tempfile.mkdtemp(prefix="gil_")
+    jpg = os.path.join(tmp, "big.jpg")
+    Image.fromarray(np.stack([img] * 3, -1)).save(jpg, quality=90)
+
+    frame = np.asarray(Image.open(jpg).convert("RGB"))
+    coeffs = [1.01, 0.01, -2.0, -0.01, 1.01, 3.0]
+
+    # idle baseline: scheduler noise with the main thread sleeping
+    with GapSpinner() as sp:
+        time.sleep(1.0)
+    idle_ms = sp.max_gap * 1000
+
+    # positive control: SAME .so via PyDLL = ctypes keeps the GIL held.
+    # dfd_warp_affine has the simplest ABI; replicate the argtypes binding.
+    pylib = ctypes.PyDLL(native._LIB)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    pylib.dfd_warp_affine.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int, u8p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_double)]
+    src_c = np.ascontiguousarray(frame)
+    dst = np.empty((args.src, args.src, 3), np.uint8)
+    c6 = (ctypes.c_double * 6)(*coeffs)
+
+    def warp_gil_held():
+        pylib.dfd_warp_affine(
+            src_c.ctypes.data_as(u8p), args.src, args.src,
+            dst.ctypes.data_as(u8p), args.src, args.src, 3, c6)
+
+    stages = {
+        "control_warp_PyDLL_gil_held": warp_gil_held,
+        "decode_native_CDLL": lambda: native.decode_jpeg_file(jpg),
+        "warp_native_CDLL": lambda: native.warp_affine_batch(
+            [frame], coeffs, (args.src, args.src)),
+        "decode_pil": lambda: np.asarray(Image.open(jpg).convert("RGB")),
+    }
+
+    rows = []
+    for name, fn in stages.items():
+        gap_ms, call_ms = max_pause_during(fn, args.reps)
+        # a pause only reads as held-GIL when it is both most of one call
+        # AND well above the scheduler-pause floor — short calls would
+        # otherwise be misread (an ordinary ~9 ms scheduler pause exceeds
+        # 70% of a 10 ms call)
+        held = gap_ms > max(0.7 * call_ms, 3 * idle_ms)
+        if call_ms < 5 * idle_ms:
+            held = None   # call too short to classify on this host
+        row = {
+            "stage": name, "call_ms": round(call_ms, 1),
+            "max_spinner_pause_ms": round(gap_ms, 1),
+            "idle_max_pause_ms": round(idle_ms, 1),
+            "gil_held": held,
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    if args.json:
+        with open(args.json, "a") as f:
+            for row in rows:
+                f.write(json.dumps(dict(row, kind="gil_pause",
+                                        src=args.src)) + "\n")
+
+    import shutil
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
