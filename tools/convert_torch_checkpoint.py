@@ -19,6 +19,14 @@ model surface (``create_deepfake_model_v4``); the flax tree deliberately
 mirrors timm's module names (``blocks.{s}.{b}.conv_pw`` ↔
 ``blocks_{s}_{b}.conv_pw``) so the translation is direct.
 
+A GENERIC structural matcher (round 5) covers every other backbone
+family — resnet/senet/densenet/dpn/xception/inception/res2net/dla/sknet/
+selecsls/hrnet/gluon/nasnet/pnasnet — by normalizing torch keys (digit
+joining, container flattening) against the target model's variable tree
+with name+shape+wrapper checks; it refuses partial conversions.  Pass
+``--model <name>`` and the right mapping is chosen automatically.  Logit
+parity per family is pinned by tests/test_convert_families.py.
+
 A second mapping covers the ViT family (this repo's extension backbone;
 timm-style checkpoints).  Besides the layout transposes it PERMUTES the
 fused-qkv output columns from timm's (3, H, D) order to this repo's
@@ -114,12 +122,18 @@ def map_key_vit(torch_key: str) -> Optional[Tuple[str, str]]:
     return None
 
 
+def _to_flax_layout(v: np.ndarray, is_kernel: bool) -> np.ndarray:
+    """Shared NCHW→NHWC layout rules for BOTH converter paths."""
+    if v.ndim == 4:
+        return np.transpose(v, (2, 3, 1, 0))          # OIHW → HWIO
+    if v.ndim == 2 and is_kernel:
+        return np.transpose(v, (1, 0))                # (out,in) → (in,out)
+    return v
+
+
 def _transform_value(flax_path: str, v: np.ndarray,
                      num_heads: Optional[int] = None) -> np.ndarray:
-    if v.ndim == 4:
-        v = np.transpose(v, (2, 3, 1, 0))             # OIHW → HWIO
-    elif v.ndim == 2 and flax_path.endswith("kernel"):
-        v = np.transpose(v, (1, 0))                   # (out,in) → (in,out)
+    v = _to_flax_layout(v, flax_path.endswith("kernel"))
     if ".attn.qkv." in flax_path:
         # timm packs the 3C output columns (3, H, D)-major; this repo's
         # _Attention reads them (H, 3, D)-major (models/vit.py)
@@ -177,6 +191,404 @@ def convert_state_dict(sd: Dict[str, Any],
     return out
 
 
+# ---------------------------------------------------------------------------
+# Generic structure-driven conversion (round 5): any backbone family whose
+# flax module names mirror the torch names modulo digit-index joining
+# (``layer1.0`` ↔ ``layer1_0``) and the Conv2d/BatchNorm2d wrapper segments
+# (``conv1.conv.kernel`` ↔ ``conv1.weight``).  A reference user has torch
+# checkpoints for ANY timm backbone (reference helpers.py load_checkpoint) —
+# this extends migration beyond the efficientnet/ViT mappings above.
+# ---------------------------------------------------------------------------
+
+# inner module names inserted by this repo's layer wrappers; stripped when
+# comparing paths (never used as *semantic* names by the model files)
+_WRAPPER_COMPS = frozenset({"conv", "bn"})
+
+# non-weight torch leaves share _BN_LEAF's collection/name mapping; the
+# generic matcher adds only the 1-D-weight → scale rule on top of it
+_LEAF_MAP = {"running_mean": _BN_LEAF["running_mean"],
+             "running_var": _BN_LEAF["running_var"],
+             "bias": _BN_LEAF["bias"]}
+
+
+def _norm_torch_comps(parts) -> Tuple[str, ...]:
+    """Merge pure-digit components into their predecessor: layer1.0 →
+    layer1_0; blocks.2.1 → blocks_2_1."""
+    out = []
+    for p in parts:
+        if p.isdigit() and out:
+            out[-1] = f"{out[-1]}_{p}"
+        else:
+            out.append(p)
+    return tuple(out)
+
+
+_INCEPTION_V4_STAGES = {
+    "0": "features_0.", "1": "features_1.", "2": "features_2.",
+    "3": "mixed_3a_", "4": "mixed_4a_", "5": "mixed_5a_",
+    "6": "inception_a_0_", "7": "inception_a_1_", "8": "inception_a_2_",
+    "9": "inception_a_3_", "10": "reduction_a_",
+    "11": "inception_b_0_", "12": "inception_b_1_", "13": "inception_b_2_",
+    "14": "inception_b_3_", "15": "inception_b_4_", "16": "inception_b_5_",
+    "17": "inception_b_6_", "18": "reduction_b_",
+    "19": "inception_c_0_", "20": "inception_c_1_", "21": "inception_c_2_",
+}
+
+
+def _preprocess_inception(sd: Dict[str, Any], v4: bool) -> Dict[str, Any]:
+    """inception_v4 / inception_resnet_v2 container flattening.
+
+    Torch inception_v4 is one ``features`` Sequential (inception_v4.py:246);
+    our module names each stage (``_INCEPTION_V4_STAGES``).  Both families'
+    ``branch{j}`` submodules flatten to ``b{j}`` siblings, and
+    inception_resnet_v2's three ``repeat`` containers become
+    ``block35_i/block17_i/block8_i`` (inception_resnet_v2.py:247-311).
+    """
+    import re
+
+    out = {}
+    for k, v in sd.items():
+        if v4:
+            m = re.match(r"^features\.(\d+)\.(.*)$", k)
+            if m and m.group(1) in _INCEPTION_V4_STAGES:
+                k = _INCEPTION_V4_STAGES[m.group(1)] + m.group(2)
+        else:
+            k = re.sub(r"^repeat\.(\d+)\.", r"block35_\1_", k)
+            k = re.sub(r"^repeat_1\.(\d+)\.", r"block17_\1_", k)
+            k = re.sub(r"^repeat_2\.(\d+)\.", r"block8_\1_", k)
+            k = re.sub(r"^block8\.", "block8_final_", k)
+        k = re.sub(r"[._]branch", "_b", k)   # branch{j} → flat _b{j} sibling
+        out[k] = v
+    return out
+
+
+def _preprocess_nasnet(sd: Dict[str, Any]) -> Dict[str, Any]:
+    """NASNet container flattening (nasnet.py): comb-iter branches become
+    ``<cell>_c{i}{l|r}`` siblings, separables flatten to ``_dw``/``_pw``,
+    the previous-input FactorizedReduce lives under ``<cell>_prev``."""
+    import re
+
+    out = {}
+    for k, v in sd.items():
+        k = re.sub(r"^conv0\.conv\.", "conv0_conv.", k)
+        k = re.sub(r"^conv0\.bn\.", "conv0_bn.", k)
+        k = re.sub(r"^([a-z0-9_]+)\.comb_iter_(\d+)_(left|right)\.",
+                   lambda m: f"{m[1]}_c{m[2]}{m[3][0]}.", k)
+        k = re.sub(r"\.separable_(\d)\.depthwise_conv2d\.",
+                   r".separable_\1_dw.", k)
+        k = re.sub(r"\.separable_(\d)\.pointwise_conv2d\.",
+                   r".separable_\1_pw.", k)
+        k = re.sub(r"^([a-z0-9_]+)\.conv_prev_1x1\.(path_\d)\.conv\.",
+                   r"\1_prev.\2_conv.", k)
+        k = re.sub(r"^([a-z0-9_]+)\.conv_prev_1x1\.final_path_bn\.",
+                   r"\1_prev.final_path_bn.", k)
+        k = re.sub(r"^([a-z0-9_]+)\.(path_\d)\.conv\.",
+                   r"\1_prev.\2_conv.", k)
+        k = re.sub(r"^([a-z0-9_]+)\.final_path_bn\.",
+                   r"\1_prev.final_path_bn.", k)
+        k = re.sub(r"^([a-z0-9_]+)\.conv_prev_1x1\.",
+                   r"\1_conv_prev_1x1.", k)
+        k = re.sub(r"^([a-z0-9_]+)\.conv_1x1\.", r"\1_conv_1x1.", k)
+        out[k] = v
+    return out
+
+
+def _preprocess_hrnet(sd: Dict[str, Any]) -> Dict[str, Any]:
+    """HRNet container flattening (hrnet.py): stem conv/bn pairs fold into
+    composites, ``branches``/``fuse_layers``/``transition``/``incre``/
+    ``downsamp``/``final_layer`` Sequentials flatten to named siblings with
+    conv at index 0 and bn at index 1."""
+    import re
+
+    def cb(idx: str) -> str:
+        return "conv." if idx == "0" else "bn."
+
+    out = {}
+    for k, v in sd.items():
+        k = re.sub(r"^bn([12])\.", r"conv\1.bn.", k)
+        k = re.sub(r"^conv([12])\.weight", r"conv\1.conv.weight", k)
+        k = re.sub(r"\.branches\.(\d+)\.(\d+)\.", r".branch\1_\2.", k)
+        k = re.sub(r"\.fuse_layers\.(\d+)\.(\d+)\.(\d+)\.([01])\.",
+                   lambda m: f".fuse{m[1]}_{m[2]}_{m[3]}.{cb(m[4])}", k)
+        k = re.sub(r"\.fuse_layers\.(\d+)\.(\d+)\.([01])\.",
+                   lambda m: f".fuse{m[1]}_{m[2]}.{cb(m[3])}", k)
+        k = re.sub(r"^transition(\d+)\.(\d+)\.(\d+)\.([01])\.",
+                   lambda m: f"transition{m[1]}_{m[2]}_{m[3]}.{cb(m[4])}", k)
+        k = re.sub(r"^transition(\d+)\.(\d+)\.([01])\.",
+                   lambda m: f"transition{m[1]}_{m[2]}.{cb(m[3])}", k)
+        k = re.sub(r"^incre_modules\.(\d+)\.0\.", r"incre\1.", k)
+        k = re.sub(r"^downsamp_modules\.(\d+)\.([01])\.",
+                   lambda m: f"downsamp{m[1]}.{cb(m[2])}", k)
+        k = re.sub(r"^final_layer\.([01])\.",
+                   lambda m: f"final_layer.{cb(m[1])}", k)
+        out[k] = v
+    return out
+
+
+def _preprocess_generic_keys(sd: Dict[str, Any]) -> Dict[str, Any]:
+    """Key rewrites for torch container idioms our modules name semantically.
+
+    * senet: the stem lives in a ``layer0`` OrderedDict container
+      (senet.py:SENet.layer0) — inner names match ours, strip the prefix.
+    * timm deep stems: ``conv1`` is Sequential(conv,bn,relu,conv,bn,relu,
+      conv) with convs at 0/3/6 and bns at 1/4 (resnet.py stem_type
+      'deep'); our stem names them conv1_0..2 / stem_bn0..1.
+    """
+    import re
+
+    # v4 signature: stage 0 is a bare BasicConv2d (child 'conv') — selecsls
+    # etc. also use an indexed ``features`` Sequential but with named
+    # block children, never ``features.0.conv.weight``
+    if "features.0.conv.weight" in sd:
+        sd = _preprocess_inception(sd, v4=True)        # inception_v4
+    elif any(k.startswith("conv2d_1a.") for k in sd):
+        sd = _preprocess_inception(sd, v4=False)       # inception_resnet_v2
+    if any(k.startswith("reduction_cell_0.") for k in sd):
+        sd = _preprocess_nasnet(sd)
+    if any(".fuse_layers." in k for k in sd):
+        sd = _preprocess_hrnet(sd)
+    if any(".rep.conv1.conv_dw." in k for k in sd):
+        # gluon_xception: rep container children are named (not indexed),
+        # skip conv/bn live in one container (gluon_xception.py
+        # skip_conv/skip_bn)
+        sd = {k.replace(".rep.", ".").replace(".skip.conv1.", ".skip_conv.")
+               .replace(".skip.bn1.", ".skip_bn.")
+               .replace("mid.block", "block"): v for k, v in sd.items()}
+    out = {}
+    deep_stem = any(k.startswith("conv1.6.") for k in sd)
+    densenet = any(k.startswith("features.denseblock") for k in sd)
+    dpn = any(k.startswith("features.conv1_1.") for k in sd)
+    dla = any(k.startswith("base_layer.0.") for k in sd)
+    sknet = any(".paths.0." in k for k in sd)
+    stem_map = {"conv1.0": "conv1_0", "conv1.1": "stem_bn0",
+                "conv1.3": "conv1_1", "conv1.4": "stem_bn1",
+                "conv1.6": "conv1_2"}
+    for k, v in sd.items():
+        if k.startswith("layer0."):
+            k = k[len("layer0."):]
+        # digit-indexed features Sequential with NAMED block children
+        # (selecsls): keep the stage as features_{i}; plain-named features
+        # containers (densenet/dpn) just drop the prefix
+        k = re.sub(r"^features\.(\d+)\.", r"features_\1.", k)
+        if k.startswith("features."):
+            k = k[len("features."):]
+        if dla:
+            # level0/level1 are Sequential(conv,bn,relu) flattened to one
+            # indexed conv/bn sibling pair (dla.py level0_0_conv/_bn)
+            k = re.sub(r"^(level[01])\.0\.", r"\1_0_conv.", k)
+            k = re.sub(r"^(level[01])\.1\.", r"\1_0_bn.", k)
+        if sknet:
+            # SelectiveKernel paths + attn (sknet.py path_{i}_conv/_bn,
+            # attn_fc/attn_bn/attn_sel)
+            k = re.sub(r"\.paths\.(\d+)\.conv\.", r".path_\1_conv.", k)
+            k = re.sub(r"\.paths\.(\d+)\.bn\.", r".path_\1_bn.", k)
+            k = k.replace(".attn.fc_reduce.", ".attn_fc.") \
+                 .replace(".attn.bn.", ".attn_bn.") \
+                 .replace(".attn.fc_select.", ".attn_sel.")
+            # ConvBnAct composites outside the SK conv (sknet.py bn2/bn3)
+            k = re.sub(r"\.conv(\d)\.bn\.", r".bn\1.", k)
+        if deep_stem:
+            for old, new in stem_map.items():
+                if k.startswith(old + "."):
+                    k = new + k[len(old):]
+                    break
+        if densenet:
+            # features.denseblock{i}.denselayer{j}.X → block{i-1}_l{j-1}_X
+            # and features.transition{i}.X → transition{i-1}_X (densenet.py
+            # flattens both containers into sibling modules)
+            k = re.sub(r"^denseblock(\d+)\.denselayer(\d+)\.",
+                       lambda m: f"block{int(m.group(1)) - 1}_"
+                                 f"l{int(m.group(2)) - 1}_", k)
+            k = re.sub(r"^transition(\d+)\.",
+                       lambda m: f"transition{int(m.group(1)) - 1}_", k)
+        if dpn:
+            # stem InputBlock container (dpn.py conv1_conv/conv1_bn)
+            k = k.replace("conv1_1.conv.", "conv1_conv.") \
+                 .replace("conv1_1.bn.", "conv1_bn.")
+        out[k] = v
+    if any(".rep." in k for k in out):
+        out = _rename_xception_reps(out)
+    return out
+
+
+def _rename_xception_reps(sd: Dict[str, Any]) -> Dict[str, Any]:
+    """Xception blocks: torch ``rep`` is a Sequential mixing ReLUs,
+    SeparableConv2ds and BNs at shifting indices (xception.py Block);
+    our module names them sep{i}/bn{i} in order.  Rank each rep index
+    among its kind to recover the semantic name."""
+    import re
+
+    by_block: Dict[str, Dict[str, set]] = {}
+    for k in sd:
+        m = re.match(r"^(.*?\brep)\.(\d+)\.(.*)$", k)
+        if not m:
+            continue
+        block, idx, rest = m.group(1), int(m.group(2)), m.group(3)
+        kind = "sep" if rest.startswith(("conv1.", "pointwise.")) else "bn"
+        by_block.setdefault(block, {"sep": set(), "bn": set()})[kind].add(idx)
+    out = {}
+    for k, v in sd.items():
+        m = re.match(r"^(.*?\brep)\.(\d+)\.(.*)$", k)
+        if m:
+            block, idx, rest = m.group(1), int(m.group(2)), m.group(3)
+            kind = "sep" if rest.startswith(("conv1.", "pointwise.")) \
+                else "bn"
+            rank = sorted(by_block[block][kind]).index(idx) + 1
+            base = block[:-len(".rep")] if block.endswith(".rep") \
+                else block[:-4]
+            k = f"{base}.{kind}{rank}.{rest}"
+        out[k] = v
+    return out
+
+
+def convert_state_dict_generic(sd: Dict[str, Any], flax_shapes: Dict[str, Any]
+                               ) -> Dict[str, Any]:
+    """Torch state dict → flax variables by structural name+shape matching.
+
+    ``flax_shapes``: the target model's variable tree of ShapeDtypeStructs
+    (``jax.eval_shape`` over ``model.init`` — no FLOPs).  Each torch key is
+    normalized (digit joining, leaf mapping, layout transpose) and matched
+    against the flax tree with wrapper segments ignored; a digit suffix is
+    dropped as a fallback for torch ``nn.Sequential`` wrappers our modules
+    name semantically (``downsample.0``/``downsample.1`` ↔
+    ``downsample.conv``/``downsample.bn`` — shape + leaf disambiguate).
+    Raises ValueError on ambiguous or missing matches and on uncovered flax
+    leaves, so a partial conversion can never be written silently.
+    """
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    # index flax leaves by (collection, wrapper-stripped comps, leaf);
+    # remember each leaf's wrapper comps for ambiguity resolution
+    index: Dict[Tuple, list] = {}
+    flat_shapes = {}
+    flax_wrappers = {}
+    for coll in flax_shapes:
+        if coll not in ("params", "batch_stats"):
+            continue
+        for path, leafval in flatten_dict(flax_shapes[coll]).items():
+            comps, leaf = tuple(path[:-1]), path[-1]
+            stripped = tuple(c for c in comps if c not in _WRAPPER_COMPS)
+            index.setdefault((coll, stripped, leaf), []).append((path,))
+            flat_shapes[(coll, path)] = tuple(leafval.shape)
+            flax_wrappers[(coll, path)] = frozenset(
+                c for c in comps if c in _WRAPPER_COMPS)
+
+    out = {"params": {}, "batch_stats": {}}
+    matched = set()
+    sd = _preprocess_generic_keys(
+        {(k[len("module."):] if k.startswith("module.") else k): v
+         for k, v in sd.items()})
+    for k, v in sd.items():
+        if k.endswith("num_batches_tracked"):
+            continue
+        arr = np.asarray(v.float().cpu().numpy() if hasattr(v, "cpu") else v)
+        parts = k.split(".")
+        # strip wrapper comps from the torch side too: torch composites
+        # with semantic .conv/.bn submodules (dpn BnActConv2d) compare
+        # equal to our wrapped flax modules after stripping both sides;
+        # the stripped wrappers are kept for ambiguity resolution below
+        raw_comps = _norm_torch_comps(parts[:-1])
+        comps = tuple(c for c in raw_comps if c not in _WRAPPER_COMPS)
+        torch_wrappers = frozenset(c for c in raw_comps
+                                   if c in _WRAPPER_COMPS)
+        leaf = parts[-1]
+        if leaf == "weight":
+            if arr.ndim == 1:
+                coll, fleaf = "params", "scale"      # BN/GN/LN gamma
+            else:
+                coll, fleaf = "params", "kernel"     # conv/dense
+        elif leaf in _LEAF_MAP:
+            coll, fleaf = _LEAF_MAP[leaf]
+        else:
+            raise ValueError(f"unrecognized torch leaf in {k!r}")
+        arr = _to_flax_layout(arr, fleaf == "kernel")
+
+        def candidates(c):
+            hits = [p for (p,) in index.get((coll, c, fleaf), [])
+                    if flat_shapes[(coll, p)] == arr.shape
+                    and (coll, p) not in matched]
+            if len(hits) > 1 and torch_wrappers:
+                # a torch .conv/.bn wrapper picks between same-shape
+                # siblings (hrnet downsamp conv.bias vs bn.bias)
+                narrowed = [p for p in hits if torch_wrappers
+                            <= flax_wrappers[(coll, p)]]
+                if narrowed:
+                    hits = narrowed
+            return hits
+
+        cand = candidates(comps)
+        if not cand and raw_comps and raw_comps[-1] in _WRAPPER_COMPS \
+                and comps:
+            # torch composite child flattened to a joined flax sibling:
+            # comb_iter_0_right.conv → comb_iter_0_right_conv (pnasnet)
+            cand = candidates(
+                comps[:-1] + (f"{comps[-1]}_{raw_comps[-1]}",))
+        if not cand and comps and "_" in comps[-1]:
+            # torch Sequential wrapper index (downsample.0/downsample.1):
+            # try the bare name (modules with inner conv/bn submodules) and
+            # the flattened *_conv / *_bn sibling naming (senet), letting
+            # leaf kind + shape disambiguate
+            base, suffix = comps[-1].rsplit("_", 1)
+            if suffix.isdigit():
+                # drop-digit forms cover modules whose Sequential wrapper
+                # has one flax module (resnet downsample.{0,1} →
+                # downsample.conv/.bn, dla base_layer.{0,1}); keep-digit
+                # forms cover per-index flattened siblings (dla
+                # level0.{0,1} → level0_0_conv/_bn)
+                for alt in (base, f"{base}_conv", f"{base}_bn",
+                            f"{comps[-1]}_conv", f"{comps[-1]}_bn"):
+                    cand = candidates(comps[:-1] + (alt,))
+                    if cand:
+                        break
+        if len(cand) != 1:
+            raise ValueError(
+                f"torch key {k!r} → {coll}/{'.'.join(comps)}.{fleaf} "
+                f"{arr.shape}: {'no' if not cand else len(cand)} "
+                f"matching flax leaves {cand[:3]}")
+        path = cand[0]
+        matched.add((coll, path))
+        node = out[coll]
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = arr
+
+    uncovered = [k for k in flat_shapes if k not in matched]
+    if uncovered:
+        raise ValueError(
+            f"{len(uncovered)} flax leaves not covered by the checkpoint, "
+            f"e.g. {['/'.join([c] + list(p)) for c, p in uncovered[:5]]}")
+    return {c: unflatten_dict({p: v for p, v in flatten_dict(t).items()})
+            for c, t in out.items()}
+
+
+def convert_for_model(sd: Dict[str, Any], model_name: str,
+                      **model_kwargs) -> Dict[str, Any]:
+    """Convert ``sd`` for ``model_name``: the efficientnet/ViT mappings for
+    their families, the generic structural matcher for everything else."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepfake_detection_tpu.models import create_model
+    if _is_vit_sd(sd):
+        return convert_state_dict(sd, num_heads=_resolve_vit_num_heads(
+            sd, model_name))
+    # strip the DDP prefix BEFORE family detection, like map_key does —
+    # a DDP-saved efficientnet checkpoint must not fall through to the
+    # generic matcher (whose name scheme differs for that family)
+    sd = {(k[len("module."):] if k.startswith("module.") else k): v
+          for k, v in sd.items()}
+    if any(k.startswith(("conv_stem", "blocks.0.")) for k in sd):
+        return convert_state_dict(sd)                # efficientnet family
+    model = create_model(model_name, **model_kwargs)
+    size = 96 if "inception" in model_name or "nasnet" in model_name else 64
+    in_chans = model_kwargs.get("in_chans", 3)
+    shapes = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((1, size, size, in_chans)),
+                             training=True),
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)})
+    return convert_state_dict_generic(sd, shapes)
+
+
 def convert_checkpoint(path: str, use_ema: bool = False,
                        model_name: Optional[str] = None) -> Dict[str, Any]:
     import torch
@@ -187,6 +599,11 @@ def convert_checkpoint(path: str, use_ema: bool = False,
         sd = ckpt[key]
     else:
         sd = ckpt
+    if model_name:
+        # routes efficientnet/ViT to their dedicated mappings and every
+        # other backbone family to the generic structural matcher (which
+        # refuses partial conversions)
+        return convert_for_model(sd, model_name)
     num_heads = None
     if _is_vit_sd(sd):
         num_heads = _resolve_vit_num_heads(sd, model_name)
