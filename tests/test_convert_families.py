@@ -38,6 +38,8 @@ _COVERED = [
     "xception", "inception_v4", "inception_resnet_v2", "res2net50_26w_4s",
     "dla34", "skresnet18", "selecsls42b", "hrnet_w18_small",
     "gluon_xception65", "nasnetalarge", "pnasnet5large",
+    "mobilenetv3_large_100", "mixnet_s", "efficientnet_cc_b0_4e",
+    "tf_efficientnet_b0",
 ]
 _CASES = [f for f in FAMILIES if f[1] in _COVERED]
 assert len(_CASES) == len(_COVERED)

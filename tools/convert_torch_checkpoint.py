@@ -77,7 +77,9 @@ def map_key(torch_key: str) -> Optional[Tuple[str, str]]:
     if head == "bn2":                                 # head BN
         return _bn("bn2", leaf)
     if head == "conv_head":
-        return "params", "conv_head.conv.kernel"
+        # mobilenetv3 heads carry a bias (head_bias, mobilenetv3.py)
+        return "params", ("conv_head.conv.kernel" if leaf == "weight"
+                          else "conv_head.conv.bias")
     if head == "classifier":
         return "params", ("classifier.kernel" if leaf == "weight"
                           else "classifier.bias")
@@ -89,6 +91,10 @@ def map_key(torch_key: str) -> Optional[Tuple[str, str]]:
                               + ("kernel" if leaf == "weight" else "bias"))
         if rest[0].startswith("bn"):
             return _bn(f"{prefix}.{rest[0]}", leaf)
+        if rest[0].startswith("conv") and len(rest) == 3 and \
+                rest[1].isdigit() and leaf == "weight":
+            # MixedConv kernel-split (mixnet): conv_pw.{i} → conv_{i}
+            return "params", f"{prefix}.{rest[0]}.conv_{rest[1]}.conv.kernel"
         if rest[0].startswith("conv") and leaf == "weight":
             return "params", f"{prefix}.{rest[0]}.conv.kernel"
     return None
@@ -577,16 +583,65 @@ def convert_for_model(sd: Dict[str, Any], model_name: str,
     # generic matcher (whose name scheme differs for that family)
     sd = {(k[len("module."):] if k.startswith("module.") else k): v
           for k, v in sd.items()}
+    def flax_shapes():
+        model = create_model(model_name, **model_kwargs)
+        size = 96 if "inception" in model_name or "nasnet" in model_name \
+            else 64
+        in_chans = model_kwargs.get("in_chans", 3)
+        return jax.eval_shape(
+            lambda r: model.init(r, jnp.zeros((1, size, size, in_chans)),
+                                 training=True),
+            {"params": jax.random.PRNGKey(0),
+             "dropout": jax.random.PRNGKey(1)})
+
     if any(k.startswith(("conv_stem", "blocks.0.")) for k in sd):
+        if any(".routing_fn." in k for k in sd):
+            return _convert_condconv(sd, flax_shapes())
         return convert_state_dict(sd)                # efficientnet family
-    model = create_model(model_name, **model_kwargs)
-    size = 96 if "inception" in model_name or "nasnet" in model_name else 64
-    in_chans = model_kwargs.get("in_chans", 3)
-    shapes = jax.eval_shape(
-        lambda r: model.init(r, jnp.zeros((1, size, size, in_chans)),
-                             training=True),
-        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)})
-    return convert_state_dict_generic(sd, shapes)
+    return convert_state_dict_generic(sd, flax_shapes())
+
+
+def _convert_condconv(sd: Dict[str, Any],
+                      flax_shapes: Dict[str, Any]) -> Dict[str, Any]:
+    """CondConv (cc) variants: experts' kernels are stored FLAT per expert
+    (``(E, out*in_g*kh*kw)``, reference cond_conv2d.py weight layout);
+    unflatten them against the target tree's ``(E, kh, kw, in_g, out)``
+    param and map the routing fc, then run the standard mapping for the
+    rest."""
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    flat = {".".join(p): tuple(v.shape)
+            for p, v in flatten_dict(flax_shapes["params"]).items()}
+    plain, extra = {}, {}
+    for k, v in sd.items():
+        parts = k.split(".")
+        if len(parts) >= 4 and parts[0] == "blocks":
+            # numpy conversion only for keys this pass may claim — the
+            # rest go to convert_state_dict untouched (no double copy)
+            arr = np.asarray(v.float().cpu().numpy()
+                             if hasattr(v, "cpu") else v)
+            prefix = f"blocks_{parts[1]}_{parts[2]}"
+            rest, leaf = parts[3:], parts[-1]
+            if rest[0] == "routing_fn":
+                path = f"{prefix}.routing_fn." + \
+                    ("kernel" if leaf == "weight" else "bias")
+                extra[path] = _to_flax_layout(arr, leaf == "weight")
+                continue
+            expert_path = f"{prefix}.{rest[0]}.weight"
+            if leaf == "weight" and arr.ndim == 2 and expert_path in flat:
+                e, kh, kw, in_g, out = flat[expert_path]
+                if arr.shape == (e, out * in_g * kh * kw):
+                    arr = arr.reshape(e, out, in_g, kh, kw) \
+                             .transpose(0, 3, 4, 2, 1)
+                    extra[expert_path] = arr
+                    continue
+        plain[k] = v
+    variables = convert_state_dict(plain)
+    params = {tuple(p.split(".")): v for p, v in extra.items()}
+    merged = flatten_dict(variables["params"])
+    merged.update(params)
+    variables["params"] = unflatten_dict(merged)
+    return variables
 
 
 def convert_checkpoint(path: str, use_ema: bool = False,

@@ -90,6 +90,14 @@ FAMILIES = [
     ("gluon_xception", "gluon_xception65", "gluon_xception65", 96, 2e-4),
     ("nasnet", "nasnetalarge", "nasnetalarge", 96, 2e-4),
     ("pnasnet", "pnasnet5large", "pnasnet5large", 96, 2e-4),
+    # efficientnet-family variants with their own mapping quirks
+    ("mobilenetv3", "mobilenetv3_large_100", "mobilenetv3_large_100",
+     64, 1e-4),                                    # biased conv head
+    ("efficientnet", "mixnet_s", "mixnet_s", 64, 1e-4),   # MixedConv split
+    ("efficientnet", "efficientnet_cc_b0_4e", "efficientnet_cc_b0_4e",
+     64, 1e-4),                                    # CondConv flat experts
+    ("efficientnet", "tf_efficientnet_b0", "tf_efficientnet_b0",
+     64, 1e-4),                                    # TF SAME padding path
 ]
 
 
@@ -101,6 +109,17 @@ def run_family(mod, ctor, flax_name, size, atol) -> str:
     from deepfake_detection_tpu.models import create_model
 
     ref = load_reference_module(mod)
+    if "_cc_" in ctor:
+        # the reference's CondConv2d.forward crashes on this torch version
+        # (cond_conv2d.py:93 `.view` on a non-contiguous input); feed it a
+        # contiguous tensor so the comparison can run — semantics unchanged
+        layers = sys.modules["timm.models.layers"]
+        orig = layers.CondConv2d.forward
+        if not getattr(layers.CondConv2d, "_contig_patched", False):
+            def patched(self, x, rw, _orig=orig):
+                return _orig(self, x.contiguous(), rw)
+            layers.CondConv2d.forward = patched
+            layers.CondConv2d._contig_patched = True
     torch.manual_seed(0)
     # default class count on both sides: several reference entrypoints
     # (dla, hrnet) mishandle a num_classes kwarg or default pretrained=True
