@@ -67,6 +67,10 @@ def _reexec_cpu(reason: str) -> None:
     env = dict(os.environ)
     env["_BENCH_CPU_FALLBACK"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
+    # the CPU fallback gets its own fixed budget — inheriting a large
+    # TPU-harvest BENCH_RUN_TIMEOUT would let watchdog+fallback overrun
+    # any outer supervisor (the chip battery's stage timeout)
+    env["BENCH_RUN_TIMEOUT"] = "900"
     # sitecustomize registers the axon TPU plugin (and may block) whenever
     # this var is set — clear it so the fallback interpreter starts clean
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -456,16 +460,18 @@ def main() -> None:
                 continue
             try:
                 rows.append(fn())
+                # store INCREMENTALLY: if a later config hangs past the
+                # watchdog (first chip contact after an outage is exactly
+                # when that happens), the rows already measured survive
+                # the CPU re-exec (custom sweeps take the other branch
+                # above and never store)
+                _store_verified_tpu_rows(rows[-1:])
             except BaseException as e:  # noqa: BLE001 — record, continue
                 import traceback
                 traceback.print_exc()
                 _log(f"config {name} failed: {e!r}")
                 rows.append({"metric": name, "error": repr(e)[:300]})
 
-    if not custom:
-        # custom sweeps never store; low-step rows are gated per-row
-        # inside _store_verified_tpu_rows
-        _store_verified_tpu_rows(rows)
     headline = next((r for r in rows if "value" in r), rows[0])
     result = dict(headline)
     result["rows"] = rows
@@ -488,8 +494,13 @@ def _run_watched() -> None:
     import threading
 
     on_cpu = bool(os.environ.get("_BENCH_CPU_FALLBACK"))
-    timeout = float(os.environ.get("BENCH_RUN_TIMEOUT", 900))
+    # 2400 s: the full matrix on a freshly recovered relay pays 5+ cold
+    # compiles (~30-60 s each through the remote-compile proxy) plus the
+    # flagship OOM ladder — a 900 s watchdog demoted exactly that
+    # first-contact harvest to CPU
+    timeout = float(os.environ.get("BENCH_RUN_TIMEOUT", 2400))
     attempts = 1 if on_cpu else 2
+    t0 = time.perf_counter()
     for attempt in range(attempts):
         box: dict = {}
 
@@ -504,7 +515,10 @@ def _run_watched() -> None:
 
         t = threading.Thread(target=work, daemon=True)
         t.start()
-        t.join(timeout)
+        # BENCH_RUN_TIMEOUT is a GLOBAL budget: a transient-fault retry
+        # gets only the remainder, so watchdog + retry can never exceed
+        # an outer supervisor's single-stage allowance
+        t.join(max(60.0, timeout - (time.perf_counter() - t0)))
         if t.is_alive():
             # a hung jax call can't be interrupted — only exec/exit escapes
             if on_cpu:

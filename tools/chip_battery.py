@@ -161,8 +161,15 @@ def harvest() -> bool:
     """Run the full battery once.  Returns True if TPU rows were captured."""
     py = sys.executable
 
-    # 1. bench matrix (internally merges verified rows -> BENCH_TPU_ROWS.json)
-    ok, out = _run_stage("bench_matrix", [py, "bench.py"], timeout=3600)
+    # 1. bench matrix (merges verified rows -> BENCH_TPU_ROWS.json
+    #    incrementally per config; the internal GLOBAL watchdog budget
+    #    fits inside our stage timeout incl. the ~900 s CPU fallback, so
+    #    it — not our SIGTERM — decides; an operator-set BENCH_RUN_TIMEOUT
+    #    passes through untouched)
+    bench_env = ({} if "BENCH_RUN_TIMEOUT" in os.environ
+                 else {"BENCH_RUN_TIMEOUT": "2400"})
+    ok, out = _run_stage("bench_matrix", [py, "bench.py"], timeout=3600,
+                         extra_env=bench_env)
     if not (ok and _bench_is_tpu(out)):
         _log("bench matrix did not produce TPU rows — returning to probe loop")
         _commit("bench attempt (no TPU rows)")
