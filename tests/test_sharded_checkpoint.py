@@ -1,0 +1,154 @@
+"""Sharded (Orbax) checkpointing: collective save, resharding restore.
+
+The msgpack path serializes the full model on rank 0 (after a
+replicate_for_save all-gather for multi-host model-parallel state);
+``save_sharded_checkpoint`` instead writes each host's addressable shards
+directly and restores into whatever sharding the template asks for — the
+save path that scales with model-parallel size (reference torch.save has
+no equivalent, utils.py:97-112).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepfake_detection_tpu.parallel import (batch_sharding,
+                                             fsdp_param_specs, make_mesh)
+from deepfake_detection_tpu.train import (create_train_state,
+                                          make_train_step,
+                                          restore_sharded_checkpoint,
+                                          save_sharded_checkpoint)
+
+def _tiny_state(mesh, fsdp=False):
+    from types import SimpleNamespace
+
+    from deepfake_detection_tpu.losses import cross_entropy
+    from deepfake_detection_tpu.models import create_model, init_model
+    from deepfake_detection_tpu.optim import create_optimizer
+
+    model = create_model("mnasnet_small", num_classes=2, in_chans=3)
+    variables = init_model(model, jax.random.PRNGKey(0), (2, 32, 32, 3),
+                           training=True)
+    if fsdp:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        specs = fsdp_param_specs(variables["params"], mesh, min_size=256)
+        shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        variables = {
+            "params": jax.tree.map(jax.device_put, variables["params"],
+                                   shardings),
+            "batch_stats": jax.device_put(
+                variables["batch_stats"],
+                jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec())),
+        }
+    tx = create_optimizer(SimpleNamespace(
+        opt="sgd", opt_eps=1e-8, momentum=0.9, weight_decay=0.0, lr=0.01))
+    state = create_train_state(variables, tx)
+    step = make_train_step(model, tx, cross_entropy, mesh=mesh,
+                           bn_mode="global")
+    return model, state, step, tx
+
+
+class TestShardedCheckpoint:
+    def test_fsdp_roundtrip_preserves_values_and_shardings(
+            self, tmp_path, devices):
+        mesh = make_mesh()
+        _, state, step, _ = _tiny_state(mesh, fsdp=True)
+        x = jax.device_put(np.random.default_rng(0).normal(
+            size=(8, 32, 32, 3)).astype(np.float32), batch_sharding(mesh))
+        y = jax.device_put(np.arange(8) % 2, batch_sharding(mesh))
+        state, _ = step(state, x, y, jax.random.PRNGKey(1))
+
+        path = str(tmp_path / "sharded_ckpt")
+        save_sharded_checkpoint(path, state, {"epoch": 3})
+
+        _, template, _, _ = _tiny_state(mesh, fsdp=True)
+        restored, meta = restore_sharded_checkpoint(path, template)
+        assert meta["epoch"] == 3
+        assert int(restored.step) == 1
+        # the contract: values from the checkpoint, shardings from the
+        # TEMPLATE (the stepped state's GSPMD-chosen layout may differ)
+        sharded = 0
+        for a, t, b in zip(jax.tree.leaves(state.params),
+                           jax.tree.leaves(template.params),
+                           jax.tree.leaves(restored.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            assert b.sharding.is_equivalent_to(t.sharding, t.ndim), \
+                (t.sharding, b.sharding)
+            sharded += not b.sharding.is_fully_replicated
+        assert sharded > 0          # fsdp leaves actually stayed sharded
+
+    def test_restore_reshards_onto_new_layout(self, tmp_path, devices):
+        """Save replicated, restore into an fsdp template: the template's
+        shardings win — the mesh-migration path (e.g. resume a dp run as
+        dp+fsdp) with no manual re-layout."""
+        mesh = make_mesh()
+        _, state, _, _ = _tiny_state(mesh, fsdp=False)
+        path = str(tmp_path / "ckpt_replicated")
+        save_sharded_checkpoint(path, state)
+
+        _, template, _, _ = _tiny_state(mesh, fsdp=True)
+        restored, _ = restore_sharded_checkpoint(path, template)
+        t_leaves = jax.tree.leaves(template.params)
+        r_leaves = jax.tree.leaves(restored.params)
+        s_leaves = jax.tree.leaves(state.params)
+        assert any(not t.sharding.is_fully_replicated for t in t_leaves)
+        for t, r, s in zip(t_leaves, r_leaves, s_leaves):
+            assert r.sharding.is_equivalent_to(t.sharding, t.ndim)
+            np.testing.assert_array_equal(np.asarray(r), np.asarray(s))
+
+    def test_no_resume_opt_under_different_optimizer(self, tmp_path,
+                                                     devices):
+        """load_opt=False must not read or structure-match the saved
+        opt_state: resume SGD-with-momentum weights under plain Adam."""
+        from types import SimpleNamespace
+
+        from deepfake_detection_tpu.optim import create_optimizer
+
+        mesh = make_mesh()
+        _, state, step, _ = _tiny_state(mesh)
+        x = jax.device_put(np.ones((8, 32, 32, 3), np.float32),
+                           batch_sharding(mesh))
+        y = jax.device_put(np.zeros(8, np.int64), batch_sharding(mesh))
+        state, _ = step(state, x, y, jax.random.PRNGKey(0))
+        path = str(tmp_path / "ckpt")
+        save_sharded_checkpoint(path, state)
+
+        tx2 = create_optimizer(SimpleNamespace(
+            opt="adam", opt_eps=1e-8, momentum=0.9, weight_decay=0.0,
+            lr=1e-3))
+        template = create_train_state(
+            jax.tree.map(jnp.copy, state.variables), tx2)
+        restored, _ = restore_sharded_checkpoint(path, template,
+                                                 load_opt=False)
+        # params restored, optimizer state fresh (step back to 0)
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree.leaves(restored.params)[0]),
+            np.asarray(jax.tree.leaves(state.params)[0]))
+        assert int(restored.step) == 0
+
+    def test_qkv_layout_guard(self, tmp_path, devices):
+        """A sharded fused-qkv checkpoint without the head-major marker
+        must be rejected, like the msgpack path (models/helpers.py)."""
+        import flax.struct
+
+        @flax.struct.dataclass
+        class Fake:
+            params: dict
+
+        state = Fake(params={"blocks_0": {"attn": {"qkv": {
+            "kernel": jnp.zeros((8, 24))}}}})
+        path = str(tmp_path / "vit_ckpt")
+        save_sharded_checkpoint(path, state)           # meta gets marker
+        restore_sharded_checkpoint(path, state)        # marker honored
+        # simulate a foreign/legacy checkpoint: strip the marker
+        import json
+        import os
+        with open(os.path.join(path, "dfd_meta.json"), "w") as f:
+            json.dump({}, f)
+        with pytest.raises(ValueError, match="qkv_layout"):
+            restore_sharded_checkpoint(path, state)
