@@ -61,11 +61,20 @@ def check_qkv_layout(variables: Dict[str, Any], meta: Dict[str, Any],
             f"source torch checkpoint with tools/convert_torch_checkpoint.py.")
 
 
+def stamp_qkv_layout(meta: Optional[Dict[str, Any]],
+                     tree: Dict[str, Any]) -> Dict[str, Any]:
+    """Return ``meta`` (copied) with the head-major marker stamped when
+    ``tree`` carries fused-qkv params — the single invariant every save
+    path must apply so :func:`check_qkv_layout` can verify on load."""
+    meta = dict(meta or {})
+    if has_fused_qkv(tree.get("params", {})):
+        meta.setdefault("qkv_layout", QKV_LAYOUT)
+    return meta
+
+
 def save_model_checkpoint(path: str, variables: Dict[str, Any],
                           meta: Optional[Dict[str, Any]] = None) -> None:
-    meta = dict(meta or {})
-    if has_fused_qkv(variables.get("params", {})):
-        meta.setdefault("qkv_layout", QKV_LAYOUT)
+    meta = stamp_qkv_layout(meta, variables)
     variables = unfreeze(variables) if isinstance(
         variables, flax.core.FrozenDict) else variables
     # np-convert only the arrays; meta stays plain python — np.asarray on a

@@ -137,8 +137,7 @@ def save_checkpoint_file(path: str, state: Any,
     to one state copy), and :func:`wait_pending_saves` flushes at exit.
     """
     wait_pending_saves()              # at most one write/payload at a time
-    from ..models.helpers import QKV_LAYOUT, has_fused_qkv
-    meta = dict(meta or {})           # meta stays plain python (strs allowed)
+    from ..models.helpers import stamp_qkv_layout
     sd_dev = serialization.to_state_dict(state)
     # start every device->host copy before the first blocking np.asarray:
     # a per-leaf blocking fetch serializes O(leaves) transfer round trips
@@ -150,8 +149,7 @@ def save_checkpoint_file(path: str, state: Any,
             except Exception:  # noqa: BLE001 — _to_host surfaces real errors
                 pass
     sd = jax.tree.map(_to_host, sd_dev)
-    if has_fused_qkv(sd.get("params", {})):
-        meta.setdefault("qkv_layout", QKV_LAYOUT)
+    meta = stamp_qkv_layout(meta, sd)  # meta stays plain python
     payload = {"state": sd, "meta": meta}
 
     def _write() -> None:
@@ -195,23 +193,33 @@ def save_sharded_checkpoint(path: str, state: Any,
     """
     import orbax.checkpoint as ocp
 
+    import json
+
+    from ..models.helpers import stamp_qkv_layout
+
     path = os.path.abspath(path)
     sd = serialization.to_state_dict(state)
+    # serialize meta BEFORE the expensive collective save so a
+    # non-serializable value fails fast (numpy scalars — accepted by the
+    # msgpack path's meta — are converted, not rejected)
+    meta_blob = json.dumps(stamp_qkv_layout(meta, sd),
+                           default=lambda v: v.item()
+                           if isinstance(v, np.generic) else str(v))
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, sd, force=True)
         ckptr.wait_until_finished()
     if jax.process_index() == 0:
-        import json
-        from ..models.helpers import QKV_LAYOUT, has_fused_qkv
-        meta = dict(meta or {})
-        if has_fused_qkv(sd.get("params", {})):
-            meta.setdefault("qkv_layout", QKV_LAYOUT)
         # atomic, and written only after the collective save returned:
-        # the meta file's existence implies a complete checkpoint
+        # the meta file's existence marks a complete checkpoint
         meta_path = os.path.join(path, "dfd_meta.json")
         with open(meta_path + ".tmp", "w") as f:
-            json.dump(meta, f)
+            f.write(meta_blob)
         os.replace(meta_path + ".tmp", meta_path)
+    if jax.process_count() > 1:
+        # other ranks must not observe save() as done before the meta
+        # marker exists (a save-then-restore flow would read meta={})
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("dfd_sharded_save_meta")
 
 
 def _fresh_opt_sd(sd: Dict[str, Any], target_state: Any) -> Dict[str, Any]:
@@ -273,10 +281,16 @@ def restore_sharded_checkpoint(path: str, target_state: Any,
     if not load_opt:
         sd = _fresh_opt_sd(sd, target_state)
     meta_path = os.path.join(path, "dfd_meta.json")
-    meta: Dict[str, Any] = {}
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
+    if not os.path.exists(meta_path):
+        # the meta marker is written only after the collective save
+        # completes — its absence means an interrupted/incomplete save,
+        # not merely missing metadata
+        raise FileNotFoundError(
+            f"{path}: no dfd_meta.json — the save was interrupted before "
+            "completion (the marker is written last); do not resume from "
+            "this checkpoint")
+    with open(meta_path) as f:
+        meta: Dict[str, Any] = json.load(f)
     from ..models.helpers import check_qkv_layout
     check_qkv_layout(sd, meta, path)
     state = serialization.from_state_dict(target_state, sd)

@@ -64,11 +64,15 @@ class TestShardedCheckpoint:
         state, _ = step(state, x, y, jax.random.PRNGKey(1))
 
         path = str(tmp_path / "sharded_ckpt")
-        save_sharded_checkpoint(path, state, {"epoch": 3})
+        # numpy scalars in meta must be accepted (the msgpack path's meta
+        # round-trips them; the json meta converts them up front)
+        save_sharded_checkpoint(path, state, {"epoch": 3,
+                                              "metric": np.float32(0.75)})
 
         _, template, _, _ = _tiny_state(mesh, fsdp=True)
         restored, meta = restore_sharded_checkpoint(path, template)
         assert meta["epoch"] == 3
+        assert meta["metric"] == pytest.approx(0.75)
         assert int(restored.step) == 1
         # the contract: values from the checkpoint, shardings from the
         # TEMPLATE (the stepped state's GSPMD-chosen layout may differ)
@@ -151,4 +155,8 @@ class TestShardedCheckpoint:
         with open(os.path.join(path, "dfd_meta.json"), "w") as f:
             json.dump({}, f)
         with pytest.raises(ValueError, match="qkv_layout"):
+            restore_sharded_checkpoint(path, state)
+        # and an interrupted save: no meta marker at all
+        os.remove(os.path.join(path, "dfd_meta.json"))
+        with pytest.raises(FileNotFoundError, match="interrupted"):
             restore_sharded_checkpoint(path, state)

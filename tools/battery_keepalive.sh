@@ -1,0 +1,14 @@
+#!/bin/sh
+# Keepalive for the chip battery daemon: background processes in this
+# container are occasionally reaped without signal or log (observed
+# round 5: three silent daemon deaths, no OOM, nothing in dmesg).
+# Relaunch the daemon whenever it is missing.  Run detached:
+#   setsid nohup sh tools/battery_keepalive.sh >> battery_logs/keepalive.log 2>&1 < /dev/null &
+cd "$(dirname "$0")/.." || exit 1
+while true; do
+  if ! pgrep -f "[c]hip_battery.py" > /dev/null; then
+    echo "[keepalive $(date +%H:%M:%S)] battery daemon missing; relaunching"
+    setsid nohup python tools/chip_battery.py >> battery_logs/battery.log 2>&1 < /dev/null &
+  fi
+  sleep 60
+done

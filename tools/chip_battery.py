@@ -221,6 +221,7 @@ def main() -> None:
     if args.probe:
         sys.exit(0 if probe() else 1)
 
+    _log(f"daemon started (pid {os.getpid()}, interval {args.interval}s)")
     harvested = False
     while True:
         if probe():
