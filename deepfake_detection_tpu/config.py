@@ -120,6 +120,9 @@ class TrainConfig:
     initial_checkpoint: str = ""
     resume: str = ""
     no_resume_opt: bool = False
+    # sharded (Orbax) checkpointing: collective per-host shard writes +
+    # resharding restore — no rank-0 full-model gather (beyond reference)
+    ckpt_sharded: bool = False
     num_classes: int = 2
     gp: str = "avg"                      # global pool: avg|max|avgmax|catavgmax
     in_chans: Optional[int] = None       # derived from input_size if None

@@ -49,7 +49,8 @@ from ..optim import create_optimizer
 from ..parallel import (batch_sharding, initialize_distributed, make_mesh,
                         transformer_tp_sharding)
 from ..scheduler import create_scheduler
-from ..train import (CheckpointSaver, create_train_state, make_eval_step,
+from ..train import (CheckpointSaver, ShardedCheckpointSaver,
+                     create_train_state, make_eval_step,
                      make_train_step, replicate_for_save,
                      restore_train_state, set_learning_rate,
                      train_one_epoch, validate, wait_pending_saves)
@@ -189,20 +190,29 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     start_epoch = cfg.start_epoch or 0
 
     if cfg.resume:
-        # capture the fresh state's shardings (opt moments / EMA inherited
-        # them from the TP'd params via eager zeros_like) so the restored
-        # host arrays go back to the same layout, not just the params
-        from jax.sharding import NamedSharding
-        shard_tree = jax.tree.map(
-            lambda x: x.sharding if isinstance(x, jax.Array)
-            and isinstance(x.sharding, NamedSharding) else None,
-            state)
-        state, meta = restore_train_state(cfg.resume, state,
-                                          load_opt=not cfg.no_resume_opt)
-        if cfg.tp_size > 1:
-            state = jax.tree.map(
-                lambda leaf, sh: jax.device_put(leaf, sh)
-                if sh is not None else leaf, state, shard_tree)
+        if os.path.isdir(cfg.resume):
+            # sharded (Orbax) checkpoint directory: collective restore
+            # directly into the fresh state's shardings — re-layout
+            # (incl. a different tp_size) happens inside the read
+            from ..train import restore_sharded_checkpoint
+            state, meta = restore_sharded_checkpoint(
+                cfg.resume, state, load_opt=not cfg.no_resume_opt)
+        else:
+            # capture the fresh state's shardings (opt moments / EMA
+            # inherited them from the TP'd params via eager zeros_like) so
+            # the restored host arrays go back to the same layout, not
+            # just the params
+            from jax.sharding import NamedSharding
+            shard_tree = jax.tree.map(
+                lambda x: x.sharding if isinstance(x, jax.Array)
+                and isinstance(x.sharding, NamedSharding) else None,
+                state)
+            state, meta = restore_train_state(
+                cfg.resume, state, load_opt=not cfg.no_resume_opt)
+            if cfg.tp_size > 1:
+                state = jax.tree.map(
+                    lambda leaf, sh: jax.device_put(leaf, sh)
+                    if sh is not None else leaf, state, shard_tree)
         start_epoch = cfg.start_epoch if cfg.start_epoch is not None \
             else int(meta.get("epoch", -1)) + 1   # helpers.py:47-73
         _logger.info("Resumed from %s (epoch %d)", cfg.resume, start_epoch)
@@ -265,15 +275,23 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
 
     # output dir + config dump (reference :785-808, :527-532)
     output_dir, saver = "", None
-    if rank == 0:
+    if rank == 0 or cfg.ckpt_sharded:
         exp_name = cfg.experiment or "-".join(
             [cfg.model_version or cfg.model,
              os.path.basename(cfg.data.split(":")[0]) or cfg.dataset])
-        output_dir = get_outdir(cfg.output, exp_name, inc=True)
-        with open(os.path.join(output_dir, "args.yaml"), "w") as f:
-            f.write(cfg.to_yaml())
+        # the sharded saver is COLLECTIVE: every rank drives it and all
+        # must agree on the directory, so multi-process sharded runs skip
+        # the auto-increment (a per-rank race) — name runs via --experiment
+        output_dir = get_outdir(
+            cfg.output, exp_name,
+            inc=not (cfg.ckpt_sharded and jax.process_count() > 1))
+        if rank == 0:
+            with open(os.path.join(output_dir, "args.yaml"), "w") as f:
+                f.write(cfg.to_yaml())
         decreasing = cfg.eval_metric == "loss"
-        saver = CheckpointSaver(
+        saver_cls = ShardedCheckpointSaver if cfg.ckpt_sharded \
+            else CheckpointSaver
+        saver = saver_cls(
             checkpoint_dir=output_dir, bak_dir=os.path.join(
                 output_dir, "_bak"), decreasing=decreasing)
 
@@ -309,15 +327,17 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
                     epoch + 1, eval_metrics[cfg.eval_metric])  # :571-573
                 state = set_learning_rate(state, new_lr)
 
-            if output_dir:
+            if output_dir and rank == 0:
                 update_summary(epoch, train_metrics, eval_metrics,
                                os.path.join(output_dir, "summary.csv"),
                                os.path.join(output_dir, "plots"),
                                write_header=epoch == start_epoch)
-            # multi-host TP/EP: every rank gathers model-sharded leaves
-            # (collective) so rank 0 can serialize; no-op otherwise
+            # sharded saver: the collective save IS the cross-host path —
+            # no gather. Otherwise multi-host TP/EP: every rank gathers
+            # model-sharded leaves so rank 0 can serialize; no-op else
+            collective = saver is not None and saver.collective
             save_state = replicate_for_save(state) \
-                if jax.process_count() > 1 else state
+                if jax.process_count() > 1 and not collective else state
             if saver is not None:
                 best_metric, best_epoch = saver.save_checkpoint(
                     save_state, meta, epoch,

@@ -1,9 +1,10 @@
 """Training runtime: state, jitted steps, checkpointing, epoch loops."""
 
-from .checkpoint import (CheckpointSaver, load_checkpoint_file,
-                         replicate_for_save, restore_sharded_checkpoint,
-                         restore_train_state, save_checkpoint_file,
-                         save_sharded_checkpoint, wait_pending_saves)
+from .checkpoint import (CheckpointSaver, ShardedCheckpointSaver,
+                         load_checkpoint_file, replicate_for_save,
+                         restore_sharded_checkpoint, restore_train_state,
+                         save_checkpoint_file, save_sharded_checkpoint,
+                         wait_pending_saves)
 from .state import (TrainState, create_train_state, get_learning_rate,
                     set_learning_rate)
 from .steps import make_eval_step, make_train_step

@@ -33,7 +33,8 @@ from flax import serialization
 
 _logger = logging.getLogger(__name__)
 
-__all__ = ["CheckpointSaver", "save_checkpoint_file", "load_checkpoint_file",
+__all__ = ["CheckpointSaver", "ShardedCheckpointSaver",
+           "save_checkpoint_file", "load_checkpoint_file",
            "replicate_for_save", "restore_train_state", "wait_pending_saves",
            "save_sharded_checkpoint", "restore_sharded_checkpoint"]
 
@@ -250,15 +251,41 @@ def restore_sharded_checkpoint(path: str, target_state: Any,
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
+    # the completeness marker is checked BEFORE the (potentially many-GB,
+    # cross-host) shard read — its absence fails in milliseconds
+    meta_path = os.path.join(path, "dfd_meta.json")
+    if not os.path.exists(meta_path):
+        # written only after the collective save completes: absence means
+        # an interrupted/incomplete save, not merely missing metadata
+        raise FileNotFoundError(
+            f"{path}: no dfd_meta.json — the save was interrupted before "
+            "completion (the marker is written last); do not resume from "
+            "this checkpoint")
+    with open(meta_path) as f:
+        meta: Dict[str, Any] = json.load(f)
     target_sd = serialization.to_state_dict(target_state)
 
+    from jax.sharding import NamedSharding
+
     def abstract(x):
-        if isinstance(x, jax.Array):
+        # only mesh (NamedSharding) layouts are pinned; leaves the
+        # template holds on a single device restore PLACEMENT-FREE (as
+        # host arrays below, like the msgpack path) — committing them to
+        # one device would fight the train step's mesh placement
+        if isinstance(x, jax.Array) and isinstance(x.sharding,
+                                                   NamedSharding):
             return jax.ShapeDtypeStruct(x.shape, x.dtype,
                                         sharding=x.sharding)
-        if isinstance(x, np.ndarray):
+        if isinstance(x, (jax.Array, np.ndarray)):
             return jax.ShapeDtypeStruct(x.shape, x.dtype)
         return x
+
+    def uncommit(t, r):
+        if isinstance(r, jax.Array) and not (
+                isinstance(t, jax.Array)
+                and isinstance(t.sharding, NamedSharding)):
+            return np.asarray(r)
+        return r
 
     template = {k: jax.tree.map(abstract, v) for k, v in target_sd.items()
                 if load_opt or k not in ("opt_state", "step")}
@@ -276,21 +303,11 @@ def restore_sharded_checkpoint(path: str, target_state: Any,
         sd = dict(ckptr.restore(path, args=ocp.args.PyTreeRestore(
             item=template, restore_args=restore_args,
             partial_restore=not load_opt)))
+    sd = {k: jax.tree.map(uncommit, target_sd[k], v) for k, v in sd.items()}
     for k in nones:
         sd[k] = None
     if not load_opt:
         sd = _fresh_opt_sd(sd, target_state)
-    meta_path = os.path.join(path, "dfd_meta.json")
-    if not os.path.exists(meta_path):
-        # the meta marker is written only after the collective save
-        # completes — its absence means an interrupted/incomplete save,
-        # not merely missing metadata
-        raise FileNotFoundError(
-            f"{path}: no dfd_meta.json — the save was interrupted before "
-            "completion (the marker is written last); do not resume from "
-            "this checkpoint")
-    with open(meta_path) as f:
-        meta: Dict[str, Any] = json.load(f)
     from ..models.helpers import check_qkv_layout
     check_qkv_layout(sd, meta, path)
     state = serialization.from_state_dict(target_state, sd)
@@ -312,6 +329,11 @@ def restore_train_state(path: str, target_state: Any,
 
 
 class CheckpointSaver:
+    #: collective savers (sharded) must be driven by EVERY process;
+    #: file-based savers run on rank 0 only
+    collective = False
+    _ext = _EXT
+
     def __init__(self, checkpoint_dir: str = "",
                  recovery_dir: str = "", bak_dir: str = "",
                  decreasing: bool = False, max_history: int = 10,
@@ -346,9 +368,9 @@ class CheckpointSaver:
                 self._cleanup_checkpoints(1)
             path = os.path.join(
                 self.checkpoint_dir,
-                f"{self.checkpoint_prefix}-{epoch}{_EXT}")
+                f"{self.checkpoint_prefix}-{epoch}{self._ext}")
             meta = dict(meta, epoch=epoch, metric=metric)
-            save_checkpoint_file(path, state, meta)
+            self._write(path, state, meta)
             self.checkpoint_files.append((path, metric))
             # best-first; metric-less entries always rank worst (last) so
             # they are the first pruned
@@ -363,11 +385,11 @@ class CheckpointSaver:
                                        or self.cmp(metric, self.best_metric)):
                 self.best_epoch = epoch
                 self.best_metric = metric
-                best = os.path.join(self.checkpoint_dir, f"model_best{_EXT}")
-                shutil.copyfile(path, best)
+                self._mark_best(path, os.path.join(
+                    self.checkpoint_dir, f"model_best{self._ext}"))
                 if self.bak_dir:
-                    shutil.copyfile(
-                        path, os.path.join(self.bak_dir, f"model_best{_EXT}"))
+                    self._mark_best(path, os.path.join(
+                        self.bak_dir, f"model_best{self._ext}"))
         return (None, None) if self.best_metric is None \
             else (self.best_metric, self.best_epoch)
 
@@ -380,7 +402,7 @@ class CheckpointSaver:
         for path, _ in to_delete:
             try:
                 _logger.debug("Cleaning checkpoint: %s", path)
-                os.remove(path)
+                self._delete(path)
             except OSError as e:
                 _logger.error("Exception %r while deleting checkpoint", e)
         self.checkpoint_files = self.checkpoint_files[:delete_index]
@@ -392,15 +414,14 @@ class CheckpointSaver:
         :128-140)."""
         path = os.path.join(
             self.recovery_dir,
-            f"{self.recovery_prefix}-{epoch}-{batch_idx}{_EXT}")
-        save_checkpoint_file(path, state, dict(meta, epoch=epoch,
-                                               batch_idx=batch_idx),
-                             async_write=True)
+            f"{self.recovery_prefix}-{epoch}-{batch_idx}{self._ext}")
+        self._write_recovery(path, state, dict(meta, epoch=epoch,
+                                               batch_idx=batch_idx))
         if os.path.exists(self.last_recovery_file):
             try:
                 _logger.debug("Cleaning recovery: %s",
                               self.last_recovery_file)
-                os.remove(self.last_recovery_file)
+                self._delete(self.last_recovery_file)
             except OSError as e:
                 _logger.error("Exception %r while removing %s", e,
                               self.last_recovery_file)
@@ -410,5 +431,68 @@ class CheckpointSaver:
     def find_recovery(self) -> str:
         """Most recent recovery file, '' if none (reference :142-147)."""
         files = glob.glob(os.path.join(
-            self.recovery_dir, self.recovery_prefix + "*" + _EXT))
+            self.recovery_dir, self.recovery_prefix + "*" + self._ext))
         return sorted(files)[-1] if files else ""
+
+    # -- IO hooks (overridden by the sharded saver) --------------------
+    def _write(self, path: str, state: Any, meta: Dict[str, Any]) -> None:
+        save_checkpoint_file(path, state, meta)
+
+    def _write_recovery(self, path: str, state: Any,
+                        meta: Dict[str, Any]) -> None:
+        save_checkpoint_file(path, state, meta, async_write=True)
+
+    def _delete(self, path: str) -> None:
+        os.remove(path)
+
+    def _mark_best(self, src: str, dst: str) -> None:
+        shutil.copyfile(src, dst)
+
+
+class ShardedCheckpointSaver(CheckpointSaver):
+    """Sharded (Orbax) retention saver: checkpoints are DIRECTORIES and
+    saves are COLLECTIVE — drive :meth:`save_checkpoint` /
+    :meth:`save_recovery` from EVERY process (the retention decisions are
+    deterministic given identical metrics, so ranks stay in lockstep);
+    only process 0 touches the filesystem for bookkeeping.
+
+    ``model_best`` is a small JSON pointer to the best checkpoint
+    directory, not a copy — duplicating a sharded tree would double
+    checkpoint storage.  Recovery snapshots are synchronous (a collective
+    cannot run on a background thread).
+    """
+
+    collective = True
+    _ext = ""
+
+    def _write(self, path: str, state: Any, meta: Dict[str, Any]) -> None:
+        save_sharded_checkpoint(path, state, meta)
+
+    _write_recovery = _write
+
+    def _delete(self, path: str) -> None:
+        if jax.process_index() == 0:
+            shutil.rmtree(path, ignore_errors=True)
+
+    def _mark_best(self, src: str, dst: str) -> None:
+        if jax.process_index() != 0:
+            return
+        if self.bak_dir and dst.startswith(self.bak_dir):
+            # a pointer in _bak would reference the SAME primary tree —
+            # no durability gained; duplicating a sharded tree would
+            # double checkpoint storage, so the bak mirror is skipped
+            return
+        import json
+        with open(dst + ".json.tmp", "w") as f:
+            json.dump({"checkpoint": src}, f)
+        os.replace(dst + ".json.tmp", dst + ".json")
+
+    def find_recovery(self) -> str:
+        """Most recent COMPLETE recovery dir: Orbax leaves
+        ``*.orbax-checkpoint-tmp-*`` droppings for torn saves, and only
+        dirs whose dfd_meta.json exists finished their collective save."""
+        cands = glob.glob(os.path.join(self.recovery_dir,
+                                       self.recovery_prefix + "*"))
+        done = [c for c in cands
+                if os.path.isfile(os.path.join(c, "dfd_meta.json"))]
+        return sorted(done)[-1] if done else ""

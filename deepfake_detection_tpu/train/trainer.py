@@ -153,14 +153,20 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
 
         if cfg.recovery_interval and (
                 last_batch or (batch_idx + 1) % cfg.recovery_interval == 0):
-            # EVERY rank computes this condition and enters the gather
-            # (collective) — only rank 0 (the one holding a saver) writes
-            from .checkpoint import replicate_for_save
-            save_state = replicate_for_save(state) \
-                if jax.process_count() > 1 else state
-            if saver is not None:
-                saver.save_recovery(save_state, meta or {}, epoch,
-                                    batch_idx=batch_idx)  # reference :686-689
+            # EVERY rank computes this condition. Collective (sharded)
+            # saver: the save itself is the cross-host path — all ranks
+            # drive it, no gather. Otherwise every rank enters the gather
+            # and only rank 0 (the one holding a saver) writes.
+            if saver is not None and saver.collective:
+                saver.save_recovery(state, meta or {}, epoch,
+                                    batch_idx=batch_idx)
+            else:
+                from .checkpoint import replicate_for_save
+                save_state = replicate_for_save(state) \
+                    if jax.process_count() > 1 else state
+                if saver is not None:
+                    saver.save_recovery(save_state, meta or {}, epoch,
+                                        batch_idx=batch_idx)  # ref :686-689
 
         if lr_scheduler is not None:
             # no stock schedule consumes a per-update metric (plateau is

@@ -21,7 +21,9 @@ def get_outdir(path: str, *paths: str, inc: bool = False) -> str:
     """mkdir -p with optional ``-N`` suffix increment (reference :188-202)."""
     outdir = os.path.join(path, *paths)
     if not os.path.exists(outdir):
-        os.makedirs(outdir)
+        # exist_ok: with a collective (sharded) saver every rank calls
+        # this concurrently on a shared filesystem
+        os.makedirs(outdir, exist_ok=True)
     elif inc:
         count = 1
         outdir_inc = f"{outdir}-{count}"

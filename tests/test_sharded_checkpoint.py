@@ -135,6 +135,36 @@ class TestShardedCheckpoint:
             np.asarray(jax.tree.leaves(state.params)[0]))
         assert int(restored.step) == 0
 
+    @pytest.mark.slow
+    def test_runner_ckpt_sharded_train_and_resume(self, tmp_path, devices):
+        """--ckpt-sharded end-to-end: train writes checkpoint DIRECTORIES
+        + a model_best.json pointer; --resume <dir> restores through the
+        collective sharded path."""
+        import os
+
+        from deepfake_detection_tpu.runners.train import launch_main
+
+        args = [
+            "--dataset", "synthetic", "--model", "mnasnet_small",
+            "--model-version", "", "--input-size-v2", "3,32,32",
+            "--batch-size", "1", "--epochs", "1",
+            "--opt", "sgd", "--lr", "0.01", "--sched", "step",
+            "--log-interval", "10", "--workers", "1",
+            "--compute-dtype", "float32", "--ckpt-sharded",
+            "--output", str(tmp_path / "o1")]
+        out = launch_main(args)
+        assert out["best_metric"] is not None
+        run = os.path.join(tmp_path, "o1", os.listdir(tmp_path / "o1")[0])
+        ckpt = os.path.join(run, "checkpoint-0")
+        assert os.path.isdir(ckpt)                      # a directory
+        assert os.path.isfile(os.path.join(ckpt, "dfd_meta.json"))
+        import json
+        best = json.load(open(os.path.join(run, "model_best.json")))
+        assert best["checkpoint"] == ckpt
+        out = launch_main(args[:-1] + [str(tmp_path / "o2"),
+                                       "--resume", ckpt, "--epochs", "2"])
+        assert out["best_metric"] is not None
+
     def test_qkv_layout_guard(self, tmp_path, devices):
         """A sharded fused-qkv checkpoint without the head-major marker
         must be rejected, like the msgpack path (models/helpers.py)."""
