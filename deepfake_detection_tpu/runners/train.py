@@ -282,9 +282,17 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         # the sharded saver is COLLECTIVE: every rank drives it and all
         # must agree on the directory, so multi-process sharded runs skip
         # the auto-increment (a per-rank race) — name runs via --experiment
-        output_dir = get_outdir(
-            cfg.output, exp_name,
-            inc=not (cfg.ckpt_sharded and jax.process_count() > 1))
+        multiproc_sharded = cfg.ckpt_sharded and jax.process_count() > 1
+        output_dir = get_outdir(cfg.output, exp_name,
+                                inc=not multiproc_sharded)
+        if multiproc_sharded and not cfg.resume and \
+                os.path.exists(os.path.join(output_dir, "args.yaml")):
+            # inc=False means a rerun would silently overwrite the
+            # previous run's checkpoints and records
+            raise ValueError(
+                f"{output_dir} already holds a run; multi-process "
+                "--ckpt-sharded disables output-dir auto-increment — "
+                "name this run with --experiment, or --resume it")
         if rank == 0:
             with open(os.path.join(output_dir, "args.yaml"), "w") as f:
                 f.write(cfg.to_yaml())

@@ -41,6 +41,12 @@ __all__ = ["CheckpointSaver", "ShardedCheckpointSaver",
 _EXT = ".ckpt"
 
 
+def _recovery_key(path: str):
+    """(epoch, batch_idx) ints parsed from recovery-<e>-<b>[.ckpt]."""
+    import re
+    return tuple(int(n) for n in re.findall(r"\d+", os.path.basename(path)))
+
+
 def _needs_gather(x: Any) -> bool:
     """True for leaves only a cross-process collective can fetch: sharded
     over devices this process cannot address AND not replicated."""
@@ -255,6 +261,15 @@ def restore_sharded_checkpoint(path: str, target_state: Any,
     # cross-host) shard read — its absence fails in milliseconds
     meta_path = os.path.join(path, "dfd_meta.json")
     if not os.path.exists(meta_path):
+        subdirs = [d for d in sorted(glob.glob(os.path.join(path, "*")))
+                   if os.path.isfile(os.path.join(d, "dfd_meta.json"))]
+        if subdirs:
+            # the common mistake: the RUN directory was passed, not a
+            # checkpoint directory inside it
+            raise FileNotFoundError(
+                f"{path} is a run directory, not a checkpoint; resume "
+                f"from one of its checkpoints, e.g. {subdirs[-1]} "
+                "(model_best.json points at the best one)")
         # written only after the collective save completes: absence means
         # an interrupted/incomplete save, not merely missing metadata
         raise FileNotFoundError(
@@ -429,10 +444,12 @@ class CheckpointSaver:
         self.curr_recovery_file = path
 
     def find_recovery(self) -> str:
-        """Most recent recovery file, '' if none (reference :142-147)."""
+        """Most recent recovery file, '' if none (reference :142-147;
+        numeric epoch/batch ordering — a lexicographic sort would prefer
+        recovery-0-999 over recovery-0-1099)."""
         files = glob.glob(os.path.join(
             self.recovery_dir, self.recovery_prefix + "*" + self._ext))
-        return sorted(files)[-1] if files else ""
+        return max(files, key=_recovery_key) if files else ""
 
     # -- IO hooks (overridden by the sharded saver) --------------------
     def _write(self, path: str, state: Any, meta: Dict[str, Any]) -> None:
@@ -495,4 +512,4 @@ class ShardedCheckpointSaver(CheckpointSaver):
                                        self.recovery_prefix + "*"))
         done = [c for c in cands
                 if os.path.isfile(os.path.join(c, "dfd_meta.json"))]
-        return sorted(done)[-1] if done else ""
+        return max(done, key=_recovery_key) if done else ""

@@ -76,8 +76,10 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
     # jax.profiler window (SURVEY §5: the reference has no profiler; an MFU
     # target can't be tuned blind).  Steps [start, start+N) of epoch 0 are
     # traced into <output_dir>/profile — view with TensorBoard or Perfetto.
+    # rank-0 only: with a collective (sharded) saver output_dir is set on
+    # every rank, but trace/image side effects must not race on shared FS
     profile_n = getattr(cfg, "profile", 0) if epoch == 0 and output_dir \
-        else 0
+        and jax.process_index() == 0 else 0
     profile_start = min(10, max(num_batches - profile_n, 0))
     profiling = False
 
@@ -145,7 +147,7 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                 batch_time_m.val / max(bs // world_size, 1),
                 batch_time_m.avg / max(bs // world_size, 1),
                 lr, data_time_m.val, data_time_m.avg, ets_time)
-            if cfg.save_images and output_dir:
+            if cfg.save_images and output_dir and jax.process_index() == 0:
                 save_image_batch(
                     x, os.path.join(output_dir,
                                     f"train-batch-{batch_idx}.jpg"),

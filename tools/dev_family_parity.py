@@ -50,20 +50,27 @@ def load_reference_module(modname: str):
         timm = types.ModuleType("timm")
         timm.__path__ = [_REF]
         sys.modules["timm"] = timm
-        td = types.ModuleType("timm.data")
-        td.IMAGENET_DEFAULT_MEAN = (0.485, 0.456, 0.406)
-        td.IMAGENET_DEFAULT_STD = (0.229, 0.224, 0.225)
-        td.IMAGENET_INCEPTION_MEAN = (0.5,) * 3
-        td.IMAGENET_INCEPTION_STD = (0.5,) * 3
-        td.IMAGENET_DPN_MEAN = tuple(x / 255 for x in (124, 117, 104))
-        td.IMAGENET_DPN_STD = tuple(1 / (.0167 * 255) for _ in range(3))
-        sys.modules["timm.data"] = td
+        sys.modules["timm.data"] = types.ModuleType("timm.data")
         tmm = types.ModuleType("timm.models")
         tmm.__path__ = [_REF + "/models"]
         sys.modules["timm.models"] = tmm
-        load("timm.models.registry", f"{_REF}/models/registry.py")
-        load("timm.models.layers", f"{_REF}/models/layers/__init__.py")
-        load("timm.models.helpers", f"{_REF}/models/helpers.py")
+    # the timm.data stub may have been installed by another harness
+    # (tests/test_convert.py) with fewer constants — ensure every constant
+    # the model files import exists regardless of who created the stub
+    td = sys.modules["timm.data"]
+    for name, val in (
+            ("IMAGENET_DEFAULT_MEAN", (0.485, 0.456, 0.406)),
+            ("IMAGENET_DEFAULT_STD", (0.229, 0.224, 0.225)),
+            ("IMAGENET_INCEPTION_MEAN", (0.5,) * 3),
+            ("IMAGENET_INCEPTION_STD", (0.5,) * 3),
+            ("IMAGENET_DPN_MEAN", tuple(x / 255 for x in (124, 117, 104))),
+            ("IMAGENET_DPN_STD", tuple(1 / (.0167 * 255)
+                                       for _ in range(3)))):
+        if not hasattr(td, name):
+            setattr(td, name, val)
+    load("timm.models.registry", f"{_REF}/models/registry.py")
+    load("timm.models.layers", f"{_REF}/models/layers/__init__.py")
+    load("timm.models.helpers", f"{_REF}/models/helpers.py")
     return load(f"timm.models.{modname}", f"{_REF}/models/{modname}.py")
 
 
