@@ -166,6 +166,28 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     n_params = sum(x.size for x in jax.tree.leaves(variables["params"]))
     _logger.info("Model %s created, param count: %d", cfg.model, n_params)
 
+    if cfg.initial_checkpoint:
+        # pretrained weights into the fresh tree (reference train.py:316 /
+        # helpers.py:31-44): non-strict — head/in_chans mismatches drop,
+        # but loudly, and a checkpoint matching NOTHING is an error (a
+        # silent from-scratch "fine-tune" is worse than failing)
+        from ..models.helpers import _flatten, filter_shape_mismatch, \
+            load_state_dict
+        loaded = load_state_dict(cfg.initial_checkpoint)
+        n_init = len(_flatten(variables))
+        n_hit = len(set(_flatten(variables)) & set(_flatten(loaded)))
+        variables, dropped = filter_shape_mismatch(variables, loaded)
+        applied = n_hit - dropped
+        if applied == 0:
+            raise ValueError(
+                f"--initial-checkpoint {cfg.initial_checkpoint} matches no "
+                f"parameter of model {cfg.model!r} — wrong architecture?")
+        _logger.info(
+            "Loaded initial checkpoint %s: %d/%d leaves applied "
+            "(%d shape-mismatched, %d missing keep their fresh init)",
+            cfg.initial_checkpoint, applied, n_init, dropped,
+            n_init - n_hit)
+
     def apply_tp(params):
         # place params under the Megatron-paired TP shardings; non-matching
         # leaves (and non-transformer models) stay replicated
@@ -364,6 +386,14 @@ def launch_main(argv=None) -> Dict[str, float]:
     """CLI entry (reference launch_main, train.py:769-816)."""
     setup_default_logging()
     cfg = TrainConfig.from_args(argv)
+    if cfg.initial_checkpoint.endswith((".pth", ".pth.tar", ".pt")):
+        # purely lexical precondition: fail before mesh construction and
+        # the (relay-expensive) jitted init, not minutes into main()
+        raise ValueError(
+            f"--initial-checkpoint {cfg.initial_checkpoint} is a torch "
+            "checkpoint; convert it first: python "
+            "tools/convert_torch_checkpoint.py <file> <out.msgpack> "
+            f"--model {cfg.model} --verify")
     if cfg.json_file:
         cluster = ClusterConfig.from_json(cfg.json_file)
         initialize_distributed(cluster, local_rank=cfg.local_rank)

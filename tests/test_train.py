@@ -198,6 +198,45 @@ class TestEndToEndSmoke:
         assert (run / "model_best.ckpt").is_file()
 
     @pytest.mark.slow
+    def test_initial_checkpoint_loads_weights(self, tmp_path, devices):
+        """--initial-checkpoint seeds the fresh model with saved weights
+        (reference train.py:316); a torch file gets a convert-first hint."""
+        from deepfake_detection_tpu.models import create_model, init_model
+        from deepfake_detection_tpu.models.helpers import (
+            load_state_dict, save_model_checkpoint)
+        from deepfake_detection_tpu.runners.train import launch_main
+
+        model = create_model("mnasnet_small", num_classes=2, in_chans=3)
+        variables = init_model(model, jax.random.PRNGKey(7), (2, 32, 32, 3),
+                               training=True)
+        # recognizable marker weights
+        variables["params"]["classifier"]["bias"] = jnp.full((2,), 7.5)
+        ckpt = str(tmp_path / "init.msgpack")
+        save_model_checkpoint(ckpt, variables)
+
+        out = launch_main([
+            "--dataset", "synthetic", "--model", "mnasnet_small",
+            "--model-version", "", "--input-size-v2", "3,32,32",
+            "--batch-size", "1", "--epochs", "1", "--opt", "sgd",
+            "--lr", "0.0", "--sched", "step", "--log-interval", "10",
+            "--workers", "1", "--compute-dtype", "float32",
+            "--initial-checkpoint", ckpt,
+            "--output", str(tmp_path / "out")])
+        assert out["best_metric"] is not None
+        run = tmp_path / "out" / os.listdir(tmp_path / "out")[0]
+        loaded = load_state_dict(str(run / "checkpoint-0.ckpt"))
+        # lr=0: the marker bias must survive one epoch untouched
+        np.testing.assert_allclose(
+            np.asarray(loaded["params"]["classifier"]["bias"]), 7.5)
+        with pytest.raises(ValueError, match="convert it first"):
+            launch_main([
+                "--dataset", "synthetic", "--model", "mnasnet_small",
+                "--model-version", "", "--input-size-v2", "3,32,32",
+                "--batch-size", "1", "--epochs", "1",
+                "--initial-checkpoint", "weights.pth.tar",
+                "--output", str(tmp_path / "out2")])
+
+    @pytest.mark.slow
     def test_resume_from_checkpoint(self, tmp_path, devices):
         from deepfake_detection_tpu.runners.train import launch_main
         args = [
