@@ -168,12 +168,10 @@ class EfficientNet(nn.Module):
             # conv_head → bn → act → pool (efficientnet.py:292-299,320-334)
             x = Conv2d(self.num_features, 1, dtype=self.dtype,
                        name="conv_head")(x)
-            if self.norm_layer == "bn":
-                x = BatchNorm2d(momentum=self.bn_momentum, eps=self.bn_eps,
-                                axis_name=self.bn_axis_name, dtype=self.dtype,
-                                name="bn2")(x, training=training)
-            elif self.norm_layer == "gn":
-                x = GroupNorm(dtype=self.dtype, name="bn2")(x, training=training)
+            from .efficientnet_blocks import _norm
+            x = _norm(self.norm_layer, self.bn_momentum, self.bn_eps,
+                      self.bn_axis_name, self.dtype,
+                      "bn2")(x, training=training)
             x = act(x)
             if not pool:
                 return x

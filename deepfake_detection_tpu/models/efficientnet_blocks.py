@@ -42,6 +42,14 @@ def round_channels(channels, multiplier: float = 1.0, divisor: int = 8,
 
 
 def _norm(norm_layer: str, momentum, eps, axis_name, dtype, name):
+    if norm_layer.startswith("split"):
+        # AdvProp split BN: 'split<k>' (reference convert_splitbn_model,
+        # split_batchnorm.py:41-69 — here a norm_layer option, since flax
+        # modules cannot be surgically rewritten post-construction)
+        from ..ops.norm import SplitBatchNorm2d
+        return SplitBatchNorm2d(num_splits=int(norm_layer[5:] or 2),
+                                momentum=momentum, eps=eps,
+                                axis_name=axis_name, dtype=dtype, name=name)
     if norm_layer == "none":
         return Identity(name=name)
     if norm_layer == "gn":

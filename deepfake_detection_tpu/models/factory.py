@@ -29,6 +29,8 @@ _BN_KWARG_MODULES = ("efficientnet", "mobilenetv3")
 _REMAT_MODULES = _BN_KWARG_MODULES + ("vit", "timesformer")
 # modules with a pluggable attention kernel (TrainConfig.attn_impl)
 _ATTN_MODULES = ("vit", "timesformer")
+
+_DROP_BLOCK_MODULES = ("resnet", "res2net", "sknet", "gluon_resnet")
 _ATTN_IMPLS = ("full", "flash", "ring", "ring_flash", "ulysses")
 
 
@@ -63,6 +65,15 @@ def create_model(model_name: str, pretrained: bool = False,
             logging.getLogger(__name__).warning(
                 "attn_impl=%r is only consumed by the %s families; "
                 "ignored for %s", ai, _ATTN_MODULES, model_name)
+    if not is_model_in_modules(model_name, _DROP_BLOCK_MODULES):
+        v = kwargs.pop("drop_block_rate", None)
+        if v:
+            import logging
+            logging.getLogger(__name__).warning(
+                "drop_block_rate=%r is only consumed by the %s families; "
+                "ignored for %s (matches the reference factory's pop of "
+                "unsupported drop_block_rate)", v, _DROP_BLOCK_MODULES,
+                model_name)
     dcr = kwargs.pop("drop_connect_rate", None)
     if dcr is not None and "drop_path_rate" not in kwargs:
         kwargs["drop_path_rate"] = dcr

@@ -77,7 +77,8 @@ def build_model(cfg: TrainConfig, in_chans: int):
     kwargs: Dict[str, Any] = dict(
         pretrained=cfg.pretrained, num_classes=cfg.num_classes,
         in_chans=in_chans, drop_rate=cfg.drop,
-        drop_path_rate=cfg.drop_path, bn_tf=cfg.bn_tf,
+        drop_path_rate=cfg.drop_path, drop_block_rate=cfg.drop_block,
+        bn_tf=cfg.bn_tf,
         bn_momentum=cfg.bn_momentum, bn_eps=cfg.bn_eps,
         global_pool=cfg.gp,
         remat_policy=cfg.checkpoint_policy,
@@ -85,6 +86,12 @@ def build_model(cfg: TrainConfig, in_chans: int):
                                             cfg.compute_dtype != "float32")
         else None)
     kwargs = {k: v for k, v in kwargs.items() if v is not None}
+    if cfg.split_bn:
+        # AdvProp split BN (reference train.py:335-337): a separate BN per
+        # augmentation split — meaningless without >1 split
+        assert cfg.aug_splits > 1 or cfg.resplit, \
+            "--split-bn needs --aug-splits > 1 or --resplit"
+        kwargs["norm_layer"] = f"split{max(cfg.aug_splits, 2)}"
     if cfg.attn_impl:
         if cfg.attn_impl in ("ring", "ring_flash", "ulysses"):
             raise ValueError(
@@ -146,6 +153,15 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     dp_size = int(mesh.shape.get("data", n_dev))
     _logger.info("Training with %d devices, mesh %s, process %d/%d",
                  n_dev, dict(mesh.shape), rank, jax.process_count())
+    if cfg.split_bn and dp_size > 1:
+        # the loader's split-major batch layout ([all clean, all aug])
+        # does not survive contiguous per-device sharding — device d
+        # would feed its main BN augmented samples, corrupting exactly
+        # the clean/aug separation AdvProp split BN exists for
+        raise NotImplementedError(
+            "--split-bn requires a single data-parallel replica "
+            "(dp=1); an interleaved per-device batch layout is needed "
+            "for dp>1 and is not implemented")
 
     # ONE seed for every host: params are logically replicated, so init must
     # be identical everywhere (the reference's per-rank seed, train.py:299,

@@ -168,3 +168,46 @@ def test_remat_policies_match_baseline():
         # past 5e-4 on a near-zero element is a real remat math change
         assert all(jnp.allclose(a, b, rtol=1e-4, atol=5e-4)
                    for a, b in zip(flat_a, flat_b)), policy
+
+
+def test_split_bn_norm_layer():
+    """AdvProp split BN as a norm_layer option (reference
+    convert_splitbn_model): per-split aux BN params exist, train batches
+    split across them, eval routes everything through the main BN."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deepfake_detection_tpu.models import create_model, init_model
+
+    m = create_model("mnasnet_small", num_classes=2, norm_layer="split2")
+    v = init_model(m, jax.random.PRNGKey(0), (4, 32, 32, 3), training=True)
+    stem_bn = v["params"]["conv_stem"]["bn1"]
+    assert "main" in stem_bn and "aux0" in stem_bn
+    # first half dark, second half bright: with split-major routing the
+    # main BN must absorb the dark statistics and aux0 the bright ones
+    x = jnp.concatenate([jnp.zeros((2, 32, 32, 3)),
+                         jnp.ones((2, 32, 32, 3))])
+    y, mut = m.apply(v, x, training=True, mutable=["batch_stats"])
+    assert y.shape == (4, 2)
+    stem_stats = mut["batch_stats"]["conv_stem"]["bn1"]
+    main_mean = np.asarray(stem_stats["main"]["bn"]["mean"])
+    aux_mean = np.asarray(stem_stats["aux0"]["bn"]["mean"])
+    assert not np.allclose(main_mean, aux_mean), \
+        "aux BN saw the same batch statistics as main — routing broken"
+    # eval path: main BN only
+    y_eval = m.apply(v, x, training=False)
+    assert y_eval.shape == (4, 2)
+
+
+def test_runner_build_model_split_bn_flag():
+    """--split-bn requires aug splits and plumbs norm_layer=split<k>."""
+    import pytest
+    from deepfake_detection_tpu.config import TrainConfig
+    from deepfake_detection_tpu.runners.train import build_model
+
+    with pytest.raises(AssertionError, match="aug-splits"):
+        build_model(TrainConfig(model="mnasnet_small", model_version="",
+                                split_bn=True), in_chans=3)
+    m = build_model(TrainConfig(model="mnasnet_small", model_version="",
+                                split_bn=True, aug_splits=2), in_chans=3)
+    assert m.norm_layer == "split2"
