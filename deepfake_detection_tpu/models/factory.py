@@ -65,6 +65,15 @@ def create_model(model_name: str, pretrained: bool = False,
             logging.getLogger(__name__).warning(
                 "attn_impl=%r is only consumed by the %s families; "
                 "ignored for %s", ai, _ATTN_MODULES, model_name)
+    if str(kwargs.get("norm_layer", "")).startswith("split") and \
+            not is_model_in_modules(model_name, _BN_KWARG_MODULES):
+        # the user explicitly asked for AdvProp split-BN semantics —
+        # silently training without them would be worse than failing
+        raise ValueError(
+            f"norm_layer={kwargs['norm_layer']!r} (--split-bn) is only "
+            f"supported by the {_BN_KWARG_MODULES} families, not "
+            f"{model_name} (the reference's post-hoc convert_splitbn_model "
+            "has no flax equivalent)")
     if not is_model_in_modules(model_name, _DROP_BLOCK_MODULES):
         v = kwargs.pop("drop_block_rate", None)
         if v:

@@ -158,6 +158,39 @@ def filter_shape_mismatch(init_vars: Dict[str, Any],
     return tree, dropped
 
 
+def expand_split_bn(loaded: Dict[str, Any],
+                    init_vars: Dict[str, Any]) -> Dict[str, Any]:
+    """Adapt a plain-BN checkpoint to a split-BN model tree.
+
+    The reference loads weights FIRST and converts to split BN after
+    (convert_splitbn_model deep-copies the pretrained BN into every aux,
+    split_batchnorm.py:41-69); a flax tree is fixed at construction, so
+    the checkpoint adapts instead: wherever the init tree has
+    ``<name>/{main,aux<i>}/bn/<leaf>`` and the checkpoint has
+    ``<name>/bn/<leaf>``, the pretrained value fans out to main AND every
+    aux.  Non-BN keys pass through untouched.
+    """
+    init_flat = _flatten(unfreeze(init_vars)
+                         if hasattr(init_vars, "items") else init_vars)
+    loaded_flat = _flatten(loaded)
+    out = dict(loaded_flat)
+    for k in init_flat:
+        for i, part in enumerate(k):
+            if part == "main" or (part.startswith("aux")
+                                  and part[3:].isdigit()):
+                src = k[:i] + k[i + 1:]
+                if src in loaded_flat and k not in loaded_flat:
+                    out[k] = loaded_flat[src]
+                break
+    tree: Dict[str, Any] = {}
+    for k, v in out.items():
+        node = tree
+        for part in k[:-1]:
+            node = node.setdefault(part, {})
+        node[k[-1]] = v
+    return tree
+
+
 def load_checkpoint(init_variables: Dict[str, Any], checkpoint_path: str,
                     use_ema: bool = False, strict: bool = True) -> Dict[str, Any]:
     """Load weights into an initialized variable tree (helpers.py:31-44)."""

@@ -88,9 +88,11 @@ def build_model(cfg: TrainConfig, in_chans: int):
     kwargs = {k: v for k, v in kwargs.items() if v is not None}
     if cfg.split_bn:
         # AdvProp split BN (reference train.py:335-337): a separate BN per
-        # augmentation split — meaningless without >1 split
-        assert cfg.aug_splits > 1 or cfg.resplit, \
-            "--split-bn needs --aug-splits > 1 or --resplit"
+        # augmentation split — meaningless without >1 split.  ValueError,
+        # not assert: CLI validation must survive python -O
+        if not (cfg.aug_splits > 1 or cfg.resplit):
+            raise ValueError("--split-bn needs --aug-splits > 1 or "
+                             "--resplit")
         kwargs["norm_layer"] = f"split{max(cfg.aug_splits, 2)}"
     if cfg.attn_impl:
         if cfg.attn_impl in ("ring", "ring_flash", "ulysses"):
@@ -187,9 +189,14 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         # helpers.py:31-44): non-strict — head/in_chans mismatches drop,
         # but loudly, and a checkpoint matching NOTHING is an error (a
         # silent from-scratch "fine-tune" is worse than failing)
-        from ..models.helpers import _flatten, filter_shape_mismatch, \
-            load_state_dict
+        from ..models.helpers import (_flatten, expand_split_bn,
+                                      filter_shape_mismatch,
+                                      load_state_dict)
         loaded = load_state_dict(cfg.initial_checkpoint)
+        if cfg.split_bn:
+            # plain-BN checkpoints fan out into main + aux BNs, like the
+            # reference's load-then-convert order (split_batchnorm.py:41)
+            loaded = expand_split_bn(loaded, variables)
         n_init = len(_flatten(variables))
         n_hit = len(set(_flatten(variables)) & set(_flatten(loaded)))
         variables, dropped = filter_shape_mismatch(variables, loaded)

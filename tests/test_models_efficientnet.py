@@ -205,9 +205,40 @@ def test_runner_build_model_split_bn_flag():
     from deepfake_detection_tpu.config import TrainConfig
     from deepfake_detection_tpu.runners.train import build_model
 
-    with pytest.raises(AssertionError, match="aug-splits"):
+    with pytest.raises(ValueError, match="aug-splits"):
         build_model(TrainConfig(model="mnasnet_small", model_version="",
                                 split_bn=True), in_chans=3)
     m = build_model(TrainConfig(model="mnasnet_small", model_version="",
                                 split_bn=True, aug_splits=2), in_chans=3)
     assert m.norm_layer == "split2"
+
+
+def test_split_bn_checkpoint_fanout():
+    """A plain-BN checkpoint loads into a split-BN model with the
+    pretrained BN fanned out to main AND aux (the reference's
+    load-then-convert order, split_batchnorm.py:41-69)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deepfake_detection_tpu.models import create_model, init_model
+    from deepfake_detection_tpu.models.helpers import (expand_split_bn,
+                                                       filter_shape_mismatch)
+
+    m0 = create_model("mnasnet_small", num_classes=2)
+    v0 = init_model(m0, jax.random.PRNGKey(3), (2, 32, 32, 3), training=True)
+    v0["params"]["conv_stem"]["bn1"]["bn"]["scale"] = jnp.full_like(
+        v0["params"]["conv_stem"]["bn1"]["bn"]["scale"], 3.25)
+    m1 = create_model("mnasnet_small", num_classes=2, norm_layer="split2")
+    v1 = init_model(m1, jax.random.PRNGKey(0), (4, 32, 32, 3), training=True)
+    merged, dropped = filter_shape_mismatch(v1, expand_split_bn(v0, v1))
+    bn = merged["params"]["conv_stem"]["bn1"]
+    assert (np.asarray(bn["main"]["bn"]["scale"]) == 3.25).all()
+    assert (np.asarray(bn["aux0"]["bn"]["scale"]) == 3.25).all()
+    assert dropped == 0
+
+
+def test_split_bn_unsupported_family_raises():
+    import pytest
+    from deepfake_detection_tpu.models import create_model
+    with pytest.raises(ValueError, match="split-bn"):
+        create_model("resnet18", norm_layer="split2")
