@@ -120,6 +120,16 @@ def load_state_dict(checkpoint_path: str, use_ema: bool = False) -> Dict[str, An
     return out
 
 
+def _unflatten(flat: Dict[tuple, Any]) -> Dict[str, Any]:
+    tree: Dict[str, Any] = {}
+    for k, v in flat.items():
+        node = tree
+        for part in k[:-1]:
+            node = node.setdefault(part, {})
+        node[k[-1]] = v
+    return tree
+
+
 def _flatten(tree, prefix=()):
     out = {}
     for k, v in tree.items():
@@ -148,14 +158,7 @@ def filter_shape_mismatch(init_vars: Dict[str, Any],
                                 "/".join(k), np.shape(lv), np.shape(v))
                 dropped += 1
             merged[k] = v
-    # unflatten
-    tree: Dict[str, Any] = {}
-    for k, v in merged.items():
-        node = tree
-        for part in k[:-1]:
-            node = node.setdefault(part, {})
-        node[k[-1]] = v
-    return tree, dropped
+    return _unflatten(merged), dropped
 
 
 def expand_split_bn(loaded: Dict[str, Any],
@@ -178,17 +181,17 @@ def expand_split_bn(loaded: Dict[str, Any],
         for i, part in enumerate(k):
             if part == "main" or (part.startswith("aux")
                                   and part[3:].isdigit()):
-                src = k[:i] + k[i + 1:]
-                if src in loaded_flat and k not in loaded_flat:
-                    out[k] = loaded_flat[src]
+                if k in loaded_flat:
+                    break
+                # plain-BN checkpoint: <name>/bn/...; split-BN checkpoint
+                # with fewer splits: its main seeds the extra aux BNs
+                for src in (k[:i] + k[i + 1:],
+                            k[:i] + ("main",) + k[i + 1:]):
+                    if src in loaded_flat:
+                        out[k] = loaded_flat[src]
+                        break
                 break
-    tree: Dict[str, Any] = {}
-    for k, v in out.items():
-        node = tree
-        for part in k[:-1]:
-            node = node.setdefault(part, {})
-        node[k[-1]] = v
-    return tree
+    return _unflatten(out)
 
 
 def load_checkpoint(init_variables: Dict[str, Any], checkpoint_path: str,

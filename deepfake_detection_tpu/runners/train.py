@@ -330,10 +330,13 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         multiproc_sharded = cfg.ckpt_sharded and jax.process_count() > 1
         output_dir = get_outdir(cfg.output, exp_name,
                                 inc=not multiproc_sharded)
-        if multiproc_sharded and not cfg.resume and \
+        if multiproc_sharded and rank == 0 and not cfg.resume and \
                 os.path.exists(os.path.join(output_dir, "args.yaml")):
             # inc=False means a rerun would silently overwrite the
-            # previous run's checkpoints and records
+            # previous run's checkpoints and records.  Rank 0 ONLY: other
+            # ranks would race against rank 0's own args.yaml write of
+            # THIS run; rank 0's failure propagates through the
+            # coordination service
             raise ValueError(
                 f"{output_dir} already holds a run; multi-process "
                 "--ckpt-sharded disables output-dir auto-increment — "

@@ -206,6 +206,16 @@ def save_sharded_checkpoint(path: str, state: Any,
 
     path = os.path.abspath(path)
     sd = serialization.to_state_dict(state)
+    if jax.process_count() > 1:
+        # host-local leaves (the step counter, injected lr — single-device
+        # arrays identical on every rank) cannot join a multi-host
+        # collective write; serialize them as host numpy instead (the
+        # restore side reloads them placement-free, matching)
+        from jax.sharding import NamedSharding
+        sd = jax.tree.map(
+            lambda x: np.asarray(x)
+            if isinstance(x, jax.Array)
+            and not isinstance(x.sharding, NamedSharding) else x, sd)
     # serialize meta BEFORE the expensive collective save so a
     # non-serializable value fails fast (numpy scalars — accepted by the
     # msgpack path's meta — are converted, not rejected)

@@ -43,7 +43,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_two_process(tmp_path, extra_args, timeout=1200, tag=""):
+def _run_two_process(tmp_path, extra_args, timeout=1200, tag="",
+                     shared_output=False):
     cluster = {
         "world_size": 2,
         "coordinator_address": f"localhost:{_free_port()}",
@@ -66,11 +67,17 @@ def _run_two_process(tmp_path, extra_args, timeout=1200, tag=""):
     args = ["--dataset", "synthetic", "--batch-size", "1", "--epochs", "1",
             "--log-interval", "1", "--workers", "0",
             "--json-file", str(cluster_json), *extra_args]
+    def _output(i: int) -> str:
+        # collective (sharded) savers need every rank on ONE directory;
+        # the rank-0-only saver gets per-rank dirs so the tests can
+        # assert only rank 0 wrote
+        return str(tmp_path / (f"out{tag}" if shared_output
+                               else f"out{tag}{i}"))
+
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _WORKER, *args,
-             "--local-rank", str(i),
-             "--output", str(tmp_path / f"out{tag}{i}")],
+             "--local-rank", str(i), "--output", _output(i)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=_REPO)
         for i in range(2)
@@ -142,4 +149,33 @@ def test_two_process_tensor_parallel_and_resume(tmp_path):
         tag="r")
     _assert_lockstep(metrics2)
     # the resumed run really continued from epoch 1
+    assert metrics2[0]["best_epoch"] == 1, metrics2[0]
+
+
+@pytest.mark.slow
+def test_two_process_sharded_checkpoint(tmp_path):
+    """--ckpt-sharded across a REAL process boundary: a (4, 2) dp×tp mesh
+    whose model-sharded state each process saves its OWN shards of
+    (collective Orbax save, no replicate_for_save gather), then a second
+    2-process run resumes from the checkpoint directory with the
+    collective resharding restore.  Covers what the single-process mesh
+    tests cannot: per-host shard writes, the cross-process completeness
+    barrier, and a restore whose template shards span processes."""
+    args = ["--model", "vit_tiny_patch16_224", "--model-version", "",
+            "--input-size-v2", "3,32,32", "--tp-size", "2",
+            "--ckpt-sharded", "--experiment", "shard"]
+    metrics = _run_two_process(tmp_path, args, shared_output=True)
+    _assert_lockstep(metrics)
+    run_dir = tmp_path / "out" / "shard"
+    ckpt = run_dir / "checkpoint-0"
+    assert ckpt.is_dir(), list(run_dir.iterdir())
+    assert (ckpt / "dfd_meta.json").is_file()
+    assert json.loads(
+        (run_dir / "model_best.json").read_text())["checkpoint"] \
+        == str(ckpt)
+
+    metrics2 = _run_two_process(
+        tmp_path, args + ["--resume", str(ckpt), "--epochs", "2"],
+        tag="r", shared_output=True)
+    _assert_lockstep(metrics2)
     assert metrics2[0]["best_epoch"] == 1, metrics2[0]
