@@ -48,7 +48,12 @@ def test_img(model_path: Optional[str], img_files: Sequence[str],
                                      num_classes=2, in_chans=12)
     variables = init_model(model, jax.random.PRNGKey(0),
                            (1, size, size, 12))
-    if model_path:
+    if model_path and os.path.isdir(model_path):
+        # sharded (--ckpt-sharded) training checkpoint directory; prefers
+        # the EMA stream like the reference's released model_half
+        from ..train.checkpoint import load_sharded_for_eval
+        variables = load_sharded_for_eval(model_path, variables)
+    elif model_path:
         variables = load_checkpoint(variables, model_path, strict=False)
     print("Model loaded!")
     score_fn = make_score_fn(model, variables)

@@ -36,7 +36,8 @@ _logger = logging.getLogger(__name__)
 __all__ = ["CheckpointSaver", "ShardedCheckpointSaver",
            "save_checkpoint_file", "load_checkpoint_file",
            "replicate_for_save", "restore_train_state", "wait_pending_saves",
-           "save_sharded_checkpoint", "restore_sharded_checkpoint"]
+           "save_sharded_checkpoint", "restore_sharded_checkpoint",
+           "load_sharded_for_eval"]
 
 _EXT = ".ckpt"
 
@@ -269,25 +270,7 @@ def restore_sharded_checkpoint(path: str, target_state: Any,
     path = os.path.abspath(path)
     # the completeness marker is checked BEFORE the (potentially many-GB,
     # cross-host) shard read — its absence fails in milliseconds
-    meta_path = os.path.join(path, "dfd_meta.json")
-    if not os.path.exists(meta_path):
-        subdirs = [d for d in sorted(glob.glob(os.path.join(path, "*")))
-                   if os.path.isfile(os.path.join(d, "dfd_meta.json"))]
-        if subdirs:
-            # the common mistake: the RUN directory was passed, not a
-            # checkpoint directory inside it
-            raise FileNotFoundError(
-                f"{path} is a run directory, not a checkpoint; resume "
-                f"from one of its checkpoints, e.g. {subdirs[-1]} "
-                "(model_best.json points at the best one)")
-        # written only after the collective save completes: absence means
-        # an interrupted/incomplete save, not merely missing metadata
-        raise FileNotFoundError(
-            f"{path}: no dfd_meta.json — the save was interrupted before "
-            "completion (the marker is written last); do not resume from "
-            "this checkpoint")
-    with open(meta_path) as f:
-        meta: Dict[str, Any] = json.load(f)
+    meta = _check_complete_sharded(path)
     target_sd = serialization.to_state_dict(target_state)
 
     from jax.sharding import NamedSharding
@@ -337,6 +320,69 @@ def restore_sharded_checkpoint(path: str, target_state: Any,
     check_qkv_layout(sd, meta, path)
     state = serialization.from_state_dict(target_state, sd)
     return state, meta
+
+
+def _check_complete_sharded(path: str) -> Dict[str, Any]:
+    """Validate the completeness marker; returns the checkpoint meta.
+
+    Diagnoses the common wrong-path mistake (the RUN directory, which
+    contains checkpoint-N subdirectories, instead of one of them).
+    """
+    import json
+
+    meta_path = os.path.join(path, "dfd_meta.json")
+    if not os.path.exists(meta_path):
+        subdirs = [d for d in sorted(glob.glob(os.path.join(path, "*")))
+                   if os.path.isfile(os.path.join(d, "dfd_meta.json"))]
+        if subdirs:
+            raise FileNotFoundError(
+                f"{path} is a run directory, not a checkpoint; use one of "
+                f"its checkpoints, e.g. {subdirs[-1]} (model_best.json "
+                "points at the best one)")
+        raise FileNotFoundError(
+            f"{path}: no dfd_meta.json — the save was interrupted before "
+            "completion (the marker is written last); do not load this "
+            "checkpoint")
+    with open(meta_path) as f:
+        return json.load(f)
+
+
+def load_sharded_for_eval(path: str, variables: Dict[str, Any],
+                          use_ema: bool = True) -> Dict[str, Any]:
+    """Model variables {params, batch_stats} from a sharded TRAIN
+    checkpoint directory — the serving path for ``--ckpt-sharded`` runs.
+
+    Prefers the EMA stream when the checkpoint carries one (the
+    reference ships its released model from the EMA stream,
+    ``model_half``); reads ONLY the selected streams, placement-free.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    meta = _check_complete_sharded(path)
+
+    def abstract(x):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype) \
+            if isinstance(x, (jax.Array, np.ndarray)) else x
+
+    tmpl = {k: jax.tree.map(abstract, variables[k])
+            for k in ("params", "batch_stats") if k in variables}
+    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+        # key presence is not enough: an EMA-less TrainState serializes
+        # ema=None, which still appears in the tree metadata
+        ema_md = (ckptr.metadata(path).item_metadata or {}).get("ema")
+        has_ema = use_ema and isinstance(ema_md, dict) and "params" in ema_md
+        item = {"ema": tmpl} if has_ema else tmpl
+        restore_args = ocp.checkpoint_utils.construct_restore_args(item)
+        out = ckptr.restore(path, args=ocp.args.PyTreeRestore(
+            item=item, restore_args=restore_args, partial_restore=True))
+    out = dict(out["ema"] if has_ema else out)
+    if has_ema:
+        _logger.info("Loaded EMA stream from %s", path)
+    out = {k: jax.tree.map(np.asarray, v) for k, v in out.items()}
+    from ..models.helpers import check_qkv_layout
+    check_qkv_layout(out, meta, path)
+    return out
 
 
 def restore_train_state(path: str, target_state: Any,

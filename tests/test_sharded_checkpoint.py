@@ -165,6 +165,62 @@ class TestShardedCheckpoint:
                                        "--resume", ckpt, "--epochs", "2"])
         assert out["best_metric"] is not None
 
+    def test_load_for_eval_prefers_ema(self, tmp_path, devices):
+        """Serving path: load_sharded_for_eval pulls the EMA stream from a
+        sharded TRAIN checkpoint (the reference ships its released model
+        from EMA), falling back to raw params without one."""
+        from types import SimpleNamespace
+
+        import numpy as np
+
+        from deepfake_detection_tpu.losses import cross_entropy
+        from deepfake_detection_tpu.models import create_model, init_model
+        from deepfake_detection_tpu.optim import create_optimizer
+        from deepfake_detection_tpu.train import make_train_step
+        from deepfake_detection_tpu.train.checkpoint import \
+            load_sharded_for_eval
+
+        mesh = make_mesh()
+        model = create_model("mnasnet_small", num_classes=2, in_chans=3)
+        variables = init_model(model, jax.random.PRNGKey(0), (2, 32, 32, 3),
+                               training=True)
+        tx = create_optimizer(SimpleNamespace(
+            opt="sgd", opt_eps=1e-8, momentum=0.0, weight_decay=0.0,
+            lr=0.05))
+        state = create_train_state(
+            jax.tree.map(jnp.copy, variables), tx, with_ema=True)
+        step = make_train_step(model, tx, cross_entropy, mesh=mesh,
+                               bn_mode="global", ema_decay=0.5)
+        x = jax.device_put(np.ones((8, 32, 32, 3), np.float32),
+                           batch_sharding(mesh))
+        y = jax.device_put(np.zeros(8, np.int64), batch_sharding(mesh))
+        state, _ = step(state, x, y, jax.random.PRNGKey(1))
+        path = str(tmp_path / "train_ckpt")
+        save_sharded_checkpoint(path, state)
+
+        out = load_sharded_for_eval(path, variables, use_ema=True)
+        # EMA(decay=.5) after one step sits strictly between init and the
+        # updated params wherever they moved
+        ema_leaf = np.asarray(jax.tree.leaves(out["params"])[0])
+        par_leaf = np.asarray(jax.tree.leaves(state.params)[0])
+        np.testing.assert_array_equal(
+            ema_leaf, np.asarray(jax.tree.leaves(state.ema["params"])[0]))
+        assert not np.array_equal(ema_leaf, par_leaf)
+        out2 = load_sharded_for_eval(path, variables, use_ema=False)
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree.leaves(out2["params"])[0]), par_leaf)
+        # a model can consume the result directly
+        logits = model.apply(out, jnp.zeros((1, 32, 32, 3)), training=False)
+        assert logits.shape == (1, 2)
+        # EMA-less checkpoint (ema=None in the TrainState): use_ema=True
+        # must FALL BACK to raw params, not crash on the None placeholder
+        state_no_ema = create_train_state(
+            jax.tree.map(jnp.copy, variables), tx, with_ema=False)
+        path2 = str(tmp_path / "train_ckpt_no_ema")
+        save_sharded_checkpoint(path2, state_no_ema)
+        out3 = load_sharded_for_eval(path2, variables, use_ema=True)
+        assert "params" in out3 and "batch_stats" in out3
+
     def test_qkv_layout_guard(self, tmp_path, devices):
         """A sharded fused-qkv checkpoint without the head-major marker
         must be rejected, like the msgpack path (models/helpers.py)."""
