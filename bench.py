@@ -5,8 +5,10 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...,
 benchmark model) and ``rows`` carries the full measured config matrix
 (VERDICT r3 item 1): B4 380², the flagship ``efficientnet_deepfake_v4``
 12×600² (with an OOM ladder over batch/remat), ViT-B/16 224² with both
-dense and Pallas-flash attention, and a forward-only B4 inference row
-(the reference serves inference from the same backbone, test.py).
+dense and Pallas-flash attention, a forward-only B4 inference row
+(the reference serves inference from the same backbone, test.py), and
+the temporal-extension TimeSformer on 4-frame clips (last in the
+matrix, so a budget truncation never costs a reference-parity row).
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 MFU / 0.70 — the fraction of the driver-set north-star target of ≥70% MFU
@@ -445,6 +447,13 @@ def main() -> None:
                 ("b4_infer", lambda: _run_config(
                     devices, "efficientnet_b4", 128, 380, 3, steps,
                     jnp.bfloat16, mode="infer")),
+                # the temporal extension flagship: divided space-time
+                # attention over the 4-frame clips (models/timesformer.py);
+                # last so a budget-truncated matrix never eats the
+                # reference-parity rows above
+                ("timesformer", lambda: _run_config(
+                    devices, "timesformer_base_patch16_224", 32, 224, 12,
+                    steps, jnp.bfloat16)),
             ]
         matrix_t0 = None
         for name, fn in matrix:
