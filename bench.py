@@ -498,6 +498,15 @@ def _is_transient(err: str) -> bool:
     return any(s in low for s in _TRANSIENT)
 
 
+def _retry_budget_left(timeout: float, elapsed: float,
+                       floor: float = 60.0) -> bool:
+    """BENCH_RUN_TIMEOUT is a GLOBAL budget; a transient-fault retry is
+    only worth taking when at least ``floor`` seconds of it remain — a
+    retry that would be watchdogged almost immediately just burns the CPU
+    fallback's slice of an outer supervisor's stage allowance."""
+    return timeout - elapsed >= floor
+
+
 def _run_watched() -> None:
     """Run main() in a worker thread; watchdog + retry + CPU fallback."""
     import threading
@@ -524,10 +533,12 @@ def _run_watched() -> None:
 
         t = threading.Thread(target=work, daemon=True)
         t.start()
-        # BENCH_RUN_TIMEOUT is a GLOBAL budget: a transient-fault retry
-        # gets only the remainder, so watchdog + retry can never exceed
-        # an outer supervisor's single-stage allowance
-        t.join(max(60.0, timeout - (time.perf_counter() - t0)))
+        # BENCH_RUN_TIMEOUT is a GLOBAL budget: a retry gets only the
+        # remainder (never a fresh 60 s grant — _retry_budget_left gated
+        # it), so watchdog + retry can never exceed an outer supervisor's
+        # single-stage allowance
+        remaining = timeout - (time.perf_counter() - t0)
+        t.join(max(60.0, remaining) if attempt == 0 else max(0.0, remaining))
         if t.is_alive():
             # a hung jax call can't be interrupted — only exec/exit escapes
             if on_cpu:
@@ -538,8 +549,11 @@ def _run_watched() -> None:
             return
         err = box.get("error", "unknown")
         if attempt + 1 < attempts and _is_transient(err):
-            _log(f"transient fault ({err[:200]}); retrying once ...")
-            continue
+            if _retry_budget_left(timeout, time.perf_counter() - t0):
+                _log(f"transient fault ({err[:200]}); retrying once ...")
+                continue
+            _log(f"transient fault ({err[:200]}) but <60s of "
+                 "BENCH_RUN_TIMEOUT remains; skipping the retry")
         if on_cpu:
             _fail_json("run", err)
             os._exit(1)

@@ -112,6 +112,12 @@ class TrainConfig:
     workers: int = 8
     pin_memory: bool = False
     prefetch_depth: int = 2
+    # host input-pipeline backend: 'thread' = in-process pool (GIL-release
+    # scaling), 'shm' = spawned worker processes writing into a shared-
+    # memory ring of batch slabs (zero-copy collate; data/shm_ring.py)
+    loader_backend: str = "thread"
+    ring_depth: int = 4                  # shm backend: batch slabs in flight
+    worker_heartbeat: float = 120.0      # shm backend: stalled-worker kill (s)
 
     # --- model ---
     model: str = "efficientnet_deepfake_v4"
@@ -259,6 +265,12 @@ class TrainConfig:
         if self.checkpoint_policy not in ("none", "full", "dots"):
             raise ValueError("checkpoint_policy must be none|full|dots, got "
                              f"{self.checkpoint_policy!r}")
+        if self.loader_backend not in ("thread", "shm"):
+            raise ValueError("loader_backend must be thread|shm, got "
+                             f"{self.loader_backend!r}")
+        if int(self.ring_depth) < 3:
+            raise ValueError("--ring-depth must be >= 3 (double buffering "
+                             f"needs one spare slab), got {self.ring_depth}")
 
     # ------------------------------------------------------------------
     @property

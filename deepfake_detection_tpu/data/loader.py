@@ -39,12 +39,15 @@ _logger = logging.getLogger(__name__)
 from .constants import IMAGENET_DEFAULT_MEAN, IMAGENET_DEFAULT_STD
 from .mixup import FastCollateMixup
 from .random_erasing import RandomErasing
-from .samplers import OrderedShardedSampler, ShardedTrainSampler
+from .samplers import (OrderedShardedSampler, ShardedTrainSampler,
+                       epoch_batches)
 from .transforms_factory import (transforms_deepfake_eval_v3,
                                  transforms_deepfake_train_v3)
 
 __all__ = ["fast_collate", "HostLoader", "DeviceLoader", "create_loader",
            "create_deepfake_loader_v3"]
+
+LOADER_BACKENDS = ("thread", "shm")
 
 
 def fast_collate(samples: Sequence[Tuple[np.ndarray, int]]
@@ -104,15 +107,8 @@ class HostLoader:
         return np.asarray(img, dtype=np.uint8), target
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        indices = list(iter(self.sampler))
-        valid = None
-        if self.valid_mask and hasattr(self.sampler, "local_indices"):
-            out = self.sampler.local_indices()
-            if isinstance(out, tuple):
-                indices, valid = out[0].tolist(), out[1]
-        nb = len(indices) // self.batch_size
-        batches = [indices[i * self.batch_size:(i + 1) * self.batch_size]
-                   for i in range(nb)]
+        batches, vms = epoch_batches(self.sampler, self.batch_size,
+                                     self.valid_mask)
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
         stop = threading.Event()
 
@@ -139,9 +135,8 @@ class HostLoader:
                             [self.seed, self.epoch, bi, 0x77]))
                         images, targets = self.collate_mixup(images, targets,
                                                              mrng)
-                    if valid is not None:
-                        vm = valid[bi * self.batch_size:(bi + 1) * self.batch_size]
-                        item: Any = (images, targets, np.asarray(vm))
+                    if vms is not None:
+                        item: Any = (images, targets, vms[bi])
                     else:
                         item = (images, targets)
                     if not put(item):
@@ -239,6 +234,12 @@ class DeviceLoader:
     def set_epoch(self, epoch: int) -> None:
         self.loader.set_epoch(epoch)
 
+    def close(self) -> None:
+        """Tear down the host loader's workers/shm (no-op for threads)."""
+        close = getattr(self.loader, "close", None)
+        if close is not None:
+            close()
+
     def __len__(self) -> int:
         return len(self.loader)
 
@@ -248,18 +249,47 @@ class DeviceLoader:
             return put_process_local(arr, self.sharding)
         return jax.device_put(arr)
 
+    def _stage(self, item, base_key):
+        """device_put + dispatch the prologue for one host batch."""
+        images, targets = item[0], item[1]
+        key = jax.random.fold_in(base_key, self._step)
+        self._step += 1
+        x = self._prologue(self._put(images), key)
+        # targets/valid views may be ring-slab backed: small, copy before
+        # the put so slot recycling can never touch them
+        y = self._put(np.array(targets))
+        if len(item) == 3:
+            return x, y, self._put(np.array(item[2]))
+        return x, y
+
     def __iter__(self):
         base_key = jax.random.PRNGKey(self.seed)
-        for item in self.loader:
-            images, targets = item[0], item[1]
-            key = jax.random.fold_in(base_key, self._step)
-            self._step += 1
-            x = self._prologue(self._put(images), key)
-            y = self._put(np.asarray(targets))
-            if len(item) == 3:
-                yield x, y, self._put(np.asarray(item[2]))
-            else:
-                yield x, y
+        it = iter(self.loader)
+        # double buffering: stage batch k+1 (host→device transfer +
+        # prologue dispatch) BEFORE yielding batch k, so the transfer
+        # overlaps the consumer's compiled step on batch k — the async-
+        # dispatch equivalent of the reference's CUDA-stream prefetcher.
+        pending = None
+        prev_x = None
+        while True:
+            if prev_x is not None:
+                # the shm ring recycles batch k's slab once batch k+2 is
+                # requested; jax CPU device_put zero-copies aligned host
+                # buffers, so batch k's prologue (the only reader of the
+                # slab) must have RUN before we pull the next host batch
+                jax.block_until_ready(prev_x)
+                prev_x = None
+            try:
+                item = next(it)
+            except StopIteration:
+                break
+            staged = self._stage(item, base_key)
+            if pending is not None:
+                prev_x = staged[0]
+                yield pending
+            pending = staged
+        if pending is not None:
+            yield pending
 
 
 def _build_loader(dataset, transform, batch_size: int, is_training: bool,
@@ -267,10 +297,12 @@ def _build_loader(dataset, transform, batch_size: int, is_training: bool,
                   num_shards: int, shard_index: int, seed: int,
                   num_workers: int, prefetch_depth: int,
                   valid_mask: Optional[bool],
-                  device_kwargs: dict) -> DeviceLoader:
+                  device_kwargs: dict, loader_backend: str = "thread",
+                  ring_depth: int = 4,
+                  worker_heartbeat: float = 120.0) -> DeviceLoader:
     """Shared factory tail: AugMix wrap, transform attach, sharded sampler
-    selection, host loader, device prologue.  Both :func:`create_loader`
-    and :func:`create_deepfake_loader_v3` end here."""
+    selection, host loader backend, device prologue.  Both
+    :func:`create_loader` and :func:`create_deepfake_loader_v3` end here."""
     if is_training and num_aug_splits > 1:
         # clean + (num_aug_splits-1) AugMix views per sample, feeding the
         # JSD consistency loss (reference dataset.py:633-670)
@@ -293,10 +325,22 @@ def _build_loader(dataset, transform, batch_size: int, is_training: bool,
             batch_size=batch_size)
     if valid_mask is None:
         valid_mask = not is_training
-    host = HostLoader(dataset, sampler, batch_size, seed=seed,
-                      num_workers=num_workers, prefetch_depth=prefetch_depth,
-                      collate_mixup=collate_mixup if is_training else None,
-                      valid_mask=valid_mask)
+    if loader_backend == "shm":
+        from .shm_ring import ShmRingLoader
+        host: Any = ShmRingLoader(
+            dataset, sampler, batch_size, seed=seed,
+            num_workers=num_workers, ring_depth=ring_depth,
+            collate_mixup=collate_mixup if is_training else None,
+            valid_mask=valid_mask, heartbeat_timeout=worker_heartbeat)
+    elif loader_backend == "thread":
+        host = HostLoader(dataset, sampler, batch_size, seed=seed,
+                          num_workers=num_workers,
+                          prefetch_depth=prefetch_depth,
+                          collate_mixup=collate_mixup if is_training else None,
+                          valid_mask=valid_mask)
+    else:
+        raise ValueError(f"loader_backend must be one of {LOADER_BACKENDS}, "
+                         f"got {loader_backend!r}")
     return DeviceLoader(host, seed=seed, **device_kwargs)
 
 
@@ -315,6 +359,8 @@ def create_loader(
         dtype: Any = jnp.bfloat16, tf_preprocessing: bool = False,
         seed: int = 42, prefetch_depth: int = 2,
         sharding: Optional[Any] = None, valid_mask: Optional[bool] = None,
+        loader_backend: str = "thread", ring_depth: int = 4,
+        worker_heartbeat: float = 120.0,
         ) -> DeviceLoader:
     """Generic single-image loader factory (reference loader.py:372-456).
 
@@ -349,7 +395,9 @@ def create_loader(
         dict(mean=mean, std=std, dtype=dtype,
              re_prob=re_prob if is_training else 0.0, re_mode=re_mode,
              re_count=re_count, re_num_splits=re_num_splits, re_max=re_max,
-             img_num=1, sharding=sharding))
+             img_num=1, sharding=sharding),
+        loader_backend=loader_backend, ring_depth=ring_depth,
+        worker_heartbeat=worker_heartbeat)
 
 
 def create_deepfake_loader_v3(
@@ -366,7 +414,8 @@ def create_deepfake_loader_v3(
         blur_prob: float = 0.0, seed: int = 42, prefetch_depth: int = 2,
         sharding: Optional[Any] = None, valid_mask: Optional[bool] = None,
         eval_crop: str = "random", device_color_jitter: bool = True,
-        fused_geom: bool = True,
+        fused_geom: bool = True, loader_backend: str = "thread",
+        ring_depth: int = 4, worker_heartbeat: float = 120.0,
         ) -> DeviceLoader:
     """Loader factory (reference loader.py:724-830): builds the v3 transform,
     picks the train/eval sharded sampler, wires collate mixup and the device
@@ -431,4 +480,6 @@ def create_deepfake_loader_v3(
              re_prob=re_prob if is_training else 0.0, re_mode=re_mode,
              re_count=re_count, re_num_splits=re_num_splits, re_max=re_max,
              img_num=max(1, img_num), sharding=sharding,
-             color_jitter=device_cj, flicker=device_flicker))
+             color_jitter=device_cj, flicker=device_flicker),
+        loader_backend=loader_backend, ring_depth=ring_depth,
+        worker_heartbeat=worker_heartbeat)

@@ -284,6 +284,19 @@ def warp_affine_batch(frames: Sequence[np.ndarray], coeffs: Sequence[float],
 
 _default_pool: Optional[DecodePool] = None
 _pool_lock = threading.Lock()
+_default_pool_threads: Optional[int] = None
+
+
+def set_default_pool_threads(num_threads: int) -> None:
+    """Pin the lazily-created default pool's thread count.
+
+    Multi-process loader workers call this before their first decode so N
+    worker processes don't each spin up the full 4-thread default pool
+    (N×4 native threads on a host with far fewer spare cores).  A no-op if
+    the pool already exists; ``DFD_NATIVE_POOL_THREADS`` overrides both.
+    """
+    global _default_pool_threads
+    _default_pool_threads = max(1, int(num_threads))
 
 
 def default_pool(num_threads: int = 4) -> Optional[DecodePool]:
@@ -294,5 +307,20 @@ def default_pool(num_threads: int = 4) -> Optional[DecodePool]:
     if _default_pool is None:
         with _pool_lock:
             if _default_pool is None:
-                _default_pool = DecodePool(num_threads)
+                n = int(os.environ.get("DFD_NATIVE_POOL_THREADS", 0)) \
+                    or _default_pool_threads or num_threads
+                _default_pool = DecodePool(n)
     return _default_pool
+
+
+def _drop_pool_after_fork() -> None:  # pragma: no cover - fork-start only
+    """The pool's C++ threads do not survive fork: calling into an
+    inherited pool handle deadlocks the child.  Drop the reference (the C
+    allocation is leaked in the child — freeing it would try to join
+    threads that don't exist there) so the child lazily builds its own."""
+    global _default_pool
+    _default_pool = None
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_drop_pool_after_fork)

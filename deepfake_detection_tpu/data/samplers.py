@@ -22,7 +22,34 @@ from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["ShardedTrainSampler", "OrderedShardedSampler"]
+__all__ = ["ShardedTrainSampler", "OrderedShardedSampler", "epoch_batches"]
+
+
+def epoch_batches(sampler, batch_size: int, valid_mask: bool = False
+                  ) -> Tuple[List[List[int]], Optional[List[np.ndarray]]]:
+    """Split one epoch of ``sampler`` into full batches.
+
+    Shared front half of every host loader backend (thread pool and shm
+    ring), so both iterate the exact same ``(epoch, batch_index) → indices``
+    mapping.  Returns ``(batches, valid)``: ``batches`` is a list of
+    per-batch index lists (trailing partial batch dropped — samplers pad to
+    a batch multiple, see module docstring), ``valid`` is a matching list of
+    per-batch bool masks when ``valid_mask`` is set and the sampler reports
+    padding validity, else None.
+    """
+    indices = list(iter(sampler))
+    valid = None
+    if valid_mask and hasattr(sampler, "local_indices"):
+        out = sampler.local_indices()
+        if isinstance(out, tuple):
+            indices, valid = out[0].tolist(), out[1]
+    nb = len(indices) // batch_size
+    batches = [indices[i * batch_size:(i + 1) * batch_size]
+               for i in range(nb)]
+    vms = None if valid is None else \
+        [np.asarray(valid[i * batch_size:(i + 1) * batch_size])
+         for i in range(nb)]
+    return batches, vms
 
 
 class ShardedTrainSampler:

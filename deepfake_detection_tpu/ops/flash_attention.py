@@ -88,7 +88,8 @@ def _out_struct(shape, dtype, like):
     mesh axes they vary over; inherit that from an input operand so the same
     kernels work standalone and under any mesh.
     """
-    vma = getattr(jax.typeof(like), "vma", None)
+    typeof = getattr(jax, "typeof", None)   # pre-0.6 jax: no VMA types
+    vma = getattr(typeof(like), "vma", None) if typeof is not None else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

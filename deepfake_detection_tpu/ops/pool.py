@@ -72,12 +72,24 @@ def max_pool2d_torch(x, window: Tuple[int, int], strides: Tuple[int, int],
         p = ((padding, padding),) * 2
         return nn.max_pool(x, window, strides=strides, padding=p)
     pads = []
+    outs = []
     for dim, k, s in zip(x.shape[1:3], window, strides):
+        # torch's ceil_mode output count: ceil formula, then drop the last
+        # window if it would START in the right padded region
         out = -((dim + 2 * padding - k) // -s) + 1
         if (out - 1) * s >= dim + padding:
             out -= 1
+        outs.append(out)
+        # end pad so flax's floor formula keeps exactly torch's windows; a
+        # NEGATIVE required pad (reachable when stride > kernel interacts
+        # with the decrement rule) cannot be expressed as padding — clamp
+        # to 0 and slice the surplus trailing window(s) off below instead
+        # of silently emitting one extra window (ADVICE.md)
         pads.append((padding, max(0, (out - 1) * s + k - dim - padding)))
-    return nn.max_pool(x, window, strides=strides, padding=pads)
+    y = nn.max_pool(x, window, strides=strides, padding=pads)
+    # both grids start windows at i*s - padding, so torch's output is
+    # exactly the first outs[...] windows; a no-op slice in the common case
+    return y[:, :outs[0], :outs[1], :]
 
 
 def avg_pool2d_torch(x, window: Tuple[int, int], strides: Tuple[int, int],

@@ -1,0 +1,50 @@
+"""jax version compatibility for the parallelism layer.
+
+This container family pins jax anywhere from 0.4.x to current; the manual
+(shard_map) API surface moved twice along the way.  One shim module so the
+call sites stay one-line imports and the fallbacks die in one place when
+the pre-0.6 floor is dropped:
+
+* ``shard_map`` — ``jax.shard_map`` (0.6+) vs
+  ``jax.experimental.shard_map.shard_map`` (same API).
+* ``axis_size`` — ``lax.axis_size`` vs ``psum(1, axis)``, which inside
+  shard_map constant-folds to a static Python int on pre-0.6 jax.
+* ``pcast_varying`` — ``lax.pcast(x, axis, to="varying")``; pre-0.6 jax
+  has no varying-manual-axes type system, so marking is a no-op there.
+* ``shard_map_check_kwargs`` — the replication/vma checker kwarg was
+  renamed ``check_rep`` → ``check_vma``.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict
+
+from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:                      # pre-0.6: experimental home
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+__all__ = ["axis_size", "pcast_varying", "shard_map",
+           "shard_map_check_kwargs"]
+
+
+def axis_size(axis_name: str) -> int:
+    size = getattr(lax, "axis_size", None)
+    if size is not None:
+        return size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pcast_varying(x: Any, axis_name: str) -> Any:
+    pcast = getattr(lax, "pcast", None)
+    return x if pcast is None else pcast(x, axis_name, to="varying")
+
+
+def shard_map_check_kwargs(enabled: bool) -> Dict[str, bool]:
+    """``{check_vma: enabled}`` or the legacy ``{check_rep: enabled}``."""
+    name = "check_vma" if "check_vma" in \
+        inspect.signature(shard_map).parameters else "check_rep"
+    return {name: enabled}

@@ -31,6 +31,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import _compat
+from ._compat import shard_map
+
 __all__ = ["gpipe_apply", "gpipe_transformer_tower",
            "pipeline_sharding", "stack_block_params"]
 
@@ -57,7 +60,7 @@ def gpipe_apply(block_apply: Callable, stacked_params: Any, x: jnp.ndarray,
     Output is valid on every stage (the last stage's results are summed
     across the axis — all other stages contribute zeros).
     """
-    s_count = lax.axis_size(axis_name)
+    s_count = _compat.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m_count = num_microbatches
     b = x.shape[0]
@@ -98,7 +101,7 @@ def gpipe_apply(block_apply: Callable, stacked_params: Any, x: jnp.ndarray,
     # outs starts as plain zeros and must be marked varying for the scan
     # carry type to be stable
     buf0 = jnp.where(idx == 0, micro[0], jnp.zeros_like(micro[0]))
-    outs0 = lax.pcast(jnp.zeros_like(micro), axis_name, to="varying")
+    outs0 = _compat.pcast_varying(jnp.zeros_like(micro), axis_name)
     (_, outs), _ = lax.scan(step, (buf0, outs0),
                             jnp.arange(s_count + m_count - 1))
     # only the last stage holds real outputs; psum broadcasts them
@@ -112,7 +115,6 @@ def gpipe_transformer_tower(mesh: Mesh, block_apply: Callable,
                             axis: str = "stage") -> jnp.ndarray:
     """shard_map wrapper: ``stacked_params`` leaves are (D, ...) global
     arrays sharded over ``axis``; ``x`` replicated."""
-    from jax import shard_map
     fn = functools.partial(gpipe_apply, block_apply,
                            axis_name=axis, num_microbatches=num_microbatches)
     return shard_map(

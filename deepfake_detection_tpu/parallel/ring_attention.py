@@ -32,6 +32,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import _compat
+from ._compat import shard_map
+
 __all__ = ["ring_attention", "ring_flash_attention", "ulysses_attention",
            "ring_self_attention", "full_attention"]
 
@@ -61,7 +64,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     Call inside ``shard_map`` with the sequence dim sharded over
     ``axis_name``.  K/V rotate ``axis_size`` times; accumulation is float32.
     """
-    n = lax.axis_size(axis_name)
+    n = _compat.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     b, lq, h, d = q.shape
@@ -101,8 +104,9 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     # mark the fresh accumulators as device-varying over the ring axis so the
     # fori_loop carry type matches the (sharded, hence varying) K/V blocks
+    # (pre-0.6 jax has no varying-manual-axes type system — no-op there)
     def vary(x):
-        return lax.pcast(x, axis_name, to="varying")
+        return _compat.pcast_varying(x, axis_name)
     acc0 = vary(jnp.zeros((b, lq, h, d), jnp.float32))
     m0 = vary(jnp.full((b, h, lq), -jnp.inf, jnp.float32))
     l0 = vary(jnp.zeros((b, h, lq), jnp.float32))
@@ -158,7 +162,7 @@ def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """
     from ..ops.flash_attention import (_bwd_dkv, _bwd_dq, _fwd, _round_up)
 
-    n = lax.axis_size(axis_name)
+    n = _compat.axis_size(axis_name)
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale_ = scale if scale is not None else d ** -0.5
@@ -179,7 +183,7 @@ def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         return jnp.transpose(x, (0, 2, 1, 3))
 
     def vary(x):
-        return lax.pcast(x, axis_name, to="varying")
+        return _compat.pcast_varying(x, axis_name)
 
     # K/V (and dK/dV in the backward) travel the ring in their raw
     # (B, l, H, D) layout: the ppermute link is the scarce ICI resource,
@@ -271,7 +275,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     Re-shards seq→heads, runs dense local attention on H/n heads over the
     full sequence, re-shards back.  Requires ``H % axis_size == 0``.
     """
-    n = lax.axis_size(axis_name)
+    n = _compat.axis_size(axis_name)
     assert q.shape[2] % n == 0, f"heads {q.shape[2]} not divisible by {n}"
 
     def to_heads(x):  # (B, L/n, H, D) -> (B, L, H/n, D)
@@ -300,7 +304,6 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     non-varying block counters with varying refs, which the vma checker
     rejects — on TPU (compiled Mosaic) the check stays on.
     """
-    from jax import shard_map
     fn = {"ring": ring_attention, "ring_flash": ring_flash_attention,
           "ulysses": ulysses_attention}[impl]
     spec = P(None, seq_axis, None, None)
@@ -309,5 +312,5 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     sharded = shard_map(
         functools.partial(fn, axis_name=seq_axis, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=not interpreted_flash)
+        **_compat.shard_map_check_kwargs(not interpreted_flash))
     return sharded(q, k, v)

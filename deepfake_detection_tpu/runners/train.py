@@ -281,7 +281,9 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         dtype=_dtype(cfg.compute_dtype), sharding=sharding,
         distributed=jax.process_count() > 1,
         num_shards=jax.process_count(), shard_index=rank,
-        prefetch_depth=cfg.prefetch_depth)
+        prefetch_depth=cfg.prefetch_depth,
+        loader_backend=cfg.loader_backend, ring_depth=cfg.ring_depth,
+        worker_heartbeat=cfg.worker_heartbeat)
     collate_mixup = FastCollateMixup(cfg.mixup, cfg.smoothing,
                                      cfg.num_classes) if cfg.mixup > 0 \
         else None
@@ -400,6 +402,11 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
                     metric=eval_metrics[cfg.eval_metric])
     except KeyboardInterrupt:                      # reference :588
         pass
+    finally:
+        # shm-backend loaders own worker processes + a shared-memory
+        # segment; release them even on interrupt (thread backend: no-op)
+        train_loader.close()
+        eval_loader.close()
     wait_pending_saves()            # flush any in-flight recovery write
     if best_metric is not None:
         _logger.info("*** Best metric: %s (epoch %s)", best_metric,
@@ -408,13 +415,32 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
             **eval_metrics}
 
 
+def _looks_like_torch_checkpoint(path: str) -> bool:
+    """Lexical suffixes torch users actually ship (.pth/.pt/.tar/.bin and
+    compounds), plus a magic sniff for existing files: torch's zip format
+    starts 'PK\\x03\\x04', its legacy format is a protocol-2+ pickle
+    (0x80 0x02..0x05 — a flax msgpack stream can't start with that pair:
+    0x80 is the EMPTY fixmap).  Cheap, runs before mesh construction."""
+    if not path:
+        return False
+    if path.endswith((".pth", ".pth.tar", ".pt", ".tar", ".bin")):
+        return True
+    try:
+        with open(path, "rb") as f:
+            magic = f.read(4)
+    except OSError:
+        return False
+    return magic[:4] == b"PK\x03\x04" or (
+        len(magic) >= 2 and magic[0] == 0x80 and 2 <= magic[1] <= 5)
+
+
 def launch_main(argv=None) -> Dict[str, float]:
     """CLI entry (reference launch_main, train.py:769-816)."""
     setup_default_logging()
     cfg = TrainConfig.from_args(argv)
-    if cfg.initial_checkpoint.endswith((".pth", ".pth.tar", ".pt")):
-        # purely lexical precondition: fail before mesh construction and
-        # the (relay-expensive) jitted init, not minutes into main()
+    if _looks_like_torch_checkpoint(cfg.initial_checkpoint):
+        # fail before mesh construction and the (relay-expensive) jitted
+        # init, not minutes into main() with a cryptic msgpack error
         raise ValueError(
             f"--initial-checkpoint {cfg.initial_checkpoint} is a torch "
             "checkpoint; convert it first: python "

@@ -184,6 +184,20 @@ def load_checkpoint_file(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     return sd, meta
 
 
+def _meta_json_default(v: Any):
+    """json.dumps fallback for checkpoint meta: numpy scalars and arrays
+    convert to their Python equivalents; anything else fails fast with a
+    TypeError instead of being silently stringified (a str(ndarray) meta
+    value survives the save but is garbage at restore time)."""
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    raise TypeError(
+        f"checkpoint meta value of type {type(v).__name__} is not "
+        "JSON-serializable; convert it to int/float/str/list before save")
+
+
 def save_sharded_checkpoint(path: str, state: Any,
                             meta: Optional[Dict[str, Any]] = None) -> None:
     """Collective SHARDED save (Orbax/TensorStore): every process calls
@@ -218,11 +232,11 @@ def save_sharded_checkpoint(path: str, state: Any,
             if isinstance(x, jax.Array)
             and not isinstance(x.sharding, NamedSharding) else x, sd)
     # serialize meta BEFORE the expensive collective save so a
-    # non-serializable value fails fast (numpy scalars — accepted by the
-    # msgpack path's meta — are converted, not rejected)
+    # non-serializable value fails fast (numpy scalars/arrays — accepted
+    # by the msgpack path's meta — are converted; anything else raises
+    # here rather than round-tripping as a useless str() on restore)
     meta_blob = json.dumps(stamp_qkv_layout(meta, sd),
-                           default=lambda v: v.item()
-                           if isinstance(v, np.generic) else str(v))
+                           default=_meta_json_default)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, sd, force=True)
         ckptr.wait_until_finished()
@@ -238,6 +252,22 @@ def save_sharded_checkpoint(path: str, state: Any,
         # marker exists (a save-then-restore flow would read meta={})
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("dfd_sharded_save_meta")
+
+
+def _partial_restore_kwargs(ocp, partial: bool) -> Dict[str, Any]:
+    """PyTreeRestore kwargs for restoring a SUBSET of the saved tree.
+
+    Current orbax spells it ``partial_restore=True``; the legacy idiom is
+    ``transforms={}`` (restore exactly the item structure, drop extra
+    checkpoint keys).  Detected by signature so both orbax generations
+    work."""
+    if not partial:
+        return {}
+    import inspect
+    params = inspect.signature(ocp.args.PyTreeRestore.__init__).parameters
+    if "partial_restore" in params:
+        return {"partial_restore": True}
+    return {"transforms": {}}
 
 
 def _fresh_opt_sd(sd: Dict[str, Any], target_state: Any) -> Dict[str, Any]:
@@ -310,7 +340,7 @@ def restore_sharded_checkpoint(path: str, target_state: Any,
         # optimizer state, no wasted shard reads
         sd = dict(ckptr.restore(path, args=ocp.args.PyTreeRestore(
             item=template, restore_args=restore_args,
-            partial_restore=not load_opt)))
+            **_partial_restore_kwargs(ocp, not load_opt))))
     sd = {k: jax.tree.map(uncommit, target_sd[k], v) for k, v in sd.items()}
     for k in nones:
         sd[k] = None
@@ -370,12 +400,14 @@ def load_sharded_for_eval(path: str, variables: Dict[str, Any],
     with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
         # key presence is not enough: an EMA-less TrainState serializes
         # ema=None, which still appears in the tree metadata
-        ema_md = (ckptr.metadata(path).item_metadata or {}).get("ema")
+        md = ckptr.metadata(path)
+        ema_md = (getattr(md, "item_metadata", md) or {}).get("ema")
         has_ema = use_ema and isinstance(ema_md, dict) and "params" in ema_md
         item = {"ema": tmpl} if has_ema else tmpl
         restore_args = ocp.checkpoint_utils.construct_restore_args(item)
         out = ckptr.restore(path, args=ocp.args.PyTreeRestore(
-            item=item, restore_args=restore_args, partial_restore=True))
+            item=item, restore_args=restore_args,
+            **_partial_restore_kwargs(ocp, True)))
     out = dict(out["ema"] if has_ema else out)
     if has_ema:
         _logger.info("Loaded EMA stream from %s", path)

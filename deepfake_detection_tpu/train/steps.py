@@ -117,9 +117,11 @@ def make_train_step(model, tx: optax.GradientTransformation,
         carry0 = (batch_stats, g0, z, z)
         if vary_axis is not None:
             # inside shard_map the microbatch outputs are device-varying;
-            # the scan carry type must match from step 0
+            # the scan carry type must match from step 0 (a no-op on
+            # pre-0.6 jax, which has no varying-manual-axes type system)
+            from ..parallel._compat import pcast_varying
             carry0 = jax.tree.map(
-                lambda v: lax.pcast(v, vary_axis, to="varying"), carry0)
+                lambda v: pcast_varying(v, vary_axis), carry0)
         (new_stats, gsum, lsum, psum_), _ = jax.lax.scan(
             micro, carry0, (xm, ym, jnp.arange(grad_accum)))
         inv = 1.0 / grad_accum
@@ -147,7 +149,7 @@ def make_train_step(model, tx: optax.GradientTransformation,
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 
     # ---- local-BN shard_map over the data axis -------------------------
-    from jax import shard_map
+    from ..parallel._compat import shard_map
 
     def local_step(state: TrainState, x, y, rng):
         rng = jax.random.fold_in(rng, lax.axis_index(axis))

@@ -91,3 +91,14 @@ class TestVerifiedRowStore:
         assert loaded == builtin | {"m1", "m2"}
         payload = json.load(open(bench._TPU_ROWS_PATH))
         assert "note" in payload and len(payload["rows"]) == len(loaded)
+
+
+def test_retry_budget_left(bench):
+    """Watchdog retry gating (ISSUE 1 satellite): a transient-fault retry
+    is skipped once less than the floor remains of the GLOBAL
+    BENCH_RUN_TIMEOUT budget — no fixed 60 s grant past exhaustion."""
+    assert bench._retry_budget_left(2400.0, 100.0)
+    assert bench._retry_budget_left(2400.0, 2340.0)       # exactly the floor
+    assert not bench._retry_budget_left(2400.0, 2341.0)
+    assert not bench._retry_budget_left(120.0, 119.0)
+    assert bench._retry_budget_left(120.0, 100.0, floor=10.0)

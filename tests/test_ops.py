@@ -80,6 +80,43 @@ def test_max_pool2d_torch_matches_torch():
 
 
 @pytest.mark.smoke
+def test_max_pool2d_torch_ceil_mode_output_count():
+    """ceil_mode output size equals torch's documented formula for EVERY
+    geometry, including stride > kernel where the computed end pad goes
+    negative and the old max(0, ...) clamp could only pray the floor
+    formula agreed (ISSUE 1 satellite; values checked against a literal
+    window-walk oracle, so no torch needed)."""
+    def torch_out(dim, k, s, p):
+        out = -((dim + 2 * p - k) // -s) + 1
+        if (out - 1) * s >= dim + p:
+            out -= 1
+        return out
+
+    def oracle(x, k, s, p):
+        B, H, W, C = x.shape
+        Ho, Wo = torch_out(H, k, s, p), torch_out(W, k, s, p)
+        out = np.empty((B, Ho, Wo, C), np.float32)
+        for i in range(Ho):
+            for j in range(Wo):
+                hs, ws = i * s - p, j * s - p
+                out[:, i, j] = x[:, max(hs, 0):min(hs + k, H),
+                                 max(ws, 0):min(ws + k, W), :].max((1, 2))
+        return out
+
+    rng = np.random.default_rng(0)
+    for n in (5, 6, 7, 9, 10, 13):
+        x = rng.normal(size=(1, n, n, 2)).astype(np.float32)
+        for k, s in ((2, 3), (2, 4), (3, 5), (3, 2), (2, 2)):
+            for p in range(k // 2 + 1):          # torch requires p <= k/2
+                got = np.asarray(ops.max_pool2d_torch(
+                    jnp.asarray(x), (k, k), (s, s), padding=p,
+                    ceil_mode=True))
+                want = oracle(x, k, s, p)
+                assert got.shape == want.shape, (n, k, s, p)
+                np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.smoke
 def test_avg_pool2d_torch_matches_torch():
     """avg_pool2d_torch == torch AvgPool2d(3, s, 1) (res2net/dla pools),
     both count_include_pad settings, even + odd sizes."""

@@ -53,6 +53,23 @@ def _tiny_state(mesh, fsdp=False):
     return model, state, step, tx
 
 
+@pytest.mark.smoke
+def test_meta_json_default_converts_numpy_rejects_unknown():
+    """Sharded-save meta serialization (ISSUE 1 satellite): numpy arrays
+    become lists, numpy scalars become Python scalars, and any other
+    unknown type raises instead of round-tripping as a garbage str()."""
+    import json
+
+    from deepfake_detection_tpu.train.checkpoint import _meta_json_default
+
+    blob = json.dumps(
+        {"arr": np.arange(3), "f": np.float32(0.5), "i": np.int64(7)},
+        default=_meta_json_default)
+    assert json.loads(blob) == {"arr": [0, 1, 2], "f": 0.5, "i": 7}
+    with pytest.raises(TypeError, match="not\\s+JSON-serializable"):
+        json.dumps({"bad": object()}, default=_meta_json_default)
+
+
 class TestShardedCheckpoint:
     def test_fsdp_roundtrip_preserves_values_and_shardings(
             self, tmp_path, devices):

@@ -33,6 +33,21 @@ def test_build_and_measure(tmp_path):
         os.environ.pop("DFD_NO_NATIVE_DECODE", None)
 
 
+def test_measure_shm_backend(tmp_path):
+    """--backend shm drives the multi-process ring loader through the same
+    harness (and tears its workers/segment down afterwards)."""
+    root = str(tmp_path / "clips")
+    os.makedirs(root)
+    bench_input.build_dataset(root, n_clips=4, size=48, frames=4)
+    args = SimpleNamespace(clips=4, size=32, frames=4, batch=2, workers=2,
+                           epochs=1)
+    try:
+        cps = bench_input.measure(root, args, native=True, backend="shm")
+        assert cps > 0
+    finally:
+        os.environ.pop("DFD_NO_NATIVE_DECODE", None)
+
+
 def test_gil_pause_methodology():
     """tools/bench_gil.py: the PyDLL control must read as GIL-held and the
     production CDLL decode as GIL-free — the measured basis for

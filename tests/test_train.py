@@ -287,6 +287,32 @@ class TestCodeReviewRegressions:
         b = jax.tree.leaves(ve["params"])[0]
         assert a.shape == b.shape
 
+    @pytest.mark.smoke
+    def test_torch_checkpoint_guard_suffixes_and_magic(self, tmp_path):
+        """--initial-checkpoint torch-file detection (ISSUE 1 satellite):
+        .tar/.bin suffixes and on-disk magic (zip 'PK', legacy pickle) get
+        the convert-first hint; msgpack suffixes and content do not."""
+        from deepfake_detection_tpu.runners.train import \
+            _looks_like_torch_checkpoint as is_torch
+
+        for name in ("w.pth", "w.pth.tar", "w.pt", "w.tar", "w.bin"):
+            assert is_torch(name), name
+        assert not is_torch("")
+        assert not is_torch("w.msgpack")          # missing file, clean suffix
+        zipped = tmp_path / "model.ckpt"
+        zipped.write_bytes(b"PK\x03\x04" + b"\0" * 8)
+        assert is_torch(str(zipped))
+        legacy = tmp_path / "legacy.ckpt"
+        legacy.write_bytes(b"\x80\x02}q\x00")     # pickle protocol 2
+        assert is_torch(str(legacy))
+        msgpack = tmp_path / "real.ckpt"
+        msgpack.write_bytes(b"\x82\xa5state\xc0")  # 2-entry msgpack map
+        assert not is_torch(str(msgpack))
+        from deepfake_detection_tpu.runners.train import launch_main
+        with pytest.raises(ValueError, match="convert it first"):
+            launch_main(["--dataset", "synthetic",
+                         "--initial-checkpoint", str(zipped)])
+
     def test_saver_none_metric(self, tmp_path):
         _, state, _ = _tiny_setup()
         saver = CheckpointSaver(checkpoint_dir=str(tmp_path / "o"),

@@ -11,19 +11,26 @@ Usage::
 
     python tools/bench_input.py [--clips 64] [--size 600] [--frames 4]
                                 [--batch 8] [--workers 4] [--epochs 2]
+                                [--backend thread|shm|all]
+                                [--scaling 1,2,4]
 
-Prints clips/s and frames/s for (native, PIL) so the decode-pool gain on
-the current host is measurable (on 1-core CI containers expect parity; the
-pool's win is GIL-free scaling across real cores).
+Prints clips/s, frames/s, and achieved GB/s (decoded output bytes staged
+for the device).  ``--backend`` selects the host-loader backend(s): the
+in-process thread pool or the multi-process shared-memory ring
+(``data/shm_ring.py``).  ``--scaling`` runs the thread-vs-shm matrix over
+the given worker counts — the measured (not extrapolated) basis for
+INPUT_BENCH.md's scaling table.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import tempfile
 import time
+from types import SimpleNamespace
 
 import numpy as np
 
@@ -54,13 +61,15 @@ def build_dataset(root: str, n_clips: int, size: int, frames: int,
             fh.write("\n".join(lst) + "\n")
 
 
-def measure(root: str, args, native: bool, fast: bool = True) -> float:
+def measure(root: str, args, native: bool, fast: bool = True,
+            backend: str = "thread") -> float:
     """clips/s through the host pipeline.
 
     ``fast`` = the production split (fused native geometric warp; color
     jitter/flicker live in the device prologue, so the host skips them);
     ``fast=False`` = the reference-exact chain (sequential PIL geometric
-    ops + host PIL jitter)."""
+    ops + host PIL jitter).  ``backend`` picks the host loader: 'thread'
+    (in-process pool) or 'shm' (worker processes + shared-memory ring)."""
     os.environ.pop("DFD_NO_NATIVE_DECODE", None)
     if not native:
         os.environ["DFD_NO_NATIVE_DECODE"] = "1"
@@ -77,19 +86,114 @@ def measure(root: str, args, native: bool, fast: bool = True) -> float:
         rotate_range=5, blur_radiu=1, blur_prob=0.05,
         flicker=0.0 if fast else 0.05, fused_geom=fast))
     sampler = ShardedTrainSampler(len(ds), batch_size=args.batch, seed=0)
-    loader = HostLoader(ds, sampler, batch_size=args.batch,
-                        num_workers=args.workers, seed=0)
-    # warmup epoch primes file cache + pool
-    for _ in loader:
-        pass
-    t0 = time.perf_counter()
-    n = 0
-    for e in range(args.epochs):
-        loader.set_epoch(e)
-        for batch in loader:
-            n += batch[0].shape[0]
-    dt = time.perf_counter() - t0
+    if backend == "shm":
+        from deepfake_detection_tpu.data.shm_ring import ShmRingLoader
+        loader = ShmRingLoader(ds, sampler, batch_size=args.batch,
+                               num_workers=args.workers, seed=0)
+    else:
+        loader = HostLoader(ds, sampler, batch_size=args.batch,
+                            num_workers=args.workers, seed=0)
+    try:
+        # warmup epoch primes file cache + pool (and, for shm, amortizes
+        # worker spawn/import out of the measured window)
+        for _ in loader:
+            pass
+        t0 = time.perf_counter()
+        n = 0
+        for e in range(args.epochs):
+            loader.set_epoch(e)
+            for batch in loader:
+                n += batch[0].shape[0]
+        dt = time.perf_counter() - t0
+    finally:
+        if hasattr(loader, "close"):
+            loader.close()
     return n / dt
+
+
+def _gbps(cps: float, args) -> float:
+    """Achieved device-staging rate: decoded uint8 clip bytes per second."""
+    return cps * args.frames * args.size * args.size * 3 / 1e9
+
+
+def _burn() -> None:  # pragma: no cover - busy-loop child
+    while True:
+        pass
+
+
+class competing_load:
+    """Context manager: N busy-loop processes during measurement.
+
+    ``--load N`` models the production condition the idle-container bench
+    misses: the input pipeline never owns the host — the train process's
+    XLA host threads, transfer engines, and logging all compete for the
+    same cores.  Preemption hits the two backends asymmetrically: a
+    preempted thread holding the GIL stalls EVERY thread in the pool (GIL
+    convoy), while shm worker processes just share cores fairly.
+    """
+
+    def __init__(self, n: int):
+        self.n = n
+        self.procs = []
+
+    def __enter__(self):
+        import multiprocessing as mp
+        ctx = mp.get_context("spawn")
+        for _ in range(self.n):
+            p = ctx.Process(target=_burn, daemon=True)
+            p.start()
+            self.procs.append(p)
+        return self
+
+    def __exit__(self, *exc):
+        for p in self.procs:
+            p.terminate()
+        for p in self.procs:
+            p.join(timeout=2.0)
+        return False
+
+
+def _emit(args, row: dict) -> None:
+    if args.json:
+        with open(args.json, "a") as fh:
+            fh.write(json.dumps(row) + "\n")
+
+
+def run_scaling(root: str, args, workers_list) -> list:
+    """thread-vs-shm matrix over worker counts (fast/native pipeline).
+
+    The two backends measure back-to-back per worker count so slow drift
+    on shared hosts cancels out of the ratio.  Returns the rows; prints a
+    markdown-ready table so the numbers can be pasted into INPUT_BENCH.md
+    as measured — not extrapolated — scaling."""
+    load = int(getattr(args, "load", 0) or 0)
+    chain = getattr(args, "chain", "fast") or "fast"
+    fast = chain == "fast"
+    rows = []
+    print(f"| workers | thread clips/s | shm clips/s | shm/thread | "
+          f"shm GB/s |   [load={load} chain={chain}]")
+    print("|---|---|---|---|---|")
+    with competing_load(load):
+        for w in workers_list:
+            sub = SimpleNamespace(**{**vars(args), "workers": w})
+            res = {}
+            for backend in ("thread", "shm"):
+                cps = measure(root, sub, native=fast, fast=fast,
+                              backend=backend)
+                res[backend] = cps
+                row = {"kind": "scaling", "backend": backend, "workers": w,
+                       "chain": chain, "clips_per_s": round(cps, 2),
+                       "frames_per_s": round(cps * args.frames, 2),
+                       "gbps": round(_gbps(cps, args), 3),
+                       "crop_size": args.size, "frames": args.frames,
+                       "batch": args.batch, "competing_load": load,
+                       "host_cpus": os.cpu_count()}
+                rows.append(row)
+                _emit(args, row)
+            print(f"| {w} | {res['thread']:.2f} | {res['shm']:.2f} "
+                  f"| {res['shm'] / max(res['thread'], 1e-9):.2f}x "
+                  f"| {_gbps(res['shm'], args):.3f} |")
+    return rows
 
 
 def main() -> None:
@@ -103,6 +207,20 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--workers", type=int, default=4)
     ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--backend", default="thread",
+                    choices=("thread", "shm", "all"),
+                    help="host-loader backend(s) to measure")
+    ap.add_argument("--scaling", default="",
+                    help="comma list of worker counts: run the thread-vs-"
+                         "shm scaling matrix instead of the mode sweep")
+    ap.add_argument("--load", type=int, default=0,
+                    help="run N busy-loop processes during measurement "
+                         "(models the trainer competing for host cores)")
+    ap.add_argument("--chain", default="fast",
+                    choices=("fast", "reference"),
+                    help="--scaling pipeline: 'fast' = production split "
+                         "(native warp + device jitter), 'reference' = "
+                         "reference-exact PIL chain (the GIL-bound case)")
     ap.add_argument("--keep", default="", help="reuse/keep dataset dir")
     ap.add_argument("--json", default="",
                     help="append one JSON result line per impl to this file")
@@ -115,25 +233,32 @@ def main() -> None:
               f"...", file=sys.stderr)
         build_dataset(root, args.clips, src, args.frames)
 
+    if args.scaling:
+        run_scaling(root, args,
+                    [int(w) for w in args.scaling.split(",") if w])
+        return
+
+    backends = ("thread", "shm") if args.backend == "all" \
+        else (args.backend,)
     # DFD_NO_NATIVE_DECODE disables the whole native library, i.e. BOTH the
     # decode pool and the fused warp fall back to PIL — label accordingly
     modes = [("fast/native", True, True), ("fast/no-native", False, True),
              ("reference-exact", False, False)]
-    for label, native, fast in modes:
-        cps = measure(root, args, native, fast)
-        print(f"{label:16s}: {cps:7.2f} clips/s  "
-              f"({cps * args.frames:8.2f} frames/s)  "
-              f"[{src}²→{args.size}²×{args.frames}f, "
-              f"{args.workers} workers]")
-        if args.json:
-            import json
-            row = {"mode": label, "clips_per_s": round(cps, 2),
-                   "frames_per_s": round(cps * args.frames, 2),
-                   "crop_size": args.size, "source_size": src,
-                   "frames": args.frames, "workers": args.workers,
-                   "host_cpus": os.cpu_count()}
-            with open(args.json, "a") as fh:
-                fh.write(json.dumps(row) + "\n")
+    for backend in backends:
+        for label, native, fast in modes:
+            cps = measure(root, args, native, fast, backend=backend)
+            print(f"{backend:6s}/{label:16s}: {cps:7.2f} clips/s  "
+                  f"({cps * args.frames:8.2f} frames/s, "
+                  f"{_gbps(cps, args):6.3f} GB/s)  "
+                  f"[{src}²→{args.size}²×{args.frames}f, "
+                  f"{args.workers} workers]")
+            _emit(args, {"mode": label, "backend": backend,
+                         "clips_per_s": round(cps, 2),
+                         "frames_per_s": round(cps * args.frames, 2),
+                         "gbps": round(_gbps(cps, args), 3),
+                         "crop_size": args.size, "source_size": src,
+                         "frames": args.frames, "workers": args.workers,
+                         "host_cpus": os.cpu_count()})
 
 
 if __name__ == "__main__":
