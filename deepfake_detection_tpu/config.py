@@ -98,6 +98,65 @@ def _tuple_of_ints(s) -> Optional[Tuple[int, ...]]:
     return tuple(int(x) for x in str(s).split(","))
 
 
+# ---------------------------------------------------------------------------
+# Shared dataclass→CLI machinery (TrainConfig + ServeConfig): one flag per
+# field (dashes), bools as store_true, and the reference's two-stage parse
+# semantics — a ``-c`` YAML file resets defaults, CLI flags override it.
+# ---------------------------------------------------------------------------
+
+def _convert_field(field_, v):
+    """Coerce a CLI string to the field's annotated type (defaults of
+    ``None`` carry no type, so the annotation is authoritative)."""
+    ann = str(field_.type)
+    default = field_.default
+    if isinstance(default, bool) or ann == "bool":
+        return bool(v)
+    if not isinstance(v, str):
+        return v
+    if "Tuple[float" in ann:
+        return tuple(float(x) for x in v.split(","))
+    if "Tuple[int" in ann:
+        return _tuple_of_ints(v)
+    if "Tuple[str" in ann:
+        return tuple(x for x in v.split(",") if x)
+    if "float" in ann or isinstance(default, float):
+        return float(v)
+    if "int" in ann or (isinstance(default, int)
+                        and not isinstance(default, bool)):
+        return int(v)
+    return v
+
+
+def _dataclass_parser(cls, description: str) -> argparse.ArgumentParser:
+    """Argparse surface generated from a config dataclass."""
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("-c", "--config", default="", metavar="FILE",
+                   help="YAML config; its values reset defaults, CLI "
+                        "overrides")
+    for f_ in fields(cls):
+        flag = "--" + f_.name.replace("_", "-")
+        if f_.type == "bool" or isinstance(f_.default, bool):
+            p.add_argument(flag, action="store_true", default=None,
+                           dest=f_.name)
+            continue
+        p.add_argument(flag, default=None, dest=f_.name)
+    return p
+
+
+def _two_stage_parse(cls, argv: Optional[Sequence[str]],
+                     parser: argparse.ArgumentParser):
+    """YAML resets defaults, CLI overrides (train.py:238-249)."""
+    ns, _ = parser.parse_known_args(argv)
+    base = cls.from_yaml(ns.config) if ns.config else cls()
+    out = dataclasses.asdict(base)
+    hints = {f_.name: f_ for f_ in fields(cls)}
+    for k, v in vars(ns).items():
+        if k == "config" or v is None or k not in hints:
+            continue
+        out[k] = _convert_field(hints[k], v)
+    return cls.from_dict(out)
+
+
 @dataclass
 class TrainConfig:
     # --- data ---
@@ -321,52 +380,120 @@ class TrainConfig:
     @classmethod
     def argument_parser(cls) -> argparse.ArgumentParser:
         """Argparse surface generated from the dataclass (flag-name parity)."""
-        p = argparse.ArgumentParser(description="TPU deepfake-detection training")
-        p.add_argument("-c", "--config", default="", metavar="FILE",
-                       help="YAML config; its values reset defaults, CLI overrides")
-        for f_ in fields(cls):
-            flag = "--" + f_.name.replace("_", "-")
-            if f_.type == "bool" or isinstance(f_.default, bool):
-                p.add_argument(flag, action="store_true", default=None,
-                               dest=f_.name)
-                continue
-            p.add_argument(flag, default=None, dest=f_.name)
+        p = _dataclass_parser(cls, "TPU deepfake-detection training")
         p.add_argument("-b", dest="batch_size", default=None)
         return p
 
     @classmethod
     def from_args(cls, argv: Optional[Sequence[str]] = None) -> "TrainConfig":
         """Two-stage parse: YAML resets defaults, CLI overrides (train.py:238-249)."""
-        parser = cls.argument_parser()
-        ns, _ = parser.parse_known_args(argv)
-        base = cls.from_yaml(ns.config) if ns.config else cls()
-        out = dataclasses.asdict(base)
-        hints = {f_.name: f_ for f_ in fields(cls)}
-        for k, v in vars(ns).items():
-            if k == "config" or v is None or k not in hints:
-                continue
-            out[k] = cls._convert(hints[k], v)
-        return cls.from_dict(out)
+        return _two_stage_parse(cls, argv, cls.argument_parser())
 
-    @staticmethod
-    def _convert(field_, v):
-        """Coerce a CLI string to the field's annotated type (defaults of
-        ``None`` carry no type, so the annotation is authoritative)."""
-        ann = str(field_.type)
-        default = field_.default
-        if isinstance(default, bool) or ann == "bool":
-            return bool(v)
-        if not isinstance(v, str):
-            return v
-        if "Tuple[float" in ann:
-            return tuple(float(x) for x in v.split(","))
-        if "Tuple[int" in ann:
-            return _tuple_of_ints(v)
-        if "Tuple[str" in ann:
-            return tuple(x for x in v.split(",") if x)
-        if "float" in ann or isinstance(default, float):
-            return float(v)
-        if "int" in ann or (isinstance(default, int)
-                            and not isinstance(default, bool)):
-            return int(v)
-        return v
+
+# ---------------------------------------------------------------------------
+# Serving config (runners/serve.py)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ServeConfig:
+    """Knob surface of the dynamic-batching inference server.
+
+    Same conventions as :class:`TrainConfig`: every field is a
+    ``--dashed-flag``, a YAML ``-c`` file resets defaults, CLI overrides.
+    The batch **buckets** are the compile cache: every entry is AOT-warmed
+    at startup and every device call pads to one of them — a request mix
+    can never trigger a mid-traffic recompile.
+    """
+    # --- network ---
+    host: str = "127.0.0.1"
+    port: int = 8377
+
+    # --- model (mirrors runners/test.py) ---
+    model: str = "efficientnet_deepfake_v4"
+    model_path: str = ""                 # msgpack file or sharded ckpt dir;
+    # empty serves a seed-0 random init (bench/demo, like test.py)
+    use_ema: bool = False                # prefer the EMA stream on load
+    image_size: int = 600                # canvas side (params.py flagship 600)
+    img_num: int = 4                     # frame replication => in_chans 3*num
+    num_classes: int = 2
+
+    # host→device wire format: 'float32' ships the fully CLI-preprocessed
+    # tensor (server scores == runners/test.py bit-for-bit); 'uint8' ships
+    # the uint8 canvas and normalizes/replicates inside the batched device
+    # call (4·img_num× less transfer; ulp-level drift vs the CLI)
+    wire: str = "float32"
+
+    # --- micro-batching / compile cache ---
+    buckets: Tuple[int, ...] = (1, 4, 16, 64)
+    batch_deadline_ms: float = 5.0       # partial-batch flush window
+    max_queue: int = 128                 # load-shed (429) past this depth
+    request_timeout_ms: float = 2000.0   # per-request deadline (504)
+
+    # --- hot weight reload ---
+    reload_dir: str = ""                 # "" disables the watcher
+    reload_interval_s: float = 5.0
+
+    # --- observability ---
+    throughput_window_s: float = 30.0
+
+    # --- CPU-host tuning ---
+    # Cap XLA's CPU backend to one eigen thread.  Small models gain
+    # nothing from intra-op threading (measured: vit-tiny b16 23 ms both
+    # ways on this class of host) and the freed cores go to request
+    # decode/preprocess — worth 2× served throughput on a 2-core box.
+    # Leave off for large models, where intra-op threads do pay.
+    single_thread_xla: bool = False
+
+    # ------------------------------------------------------------------
+    def __post_init__(self):
+        if isinstance(self.buckets, str):
+            self.buckets = _tuple_of_ints(self.buckets)
+        self.buckets = tuple(sorted(set(int(b) for b in self.buckets)))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"--buckets must be positive ints, got "
+                             f"{self.buckets}")
+        if self.batch_deadline_ms < 0:
+            raise ValueError("--batch-deadline-ms must be >= 0")
+        if self.max_queue < self.buckets[-1]:
+            raise ValueError(
+                f"--max-queue ({self.max_queue}) below the largest bucket "
+                f"({self.buckets[-1]}) could never fill a full batch")
+        if self.img_num < 1:
+            raise ValueError("--img-num must be >= 1")
+        if self.wire not in ("float32", "uint8"):
+            raise ValueError(f"--wire must be float32|uint8, "
+                             f"got {self.wire!r}")
+
+    @property
+    def max_batch_size(self) -> int:
+        return self.buckets[-1]
+
+    @property
+    def in_chans(self) -> int:
+        return 3 * self.img_num
+
+    # ------------------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ServeConfig":
+        known = {f_.name for f_ in fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ServeConfig":
+        with open(path) as f:
+            d = yaml.safe_load(f) if _HAS_YAML else json.load(f)
+        return cls.from_dict(d or {})
+
+    @classmethod
+    def argument_parser(cls) -> argparse.ArgumentParser:
+        return _dataclass_parser(
+            cls, "dynamic-batching deepfake-detection inference server")
+
+    @classmethod
+    def from_args(cls, argv: Optional[Sequence[str]] = None) -> "ServeConfig":
+        """Two-stage parse: YAML resets defaults, CLI overrides (the
+        TrainConfig.from_args semantics)."""
+        return _two_stage_parse(cls, argv, cls.argument_parser())

@@ -16,7 +16,8 @@ import numpy as np
 from PIL import Image
 
 __all__ = ["img_mean", "img_std", "image_max_height", "image_max_width",
-           "img_num", "resize", "padding_image", "make_score_fn"]
+           "img_num", "resize", "padding_image", "prepare_canvas",
+           "normalize_replicate", "make_score_fn"]
 
 img_mean = np.asarray([0.485, 0.456, 0.406], np.float32) * 255.0
 img_std = np.asarray([0.229, 0.224, 0.225], np.float32) * 255.0
@@ -55,13 +56,47 @@ def padding_image(image: np.ndarray, target_h: int = image_max_height,
                   "constant", constant_values=0)
 
 
+def prepare_canvas(image: np.ndarray, size: int = image_max_height
+                   ) -> np.ndarray:
+    """Geometric half of the inference preprocess: aspect-preserving downfit
+    + center pad to the ``size×size`` canvas, still uint8 HWC.
+
+    Split out of ``runners/test.py::preprocess`` so the serving engine can
+    ship this uint8 canvas over the wire and run the photometric half
+    (:func:`normalize_replicate`) inside the batched device call — same
+    uint8-wire idiom as ``data/loader.py``'s device prologue.
+    """
+    return padding_image(resize(image, (size, size)), size, size)
+
+
+def normalize_replicate(image: np.ndarray, num: int = img_num) -> np.ndarray:
+    """Photometric half: uint8 HWC → normalized float32, replicated ×num to
+    the model's ``3*num``-channel input (reference test.py:56-57).
+
+    Elementwise float32 ops only, so the jitted device-side version in
+    ``serving/engine.py`` is bit-identical to this host version.
+    """
+    image = (image.astype(np.float32) - img_mean) / img_std
+    if num > 1:
+        image = np.concatenate([image] * num, axis=-1)
+    return image
+
+
 def make_score_fn(model, variables):
     """Jitted ``image → softmax scores`` (the reference's ``DeepFakeModel``
-    nn wrapper, params.py:34-42); ``scores[:, 0]`` = P(fake)."""
+    nn wrapper, params.py:34-42); ``scores[:, 0]`` = P(fake).
+
+    ``variables`` ride the jitted call as an *argument*, not a closure
+    constant: closed-over weights would be embedded into the program as
+    constants (bloating compile memory and enabling constant-folding whose
+    rounding drifts ~1 ulp from the argument-passing form), and the
+    serving engine (serving/engine.py) compiles this exact
+    variables-as-argument program — so CLI and server scores agree
+    bit-for-bit."""
 
     @jax.jit
-    def score(x: jnp.ndarray) -> jnp.ndarray:
+    def score(variables, x: jnp.ndarray) -> jnp.ndarray:
         logits = model.apply(variables, x, training=False)
         return jax.nn.softmax(logits, axis=-1)
 
-    return score
+    return lambda x: score(variables, x)

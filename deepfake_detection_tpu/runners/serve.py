@@ -1,0 +1,138 @@
+"""Long-lived inference server entrypoint.
+
+Where ``runners/test.py`` is the reference's one-shot CLI (pay interpreter
+start + model build + XLA compile per invocation), this runner keeps one
+process alive: params resident on device, every batch bucket AOT-compiled
+before the first request, arrival-order traffic coalesced into those
+buckets, overload shed with 429, and weights hot-swappable from a watched
+checkpoint dir — the serving half of the ROADMAP's "heavy traffic" north
+star, chip-independent (runs on CPU JAX identically).
+
+Usage::
+
+    python -m deepfake_detection_tpu.runners.serve \
+        --model-path model.msgpack [--port 8377] [--buckets 1,4,16,64] \
+        [--batch-deadline-ms 5] [--max-queue 128] [--reload-dir ckpts/]
+
+    curl -s -X POST --data-binary @face.jpg -H 'Content-Type: image/jpeg' \
+        http://127.0.0.1:8377/score
+
+Scores are exactly ``runners/test.py``'s: same model build, same
+checkpoint load paths, same preprocess split host/device
+(tests/test_serving.py pins server == CLI bit-for-bit).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+import threading
+from typing import Optional, Sequence
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["build_server", "main"]
+
+
+def _load_variables(model, cfg):
+    """Checkpoint load, mirroring ``runners/test.py::test_img``."""
+    import jax
+
+    from ..models import init_model
+    from ..models.helpers import load_checkpoint
+
+    variables = init_model(model, jax.random.PRNGKey(0),
+                           (1, cfg.image_size, cfg.image_size, cfg.in_chans))
+    if cfg.model_path and os.path.isdir(cfg.model_path):
+        from ..train.checkpoint import load_sharded_for_eval
+        variables = load_sharded_for_eval(cfg.model_path, variables)
+    elif cfg.model_path:
+        variables = load_checkpoint(variables, cfg.model_path,
+                                    use_ema=cfg.use_ema, strict=False)
+    else:
+        _logger.warning("no --model-path: serving a seed-0 random init "
+                        "(bench/demo mode)")
+    return variables
+
+
+def build_server(cfg):
+    """Wire model → engine → batcher → HTTP server; returns the (not yet
+    started) :class:`ServingServer` with engine/batcher attached."""
+    from ..models import create_model
+    from ..serving.batcher import MicroBatcher
+    from ..serving.engine import InferenceEngine
+    from ..serving.http import make_server
+    from ..serving.metrics import ServingMetrics
+
+    _logger.info("building %s (in_chans=%d, canvas %d²)", cfg.model,
+                 cfg.in_chans, cfg.image_size)
+    model = create_model(cfg.model, num_classes=cfg.num_classes,
+                         in_chans=cfg.in_chans)
+    variables = _load_variables(model, cfg)
+    metrics = ServingMetrics(throughput_window_s=cfg.throughput_window_s)
+    _logger.info("AOT-warming buckets %s ...", list(cfg.buckets))
+    engine = InferenceEngine(
+        model, variables, image_size=cfg.image_size, img_num=cfg.img_num,
+        buckets=cfg.buckets, metrics=metrics, wire=cfg.wire)
+    batcher = MicroBatcher(max_batch=cfg.max_batch_size,
+                           deadline_ms=cfg.batch_deadline_ms,
+                           max_queue=cfg.max_queue, metrics=metrics)
+    server = make_server(cfg.host, cfg.port, engine, batcher, metrics,
+                         request_timeout_s=cfg.request_timeout_ms / 1000.0)
+    if cfg.reload_dir:
+        engine.start_reload_watcher(cfg.reload_dir,
+                                    interval_s=cfg.reload_interval_s,
+                                    use_ema=cfg.use_ema)
+        _logger.info("hot-reload watcher on %s (every %.1fs)",
+                     cfg.reload_dir, cfg.reload_interval_s)
+    return server
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    # many handler threads + the engine share few cores; the default 5 ms
+    # GIL switch interval convoys tail latency badly under load
+    sys.setswitchinterval(0.002)
+    from ..config import ServeConfig
+    cfg = ServeConfig.from_args(argv)
+    if cfg.single_thread_xla:
+        # must land before the first jax import (build_server's) initializes
+        # the backend; see ServeConfig.single_thread_xla
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_cpu_multi_thread_eigen" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_cpu_multi_thread_eigen=false").strip()
+    server = build_server(cfg)
+    server.engine.start(server.batcher)
+
+    stop = threading.Event()
+
+    def _sig(signum, frame):
+        _logger.info("signal %d: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    host, port = server.server_address[:2]
+    _logger.info("serving on http://%s:%d (POST /score, GET /healthz "
+                 "/readyz /metrics)", host, port)
+    t = threading.Thread(target=server.serve_forever,
+                         kwargs={"poll_interval": 0.1}, daemon=True)
+    t.start()
+    try:
+        while not stop.is_set():
+            stop.wait(0.5)
+    finally:
+        server.shutdown()
+        server.engine.stop()
+        server.batcher.close()
+        server.server_close()
+        _logger.info("bye")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

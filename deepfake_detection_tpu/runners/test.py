@@ -25,19 +25,20 @@ from PIL import Image
 
 from ..models import create_deepfake_model_v4, init_model
 from ..models.helpers import load_checkpoint
-from ..params import (image_max_height, image_max_width, img_mean, img_num,
-                      img_std, make_score_fn, padding_image, resize)
+from ..params import (image_max_height, img_num, make_score_fn,
+                      normalize_replicate, prepare_canvas)
 
 __all__ = ["test_img", "preprocess"]
 
 
-def preprocess(img_file: str, size: int = image_max_height) -> np.ndarray:
-    """file → (1, H, W, 12) normalized float32 (reference test.py:49-56)."""
+def preprocess(img_file, size: int = image_max_height,
+               num: int = img_num) -> np.ndarray:
+    """file (path or file-like) → (1, H, W, 3*num) normalized float32
+    (reference test.py:49-56).  The two halves live in ``params.py`` so the
+    serving subsystem (serving/engine.py) reuses them verbatim: geometric
+    canvas on host, photometrics replicated on device."""
     img = np.asarray(Image.open(img_file).convert("RGB"), np.uint8)
-    img = padding_image(resize(img, (size, size)), size, size)
-    img = (img.astype(np.float32) - img_mean) / img_std     # HWC, NHWC layout
-    img = np.concatenate([img] * img_num, axis=-1)          # replicate ×4
-    return img[None]
+    return normalize_replicate(prepare_canvas(img, size), num)[None]
 
 
 def test_img(model_path: Optional[str], img_files: Sequence[str],

@@ -254,10 +254,21 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
                 state)
             state, meta = restore_train_state(
                 cfg.resume, state, load_opt=not cfg.no_resume_opt)
-            if cfg.tp_size > 1:
-                state = jax.tree.map(
-                    lambda leaf, sh: jax.device_put(leaf, sh)
-                    if sh is not None else leaf, state, shard_tree)
+
+            # msgpack restore yields HOST numpy leaves; the compiled train
+            # step DONATES its state, and jax's CPU backend zero-copies
+            # suitably-aligned host buffers into jax arrays — donating such
+            # an alias frees memory numpy still owns, a use-after-free that
+            # surfaced as a native SIGSEGV/SIGABRT on the first resumed
+            # steps of a tp run.  Copy every restored host leaf into a
+            # device-OWNED array (re-applying the fresh state's sharding
+            # where it had one — restore must also re-lay-out for tp).
+            def _own(leaf, sh):
+                if isinstance(leaf, np.ndarray):
+                    leaf = jnp.array(leaf)        # device-owned copy
+                return jax.device_put(leaf, sh) if sh is not None else leaf
+
+            state = jax.tree.map(_own, state, shard_tree)
         start_epoch = cfg.start_epoch if cfg.start_epoch is not None \
             else int(meta.get("epoch", -1)) + 1   # helpers.py:47-73
         _logger.info("Resumed from %s (epoch %d)", cfg.resume, start_epoch)

@@ -1,0 +1,34 @@
+"""Dynamic-batching inference serving subsystem.
+
+The path from a trained checkpoint to a long-lived, concurrent, observable
+service: ``batcher`` coalesces arrival-order requests into pre-compiled
+batch buckets, ``engine`` owns the params on device (bucketed AOT compile
+cache, double-buffered staging, hot weight reload), ``http`` is the
+stdlib-only front end, ``metrics`` the Prometheus-text observability.
+Entry point: ``python -m deepfake_detection_tpu.runners.serve``.
+
+Lazy exports (same idiom as ``data/__init__``): importing the package
+itself stays cheap — submodules (and their jax import) load on first
+attribute access.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "MicroBatcher": "batcher", "Request": "batcher", "QueueFull": "batcher",
+    "DeadlineExceeded": "batcher", "pick_bucket": "batcher",
+    "InferenceEngine": "engine", "DEFAULT_BUCKETS": "engine",
+    "ServingMetrics": "metrics",
+    "ServingServer": "http", "make_server": "http",
+    "serve_forever_in_thread": "http",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

@@ -1,0 +1,229 @@
+"""Dynamic micro-batcher: coalesce concurrent requests into bucket-shaped
+device batches.
+
+The core serving problem with a jitted XLA model is that every novel batch
+shape is a fresh multi-second compile, while real traffic arrives one
+request at a time.  The batcher sits between the HTTP threads and the
+engine thread and turns arrival-order requests into batches that are
+
+* **coalesced**: up to ``max_batch`` requests, or whatever arrived within
+  ``deadline_ms`` of the first dequeued request — whichever happens first;
+* **bounded**: a queue deeper than ``max_queue`` load-sheds new submits
+  with :class:`QueueFull` (HTTP 429 + Retry-After) instead of growing an
+  unbounded backlog whose tail can never meet its deadline;
+* **deadline-aware**: requests that exceeded their per-request timeout
+  while queued are failed (HTTP 504) at dequeue time, never shipped to the
+  device.
+
+Bucket padding itself lives in the engine (`serving/engine.py`); the
+batcher only promises ``1 <= len(batch) <= max_batch``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from typing import Any, List, Optional, Sequence
+
+__all__ = ["Request", "QueueFull", "DeadlineExceeded", "MicroBatcher",
+           "pick_bucket"]
+
+
+class QueueFull(Exception):
+    """Raised by submit() when the queue is at max depth (load shedding)."""
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(f"queue full (depth {depth})")
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(Exception):
+    """The request spent longer than its deadline waiting for the device."""
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest pre-compiled bucket that fits ``n`` rows."""
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(f"batch of {n} exceeds the largest bucket "
+                     f"{max(buckets)}")
+
+
+class Request:
+    """One scoring request: a preprocessed uint8 canvas plus a one-shot
+    completion slot the HTTP thread blocks on.
+
+    A stripped-down future (stdlib ``concurrent.futures.Future`` drags in
+    condition-variable state we don't need): exactly one producer — the
+    engine — resolves it exactly once.
+    """
+
+    _ids = itertools.count()
+
+    __slots__ = ("id", "array", "enqueue_t", "deadline_t", "timings",
+                 "_event", "_result", "_error")
+
+    def __init__(self, array: Any, timeout_s: Optional[float] = None):
+        self.id = next(self._ids)
+        self.array = array
+        self.enqueue_t = time.monotonic()
+        self.deadline_t = (self.enqueue_t + timeout_s
+                           if timeout_s and timeout_s > 0 else None)
+        self.timings: dict = {}
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline_t is not None and \
+            (time.monotonic() if now is None else now) > self.deadline_t
+
+    def set_result(self, value: Any) -> None:
+        self._result = value
+        self._event.set()
+
+    def set_exception(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """Block until resolved; raises the producer's exception, or
+        :class:`DeadlineExceeded` if ``timeout`` elapses first."""
+        if not self._event.wait(timeout):
+            raise DeadlineExceeded(f"request {self.id}: no result within "
+                                   f"{timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class MicroBatcher:
+    """Bounded request queue with deadline-or-full coalescing.
+
+    ``submit()`` is called from many HTTP threads; ``next_batch()`` from
+    the single engine thread.  ``queue.Queue`` provides the blocking
+    semantics; depth accounting is explicit so load-shedding reads a
+    consistent value.
+    """
+
+    def __init__(self, max_batch: int = 64, deadline_ms: float = 5.0,
+                 max_queue: int = 128, metrics: Optional[Any] = None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = int(max_batch)
+        self.deadline_s = float(deadline_ms) / 1000.0
+        self.max_queue = int(max_queue)
+        self.metrics = metrics
+        self._q: "queue.Queue[Request]" = queue.Queue()
+        self._depth = 0
+        self._depth_lock = threading.Lock()
+        self._closed = threading.Event()
+
+    # ------------------------------------------------------------------
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def _track_depth(self, delta: int) -> int:
+        with self._depth_lock:
+            self._depth += delta
+            d = self._depth
+        if self.metrics is not None:
+            self.metrics.queue_depth = d
+        return d
+
+    # ------------------------------------------------------------------
+    def submit(self, array: Any,
+               timeout_s: Optional[float] = None) -> Request:
+        """Enqueue one preprocessed request; raises :class:`QueueFull` past
+        ``max_queue`` depth."""
+        if self._closed.is_set():
+            raise RuntimeError("batcher is closed")
+        with self._depth_lock:
+            if self._depth >= self.max_queue:
+                depth = self._depth
+                full = True
+            else:
+                self._depth += 1
+                depth = self._depth
+                full = False
+        if self.metrics is not None:
+            self.metrics.queue_depth = depth
+        if full:
+            if self.metrics is not None:
+                self.metrics.shed_total.inc()
+            # Retry-After estimate: drain time of the current backlog at
+            # one deadline-window per max_batch, floored at 1s (the
+            # HTTP-date alternative needs no clock sync this way)
+            retry = max(1.0, depth / self.max_batch * self.deadline_s)
+            raise QueueFull(depth, retry)
+        req = Request(array, timeout_s)
+        self._q.put(req)
+        return req
+
+    # ------------------------------------------------------------------
+    def take(self, timeout: Optional[float]) -> Optional[Request]:
+        """One queue pop; drops (fails) requests that expired while queued
+        and keeps popping within the same grant.  The engine uses this
+        directly to gather the next batch while the device is busy."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            try:
+                req = self._q.get(block=remaining is None or remaining > 0,
+                                  timeout=remaining)
+            except queue.Empty:
+                return None
+            self._track_depth(-1)
+            if req.expired():
+                req.timings["queue"] = time.monotonic() - req.enqueue_t
+                if self.metrics is not None:
+                    self.metrics.deadline_total.inc()
+                req.set_exception(DeadlineExceeded(
+                    f"request {req.id} expired after "
+                    f"{req.timings['queue'] * 1000:.0f} ms in queue"))
+                continue
+            return req
+
+    def next_batch(self, timeout: Optional[float] = 0.1) -> List[Request]:
+        """Dequeue the next batch.
+
+        Blocks up to ``timeout`` for the FIRST request (empty list on
+        timeout), then coalesces followers for up to ``deadline_ms`` —
+        measured from that first dequeue — returning early once
+        ``max_batch`` is reached.  (While a previous batch is still
+        executing, the engine instead gathers via :meth:`take` directly,
+        paced by the device rather than the clock — engine.py.)
+        """
+        first = self.take(timeout)
+        if first is None:
+            return []
+        batch = [first]
+        flush_at = time.monotonic() + self.deadline_s
+        while len(batch) < self.max_batch:
+            wait = flush_at - time.monotonic()
+            nxt = self.take(max(0.0, wait))
+            if nxt is None:       # flush window elapsed / queue drained
+                break
+            batch.append(nxt)
+        now = time.monotonic()
+        for r in batch:
+            r.timings["queue"] = now - r.enqueue_t
+        return batch
+
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        """Fail everything still queued (server shutdown)."""
+        self._closed.set()
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            self._track_depth(-1)
+            req.set_exception(RuntimeError("server shutting down"))

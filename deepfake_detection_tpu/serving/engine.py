@@ -1,0 +1,452 @@
+"""Inference engine: bucketed AOT compile cache, double-buffered staging,
+hot weight reload.
+
+Design (mirrors what ``data/loader.py`` does for training input):
+
+* **Bucketed compile cache** — the scoring function is AOT-compiled once
+  per batch bucket (default 1/4/16/64) at startup, *before* the server
+  reports ready.  Every device call thereafter hits a pre-compiled
+  executable: a partial batch pads up to the nearest bucket and the pad
+  rows are sliced off the result.  Because batch rows are independent in
+  eval mode (running-stat BN, per-row softmax), the real rows of a padded
+  bucket are bit-identical to an unpadded call (tests/test_serving.py).
+  Novel shapes cannot recompile silently — an unknown bucket is a hard
+  error, and ``compiles_total`` growing after ready=1 is the alarm.
+
+* **uint8 wire** — HTTP threads ship the geometric canvas
+  (``params.prepare_canvas``, uint8 HWC); normalize + ×img_num replication
+  run inside the compiled call (``params.normalize_replicate`` semantics,
+  elementwise float32, bit-identical to the CLI's host version).  Same
+  idiom as the training loader's device prologue: 4× less host→device
+  traffic and the photometrics get batched for free.
+
+* **Double-buffered staging** — while batch k executes, the engine drains
+  already-queued requests into batch k+1 and dispatches it (JAX async
+  dispatch) before blocking on k's result: transfer/stage of k+1 overlaps
+  device compute of k, exactly like ``DeviceLoader.__iter__``.
+
+* **Hot weight reload** — params ride the compiled call as an *argument*
+  (not a closure constant), so swapping them is aval-compatible and free
+  of recompiles.  A watcher thread polls a checkpoint dir; a new file is
+  loaded host-side through ``models/helpers.py`` and swapped in atomically
+  between batches.  Shape-incompatible checkpoints are rejected, counted,
+  and the old weights keep serving.
+
+* **Crash recovery** — an exception anywhere in the serve loop fails the
+  affected requests (HTTP 500) and restarts the loop; the worker thread
+  never dies with requests stranded.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..params import image_max_height, img_mean, img_num as _default_img_num, \
+    img_std
+from .batcher import MicroBatcher, Request, pick_bucket
+from .metrics import ServingMetrics
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["InferenceEngine", "DEFAULT_BUCKETS"]
+
+DEFAULT_BUCKETS = (1, 4, 16, 64)
+
+#: checkpoint filenames the reload watcher considers (others — .tmp
+#: renames in flight, logs — are ignored)
+_CKPT_SUFFIXES = (".msgpack", ".ckpt", ".flax", ".pkt")
+
+
+class _Staged:
+    __slots__ = ("requests", "out", "bucket", "dispatch_t")
+
+    def __init__(self, requests: List[Request], out: Any, bucket: int,
+                 dispatch_t: float):
+        self.requests = requests
+        self.out = out
+        self.bucket = bucket
+        self.dispatch_t = dispatch_t
+
+
+class InferenceEngine:
+    def __init__(self, model, variables, *,
+                 image_size: int = image_max_height,
+                 img_num: int = _default_img_num,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 metrics: Optional[ServingMetrics] = None,
+                 wire: str = "float32",
+                 warmup: bool = True):
+        self.model = model
+        self.image_size = int(image_size)
+        self.img_num = int(img_num)
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"invalid buckets {buckets}")
+        if wire not in ("float32", "uint8"):
+            raise ValueError(f"wire must be float32|uint8, got {wire!r}")
+        self.wire = wire
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        # real-compile observer: a silent recompile anywhere in the process
+        # shows up in /metrics as backend_compiles_total growth (the
+        # engine's own counter below only counts its AOT bucket builds)
+        from .metrics import install_backend_compile_listener
+        install_backend_compile_listener()
+        # host-side template for non-strict reload merging; the device copy
+        # is what executes
+        self._host_template = jax.tree.map(np.asarray, variables)
+        self._variables = jax.device_put(variables)
+        self._var_shapes = jax.tree.map(
+            lambda a: (tuple(np.shape(a)), np.asarray(a).dtype),
+            self._host_template)
+        self._compiled: Dict[int, Any] = {}
+        self._pending: Optional[_Staged] = None
+        self._reload_box: List[Tuple[Any, str]] = []   # [(host_tree, path)]
+        self._reload_lock = threading.Lock()
+        self._last_reload_key: Optional[Tuple[str, float, int]] = None
+        self.reload_count = 0
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        self._watcher: Optional[threading.Thread] = None
+
+        # Wire formats:
+        #
+        # * ``float32`` (default) — HTTP threads run the FULL CLI
+        #   preprocess (``params.normalize_replicate`` incl. ×img_num
+        #   replication) and ship normalized float32; the compiled program
+        #   is exactly the CLI's score fn, so server scores reproduce
+        #   ``runners/test.py`` bit-for-bit (tested).
+        # * ``uint8`` — HTTP threads ship the uint8 canvas and normalize +
+        #   replicate run inside the batched device call (the training
+        #   loader's device-prologue idiom): 4·img_num× less host→device
+        #   traffic — the deployment mode for real accelerators.  Mean/std
+        #   ride the call as ARGUMENTS (a constant divisor would be
+        #   strength-reduced to multiply-by-reciprocal, ~1 ulp off host
+        #   division), but cross-program fusion still allows ulp-level
+        #   drift vs the CLI, so this mode is "allclose", not bit-equal.
+        self._mean = jax.device_put(jnp.asarray(img_mean))
+        self._std = jax.device_put(jnp.asarray(img_std))
+        n_rep = self.img_num
+
+        if self.wire == "uint8":
+            def _score(variables, x_u8, mean, std):
+                x = (x_u8.astype(jnp.float32) - mean) / std
+                if n_rep > 1:
+                    x = jnp.tile(x, (1, 1, 1, n_rep))
+                logits = self.model.apply(variables, x, training=False)
+                return jax.nn.softmax(logits, axis=-1)
+        else:
+            def _score(variables, x):
+                logits = self.model.apply(variables, x, training=False)
+                return jax.nn.softmax(logits, axis=-1)
+
+        self._score = _score
+        if warmup:
+            self.warmup()
+
+    @property
+    def _wire_spec(self) -> Tuple[int, Any]:
+        """(channels, dtype) of one wire-format sample."""
+        if self.wire == "uint8":
+            return 3, np.uint8
+        return 3 * self.img_num, np.float32
+
+    def _run(self, bucket: int, variables, x):
+        if self.wire == "uint8":
+            return self._compiled[bucket](variables, x, self._mean,
+                                          self._std)
+        return self._compiled[bucket](variables, x)
+
+    # ------------------------------------------------------------------
+    # compile cache
+    # ------------------------------------------------------------------
+    @property
+    def compile_count(self) -> int:
+        return self.metrics.compiles_total.value
+
+    @property
+    def ready(self) -> bool:
+        return self.metrics.ready
+
+    def warmup(self) -> None:
+        """AOT-compile every bucket and execute each once (primes any
+        first-run allocation paths), then flip ready."""
+        s = self.image_size
+        chans, dtype = self._wire_spec
+        for b in self.buckets:
+            if b in self._compiled:
+                continue
+            t0 = time.monotonic()
+            x_spec = jax.ShapeDtypeStruct((b, s, s, chans),
+                                          jnp.dtype(dtype))
+            if self.wire == "uint8":
+                lowered = jax.jit(self._score).lower(
+                    self._variables, x_spec, self._mean, self._std)
+            else:
+                lowered = jax.jit(self._score).lower(self._variables,
+                                                     x_spec)
+            self._compiled[b] = lowered.compile()
+            self.metrics.compiles_total.inc()
+            out = self._run(b, self._variables,
+                            jnp.zeros((b, s, s, chans), dtype))
+            jax.block_until_ready(out)
+            _logger.info("bucket %d compiled + warmed in %.1fs", b,
+                         time.monotonic() - t0)
+        self.metrics.ready = True
+
+    # ------------------------------------------------------------------
+    # scoring
+    # ------------------------------------------------------------------
+    def _pad_batch(self, arrays: List[np.ndarray]) -> Tuple[np.ndarray, int]:
+        n = len(arrays)
+        bucket = pick_bucket(n, self.buckets)
+        s = self.image_size
+        chans, dtype = self._wire_spec
+        # fresh buffer every batch: jax CPU device_put zero-copies aligned
+        # host memory, so reusing one buffer would race the still-executing
+        # previous batch (same hazard data/loader.py guards with
+        # block_until_ready)
+        buf = np.zeros((bucket, s, s, chans), dtype)
+        for i, a in enumerate(arrays):
+            buf[i] = a
+        return buf, bucket
+
+    def score_batch(self, arrays: List[np.ndarray]) -> np.ndarray:
+        """Synchronous scoring of up to max-bucket wire-format samples
+        (tests, warm checks); the serving path goes through
+        stage/complete instead."""
+        buf, bucket = self._pad_batch(arrays)
+        out = self._run(bucket, self._variables, jax.device_put(buf))
+        return np.asarray(out)[:len(arrays)]
+
+    def _stage(self, requests: List[Request]) -> _Staged:
+        buf, bucket = self._pad_batch([r.array for r in requests])
+        out = self._run(bucket, self._variables, jax.device_put(buf))
+        self.metrics.inflight += len(requests)
+        now = time.monotonic()
+        for r in requests:
+            r.timings["queue"] = now - r.enqueue_t
+        return _Staged(requests, out, bucket, now)
+
+    def _complete(self, staged: _Staged) -> None:
+        scores = np.asarray(staged.out)          # blocks on the device
+        now = time.monotonic()
+        device_dt = now - staged.dispatch_t
+        n = len(staged.requests)
+        m = self.metrics
+        m.inflight -= n
+        m.batches_total.inc()
+        m.batch_rows_total.inc(n)
+        m.padded_rows_total.inc(staged.bucket - n)
+        m.latency["device"].observe(device_dt)
+        m.count_completion(n, now)
+        for i, r in enumerate(staged.requests):
+            r.timings["device"] = device_dt
+            m.latency["queue"].observe(r.timings.get("queue", 0.0))
+            r.set_result(scores[i])
+
+    @staticmethod
+    def _fail(requests: List[Request], err: BaseException) -> None:
+        for r in requests:
+            if not r._event.is_set():
+                r.set_exception(err)
+
+    # ------------------------------------------------------------------
+    # serve loop
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _out_ready(out) -> bool:
+        try:
+            return bool(out.is_ready())
+        except AttributeError:        # pragma: no cover — very old jax
+            return True
+
+    def _loop_once(self, batcher: MicroBatcher) -> None:
+        self._maybe_apply_reload()
+        if self._pending is None:
+            # device idle: block for the first request, then coalesce
+            # within the deadline window
+            requests = batcher.next_batch(timeout=0.05)
+            if requests:
+                try:
+                    self._pending = self._stage(requests)
+                except Exception as e:             # noqa: BLE001
+                    self._fail(requests, e)        # poisoned batch: 500s
+                    raise                          # now, not at timeout
+            return
+        # Device busy on batch k: its execution time is FREE coalescing
+        # time — gather batch k+1 until k's result lands AND the deadline
+        # window has run, or the bucket fills (short-poll takes so
+        # is_ready is re-checked ~1ms), then a last non-blocking drain for
+        # stragglers already queued.  Honoring the deadline window here
+        # too matters under closed-loop load: responses fan out staggered,
+        # so the resends of batch k's clients arrive over several ms — a
+        # gather that stops the instant the device idles locks into a
+        # small-batch equilibrium (tiny batch → short exec → short gather
+        # → tiny batch again).
+        requests: List[Request] = []
+        out = self._pending.out
+        flush_at = time.monotonic() + batcher.deadline_s
+        while len(requests) < batcher.max_batch:
+            if self._out_ready(out) and time.monotonic() >= flush_at:
+                break
+            r = batcher.take(timeout=0.001)
+            if r is not None:
+                requests.append(r)
+        while len(requests) < batcher.max_batch:
+            r = batcher.take(timeout=0.0)
+            if r is None:
+                break
+            requests.append(r)
+        # dispatch k+1 (async) BEFORE blocking on k: transfer + compute of
+        # k+1 overlap k's completion — the DeviceLoader double buffer
+        staged = None
+        if requests:
+            try:
+                staged = self._stage(requests)
+            except Exception as e:                 # noqa: BLE001
+                self._fail(requests, e)
+                raise
+        pending, self._pending = self._pending, None
+        try:
+            self._complete(pending)
+        except Exception as e:                     # noqa: BLE001
+            self.metrics.inflight -= len(pending.requests)
+            self._fail(pending.requests, e)
+            raise
+        finally:
+            self._pending = staged
+
+    def serve_loop(self, batcher: MicroBatcher) -> None:
+        """Run until stop(); never lets an exception strand requests or
+        kill the worker."""
+        while not self._stop.is_set():
+            try:
+                self._loop_once(batcher)
+            except Exception:                      # noqa: BLE001
+                # _loop_once already failed the requests of whichever batch
+                # crashed; self._pending (if any) is a healthy dispatched
+                # batch the next iteration will complete — don't touch it
+                _logger.exception("engine worker crashed; recovering")
+                self.metrics.worker_restarts_total.inc()
+                time.sleep(0.01)     # a persistent fault must not spin-log
+
+    def start(self, batcher: MicroBatcher) -> None:
+        assert self._worker is None, "engine already started"
+        self._worker = threading.Thread(
+            target=self.serve_loop, args=(batcher,),
+            name="serving-engine", daemon=True)
+        self._worker.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
+            self._worker = None
+        if self._pending is not None:
+            self._fail(self._pending.requests,
+                       RuntimeError("server shutting down"))
+            self._pending = None
+
+    # ------------------------------------------------------------------
+    # hot weight reload
+    # ------------------------------------------------------------------
+    def submit_reload(self, host_tree: Any, source: str = "<api>") -> None:
+        """Queue a host-side variable tree for an atomic between-batch swap
+        (called by the watcher thread, or directly in tests)."""
+        with self._reload_lock:
+            self._reload_box = [(host_tree, source)]
+
+    def _maybe_apply_reload(self) -> None:
+        with self._reload_lock:
+            if not self._reload_box:
+                return
+            host_tree, source = self._reload_box.pop()
+        try:
+            shapes = jax.tree.map(
+                lambda a: (tuple(np.shape(a)), np.asarray(a).dtype),
+                host_tree)
+            if shapes != self._var_shapes:
+                raise ValueError("checkpoint tree/shape mismatch vs the "
+                                 "serving model")
+            new_vars = jax.device_put(host_tree)
+            # one throwaway execution proves aval compatibility with the
+            # compiled executables BEFORE the swap (a dtype drift would
+            # otherwise 500 every request after)
+            chans, dtype = self._wire_spec
+            probe = self._run(
+                self.buckets[0], new_vars,
+                jnp.zeros((self.buckets[0], self.image_size,
+                           self.image_size, chans), dtype))
+            jax.block_until_ready(probe)
+        except Exception:                          # noqa: BLE001
+            _logger.exception("hot reload from %s rejected", source)
+            self.metrics.reload_errors_total.inc()
+            return
+        self._variables = new_vars
+        self.reload_count += 1
+        self.metrics.reloads_total.inc()
+        _logger.info("hot-reloaded weights from %s (reload #%d)", source,
+                     self.reload_count)
+
+    # ------------------------------------------------------------------
+    def _newest_checkpoint(self, ckpt_dir: str
+                           ) -> Optional[Tuple[str, float, int]]:
+        try:
+            names = os.listdir(ckpt_dir)
+        except OSError:
+            return None
+        best = None
+        for name in names:
+            if not name.endswith(_CKPT_SUFFIXES):
+                continue
+            path = os.path.join(ckpt_dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            key = (path, st.st_mtime, st.st_size)
+            if best is None or key[1] > best[1]:
+                best = key
+        return best
+
+    def _watch_loop(self, ckpt_dir: str, interval_s: float,
+                    use_ema: bool) -> None:
+        from ..models.helpers import load_checkpoint
+        while not self._stop.wait(interval_s):
+            newest = self._newest_checkpoint(ckpt_dir)
+            if newest is None or newest == self._last_reload_key:
+                continue
+            path = newest[0]
+            try:
+                loaded = load_checkpoint(self._host_template, path,
+                                         use_ema=use_ema, strict=False)
+            except Exception:                      # noqa: BLE001
+                _logger.exception("reload watcher: cannot load %s", path)
+                self.metrics.reload_errors_total.inc()
+                self._last_reload_key = newest     # don't re-log every tick
+                continue
+            self._last_reload_key = newest
+            self.submit_reload(loaded, source=path)
+
+    def start_reload_watcher(self, ckpt_dir: str, interval_s: float = 5.0,
+                             use_ema: bool = False) -> None:
+        """Poll ``ckpt_dir`` for new ``models/helpers.py`` checkpoints and
+        hot-swap them in.  Writers must rename atomically into place (the
+        repo's ``save_model_checkpoint`` does)."""
+        assert self._watcher is None, "watcher already started"
+        # remember the current newest so only files appearing AFTER start
+        # trigger a reload (the serving checkpoint itself usually lives in
+        # the watched dir)
+        self._last_reload_key = self._newest_checkpoint(ckpt_dir)
+        self._watcher = threading.Thread(
+            target=self._watch_loop, args=(ckpt_dir, interval_s, use_ema),
+            name="serving-reload-watcher", daemon=True)
+        self._watcher.start()

@@ -1,0 +1,222 @@
+"""Stdlib HTTP front end: ``POST /score``, health/readiness, Prometheus
+metrics.
+
+``http.server.ThreadingHTTPServer`` — one thread per connection, HTTP/1.1
+keep-alive — is deliberately boring: request decode + preprocess are
+GIL-releasing (PIL), the real concurrency is the micro-batcher, and no new
+dependency enters the image.  The handler threads do the per-request CPU
+work (JPEG decode, resize to canvas) so it overlaps the engine thread's
+device calls.
+
+Endpoints:
+
+* ``POST /score`` — body is either raw image bytes (``Content-Type:
+  image/*`` or ``application/octet-stream``) or JSON
+  ``{"image_b64": "..."}``.  Responds ``{"fake_score": p, "scores":
+  [...], "timings_ms": {...}}``; 400 undecodable, 429 + ``Retry-After``
+  when load-shedding, 503 before warmup, 504 past the request deadline.
+* ``GET /healthz`` — process liveness (200 while the process serves).
+* ``GET /readyz`` — 200 only after every bucket is compiled+warmed.
+* ``GET /metrics`` — Prometheus text format (serving/metrics.py).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+import numpy as np
+from PIL import Image
+
+from ..params import normalize_replicate, prepare_canvas
+from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
+from .engine import InferenceEngine
+from .metrics import ServingMetrics
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["ServingServer", "make_server", "serve_forever_in_thread"]
+
+_MAX_BODY = 32 * 1024 * 1024            # 32 MiB: generous for one image
+
+
+class ServingServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer carrying the serving wiring."""
+
+    daemon_threads = True
+    # keep-alive matters: the load generator and any sane client reuse
+    # connections, and accept() is the single-threaded part of this server
+    protocol_version = "HTTP/1.1"
+
+    def __init__(self, addr: Tuple[str, int], engine: InferenceEngine,
+                 batcher: MicroBatcher, metrics: ServingMetrics,
+                 request_timeout_s: float = 2.0):
+        super().__init__(addr, _Handler)
+        self.engine = engine
+        self.batcher = batcher
+        self.metrics = metrics
+        self.request_timeout_s = float(request_timeout_s)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: ServingServer   # typing aid
+
+    # ------------------------------------------------------------------
+    def log_message(self, fmt, *args):            # BaseHTTP logs to stderr
+        _logger.debug("%s " + fmt, self.address_string(), *args)
+
+    def _respond(self, status: int, body: bytes,
+                 content_type: str = "application/json",
+                 extra_headers: Optional[dict] = None) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+        self.server.metrics.count_request(status)
+
+    def _respond_json(self, status: int, obj: dict,
+                      extra_headers: Optional[dict] = None) -> None:
+        self._respond(status, json.dumps(obj).encode(),
+                      extra_headers=extra_headers)
+
+    # ------------------------------------------------------------------
+    def do_GET(self) -> None:                     # noqa: N802 (stdlib API)
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            self._respond(200, b"ok\n", "text/plain")
+        elif path == "/readyz":
+            if self.server.engine.ready:
+                self._respond(200, b"ready\n", "text/plain")
+            else:
+                self._respond(503, b"warming up\n", "text/plain")
+        elif path == "/metrics":
+            text = self.server.metrics.render_prometheus()
+            self._respond(200, text.encode(),
+                          "text/plain; version=0.0.4; charset=utf-8")
+        else:
+            self._respond_json(404, {"error": f"no route {path!r}"})
+
+    # ------------------------------------------------------------------
+    def _read_body(self) -> Optional[bytes]:
+        """Drain the request body (None = unreadable/oversize, connection
+        will be closed).
+
+        MUST run before any response on a POST: the connections are
+        HTTP/1.1 keep-alive, so an unread body would be parsed as the
+        next request line by the same socket's next round trip."""
+        if self.headers.get("Transfer-Encoding"):
+            # chunked bodies are unsupported and of unknown length —
+            # poison the connection instead of the stream
+            self.close_connection = True
+            return None
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = -1
+        if not 0 <= length <= _MAX_BODY:
+            # can't safely drain (unknown/huge length): poison the
+            # connection instead of the stream
+            self.close_connection = True
+            return None
+        return self.rfile.read(length)
+
+    @staticmethod
+    def _decode_image(body: bytes, ctype: str) -> Optional[np.ndarray]:
+        """Body bytes → uint8 RGB array, or None if undecodable."""
+        if ctype == "application/json":
+            try:
+                payload = json.loads(body)
+                b64 = payload.get("image_b64") or payload.get("image")
+                body = base64.b64decode(b64, validate=True)
+            except (ValueError, TypeError, KeyError, AttributeError):
+                return None        # AttributeError: valid non-dict JSON
+        try:
+            img = Image.open(io.BytesIO(body))
+            return np.asarray(img.convert("RGB"), np.uint8)
+        except Exception:                          # noqa: BLE001 — 400 path
+            return None
+
+    def do_POST(self) -> None:                    # noqa: N802 (stdlib API)
+        t0 = time.monotonic()
+        body = self._read_body()        # always drain before responding
+        t_body = time.monotonic()       # preprocess stage must not bill a
+        path = self.path.split("?", 1)[0]       # slow client's socket time
+        if path != "/score":
+            self._respond_json(404, {"error": f"no route {path!r}"})
+            return
+        srv = self.server
+        if not srv.engine.ready:
+            self._respond_json(503, {"error": "model warming up"},
+                               extra_headers={"Retry-After": 1})
+            return
+        ctype = (self.headers.get("Content-Type") or "") \
+            .split(";")[0].strip()
+        img = self._decode_image(body, ctype) if body else None
+        if img is None:
+            self._respond_json(400, {"error": "undecodable image payload"})
+            return
+        payload = prepare_canvas(img, srv.engine.image_size)
+        if srv.engine.wire == "float32":
+            # full CLI preprocess on the handler thread (bit-exact parity
+            # mode); the uint8 wire defers this to the device prologue
+            payload = normalize_replicate(payload, srv.engine.img_num)
+        t_pre = time.monotonic() - t_body     # decode+canvas only
+        srv.metrics.latency["preprocess"].observe(t_pre)
+        try:
+            req = srv.batcher.submit(payload,
+                                     timeout_s=srv.request_timeout_s)
+        except QueueFull as e:
+            self._respond_json(
+                429, {"error": "overloaded, retry later",
+                      "queue_depth": e.depth},
+                extra_headers={"Retry-After":
+                               max(1, int(round(e.retry_after_s)))})
+            return
+        try:
+            # the batcher/engine enforce the queue-side deadline; the extra
+            # 5s here only catches a wedged engine so the HTTP thread can
+            # never hang forever
+            scores = req.result(timeout=srv.request_timeout_s + 5.0)
+        except DeadlineExceeded:
+            self._respond_json(504, {"error": "deadline exceeded"})
+            return
+        except Exception as e:                     # noqa: BLE001
+            self._respond_json(500, {"error": f"scoring failed: {e!r}"})
+            return
+        total = time.monotonic() - t0
+        srv.metrics.latency["total"].observe(total)
+        self._respond_json(200, {
+            "fake_score": float(scores[0]),
+            "scores": [float(s) for s in scores],
+            "timings_ms": {
+                "preprocess": round(t_pre * 1000, 3),
+                "queue": round(req.timings.get("queue", 0.0) * 1000, 3),
+                "device": round(req.timings.get("device", 0.0) * 1000, 3),
+                "total": round(total * 1000, 3),
+            },
+        })
+
+
+def make_server(host: str, port: int, engine: InferenceEngine,
+                batcher: MicroBatcher, metrics: ServingMetrics,
+                request_timeout_s: float = 2.0) -> ServingServer:
+    return ServingServer((host, port), engine, batcher, metrics,
+                         request_timeout_s)
+
+
+def serve_forever_in_thread(server: ServingServer) -> threading.Thread:
+    t = threading.Thread(target=server.serve_forever,
+                         kwargs={"poll_interval": 0.1},
+                         name="serving-http", daemon=True)
+    t.start()
+    return t

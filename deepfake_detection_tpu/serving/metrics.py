@@ -1,0 +1,220 @@
+"""Serving observability: per-stage latency histograms, counters, rolling
+throughput, and a Prometheus text-format renderer.
+
+Built on :class:`deepfake_detection_tpu.utils.metrics.LatencyHistogram` —
+the host-side sibling of the train loop's ``AverageMeter``.  Everything is
+stdlib: no prometheus_client dependency, just the text exposition format
+(https://prometheus.io/docs/instrumenting/exposition_formats/), which is
+what ``GET /metrics`` serves.
+
+Stages mirror a request's life: ``queue`` (submit → batch dispatch),
+``preprocess`` (decode+resize on the HTTP thread), ``device`` (padded
+bucket executes), ``total`` (socket in → response out).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Deque, Dict, List, Tuple
+
+from ..utils.metrics import LatencyHistogram
+
+__all__ = ["ServingMetrics"]
+
+_PREFIX = "dfd_serving"
+
+# ---------------------------------------------------------------------------
+# Process-wide backend-compile observer.  The engine's own compiles_total
+# counts its AOT bucket builds, but only a signal from INSIDE jax can
+# catch a silent recompile some other code path triggers — this listener
+# increments on every real backend compile in the process, and the bench's
+# zero-recompile probe asserts the DELTA across the load phase is zero.
+# ---------------------------------------------------------------------------
+
+_backend_compiles = 0
+_backend_lock = threading.Lock()
+_listener_installed = False
+
+
+def _on_event_duration(name: str, *_args, **_kw) -> None:
+    if name == "/jax/core/compile/backend_compile_duration":
+        global _backend_compiles
+        with _backend_lock:
+            _backend_compiles += 1
+
+
+def install_backend_compile_listener() -> bool:
+    """Idempotent; returns True if the jax monitoring hook is available."""
+    global _listener_installed
+    if _listener_installed:
+        return True
+    try:
+        from jax._src import monitoring
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+    except Exception:                              # noqa: BLE001 — optional
+        return False
+    _listener_installed = True
+    return True
+
+
+def backend_compile_count() -> int:
+    """Backend compiles observed process-wide since the listener went in
+    (0 until then)."""
+    with _backend_lock:
+        return _backend_compiles
+
+#: serving latencies cluster well under the train-loop default bounds —
+#: extend down to 100 µs so queue-wait under light load still resolves
+_BOUNDS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+           0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+STAGES = ("queue", "preprocess", "device", "total")
+
+
+class _Counter:
+    """Monotonic counter; int ops under the GIL are atomic enough, the lock
+    is for the read-modify-write of labeled maps."""
+
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class ServingMetrics:
+    """One registry per server process."""
+
+    def __init__(self, throughput_window_s: float = 30.0):
+        self.latency: Dict[str, LatencyHistogram] = {
+            s: LatencyHistogram(_BOUNDS) for s in STAGES}
+        self.requests_total: Dict[str, _Counter] = {}   # keyed by status
+        self._requests_lock = threading.Lock()
+        self.shed_total = _Counter()
+        self.deadline_total = _Counter()
+        self.batches_total = _Counter()
+        self.batch_rows_total = _Counter()
+        self.padded_rows_total = _Counter()
+        self.compiles_total = _Counter()
+        self.reloads_total = _Counter()
+        self.reload_errors_total = _Counter()
+        self.worker_restarts_total = _Counter()
+        self.queue_depth = 0            # gauge, written by the batcher
+        self.inflight = 0               # gauge, written by the engine
+        self.ready = False              # gauge, flipped after warmup
+        self._window_s = float(throughput_window_s)
+        self._completions: Deque[Tuple[float, int]] = collections.deque()
+        self._completions_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def count_request(self, status: int) -> None:
+        key = str(int(status))
+        with self._requests_lock:
+            c = self.requests_total.get(key)
+            if c is None:
+                c = self.requests_total[key] = _Counter()
+        c.inc()
+
+    def count_completion(self, n: int, now: float | None = None) -> None:
+        """Record ``n`` scored requests for the rolling-throughput gauge."""
+        now = time.monotonic() if now is None else now
+        with self._completions_lock:
+            self._completions.append((now, n))
+            self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        cutoff = now - self._window_s
+        while self._completions and self._completions[0][0] < cutoff:
+            self._completions.popleft()
+
+    def throughput(self, now: float | None = None) -> float:
+        """Scored requests/sec over the trailing window."""
+        now = time.monotonic() if now is None else now
+        with self._completions_lock:
+            self._trim(now)
+            if not self._completions:
+                return 0.0
+            total = sum(n for _, n in self._completions)
+            span = max(now - self._completions[0][0], 1e-9)
+            # a single just-landed batch would divide by ~0; floor the span
+            # at 1s so the gauge ramps instead of spiking
+            return total / max(span, 1.0)
+
+    # ------------------------------------------------------------------
+    def render_prometheus(self) -> str:
+        lines: List[str] = []
+
+        def counter(name: str, help_: str, value: int,
+                    labels: str = "") -> None:
+            lines.append(f"# HELP {_PREFIX}_{name} {help_}")
+            lines.append(f"# TYPE {_PREFIX}_{name} counter")
+            lines.append(f"{_PREFIX}_{name}{labels} {value}")
+
+        def gauge(name: str, help_: str, value) -> None:
+            lines.append(f"# HELP {_PREFIX}_{name} {help_}")
+            lines.append(f"# TYPE {_PREFIX}_{name} gauge")
+            lines.append(f"{_PREFIX}_{name} {value}")
+
+        lines.append(f"# HELP {_PREFIX}_requests_total Requests by HTTP "
+                     "status")
+        lines.append(f"# TYPE {_PREFIX}_requests_total counter")
+        with self._requests_lock:
+            items = sorted((k, c.value) for k, c in
+                           self.requests_total.items())
+        for status, value in items:
+            lines.append(
+                f'{_PREFIX}_requests_total{{status="{status}"}} {value}')
+        counter("shed_total", "Requests rejected 429 (queue full)",
+                self.shed_total.value)
+        counter("deadline_total", "Requests failed 504 (deadline exceeded)",
+                self.deadline_total.value)
+        counter("batches_total", "Device batches executed",
+                self.batches_total.value)
+        counter("batch_rows_total", "Real rows across executed batches",
+                self.batch_rows_total.value)
+        counter("padded_rows_total", "Padding rows across executed batches",
+                self.padded_rows_total.value)
+        counter("compiles_total", "Bucket executables built by the engine "
+                "(startup warmup only)", self.compiles_total.value)
+        counter("backend_compiles_total", "Real XLA backend compiles "
+                "observed process-wide (jax monitoring hook; growth after "
+                "ready=1 means something recompiled)",
+                backend_compile_count())
+        counter("reloads_total", "Successful hot weight reloads",
+                self.reloads_total.value)
+        counter("reload_errors_total", "Rejected/failed hot reloads",
+                self.reload_errors_total.value)
+        counter("worker_restarts_total", "Engine worker crash recoveries",
+                self.worker_restarts_total.value)
+        gauge("queue_depth", "Requests waiting in the micro-batch queue",
+              self.queue_depth)
+        gauge("inflight", "Requests staged on device", self.inflight)
+        gauge("ready", "1 once all buckets are warmed", int(self.ready))
+        gauge("throughput_rps",
+              f"Scored requests/sec, trailing {self._window_s:.0f}s window",
+              round(self.throughput(), 3))
+
+        for stage in STAGES:
+            h = self.latency[stage]
+            name = f"{_PREFIX}_latency_seconds"
+            lines.append(f"# HELP {name} Per-stage request latency")
+            lines.append(f"# TYPE {name} histogram")
+            # ONE snapshot per stage: buckets, sum and count must come
+            # from the same consistent view or the +Inf bucket can exceed
+            # _count within a single exposition (spec violation that
+            # breaks histogram_quantile exactly under load)
+            counts, s, c = h.snapshot()
+            acc = 0
+            for bound, n in zip(h.bounds, counts):
+                acc += n
+                lines.append(f'{name}_bucket{{stage="{stage}",'
+                             f'le="{bound!r}"}} {acc}')
+            lines.append(
+                f'{name}_bucket{{stage="{stage}",le="+Inf"}} {c}')
+            lines.append(f'{name}_sum{{stage="{stage}"}} {s}')
+            lines.append(f'{name}_count{{stage="{stage}"}} {c}')
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Dynamic-batching inference server (ISSUE 2; flag conventions mirror
+# scripts/test.sh: MODEL_PATH env overrides the checkpoint, extra flags
+# pass through).
+python -m deepfake_detection_tpu.runners.serve \
+    --model-path "${MODEL_PATH:-../models/model_best.ckpt}" "$@"

@@ -11,9 +11,13 @@ The matcher refuses partial conversions (every flax leaf must be covered,
 every torch tensor must match exactly one leaf), so these tests also pin
 the tree structures against the reference.
 
-inception_v3 is absent: the reference model itself wraps torchvision,
-which this image does not ship, so the torch side cannot be constructed
-(conversion for it is untestable here, not unsupported by design).
+inception_v3 (the 21st parametrization) is special: the reference model
+wraps torchvision, which this image does not ship, so the torch side
+cannot be constructed — instead a synthetic state dict matching
+torchvision's ``Inception3`` key/shape schema
+(tools/inception_v3_fixture.py) drives the converter, with full-coverage
++ exact-shape + layout-value + finite-forward checks in place of logit
+parity (ISSUE 2 satellite, VERDICT missing #5).
 """
 
 import os
@@ -28,16 +32,17 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
                                 "tools"))
 
-from dev_family_parity import FAMILIES, run_family  # noqa: E402
+from dev_family_parity import (FAMILIES, run_family,  # noqa: E402
+                               run_inception_v3_fixture)
 
 # one ctor per distinct mapping path; duplicates of an already-covered
 # rule set (gluon_resnet ≡ resnet, seresnext ≡ seresnet, …) are trimmed
 # to keep slow-tier time bounded
 _COVERED = [
     "resnet18", "resnet26d", "seresnet18", "densenet121", "dpn68",
-    "xception", "inception_v4", "inception_resnet_v2", "res2net50_26w_4s",
-    "dla34", "skresnet18", "selecsls42b", "hrnet_w18_small",
-    "gluon_xception65", "nasnetalarge", "pnasnet5large",
+    "xception", "inception_v3", "inception_v4", "inception_resnet_v2",
+    "res2net50_26w_4s", "dla34", "skresnet18", "selecsls42b",
+    "hrnet_w18_small", "gluon_xception65", "nasnetalarge", "pnasnet5large",
     "mobilenetv3_large_100", "mixnet_s", "efficientnet_cc_b0_4e",
     "tf_efficientnet_b0",
 ]
@@ -48,6 +53,10 @@ assert len(_CASES) == len(_COVERED)
 @pytest.mark.parametrize("mod,ctor,flax_name,size,atol", _CASES,
                          ids=[f[1] for f in _CASES])
 def test_family_conversion_parity(mod, ctor, flax_name, size, atol):
-    pytest.importorskip("torch")
-    line = run_family(mod, ctor, flax_name, size, atol)
+    if ctor == "inception_v3":
+        # torchvision-free fixture path (see module docstring)
+        line = run_inception_v3_fixture(size)
+    else:
+        pytest.importorskip("torch")
+        line = run_family(mod, ctor, flax_name, size, atol)
     assert line.startswith("OK"), line

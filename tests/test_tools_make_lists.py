@@ -1,0 +1,95 @@
+"""tools/make_lists.py against a tmpdir fixture tree (ISSUE 2 satellite)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+from PIL import Image
+
+pytestmark = pytest.mark.smoke
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+
+from make_lists import contiguous_count, main, scan_clips  # noqa: E402
+
+
+def _write_frames(clip_dir, indices, wh=16):
+    os.makedirs(clip_dir, exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in indices:
+        Image.fromarray(rng.integers(0, 255, (wh, wh, 3), dtype=np.uint8)
+                        ).save(os.path.join(clip_dir, f"{i}.jpg"))
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    root = tmp_path / "data"
+    _write_frames(str(root / "real" / "clip_a"), range(4))
+    _write_frames(str(root / "real" / "clip_b"), range(6))
+    # nested clip (DeeperForensics-style manipulation subdirs)
+    _write_frames(str(root / "fake" / "manip_x" / "clip_c"), range(4))
+    # short clip: 2 frames
+    _write_frames(str(root / "fake" / "clip_short"), range(2))
+    # gap: frames 0,1,3 — only 2 reachable
+    _write_frames(str(root / "fake" / "clip_gap"), [0, 1, 3])
+    # corrupt jpeg in an otherwise fine clip
+    _write_frames(str(root / "fake" / "clip_bad"), range(4))
+    with open(str(root / "fake" / "clip_bad" / "2.jpg"), "wb") as f:
+        f.write(b"\xff\xd8\xff\xe0 truncated garbage")
+    return str(root)
+
+
+def test_lists_written_in_v3_format(tree):
+    assert main([tree]) == 0
+    with open(os.path.join(tree, "real_list.txt")) as f:
+        real = dict(line.strip().split(":") for line in f)
+    assert real == {"clip_a": "4", "clip_b": "6"}
+    with open(os.path.join(tree, "fake_list.txt")) as f:
+        fake = dict(line.strip().split(":") for line in f)
+    assert fake[os.path.join("manip_x", "clip_c")] == "4"
+    assert fake["clip_short"] == "2"
+    assert fake["clip_gap"] == "2"         # dense prefix stops at the gap
+
+    # the dataset layer consumes these files directly
+    from deepfake_detection_tpu.data.dataset import read_clip_list
+    clips = read_clip_list(os.path.join(tree, "real_list.txt"))
+    assert [(c[0], c[1]) for c in clips] == [("clip_a", 4), ("clip_b", 6)]
+
+
+def test_validate_flags_all_three_problem_kinds(tree, capsys):
+    rc = main([tree, "--validate", "--strict"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "missing frame 2.jpg" in err            # clip_gap
+    assert "short clip" in err                     # clip_short (and gap)
+    assert "corrupt JPEG" in err                   # clip_bad/2.jpg
+    # non-strict validate reports but exits 0
+    assert main([tree, "--validate"]) == 0
+
+
+def test_out_dir_and_missing_class_dir(tmp_path, capsys):
+    root = tmp_path / "only_real"
+    _write_frames(str(root / "real" / "c"), range(4))
+    out = tmp_path / "lists"
+    os.makedirs(str(out))
+    assert main([str(root), "--out-dir", str(out)]) == 0
+    assert open(str(out / "real_list.txt")).read() == "c:4\n"
+    assert open(str(out / "fake_list.txt")).read() == ""
+
+
+def test_contiguous_count():
+    assert contiguous_count([0, 1, 2, 3]) == 4
+    assert contiguous_count([0, 1, 3]) == 2
+    assert contiguous_count([1, 2]) == 0
+    assert contiguous_count([]) == 0
+
+
+def test_scan_clips_ignores_non_frame_files(tmp_path):
+    clip = tmp_path / "real" / "c"
+    _write_frames(str(clip), range(3))
+    open(str(clip / "notes.txt"), "w").write("x")
+    open(str(clip / "frame_07.jpg"), "w").write("x")   # not <i>.jpg
+    clips = scan_clips(str(tmp_path / "real"))
+    assert clips == {"c": [0, 1, 2]}

@@ -85,10 +85,54 @@ def test_tp_train_step(mesh2d):
     assert "model" in str(k.sharding.spec)
 
 
+_CLI_DRIVER = """
+import json, os, sys
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+cache = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+if cache:
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+from deepfake_detection_tpu.runners.train import launch_main
+out = launch_main(sys.argv[1:])
+print("RESULT " + json.dumps({"best_metric": out["best_metric"]}))
+"""
+
+
+def _launch_cli(args):
+    """Run the train CLI end-to-end in a FRESH interpreter.
+
+    A fresh interpreter IS the artifact a CLI test should exercise — and
+    process isolation means a native crash in the runner (the class of
+    bug that donated-alias resume used to hit, see runners/train.py's
+    resume ``_own`` note) can at worst fail this one test instead of
+    killing the whole pytest process and every test after it."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)     # dark-relay guard (conftest)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_COMPILATION_CACHE_DIR"] = str(jax.config.jax_compilation_cache_dir or "")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run([sys.executable, "-c", _CLI_DRIVER, *args],
+                          cwd=repo, env=env, capture_output=True, text=True,
+                          timeout=600)
+    assert proc.returncode == 0, \
+        f"CLI run failed rc={proc.returncode}\n{proc.stdout[-2000:]}\n" \
+        f"{proc.stderr[-2000:]}"
+    import json
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("RESULT ")][-1]
+    return json.loads(line[len("RESULT "):])
+
+
 def test_tp_cli_e2e(tmp_path, devices):
     """--tp-size from the CLI: dp(2)xtp(4) synthetic smoke train."""
-    from deepfake_detection_tpu.runners.train import launch_main
-    out = launch_main([
+    out = _launch_cli([
         "--dataset", "synthetic", "--model", "vit_tiny_patch16_224",
         "--model-version", "", "--input-size-v2", "3,32,32",
         "--batch-size", "1", "--epochs", "1", "--opt", "adamw",
@@ -98,7 +142,7 @@ def test_tp_cli_e2e(tmp_path, devices):
     assert out["best_metric"] is not None
     # resume re-applies the TP layout (restore rebuilds host arrays)
     run = next((tmp_path / "out").iterdir())
-    out2 = launch_main([
+    out2 = _launch_cli([
         "--dataset", "synthetic", "--model", "vit_tiny_patch16_224",
         "--model-version", "", "--input-size-v2", "3,32,32",
         "--batch-size", "1", "--epochs", "2", "--opt", "adamw",

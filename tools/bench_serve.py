@@ -1,0 +1,557 @@
+"""Closed-loop load generator for the serving subsystem (ISSUE 2).
+
+Spawns ``runners/serve.py`` as a subprocess (or targets ``--url``), drives
+``POST /score`` with persistent keep-alive connections at several
+concurrency levels, and reports a latency/throughput table plus two
+baselines:
+
+* **warm sequential** — the ``runners/test.py`` scoring loop (same model,
+  same preprocess, batch-1 jit call per image) in a warmed process: the
+  best the one-shot CLI path can do when amortized;
+* **cold one-shot** — the same scoring of ONE image in a fresh
+  interpreter: what the status-quo CLI actually costs per invocation
+  (startup + model build + compile).
+
+Also probes ``/metrics`` around the load phases and **fails loudly if
+``compiles_total`` grew after warmup** — the bucketed compile cache's
+zero-recompile guarantee is part of the acceptance bar.
+
+Defaults are sized for a small-CPU box (the serving stack is
+chip-independent); on real accelerators pass the flagship config.
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python tools/bench_serve.py --out SERVE_BENCH.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import io
+import os
+import socket
+import statistics
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _log(msg: str) -> None:
+    print(f"[bench_serve] {msg}", file=sys.stderr, flush=True)
+
+
+def make_jpegs(n: int, src_size: int, seed: int = 0) -> List[bytes]:
+    """Synthetic photographic-ish JPEGs (random noise compresses terribly
+    and decodes unrealistically fast; smooth gradients + noise is closer)."""
+    from PIL import Image
+    out = []
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:src_size, 0:src_size].astype(np.float32)
+    for i in range(n):
+        base = (128 + 80 * np.sin(xx / (8 + i % 7) + i)
+                + 40 * np.cos(yy / (11 + i % 5)))
+        img = np.stack([base + rng.normal(0, 12, base.shape)
+                        for _ in range(3)], axis=-1)
+        img = np.clip(img, 0, 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, "JPEG", quality=88)
+        out.append(buf.getvalue())
+    return out
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# server lifecycle
+# ---------------------------------------------------------------------------
+
+def spawn_server(args) -> Tuple[subprocess.Popen, str]:
+    port = free_port()
+    cmd = [sys.executable, "-m", "deepfake_detection_tpu.runners.serve",
+           "--model", args.model, "--image-size", str(args.image_size),
+           "--img-num", str(args.img_num), "--port", str(port),
+           "--buckets", args.buckets,
+           "--batch-deadline-ms", str(args.deadline_ms),
+           "--max-queue", str(args.max_queue)]
+    if args.single_thread_xla:
+        cmd += ["--single-thread-xla"]
+    if args.wire:
+        cmd += ["--wire", args.wire]
+    if args.model_path:
+        cmd += ["--model-path", args.model_path]
+    env = dict(os.environ)
+    # the sitecustomize registers a (possibly dark) TPU relay whenever this
+    # var is set; the server child must not block on it unless asked
+    if not args.keep_env:
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+    _log("spawning: " + " ".join(cmd))
+    proc = subprocess.Popen(cmd, cwd=_REPO, env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    return proc, f"127.0.0.1:{port}"
+
+
+def wait_ready(netloc: str, timeout: float = 900.0) -> None:
+    host, port = netloc.split(":")
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        try:
+            conn = http.client.HTTPConnection(host, int(port), timeout=2)
+            conn.request("GET", "/readyz")
+            if conn.getresponse().status == 200:
+                _log(f"server ready after {time.monotonic() - t0:.1f}s")
+                return
+        except OSError:
+            pass
+        time.sleep(0.5)
+    raise TimeoutError(f"server at {netloc} not ready within {timeout}s")
+
+
+def scrape_metrics(netloc: str) -> Dict[str, float]:
+    host, port = netloc.split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=5)
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) == 2 and "{" not in parts[0]:
+            try:
+                out[parts[0]] = float(parts[1])
+            except ValueError:
+                pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# closed-loop load
+# ---------------------------------------------------------------------------
+
+class _Client(threading.Thread):
+    """Keep-alive closed-loop client on a raw socket with pre-serialized
+    requests — ``http.client``'s object churn would bill ~1 ms/req of this
+    2-core box's CPU to the load generator instead of the server."""
+
+    def __init__(self, netloc: str, jpegs: List[bytes], stop: threading.Event,
+                 measure_from: float, seed: int):
+        super().__init__(daemon=True)
+        host, port = netloc.split(":")
+        self.addr = (host, int(port))
+        self.stop_ev = stop
+        self.measure_from = measure_from
+        self.latencies: List[float] = []
+        self.statuses: Dict[int, int] = {}
+        # pre-serialize one request per source image
+        self.requests = []
+        for body in jpegs:
+            head = (f"POST /score HTTP/1.1\r\nHost: {host}\r\n"
+                    f"Content-Type: image/jpeg\r\n"
+                    f"Content-Length: {len(body)}\r\n\r\n").encode()
+            self.requests.append(head + body)
+        self.offset = int(np.random.default_rng(seed).integers(
+            0, len(self.requests)))
+
+    def _recv_response(self, sock_file) -> int:
+        """Minimal HTTP/1.1 response read: status + headers +
+        Content-Length body."""
+        status_line = sock_file.readline()
+        if not status_line:
+            raise OSError("connection closed")
+        status = int(status_line.split(b" ", 2)[1])
+        length = 0
+        while True:
+            line = sock_file.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if line.lower().startswith(b"content-length:"):
+                length = int(line.split(b":", 1)[1])
+        if length:
+            sock_file.read(length)
+        return status
+
+    def run(self) -> None:
+        sock = None
+        f = None
+        i = self.offset
+        while not self.stop_ev.is_set():
+            t0 = time.monotonic()
+            try:
+                if sock is None:
+                    sock = socket.create_connection(self.addr, timeout=30)
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                                    1)
+                    f = sock.makefile("rb")
+                sock.sendall(self.requests[i % len(self.requests)])
+                i += 1
+                status = self._recv_response(f)
+            except OSError:
+                if sock is not None:
+                    sock.close()
+                sock = None
+                status = -1
+            dt = time.monotonic() - t0
+            if t0 >= self.measure_from:
+                if status == 200:
+                    self.latencies.append(dt)
+                self.statuses[status] = self.statuses.get(status, 0) + 1
+            if status == 429:
+                time.sleep(0.05)
+        if sock is not None:
+            sock.close()
+
+
+def run_load(netloc: str, jpegs: List[bytes], concurrency: int,
+             duration: float, warmup: float) -> Dict[str, float]:
+    stop = threading.Event()
+    t_start = time.monotonic()
+    measure_from = t_start + warmup
+    clients = [_Client(netloc, jpegs, stop, measure_from, seed=c)
+               for c in range(concurrency)]
+    for c in clients:
+        c.start()
+    time.sleep(warmup + duration)
+    stop.set()
+    for c in clients:
+        c.join(timeout=10)
+    lats = sorted(l for c in clients for l in c.latencies)
+    statuses: Dict[int, int] = {}
+    for c in clients:
+        for s, n in c.statuses.items():
+            statuses[s] = statuses.get(s, 0) + n
+    n_ok = len(lats)
+    if n_ok == 0:
+        return {"rps": 0.0, "p50": float("nan"), "p95": float("nan"),
+                "p99": float("nan"), "statuses": statuses}
+
+    def pct(p: float) -> float:
+        return lats[min(n_ok - 1, int(p / 100.0 * n_ok))] * 1000.0
+
+    return {"rps": n_ok / duration, "p50": pct(50), "p95": pct(95),
+            "p99": pct(99), "mean": statistics.fmean(lats) * 1000.0,
+            "statuses": statuses}
+
+
+def engine_closed_loop(args, jpegs: List[bytes], concurrency: int,
+                       duration: float, warmup: float) -> Dict[str, float]:
+    """The serving subsystem WITHOUT the socket layer: threads preprocess
+    + submit + wait against an in-process batcher/engine.  Separates what
+    the micro-batcher + bucketed compile cache deliver from what this
+    box's python HTTP tax costs (the colocated load generator shares the
+    cores with the server, so the HTTP rows under-read on small hosts)."""
+    import jax
+
+    from deepfake_detection_tpu.models import create_model, init_model
+    from deepfake_detection_tpu.params import (normalize_replicate,
+                                               prepare_canvas)
+    from deepfake_detection_tpu.serving.batcher import MicroBatcher
+    from deepfake_detection_tpu.serving.engine import InferenceEngine
+    from deepfake_detection_tpu.serving.metrics import ServingMetrics
+    from PIL import Image
+
+    size = args.image_size
+    chans = 3 * args.img_num
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    model = create_model(args.model, num_classes=2, in_chans=chans)
+    variables = init_model(model, jax.random.PRNGKey(0),
+                           (1, size, size, chans))
+    metrics = ServingMetrics()
+    engine = InferenceEngine(model, variables, image_size=size,
+                             img_num=args.img_num, buckets=buckets,
+                             metrics=metrics, wire=args.wire)
+    batcher = MicroBatcher(max_batch=buckets[-1],
+                           deadline_ms=args.deadline_ms,
+                           max_queue=args.max_queue, metrics=metrics)
+    engine.start(batcher)
+    stop = threading.Event()
+    t_start = time.monotonic()
+    measure_from = t_start + warmup
+    lats_per: List[List[float]] = [[] for _ in range(concurrency)]
+
+    def client(ci: int) -> None:
+        i = ci
+        while not stop.is_set():
+            t0 = time.monotonic()
+            img = np.asarray(Image.open(io.BytesIO(
+                jpegs[i % len(jpegs)])).convert("RGB"), np.uint8)
+            i += 1
+            payload = prepare_canvas(img, size)
+            if args.wire == "float32":
+                payload = normalize_replicate(payload, args.img_num)
+            req = batcher.submit(payload, timeout_s=30)
+            req.result(timeout=30)
+            if t0 >= measure_from:
+                lats_per[ci].append(time.monotonic() - t0)
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(concurrency)]
+    for t in threads:
+        t.start()
+    time.sleep(warmup + duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    engine.stop()
+    batcher.close()
+    lats = sorted(l for per in lats_per for l in per)
+    n = len(lats)
+
+    def pct(p: float) -> float:
+        return lats[min(n - 1, int(p / 100.0 * n))] * 1000.0 if n else \
+            float("nan")
+
+    return {"rps": n / duration, "p50": pct(50), "p95": pct(95),
+            "p99": pct(99), "statuses": {200: n}}
+
+
+# ---------------------------------------------------------------------------
+# baselines
+# ---------------------------------------------------------------------------
+
+def warm_sequential_baseline(args, jpegs: List[bytes],
+                             n_images: int = 64) -> float:
+    """runners/test.py scoring semantics in a warmed process: preprocess +
+    batch-1 jitted score per image, one at a time."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepfake_detection_tpu.models import create_model, init_model
+    from deepfake_detection_tpu.params import make_score_fn
+    from deepfake_detection_tpu.runners.test import preprocess
+
+    size = args.image_size
+    chans = 3 * args.img_num
+    model = create_model(args.model, num_classes=2, in_chans=chans)
+    variables = init_model(model, jax.random.PRNGKey(0),
+                           (1, size, size, chans))
+    score_fn = make_score_fn(model, variables)
+    for d in jpegs[:2]:          # compile + warm
+        np.asarray(score_fn(jnp.asarray(
+            preprocess(io.BytesIO(d), size, num=args.img_num))))
+    t0 = time.monotonic()
+    for i in range(n_images):
+        d = jpegs[i % len(jpegs)]
+        np.asarray(score_fn(jnp.asarray(
+            preprocess(io.BytesIO(d), size, num=args.img_num))))
+    return n_images / (time.monotonic() - t0)
+
+
+_COLD_SNIPPET = r"""
+import io, sys, time
+t0 = time.monotonic()
+import numpy as np, jax, jax.numpy as jnp
+from deepfake_detection_tpu.models import create_model, init_model
+from deepfake_detection_tpu.params import make_score_fn
+from deepfake_detection_tpu.runners.test import preprocess
+model_name, size, num = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+with open(sys.argv[4], "rb") as f:
+    data = f.read()
+model = create_model(model_name, num_classes=2, in_chans=3 * num)
+variables = init_model(model, jax.random.PRNGKey(0),
+                       (1, size, size, 3 * num))
+score_fn = make_score_fn(model, variables)
+np.asarray(score_fn(jnp.asarray(preprocess(io.BytesIO(data), size,
+                                           num=num))))
+print(time.monotonic() - t0)
+"""
+
+
+def cold_oneshot_baseline(args, jpeg: bytes) -> Optional[float]:
+    """Wall seconds for one image through a FRESH interpreter (the one-shot
+    CLI reality): startup + build + compile + score.  Runs with a cleared
+    XLA compile cache dir so it measures the true cold path."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        img = os.path.join(td, "img.jpg")
+        with open(img, "wb") as f:
+            f.write(jpeg)
+        env = dict(os.environ)
+        if not args.keep_env:
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(td, "cache")
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _COLD_SNIPPET, args.model,
+                 str(args.image_size), str(args.img_num), img],
+                cwd=_REPO, env=env, capture_output=True, text=True,
+                timeout=1800, check=True)
+            return float(out.stdout.strip().splitlines()[-1])
+        except (subprocess.SubprocessError, ValueError) as e:
+            _log(f"cold baseline failed: {e!r}")
+            return None
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--model", default="vit_tiny_patch16_224",
+                    help="registered model name (default sized for a "
+                         "small-CPU box)")
+    ap.add_argument("--model-path", default="")
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--img-num", type=int, default=1)
+    ap.add_argument("--buckets", default="1,4,16,64")
+    ap.add_argument("--deadline-ms", type=float, default=4.0)
+    ap.add_argument("--max-queue", type=int, default=128)
+    ap.add_argument("--concurrency", default="1,4,16")
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--warmup", type=float, default=2.0)
+    ap.add_argument("--src-size", type=int, default=256,
+                    help="synthetic source image side before server resize")
+    ap.add_argument("--single-thread-xla", action="store_true",
+                    help="serve with XLA capped to one CPU thread (pays "
+                         "off for small models: decode gets the cores)")
+    ap.add_argument("--wire", default="uint8",
+                    choices=["uint8", "float32"],
+                    help="host->device wire format (uint8 = device-side "
+                         "normalize, the high-throughput mode; float32 = "
+                         "bit-exact CLI parity, the server default)")
+    ap.add_argument("--url", default="",
+                    help="target an already-running server instead of "
+                         "spawning one")
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--no-cold-baseline", action="store_true")
+    ap.add_argument("--no-engine-loop", action="store_true")
+    ap.add_argument("--keep-env", action="store_true",
+                    help="inherit the env as-is (e.g. to bench on TPU)")
+    ap.add_argument("--out", default="", help="write the markdown here")
+    args = ap.parse_args(argv)
+
+    jpegs = make_jpegs(32, args.src_size)
+    _log(f"{len(jpegs)} synthetic JPEGs, ~{len(jpegs[0]) // 1024} KiB each")
+
+    proc = None
+    if args.url:
+        netloc = args.url.replace("http://", "").rstrip("/")
+    else:
+        proc, netloc = spawn_server(args)
+    try:
+        wait_ready(netloc)
+        m0 = scrape_metrics(netloc)
+        compiles_at_ready = m0.get("dfd_serving_compiles_total", 0)
+        # the REAL probe: backend compiles observed by jax's monitoring
+        # hook inside the server process (the engine counter above only
+        # counts its own AOT builds and can't see a stray jit)
+        backend_at_ready = m0.get("dfd_serving_backend_compiles_total", 0)
+
+        rows = []
+        for c in [int(x) for x in args.concurrency.split(",") if x]:
+            _log(f"closed loop: concurrency {c}, {args.duration:.0f}s "
+                 f"(+{args.warmup:.0f}s warmup)")
+            r = run_load(netloc, jpegs, c, args.duration, args.warmup)
+            _log(f"  -> {r['rps']:.1f} req/s, p50 {r['p50']:.1f} ms, "
+                 f"p95 {r['p95']:.1f} ms, statuses {r['statuses']}")
+            rows.append((c, r))
+
+        m1 = scrape_metrics(netloc)
+        compiles_after = m1.get("dfd_serving_compiles_total", 0)
+        backend_after = m1.get("dfd_serving_backend_compiles_total", 0)
+        recompiles = (compiles_after - compiles_at_ready) + \
+                     (backend_after - backend_at_ready)
+        batches = m1.get("dfd_serving_batches_total", 0)
+        real_rows = m1.get("dfd_serving_batch_rows_total", 0)
+        padded = m1.get("dfd_serving_padded_rows_total", 0)
+    finally:
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    eng = None
+    if not args.no_engine_loop:
+        c = max(int(x) for x in args.concurrency.split(","))
+        _log(f"engine closed loop (no socket layer), concurrency {c} ...")
+        eng = engine_closed_loop(args, jpegs, c, args.duration, args.warmup)
+        _log(f"  -> {eng['rps']:.1f} req/s, p50 {eng['p50']:.1f} ms")
+
+    seq = None
+    if not args.no_baseline:
+        _log("warm sequential baseline (runners/test.py loop) ...")
+        seq = warm_sequential_baseline(args, jpegs)
+        _log(f"  -> {seq:.1f} img/s")
+    cold = None
+    if not args.no_cold_baseline:
+        _log("cold one-shot baseline (fresh interpreter) ...")
+        cold = cold_oneshot_baseline(args, jpegs[0])
+        if cold:
+            _log(f"  -> {cold:.1f} s/image")
+
+    # ------------------------------------------------------------------
+    lines = []
+    lines.append(f"Config: `{args.model}` @ {args.image_size}² × "
+                 f"{3 * args.img_num}ch, buckets `{args.buckets}`, "
+                 f"deadline {args.deadline_ms} ms, "
+                 f"{os.cpu_count()} CPU cores, platform "
+                 f"`{os.environ.get('JAX_PLATFORMS', 'default')}`")
+    lines.append("")
+    lines.append("| setup | throughput (img/s) | vs warm CLI loop | "
+                 "p50 (ms) | p95 (ms) | p99 (ms) |")
+    lines.append("|---|---|---|---|---|---|")
+    if cold:
+        rate = 1.0 / cold
+        ratio = f"{rate / seq:.2f}×" if seq else "–"
+        lines.append(f"| one-shot CLI, cold (status quo) | {rate:.2f} | "
+                     f"{ratio} | {cold * 1000:.0f} | – | – |")
+    if seq:
+        lines.append(f"| warm sequential CLI loop (baseline) | {seq:.1f} | "
+                     f"1.00× | – | – | – |")
+    for c, r in rows:
+        ratio = f"{r['rps'] / seq:.2f}×" if seq else "–"
+        shed = r["statuses"].get(429, 0)
+        note = f" ({shed} shed)" if shed else ""
+        lines.append(f"| server (HTTP), concurrency {c}{note} | "
+                     f"{r['rps']:.1f} | {ratio} | {r['p50']:.1f} | "
+                     f"{r['p95']:.1f} | {r['p99']:.1f} |")
+    if eng:
+        c = max(int(x) for x in args.concurrency.split(","))
+        ratio = f"{eng['rps'] / seq:.2f}×" if seq else "–"
+        lines.append(f"| batcher+engine, no socket layer, concurrency {c} "
+                     f"| {eng['rps']:.1f} | {ratio} | {eng['p50']:.1f} | "
+                     f"{eng['p95']:.1f} | {eng['p99']:.1f} |")
+    lines.append("")
+    lines.append(f"Compile probe: {compiles_at_ready:.0f} bucket "
+                 f"executables at ready, **{recompiles:+.0f} after "
+                 f"{sum(r['statuses'].get(200, 0) for _, r in rows)} "
+                 f"scored requests** (zero = the compile cache held); "
+                 f"{batches:.0f} device batches, {real_rows:.0f} real + "
+                 f"{padded:.0f} padded rows "
+                 f"({100 * padded / max(1, real_rows + padded):.1f}% "
+                 f"padding).")
+    table = "\n".join(lines)
+    print(table)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write("# SERVE_BENCH — dynamic-batching server vs one-shot "
+                    "CLI\n\n")
+            f.write("Generated by `tools/bench_serve.py` (closed-loop "
+                    "load generator, persistent\nkeep-alive connections; "
+                    "baselines described in the tool's docstring).\n\n")
+            f.write(table + "\n")
+        _log(f"wrote {args.out}")
+
+    if recompiles != 0:
+        _log(f"FAIL: {recompiles:+.0f} recompiles after warmup")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

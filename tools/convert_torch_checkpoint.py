@@ -268,6 +268,35 @@ def _preprocess_inception(sd: Dict[str, Any], v4: bool) -> Dict[str, Any]:
     return out
 
 
+#: torchvision Inception3 stem names → our semantic stem names
+_INCEPTION_V3_STEM = {
+    "Conv2d_1a_3x3": "conv0", "Conv2d_2a_3x3": "conv1",
+    "Conv2d_2b_3x3": "conv2", "Conv2d_3b_1x1": "conv3",
+    "Conv2d_4a_3x3": "conv4",
+}
+
+
+def _preprocess_inception_v3(sd: Dict[str, Any]) -> Dict[str, Any]:
+    """torchvision ``Inception3`` (the reference's inception_v3 wraps it
+    wholesale) → our ``models/inception_v3.py`` names: CamelCase stem
+    convs map per :data:`_INCEPTION_V3_STEM`, ``Mixed_5b.branch1x1`` →
+    ``mixed_5b_b1x1`` flat siblings (``branch_pool`` → ``bpool``), the
+    ``AuxLogits`` container becomes ``aux_*``, ``fc`` passes through."""
+    out = {}
+    for k, v in sd.items():
+        head, _, rest = k.partition(".")
+        if head in _INCEPTION_V3_STEM:
+            k = f"{_INCEPTION_V3_STEM[head]}.{rest}"
+        elif head.startswith("Mixed_"):
+            rest = rest.replace("branch_pool.", "bpool.") \
+                       .replace("branch", "b")
+            k = f"{head.lower()}_{rest}"
+        elif head == "AuxLogits":
+            k = f"aux_{rest}"
+        out[k] = v
+    return out
+
+
 def _preprocess_nasnet(sd: Dict[str, Any]) -> Dict[str, Any]:
     """NASNet container flattening (nasnet.py): comb-iter branches become
     ``<cell>_c{i}{l|r}`` siblings, separables flatten to ``_dw``/``_pw``,
@@ -349,6 +378,8 @@ def _preprocess_generic_keys(sd: Dict[str, Any]) -> Dict[str, Any]:
         sd = _preprocess_inception(sd, v4=True)        # inception_v4
     elif any(k.startswith("conv2d_1a.") for k in sd):
         sd = _preprocess_inception(sd, v4=False)       # inception_resnet_v2
+    elif any(k.startswith("Conv2d_1a_3x3.") for k in sd):
+        sd = _preprocess_inception_v3(sd)              # torchvision v3
     if any(k.startswith("reduction_cell_0.") for k in sd):
         sd = _preprocess_nasnet(sd)
     if any(".fuse_layers." in k for k in sd):

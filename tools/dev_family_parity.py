@@ -154,13 +154,78 @@ def run_family(mod, ctor, flax_name, size, atol) -> str:
            f"(logit scale {scale:.2e})"
 
 
+def run_inception_v3_fixture(size: int = 96) -> str:
+    """Converter parity for inception_v3 WITHOUT torch/torchvision (the
+    reference model wraps torchvision, which this image does not ship):
+    convert the synthetic torchvision-schema state dict
+    (tools/inception_v3_fixture.py) and require full leaf coverage, exact
+    shapes, layout-correct values, and a finite forward pass.  Logit
+    parity against the torch model is what the OTHER families pin; here
+    the torch side cannot execute, so value-level checks verify the
+    layout transposes instead."""
+    import jax
+    import jax.numpy as jnp
+    from flax.traverse_util import flatten_dict
+
+    from convert_torch_checkpoint import convert_for_model
+    from deepfake_detection_tpu.models import create_model
+    from inception_v3_fixture import inception_v3_state_dict
+
+    sd = inception_v3_state_dict()
+    # convert_for_model raises on ANY uncovered flax leaf / unmatched
+    # torch tensor — reaching here already proves coverage is total
+    variables = convert_for_model(sd, "inception_v3")
+    model = create_model("inception_v3")
+    shapes = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((1, size, size, 3)),
+                             training=True),
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)})
+    for coll in ("params", "batch_stats"):
+        want = flatten_dict(shapes[coll], sep="/")
+        got = flatten_dict(variables.get(coll, {}), sep="/")
+        if set(want) != set(got):
+            return f"FAIL inception_v3(fixture) {coll}: " \
+                   f"missing {sorted(set(want) - set(got))[:3]} " \
+                   f"extra {sorted(set(got) - set(want))[:3]}"
+        bad = [k for k in want
+               if tuple(want[k].shape) != tuple(np.shape(got[k]))]
+        if bad:
+            return f"FAIL inception_v3(fixture) {coll} shapes: {bad[:3]}"
+    # layout spot checks: conv OIHW→HWIO, linear (out,in)→(in,out),
+    # running stats land in batch_stats
+    p, bs = variables["params"], variables["batch_stats"]
+    checks = [
+        (np.transpose(sd["Conv2d_1a_3x3.conv.weight"], (2, 3, 1, 0)),
+         p["conv0"]["conv"]["conv"]["kernel"]),
+        (np.transpose(sd["Mixed_6b.branch7x7_2.conv.weight"], (2, 3, 1, 0)),
+         p["mixed_6b_b7x7_2"]["conv"]["conv"]["kernel"]),
+        (sd["Mixed_5b.branch_pool.bn.running_var"],
+         bs["mixed_5b_bpool"]["bn"]["bn"]["var"]),
+        (np.transpose(sd["fc.weight"]), p["fc"]["kernel"]),
+        (sd["AuxLogits.fc.bias"], p["aux_fc"]["bias"]),
+    ]
+    for i, (want_a, got_a) in enumerate(checks):
+        if not np.array_equal(want_a, np.asarray(got_a)):
+            return f"FAIL inception_v3(fixture) value check #{i}"
+    logits = np.asarray(model.apply(
+        variables, jnp.zeros((1, size, size, 3)), training=False))
+    if logits.shape != (1, 1000) or not np.all(np.isfinite(logits)):
+        return f"FAIL inception_v3(fixture) forward: {logits.shape}"
+    return f"OK   inception_v3(fixture)             " \
+           f"{len(sd)} torch tensors -> full coverage, forward finite"
+
+
 def main() -> None:
     only = set(sys.argv[1:])
     for mod, ctor, flax_name, size, atol in FAMILIES:
         if only and ctor not in only and mod not in only:
             continue
         try:
-            print(run_family(mod, ctor, flax_name, size, atol), flush=True)
+            if ctor == "inception_v3":
+                print(run_inception_v3_fixture(size), flush=True)
+            else:
+                print(run_family(mod, ctor, flax_name, size, atol),
+                      flush=True)
         except Exception as e:  # noqa: BLE001 — survey run, keep going
             print(f"ERR  {ctor:28s} {type(e).__name__}: {str(e)[:160]}",
                   flush=True)

@@ -1,0 +1,133 @@
+"""Synthetic torchvision ``Inception3`` state dict (ISSUE 2 satellite).
+
+The reference's ``inception_v3`` entrypoints wrap
+``torchvision.models.Inception3`` wholesale, but this image ships no
+torchvision, so the converter's inception_v3 path was untestable (the
+"converter hole", VERDICT missing #5).  This module reconstructs the
+EXACT key/shape schema of ``Inception3(aux_logits=True).state_dict()``
+from the architecture definition (torchvision inception.py lineage, the
+same channel plan ``models/inception_v3.py`` implements natively), so
+``tests/test_convert_families.py`` can exercise
+``convert_for_model(sd, 'inception_v3')`` without torch OR torchvision.
+
+Every module is a ``BasicConv2d`` — conv(bias=False) + BN(affine,
+running stats, num_batches_tracked) — except the two Linear heads.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple, Union
+
+import numpy as np
+
+__all__ = ["inception_v3_state_dict"]
+
+_K = Union[int, Tuple[int, int]]
+
+
+def _conv_bn(sd: Dict[str, np.ndarray], rng, name: str, cin: int,
+             cout: int, k: _K) -> None:
+    kh, kw = (k, k) if isinstance(k, int) else k
+    sd[f"{name}.conv.weight"] = rng.normal(
+        0, 0.05, (cout, cin, kh, kw)).astype(np.float32)
+    sd[f"{name}.bn.weight"] = rng.uniform(
+        0.5, 1.5, cout).astype(np.float32)
+    sd[f"{name}.bn.bias"] = rng.normal(0, 0.1, cout).astype(np.float32)
+    sd[f"{name}.bn.running_mean"] = rng.normal(
+        0, 0.1, cout).astype(np.float32)
+    sd[f"{name}.bn.running_var"] = rng.uniform(
+        0.8, 1.2, cout).astype(np.float32)
+    sd[f"{name}.bn.num_batches_tracked"] = np.asarray(100, np.int64)
+
+
+def _linear(sd: Dict[str, np.ndarray], rng, name: str, cin: int,
+            cout: int) -> None:
+    sd[f"{name}.weight"] = rng.normal(
+        0, 0.02, (cout, cin)).astype(np.float32)
+    sd[f"{name}.bias"] = rng.normal(0, 0.02, cout).astype(np.float32)
+
+
+def _mix_a(sd, rng, name: str, cin: int, pool: int) -> int:
+    _conv_bn(sd, rng, f"{name}.branch1x1", cin, 64, 1)
+    _conv_bn(sd, rng, f"{name}.branch5x5_1", cin, 48, 1)
+    _conv_bn(sd, rng, f"{name}.branch5x5_2", 48, 64, 5)
+    _conv_bn(sd, rng, f"{name}.branch3x3dbl_1", cin, 64, 1)
+    _conv_bn(sd, rng, f"{name}.branch3x3dbl_2", 64, 96, 3)
+    _conv_bn(sd, rng, f"{name}.branch3x3dbl_3", 96, 96, 3)
+    _conv_bn(sd, rng, f"{name}.branch_pool", cin, pool, 1)
+    return 64 + 64 + 96 + pool
+
+
+def _mix_b(sd, rng, name: str, cin: int) -> int:
+    _conv_bn(sd, rng, f"{name}.branch3x3", cin, 384, 3)
+    _conv_bn(sd, rng, f"{name}.branch3x3dbl_1", cin, 64, 1)
+    _conv_bn(sd, rng, f"{name}.branch3x3dbl_2", 64, 96, 3)
+    _conv_bn(sd, rng, f"{name}.branch3x3dbl_3", 96, 96, 3)
+    return 384 + 96 + cin
+
+
+def _mix_c(sd, rng, name: str, cin: int, c7: int) -> int:
+    _conv_bn(sd, rng, f"{name}.branch1x1", cin, 192, 1)
+    _conv_bn(sd, rng, f"{name}.branch7x7_1", cin, c7, 1)
+    _conv_bn(sd, rng, f"{name}.branch7x7_2", c7, c7, (1, 7))
+    _conv_bn(sd, rng, f"{name}.branch7x7_3", c7, 192, (7, 1))
+    _conv_bn(sd, rng, f"{name}.branch7x7dbl_1", cin, c7, 1)
+    _conv_bn(sd, rng, f"{name}.branch7x7dbl_2", c7, c7, (7, 1))
+    _conv_bn(sd, rng, f"{name}.branch7x7dbl_3", c7, c7, (1, 7))
+    _conv_bn(sd, rng, f"{name}.branch7x7dbl_4", c7, c7, (7, 1))
+    _conv_bn(sd, rng, f"{name}.branch7x7dbl_5", c7, 192, (1, 7))
+    _conv_bn(sd, rng, f"{name}.branch_pool", cin, 192, 1)
+    return 192 * 4
+
+
+def _mix_d(sd, rng, name: str, cin: int) -> int:
+    _conv_bn(sd, rng, f"{name}.branch3x3_1", cin, 192, 1)
+    _conv_bn(sd, rng, f"{name}.branch3x3_2", 192, 320, 3)
+    _conv_bn(sd, rng, f"{name}.branch7x7x3_1", cin, 192, 1)
+    _conv_bn(sd, rng, f"{name}.branch7x7x3_2", 192, 192, (1, 7))
+    _conv_bn(sd, rng, f"{name}.branch7x7x3_3", 192, 192, (7, 1))
+    _conv_bn(sd, rng, f"{name}.branch7x7x3_4", 192, 192, 3)
+    return 320 + 192 + cin
+
+
+def _mix_e(sd, rng, name: str, cin: int) -> int:
+    _conv_bn(sd, rng, f"{name}.branch1x1", cin, 320, 1)
+    _conv_bn(sd, rng, f"{name}.branch3x3_1", cin, 384, 1)
+    _conv_bn(sd, rng, f"{name}.branch3x3_2a", 384, 384, (1, 3))
+    _conv_bn(sd, rng, f"{name}.branch3x3_2b", 384, 384, (3, 1))
+    _conv_bn(sd, rng, f"{name}.branch3x3dbl_1", cin, 448, 1)
+    _conv_bn(sd, rng, f"{name}.branch3x3dbl_2", 448, 384, 3)
+    _conv_bn(sd, rng, f"{name}.branch3x3dbl_3a", 384, 384, (1, 3))
+    _conv_bn(sd, rng, f"{name}.branch3x3dbl_3b", 384, 384, (3, 1))
+    _conv_bn(sd, rng, f"{name}.branch_pool", cin, 192, 1)
+    return 320 + 2 * 384 + 2 * 384 + 192
+
+
+def inception_v3_state_dict(num_classes: int = 1000,
+                            seed: int = 0) -> Dict[str, np.ndarray]:
+    """``Inception3(num_classes, aux_logits=True).state_dict()`` schema
+    with seeded random values (numpy arrays; the converter accepts both
+    torch tensors and arrays)."""
+    rng = np.random.default_rng(seed)
+    sd: Dict[str, np.ndarray] = {}
+    _conv_bn(sd, rng, "Conv2d_1a_3x3", 3, 32, 3)
+    _conv_bn(sd, rng, "Conv2d_2a_3x3", 32, 32, 3)
+    _conv_bn(sd, rng, "Conv2d_2b_3x3", 32, 64, 3)
+    _conv_bn(sd, rng, "Conv2d_3b_1x1", 64, 80, 1)
+    _conv_bn(sd, rng, "Conv2d_4a_3x3", 80, 192, 3)
+    c = _mix_a(sd, rng, "Mixed_5b", 192, pool=32)     # 256
+    c = _mix_a(sd, rng, "Mixed_5c", c, pool=64)       # 288
+    c = _mix_a(sd, rng, "Mixed_5d", c, pool=64)       # 288
+    c = _mix_b(sd, rng, "Mixed_6a", c)                # 768
+    c = _mix_c(sd, rng, "Mixed_6b", c, c7=128)
+    c = _mix_c(sd, rng, "Mixed_6c", c, c7=160)
+    c = _mix_c(sd, rng, "Mixed_6d", c, c7=160)
+    c = _mix_c(sd, rng, "Mixed_6e", c, c7=192)        # 768
+    _conv_bn(sd, rng, "AuxLogits.conv0", c, 128, 1)
+    _conv_bn(sd, rng, "AuxLogits.conv1", 128, 768, 5)
+    _linear(sd, rng, "AuxLogits.fc", 768, num_classes)
+    c = _mix_d(sd, rng, "Mixed_7a", c)                # 1280
+    c = _mix_e(sd, rng, "Mixed_7b", c)                # 2048
+    c = _mix_e(sd, rng, "Mixed_7c", c)                # 2048
+    _linear(sd, rng, "fc", c, num_classes)
+    return sd

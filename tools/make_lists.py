@@ -1,0 +1,170 @@
+"""Build the v3 clip-list files from an extracted frame tree (ISSUE 2
+satellite; VERDICT next-round #4).
+
+Walks ``<root>/{real,fake}/<clip>/<i>.jpg`` and writes
+``<root>/real_list.txt`` / ``<root>/fake_list.txt`` in the ``name:num``
+format ``data/dataset.py::read_clip_list`` consumes (the reference's
+``get_all_images_list_v3`` expected these files to pre-exist; this tool
+closes the gap from raw extracted frames — e.g. DeeperForensics dumps —
+to a trainable root).
+
+Clips may nest (``fake/manip_x/clip001/``): any directory that directly
+contains ``<i>.jpg`` frames is a clip, its name the path relative to the
+class dir.  Frame count is the contiguous run ``0.jpg .. (n-1).jpg`` —
+the loader indexes frames densely from 0, so trailing/gapped extras are
+unreachable and ``--validate`` flags them.
+
+``--validate`` additionally reports:
+
+* **missing frames** — gaps in the 0..max index range (count stops at
+  the gap, unreachable frames beyond it are wasted);
+* **short clips** — fewer than ``--min-frames`` (default 4) frames; the
+  loader front-pads these with frame 0, which is legal but worth eyes;
+* **corrupt JPEGs** — files PIL cannot fully decode.
+
+Exit code is 1 when ``--validate --strict`` finds problems.
+
+Usage (see README "Data lists" recipe)::
+
+    python tools/make_lists.py /data/deeperforensics_frames --validate
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+_FRAME_RE = re.compile(r"^(\d+)\.jpe?g$", re.IGNORECASE)
+
+KINDS = ("real", "fake")
+
+
+def scan_clips(class_dir: str) -> Dict[str, List[int]]:
+    """{clip_name: sorted frame indices} for every dir under ``class_dir``
+    that directly holds ``<i>.jpg`` frames."""
+    clips: Dict[str, List[int]] = {}
+    for dirpath, _dirnames, filenames in os.walk(class_dir):
+        idxs = sorted(int(m.group(1)) for f in filenames
+                      if (m := _FRAME_RE.match(f)))
+        if idxs:
+            name = os.path.relpath(dirpath, class_dir)
+            clips[name] = idxs
+    return clips
+
+
+def contiguous_count(idxs: List[int]) -> int:
+    """Length of the dense 0..n-1 prefix (what the loader can reach)."""
+    n = 0
+    for i in idxs:
+        if i != n:
+            break
+        n += 1
+    return n
+
+
+def _check_jpeg(path: str) -> bool:
+    """True if the file fully decodes."""
+    from PIL import Image
+    try:
+        with Image.open(path) as im:
+            im.load()
+        return True
+    except Exception:                              # noqa: BLE001
+        return False
+
+
+def validate_clips(class_dir: str, clips: Dict[str, List[int]],
+                   min_frames: int, check_decode: bool) -> List[str]:
+    problems = []
+    for name in sorted(clips):
+        idxs = clips[name]
+        n = contiguous_count(idxs)
+        if n < len(idxs):
+            # the dense prefix is exactly 0..n-1, so n IS the first gap
+            problems.append(
+                f"{class_dir}/{name}: missing frame {n}.jpg — only "
+                f"{n}/{len(idxs)} frames reachable")
+        if n < min_frames:
+            problems.append(
+                f"{class_dir}/{name}: short clip ({n} < {min_frames} "
+                f"frames; loader will front-pad with frame 0)")
+        if check_decode:
+            # probe the ACTUAL filenames (scan matched extensions
+            # case-insensitively — '0.JpG' is a frame, not "missing")
+            clip_dir = os.path.join(class_dir, name)
+            frames = {int(m.group(1)): f
+                      for f in os.listdir(clip_dir)
+                      if (m := _FRAME_RE.match(f))}
+            for i in idxs:
+                path = os.path.join(clip_dir, frames[i])
+                if not _check_jpeg(path):
+                    problems.append(f"{path}: corrupt JPEG")
+    return problems
+
+
+def write_list(path: str, clips: Dict[str, List[int]]) -> int:
+    """Write ``name:num`` lines (dense-prefix counts, deterministic
+    order); returns the number of listed clips."""
+    lines = []
+    for name in sorted(clips):
+        n = contiguous_count(clips[name])
+        if n > 0:
+            lines.append(f"{name}:{n}\n")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.writelines(lines)
+    os.replace(tmp, path)
+    return len(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="emit real_list.txt/fake_list.txt from a "
+                    "<root>/{real,fake}/<clip>/<i>.jpg tree")
+    ap.add_argument("root", help="dataset root holding real/ and fake/")
+    ap.add_argument("--out-dir", default="",
+                    help="where to write the lists (default: root)")
+    ap.add_argument("--min-frames", type=int, default=4,
+                    help="short-clip threshold for --validate (img_num)")
+    ap.add_argument("--validate", action="store_true",
+                    help="flag missing frames, short clips, corrupt JPEGs")
+    ap.add_argument("--strict", action="store_true",
+                    help="with --validate: exit 1 when problems found")
+    args = ap.parse_args(argv)
+
+    out_dir = args.out_dir or args.root
+    problems: List[str] = []
+    totals: List[Tuple[str, int, int]] = []
+    for kind in KINDS:
+        class_dir = os.path.join(args.root, kind)
+        if not os.path.isdir(class_dir):
+            print(f"warning: {class_dir} does not exist; writing an empty "
+                  f"{kind}_list.txt", file=sys.stderr)
+            clips = {}
+        else:
+            clips = scan_clips(class_dir)
+        if args.validate and clips:
+            problems += validate_clips(class_dir, clips, args.min_frames,
+                                       check_decode=True)
+        n_listed = write_list(os.path.join(out_dir, f"{kind}_list.txt"),
+                              clips)
+        frames = sum(contiguous_count(v) for v in clips.values())
+        totals.append((kind, n_listed, frames))
+
+    for kind, n, frames in totals:
+        print(f"{kind}: {n} clips, {frames} reachable frames "
+              f"-> {os.path.join(out_dir, f'{kind}_list.txt')}")
+    if problems:
+        print(f"\n{len(problems)} problem(s):", file=sys.stderr)
+        for p in problems:
+            print("  " + p, file=sys.stderr)
+        if args.strict:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
