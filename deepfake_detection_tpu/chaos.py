@@ -1,0 +1,96 @@
+"""Env-gated fault injection points (``DFD_CHAOS``).
+
+The resilience layer (train/resilience.py) is only trustworthy if every
+recovery path is exercised by an *injected* fault, not just unit-tested.
+This module is the one switchboard: a ``DFD_CHAOS`` spec names faults and
+the step at which they fire, and the production code paths (trainer loop,
+host loaders, shm workers) carry tiny ``chaos.fires(...)`` probes that are
+dead when the env var is unset.
+
+Spec grammar — comma-separated entries of::
+
+    <name>@<step>[x<count>][:<arg>]
+
+* ``name``  — injection point (``sigterm``, ``nanbatch``, ``truncate_ckpt``,
+  ``stall_loader``, ``kill_shm_worker``, ...; the probe site defines it).
+* ``step``  — the counter value at which the fault fires.  What the counter
+  means is per-point: global optimizer updates for trainer points, batch
+  index for loader points, completed tasks for shm-worker points.
+* ``x<count>`` — fire at ``count`` consecutive counter values (a burst:
+  ``nanbatch@5x3`` poisons updates 5, 6 and 7).
+* ``:<arg>`` — float argument (e.g. ``stall_loader@3:30`` stalls 30 s).
+
+Every (name, step) pair fires AT MOST ONCE per injector instance: a rewind
+that re-executes the same steps sees clean data the second time, which is
+exactly the transient-fault semantics the recovery machinery targets.
+
+Deliberately jax-free and import-light: spawned shm workers import this
+without dragging the jax/flax stack into every worker process.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Optional, Set, Tuple
+
+__all__ = ["ChaosInjector", "chaos_from_env", "CHAOS_ENV_VAR"]
+
+CHAOS_ENV_VAR = "DFD_CHAOS"
+
+_SPEC_RE = re.compile(
+    r"^(?P<name>[a-z][a-z0-9_]*)@(?P<step>\d+)"
+    r"(?:x(?P<count>\d+))?(?::(?P<arg>[-+0-9.eE]+))?$")
+
+
+class ChaosInjector:
+    """Parsed ``DFD_CHAOS`` spec with fire-once bookkeeping.
+
+    An empty spec parses to an inactive injector whose probes cost one
+    attribute read — probe sites guard on :attr:`active` and skip entirely
+    in production runs.
+    """
+
+    def __init__(self, spec: str = ""):
+        #: name -> (first_step, count, arg)
+        self.points: Dict[str, Tuple[int, int, Optional[float]]] = {}
+        self._fired: Set[Tuple[str, int]] = set()
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            m = _SPEC_RE.match(part)
+            if m is None:
+                raise ValueError(
+                    f"bad {CHAOS_ENV_VAR} entry {part!r}; expected "
+                    "<name>@<step>[x<count>][:<arg>]")
+            self.points[m["name"]] = (
+                int(m["step"]), int(m["count"] or 1),
+                float(m["arg"]) if m["arg"] is not None else None)
+
+    @property
+    def active(self) -> bool:
+        return bool(self.points)
+
+    def fires(self, name: str, step: int) -> bool:
+        """True exactly once per (name, step) inside the point's window."""
+        p = self.points.get(name)
+        if p is None:
+            return False
+        start, count, _ = p
+        if not (start <= int(step) < start + count):
+            return False
+        key = (name, int(step))
+        if key in self._fired:
+            return False
+        self._fired.add(key)
+        return True
+
+    def arg(self, name: str, default: float = 0.0) -> float:
+        """The point's ``:<arg>`` value (``default`` when omitted)."""
+        p = self.points.get(name)
+        if p is None or p[2] is None:
+            return default
+        return p[2]
+
+
+def chaos_from_env() -> ChaosInjector:
+    """Injector from ``DFD_CHAOS`` (inactive when unset/empty)."""
+    return ChaosInjector(os.environ.get(CHAOS_ENV_VAR, ""))

@@ -274,6 +274,24 @@ class TrainConfig:
     compute_dtype: str = "bfloat16"      # bfloat16 | float32
     param_dtype: str = "float32"
 
+    # --- fault tolerance (train/resilience.py) ---
+    # consult the run dir's recovery snapshots at startup and fast-forward
+    # to the exact (epoch, batch) loop position (bit-continuous resume);
+    # implies a STABLE output dir (no -N auto-increment) — name runs with
+    # --experiment when launching many
+    auto_resume: bool = False
+    # non-finite loss/grad-norm policy inside the jitted step:
+    # 'skip' selects the pre-step state (params/moments/EMA/stats
+    # untouched), 'off' reproduces the reference (poisoned update applied)
+    guard_nonfinite: str = "skip"
+    guard_spike_window: int = 0     # rolling robust-stats window (0 = off)
+    guard_spike_zmax: float = 8.0   # spike threshold in MAD-scaled z units
+    guard_rewind_after: int = 3     # K consecutive bad steps → rewind
+    guard_rewind_limit: int = 2     # rewind budget per run
+    # seconds without a completed step before the stall watchdog dumps all
+    # thread stacks and aborts with exit code 85 (0 = off)
+    watchdog_timeout: float = 0.0
+
     # --- misc / infra ---
     seed: int = 42
     log_interval: int = 50
@@ -327,6 +345,9 @@ class TrainConfig:
         if self.loader_backend not in ("thread", "shm"):
             raise ValueError("loader_backend must be thread|shm, got "
                              f"{self.loader_backend!r}")
+        if self.guard_nonfinite not in ("off", "skip"):
+            raise ValueError("guard_nonfinite must be off|skip, got "
+                             f"{self.guard_nonfinite!r}")
         if int(self.ring_depth) < 3:
             raise ValueError("--ring-depth must be >= 3 (double buffering "
                              f"needs one spare slab), got {self.ring_depth}")

@@ -24,8 +24,10 @@ floats.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 import logging
 from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
@@ -48,6 +50,17 @@ __all__ = ["fast_collate", "HostLoader", "DeviceLoader", "create_loader",
            "create_deepfake_loader_v3"]
 
 LOADER_BACKENDS = ("thread", "shm")
+
+
+def _loader_chaos():
+    """Chaos injector for loader-side fault points, None in production
+    (``DFD_CHAOS`` unset — the probe then costs one env read per epoch).
+    Fresh per iteration: loader points key on the batch index within an
+    epoch, unlike the trainer's run-global update counter."""
+    if not os.environ.get("DFD_CHAOS"):
+        return None
+    from ..chaos import chaos_from_env
+    return chaos_from_env()
 
 
 def fast_collate(samples: Sequence[Tuple[np.ndarray, int]]
@@ -90,9 +103,15 @@ class HostLoader:
         self.collate_mixup = collate_mixup
         self.valid_mask = valid_mask
         self.epoch = 0
+        # mid-epoch resume: skip producing batches < start_batch while
+        # keeping their ABSOLUTE indices for every per-batch RNG, so a
+        # fast-forwarded epoch's remaining batches are bit-identical to an
+        # uninterrupted one.  Reset by set_epoch (one epoch's worth).
+        self.start_batch = 0
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
+        self.start_batch = 0
         self.sampler.set_epoch(epoch)
         if hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(epoch)
@@ -109,6 +128,8 @@ class HostLoader:
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         batches, vms = epoch_batches(self.sampler, self.batch_size,
                                      self.valid_mask)
+        start = self.start_batch
+        chaos = _loader_chaos()
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
         stop = threading.Event()
 
@@ -126,8 +147,18 @@ class HostLoader:
         def produce():
             with ThreadPoolExecutor(self.num_workers) as pool:
                 for bi, batch_idx in enumerate(batches):
+                    if bi < start:
+                        continue
                     if stop.is_set():
                         return
+                    if chaos is not None and chaos.fires("stall_loader", bi):
+                        # simulates a wedged data source: no batch reaches
+                        # the train loop until the sleep (default 120 s)
+                        # ends — long enough to trip any sane watchdog
+                        _logger.warning("chaos: stalling loader %.0fs at "
+                                        "batch %d",
+                                        chaos.arg("stall_loader", 120.0), bi)
+                        time.sleep(chaos.arg("stall_loader", 120.0))
                     samples = list(pool.map(self._load_one, batch_idx))
                     images, targets = fast_collate(samples)
                     if self.collate_mixup is not None:
@@ -233,6 +264,26 @@ class DeviceLoader:
 
     def set_epoch(self, epoch: int) -> None:
         self.loader.set_epoch(epoch)
+        # pin the prologue key stream to the ABSOLUTE position: every epoch
+        # stages exactly len(loader) batches, so _step == epoch * len at an
+        # epoch start in ANY run — a no-op for an uninterrupted run, and
+        # the thing that makes a freshly-constructed loader's RandomErasing/
+        # jitter keys bit-identical to the original run's after auto-resume
+        self._step = epoch * len(self.loader)
+
+    def fast_forward(self, start_batch: int) -> None:
+        """Resume mid-epoch: the next iteration yields batches from
+        ``start_batch`` on, bit-identical to the tail of a full epoch
+        (host loaders keep absolute batch indices for their per-batch RNG;
+        the prologue key stream advances to match).  Call AFTER
+        :meth:`set_epoch`; cleared by the next ``set_epoch``."""
+        if start_batch <= 0:
+            return
+        if not hasattr(self.loader, "start_batch"):
+            raise NotImplementedError(
+                f"{type(self.loader).__name__} cannot fast-forward")
+        self.loader.start_batch = int(start_batch)
+        self._step += int(start_batch)
 
     def close(self) -> None:
         """Tear down the host loader's workers/shm (no-op for threads)."""

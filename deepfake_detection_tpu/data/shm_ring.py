@@ -170,6 +170,16 @@ def _worker_main(wid: int, dataset: Any, seed: int, shm_name: str,
         _native.set_default_pool_threads(native_threads)
     except Exception:  # pragma: no cover - native module is optional
         pass
+    chaos = None
+    if wid == 0 and os.environ.get("DFD_CHAOS"):
+        # env-gated fault injection (worker 0 only, deterministic): die
+        # after the Nth completed task so the consumer's crash-recovery
+        # path (respawn + re-dispatch) is driven by a REAL dead process
+        from ..chaos import chaos_from_env
+        chaos = chaos_from_env()
+        if "kill_shm_worker" not in chaos.points:
+            chaos = None
+    tasks_done = 0
     ring = ShmRing(depth, rows, img_shape, batch, name=shm_name)
     base = 3 * wid
     last_epoch: Optional[int] = None
@@ -184,6 +194,10 @@ def _worker_main(wid: int, dataset: Any, seed: int, shm_name: str,
                 continue
             if task is None:
                 break
+            if chaos is not None and chaos.fires("kill_shm_worker",
+                                                 tasks_done):
+                os._exit(113)       # hard death: no ack, no cleanup
+            tasks_done += 1
             slot, j, index, epoch, bi, task_gen = task
             cur[base + 1] = bi
             cur[base + 2] = j
@@ -280,6 +294,10 @@ class ShmRingLoader:
         self.valid_mask = valid_mask
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.epoch = 0
+        # mid-epoch resume: first yielded batch of the next iteration
+        # (absolute indices are kept for slot tokens and per-batch RNG);
+        # reset by set_epoch — see HostLoader.start_batch
+        self.start_batch = 0
         self.respawn_count = 0          # lifetime total: observability/tests
         self._iter_respawns = 0         # windowed: crash-loop abort guard
         self._slow_tasks: Set[Tuple[int, int]] = set()  # kill-once ledger
@@ -296,6 +314,7 @@ class ShmRingLoader:
     # -- HostLoader interface parity ------------------------------------
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
+        self.start_batch = 0
         self.sampler.set_epoch(epoch)
         if hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(epoch)
@@ -355,7 +374,16 @@ class ShmRingLoader:
                   self._hb, self._cur, self._gen, self._owner,
                   self._native_threads),
             daemon=True, name=f"dfd-shm-worker-{i}")
-        p.start()
+        # chaos worker-kill is a TRANSIENT fault: the replacement worker
+        # must not inherit the spec and die again in a loop (spawn-context
+        # children snapshot os.environ at start)
+        chaos_env = os.environ.pop("DFD_CHAOS", None) \
+            if self.respawn_count else None
+        try:
+            p.start()
+        finally:
+            if chaos_env is not None:
+                os.environ["DFD_CHAOS"] = chaos_env
         self._workers[i] = p
 
     def close(self) -> None:
@@ -505,7 +533,8 @@ class ShmRingLoader:
     def __iter__(self):
         batches, vms = epoch_batches(self.sampler, self.batch_size,
                                      self.valid_mask)
-        if not batches:
+        start = self.start_batch
+        if not batches or start >= len(batches):
             return
         self._ensure_started()
         if self._dirty:
@@ -545,12 +574,12 @@ class ShmRingLoader:
             for j, idx in enumerate(batches[bi]):
                 self._task_q.put((slot, j, int(idx), epoch, bi, gen))
 
-        for bi in range(min(D, nb)):
+        for bi in range(start, min(start + D, nb)):
             dispatch(bi)
-        for bi in range(nb):
+        for bi in range(start, nb):
             # slot of batch bi-2 is free by contract (the caller has
             # requested two batches past it) → refill the ring
-            if bi >= 2 and bi - 2 + D < nb:
+            if bi >= start + 2 and bi - 2 + D < nb:
                 dispatch(bi - 2 + D)
             self._collect(bi, done, batches, epoch, gen)
             images = self._ring.images[bi % D]

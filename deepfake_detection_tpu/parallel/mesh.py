@@ -20,6 +20,8 @@ file://<shared_nfs_file>)`` with rank arithmetic from a JSON server map,
 from __future__ import annotations
 
 import logging
+import os
+import time
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -33,12 +35,22 @@ __all__ = ["initialize_distributed", "make_mesh", "local_batch_size",
 
 
 def initialize_distributed(cluster=None, hostname: Optional[str] = None,
-                           local_rank: int = 0) -> None:
+                           local_rank: int = 0, retries: Optional[int] = None,
+                           backoff: float = 2.0) -> None:
     """Multi-host JAX runtime init (replaces NCCL file rendezvous).
 
     ``cluster`` is a :class:`ClusterConfig` (or None).  Single-process setups
     return immediately.  Safe to call multiple times (subsequent calls
     no-op).
+
+    The rendezvous is retried with exponential backoff (``retries``
+    attempts, default 4, env-overridable via ``DFD_INIT_RETRIES``): after a
+    preemption the restart wrapper relaunches hosts at skewed times, and a
+    coordinator that is itself still being rescheduled must not turn every
+    late-arriving worker's bounded connect timeout into a permanent abort.
+    The LAST failure still raises — a genuinely unreachable coordinator on
+    a required multi-host setup must abort the job (swallowing it would
+    silently train N isolated copies).
     """
     if cluster is None or cluster.world_size <= 1:
         return
@@ -52,9 +64,22 @@ def initialize_distributed(cluster=None, hostname: Optional[str] = None,
         kwargs["coordinator_address"] = cluster.coordinator_address
         kwargs["num_processes"] = cluster.world_size
         kwargs["process_id"] = cluster.process_id(hostname, local_rank)
-    # no try/except: a failed init on a required multi-host setup must abort
-    # the job — swallowing it would silently train N isolated copies
-    jax.distributed.initialize(**kwargs)
+    if retries is None:
+        retries = int(os.environ.get("DFD_INIT_RETRIES", "4"))
+    attempts = max(1, retries)
+    delay = 1.0
+    for attempt in range(attempts):
+        try:
+            jax.distributed.initialize(**kwargs)
+            break
+        except Exception as e:  # noqa: BLE001 — re-raised on the last try
+            if attempt == attempts - 1:
+                raise
+            _logger.warning(
+                "jax.distributed.initialize failed (attempt %d/%d: %r); "
+                "retrying in %.1fs", attempt + 1, attempts, e, delay)
+            time.sleep(delay)
+            delay = min(delay * backoff, 30.0)
     _logger.info("jax.distributed initialized: process %d/%d",
                  jax.process_index(), jax.process_count())
 

@@ -49,8 +49,10 @@ from ..optim import create_optimizer
 from ..parallel import (batch_sharding, initialize_distributed, make_mesh,
                         transformer_tp_sharding)
 from ..scheduler import create_scheduler
-from ..train import (CheckpointSaver, ShardedCheckpointSaver,
-                     create_train_state, make_eval_step,
+from ..train import (EXIT_PREEMPTED, CheckpointCorrupt, CheckpointSaver,
+                     Preempted, Resilience, RewindRequested,
+                     ShardedCheckpointSaver, create_train_state,
+                     find_resume_candidates, make_eval_step,
                      make_train_step, replicate_for_save,
                      restore_train_state, set_learning_rate,
                      train_one_epoch, validate, wait_pending_saves)
@@ -234,48 +236,131 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     lr_scheduler, num_epochs = create_scheduler(cfg, base_lr=lr)
     start_epoch = cfg.start_epoch or 0
 
-    if cfg.resume:
-        if os.path.isdir(cfg.resume):
+    # output dir + config dump (reference :785-808, :527-532) — built
+    # BEFORE resume handling so --auto-resume can consult the run
+    # directory's recovery snapshots at startup
+    output_dir, saver = "", None
+    if rank == 0 or cfg.ckpt_sharded or cfg.auto_resume:
+        exp_name = cfg.experiment or "-".join(
+            [cfg.model_version or cfg.model,
+             os.path.basename(cfg.data.split(":")[0]) or cfg.dataset])
+        # the sharded saver is COLLECTIVE: every rank drives it and all
+        # must agree on the directory, so multi-process sharded runs skip
+        # the auto-increment (a per-rank race) — name runs via --experiment.
+        # --auto-resume equally needs a STABLE directory across relaunches
+        # (the -N increment would "resume" into a fresh empty dir).
+        multiproc_sharded = cfg.ckpt_sharded and jax.process_count() > 1
+        output_dir = get_outdir(cfg.output, exp_name,
+                                inc=not (multiproc_sharded or
+                                         cfg.auto_resume))
+        if multiproc_sharded and rank == 0 and not cfg.resume and \
+                not cfg.auto_resume and \
+                os.path.exists(os.path.join(output_dir, "args.yaml")):
+            # inc=False means a rerun would silently overwrite the
+            # previous run's checkpoints and records.  Rank 0 ONLY: other
+            # ranks would race against rank 0's own args.yaml write of
+            # THIS run; rank 0's failure propagates through the
+            # coordination service
+            raise ValueError(
+                f"{output_dir} already holds a run; multi-process "
+                "--ckpt-sharded disables output-dir auto-increment — "
+                "name this run with --experiment, or --resume it")
+        if rank == 0:
+            with open(os.path.join(output_dir, "args.yaml"), "w") as f:
+                f.write(cfg.to_yaml())
+        if rank == 0 or cfg.ckpt_sharded:
+            decreasing = cfg.eval_metric == "loss"
+            saver_cls = ShardedCheckpointSaver if cfg.ckpt_sharded \
+                else CheckpointSaver
+            saver = saver_cls(
+                checkpoint_dir=output_dir, bak_dir=os.path.join(
+                    output_dir, "_bak"), decreasing=decreasing)
+
+    def _restore_msgpack(path: str, template, load_opt: bool):
+        """msgpack restore into ``template``'s structure AND device layout
+        (shared by --resume, --auto-resume and the guard's rewind path).
+
+        Capture the fresh state's shardings (opt moments / EMA inherited
+        them from the TP'd params via eager zeros_like) so the restored
+        host arrays go back to the same layout, not just the params.
+
+        msgpack restore yields HOST numpy leaves; the compiled train step
+        DONATES its state, and jax's CPU backend zero-copies suitably-
+        aligned host buffers into jax arrays — donating such an alias
+        frees memory numpy still owns, a use-after-free that surfaced as
+        a native SIGSEGV/SIGABRT on the first resumed steps of a tp run.
+        Copy every restored host leaf into a device-OWNED array
+        (re-applying the template's sharding where it had one — restore
+        must also re-lay-out for tp).
+        """
+        from jax.sharding import NamedSharding
+        shard_tree = jax.tree.map(
+            lambda x: x.sharding if isinstance(x, jax.Array)
+            and isinstance(x.sharding, NamedSharding) else None,
+            template)
+        restored, meta_r = restore_train_state(
+            path, template, load_opt=load_opt)
+
+        def _own(leaf, sh):
+            if isinstance(leaf, np.ndarray):
+                leaf = jnp.array(leaf)        # device-owned copy
+            return jax.device_put(leaf, sh) if sh is not None else leaf
+
+        return jax.tree.map(_own, restored, shard_tree), meta_r
+
+    def _restore_any(path: str, template, load_opt: Optional[bool] = None):
+        if load_opt is None:
+            load_opt = not cfg.no_resume_opt
+        if os.path.isdir(path):
             # sharded (Orbax) checkpoint directory: collective restore
-            # directly into the fresh state's shardings — re-layout
+            # directly into the template's shardings — re-layout
             # (incl. a different tp_size) happens inside the read
             from ..train import restore_sharded_checkpoint
-            state, meta = restore_sharded_checkpoint(
-                cfg.resume, state, load_opt=not cfg.no_resume_opt)
-        else:
-            # capture the fresh state's shardings (opt moments / EMA
-            # inherited them from the TP'd params via eager zeros_like) so
-            # the restored host arrays go back to the same layout, not
-            # just the params
-            from jax.sharding import NamedSharding
-            shard_tree = jax.tree.map(
-                lambda x: x.sharding if isinstance(x, jax.Array)
-                and isinstance(x.sharding, NamedSharding) else None,
-                state)
-            state, meta = restore_train_state(
-                cfg.resume, state, load_opt=not cfg.no_resume_opt)
+            return restore_sharded_checkpoint(
+                path, template, load_opt=load_opt)
+        return _restore_msgpack(path, template, load_opt)
 
-            # msgpack restore yields HOST numpy leaves; the compiled train
-            # step DONATES its state, and jax's CPU backend zero-copies
-            # suitably-aligned host buffers into jax arrays — donating such
-            # an alias frees memory numpy still owns, a use-after-free that
-            # surfaced as a native SIGSEGV/SIGABRT on the first resumed
-            # steps of a tp run.  Copy every restored host leaf into a
-            # device-OWNED array (re-applying the fresh state's sharding
-            # where it had one — restore must also re-lay-out for tp).
-            def _own(leaf, sh):
-                if isinstance(leaf, np.ndarray):
-                    leaf = jnp.array(leaf)        # device-owned copy
-                return jax.device_put(leaf, sh) if sh is not None else leaf
+    def _restore_with_fallback(template, load_opt: Optional[bool] = None):
+        """Walk the resume ladder (recovery snapshots newest-first, then
+        the _bak best-copy, then model_best), skipping torn/corrupt files
+        instead of crashing on them.  Returns (state, meta, path) or
+        None."""
+        cands = find_resume_candidates(
+            output_dir, bak_dir=os.path.join(output_dir, "_bak"),
+            sharded=cfg.ckpt_sharded)
+        for path in cands:
+            try:
+                st, meta_r = _restore_any(path, template, load_opt)
+                return st, meta_r, path
+            except (CheckpointCorrupt, FileNotFoundError) as e:
+                _logger.warning("auto-resume: skipping unusable "
+                                "checkpoint %s (%s)", path, e)
+        return None
 
-            state = jax.tree.map(_own, state, shard_tree)
+    resume_batch = 0
+    if cfg.resume:
+        state, meta = _restore_any(cfg.resume, state)
         start_epoch = cfg.start_epoch if cfg.start_epoch is not None \
             else int(meta.get("epoch", -1)) + 1   # helpers.py:47-73
         _logger.info("Resumed from %s (epoch %d)", cfg.resume, start_epoch)
-    if lr_scheduler is not None and start_epoch > 0:
-        state = set_learning_rate(
-            state, lr_scheduler.step(start_epoch))    # train.py:416-417
-
+    if cfg.auto_resume:
+        # newer than any --resume argument when present: a relaunch after
+        # preemption continues from its own recovery snapshot, not the
+        # checkpoint the run was originally seeded from
+        restored = _restore_with_fallback(state)
+        if restored is not None:
+            state, meta_r, path = restored
+            if "batch_idx" in meta_r:
+                # recovery snapshot: exact mid-epoch loop position
+                start_epoch = int(meta_r["epoch"])
+                resume_batch = int(meta_r["batch_idx"]) + 1
+            else:                       # epoch-boundary checkpoint
+                start_epoch = int(meta_r.get("epoch", -1)) + 1
+            _logger.info("Auto-resumed from %s (epoch %d, batch %d)",
+                         path, start_epoch, resume_batch)
+        else:
+            _logger.info("--auto-resume: nothing to resume in %s; "
+                         "starting fresh", output_dir)
     train_ds, eval_ds = build_datasets(cfg, input_size)
     sharding = batch_sharding(mesh)
     # loaders produce the *per-process* slice of the global batch; the device
@@ -326,43 +411,26 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     train_step = make_train_step(
         model, tx, train_loss_fn, mesh=mesh, bn_mode=bn_mode,
         ema_decay=cfg.model_ema_decay if cfg.model_ema else 0.0,
-        clip_grad=cfg.clip_grad, grad_accum=cfg.grad_accum)
+        clip_grad=cfg.clip_grad, grad_accum=cfg.grad_accum,
+        nonfinite_guard=cfg.guard_nonfinite == "skip")
     eval_step = make_eval_step(model, cross_entropy)
     eval_step_ema = make_eval_step(model, cross_entropy, use_ema=True) \
         if cfg.model_ema else None
 
-    # output dir + config dump (reference :785-808, :527-532)
-    output_dir, saver = "", None
-    if rank == 0 or cfg.ckpt_sharded:
-        exp_name = cfg.experiment or "-".join(
-            [cfg.model_version or cfg.model,
-             os.path.basename(cfg.data.split(":")[0]) or cfg.dataset])
-        # the sharded saver is COLLECTIVE: every rank drives it and all
-        # must agree on the directory, so multi-process sharded runs skip
-        # the auto-increment (a per-rank race) — name runs via --experiment
-        multiproc_sharded = cfg.ckpt_sharded and jax.process_count() > 1
-        output_dir = get_outdir(cfg.output, exp_name,
-                                inc=not multiproc_sharded)
-        if multiproc_sharded and rank == 0 and not cfg.resume and \
-                os.path.exists(os.path.join(output_dir, "args.yaml")):
-            # inc=False means a rerun would silently overwrite the
-            # previous run's checkpoints and records.  Rank 0 ONLY: other
-            # ranks would race against rank 0's own args.yaml write of
-            # THIS run; rank 0's failure propagates through the
-            # coordination service
-            raise ValueError(
-                f"{output_dir} already holds a run; multi-process "
-                "--ckpt-sharded disables output-dir auto-increment — "
-                "name this run with --experiment, or --resume it")
-        if rank == 0:
-            with open(os.path.join(output_dir, "args.yaml"), "w") as f:
-                f.write(cfg.to_yaml())
-        decreasing = cfg.eval_metric == "loss"
-        saver_cls = ShardedCheckpointSaver if cfg.ckpt_sharded \
-            else CheckpointSaver
-        saver = saver_cls(
-            checkpoint_dir=output_dir, bak_dir=os.path.join(
-                output_dir, "_bak"), decreasing=decreasing)
+    # a recovery snapshot taken at the LAST batch of an epoch resumes at
+    # the next epoch's first batch
+    if resume_batch >= len(train_loader) > 0:
+        start_epoch += 1
+        resume_batch = 0
+    if lr_scheduler is not None and start_epoch > 0 and resume_batch == 0:
+        # mid-epoch resume keeps the snapshot's injected LR exactly (it
+        # already carries any per-update scheduling); epoch-boundary
+        # resume re-derives it like the reference (train.py:416-417).
+        # Must run AFTER the last-batch normalization above: a snapshot
+        # taken at the final batch of epoch E resumes as (E+1, batch 0)
+        # and needs E+1's LR, not the snapshot's epoch-E value.
+        state = set_learning_rate(
+            state, lr_scheduler.step(start_epoch))
 
     if jax.process_count() > 1:
         # all host-side setup (datasets, eager init, output dir) is done —
@@ -376,49 +444,136 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     meta = {"arch": cfg.model, "version": 2}
     best_metric, best_epoch = None, None
     eval_metrics: Dict[str, float] = {}
+    exit_code: Optional[int] = None
+    resilience = Resilience.from_config(cfg)
     try:
-        for epoch in range(start_epoch, num_epochs):
-            train_loader.set_epoch(epoch)          # reference :549
-            epoch_rng = jax.random.fold_in(rng, epoch)
-            state, train_metrics = train_one_epoch(
-                epoch, train_step, state, train_loader, cfg, epoch_rng,
-                lr_scheduler=lr_scheduler, saver=saver,
-                output_dir=output_dir, meta=meta, world_size=n_dev)
+        with resilience:
+            epoch = start_epoch
+            while epoch < num_epochs:
+                train_loader.set_epoch(epoch)      # reference :549
+                if resume_batch:
+                    train_loader.fast_forward(resume_batch)
+                epoch_rng = jax.random.fold_in(rng, epoch)
+                # note, not heartbeat: a beat here would end the watchdog's
+                # first-compile grace window before the first step compiles
+                resilience.note(f"epoch {epoch} start "
+                                f"(batch {resume_batch})")
+                try:
+                    state, train_metrics = train_one_epoch(
+                        epoch, train_step, state, train_loader, cfg,
+                        epoch_rng, lr_scheduler=lr_scheduler, saver=saver,
+                        output_dir=output_dir, meta=meta, world_size=n_dev,
+                        start_batch=resume_batch, resilience=resilience)
+                except RewindRequested as e:
+                    # K consecutive bad steps: continuing would train on
+                    # (or EMA-blend in) corrupted state — reload the last
+                    # good snapshot and fast-forward back to position.
+                    # Deterministic on every host (the verdict is a pure
+                    # function of replicated scalars), so collective
+                    # restores stay in lockstep.
+                    if jax.process_count() > 1 and not (
+                            cfg.ckpt_sharded or cfg.auto_resume):
+                        # rank != 0 has no output_dir on this layout
+                        # (inc=True names are rank-0-local), so a per-rank
+                        # restore would diverge — one rank reloading while
+                        # others error is a guaranteed collective hang.
+                        # The config-derived condition is identical on
+                        # every host: ALL ranks abort in lockstep instead.
+                        raise RuntimeError(
+                            "guard rewind on a multi-process run needs a "
+                            "rank-agnostic run dir: relaunch with "
+                            "--auto-resume (+--experiment) or "
+                            "--ckpt-sharded") from e
+                    resilience.start_rewind(str(e))  # raises budget-spent
+                    # load_opt=True always: a rewind restores the run's OWN
+                    # snapshot (--no-resume-opt governs seeding from a
+                    # foreign checkpoint), and the --no-resume-opt
+                    # substitution would copy opt/step leaves out of the
+                    # template — here the epoch-entry state, whose buffers
+                    # the donating train step already deleted
+                    restored = _restore_with_fallback(state, load_opt=True)
+                    if restored is None:
+                        raise RuntimeError(
+                            "rewind requested but no loadable recovery "
+                            "snapshot exists — enable --recovery-interval "
+                            "so the guard has somewhere to rewind to"
+                        ) from e
+                    state, meta_r, path = restored
+                    _logger.warning("rewound to %s", path)
+                    if "batch_idx" in meta_r:
+                        epoch = int(meta_r["epoch"])
+                        resume_batch = int(meta_r["batch_idx"]) + 1
+                        if resume_batch >= len(train_loader):
+                            epoch += 1
+                            resume_batch = 0
+                    else:
+                        epoch = int(meta_r.get("epoch", -1)) + 1
+                        resume_batch = 0
+                    if lr_scheduler is not None and resume_batch == 0 \
+                            and epoch > 0:
+                        # same rule as the startup resume path: an
+                        # epoch-boundary re-entry re-derives the LR
+                        state = set_learning_rate(
+                            state, lr_scheduler.step(epoch))
+                    continue
+                resume_batch = 0
 
-            eval_metrics = validate(eval_step, state, eval_loader, cfg)
-            if eval_step_ema is not None:
-                # EMA eval *replaces* the metrics (reference :563-569)
-                eval_metrics = validate(eval_step_ema, state, eval_loader,
-                                        cfg, log_suffix=" (EMA)")
+                eval_metrics = validate(eval_step, state, eval_loader, cfg,
+                                        resilience=resilience)
+                if eval_step_ema is not None:
+                    # EMA eval *replaces* the metrics (reference :563-569)
+                    eval_metrics = validate(eval_step_ema, state,
+                                            eval_loader, cfg,
+                                            log_suffix=" (EMA)",
+                                            resilience=resilience)
 
-            if lr_scheduler is not None:
-                new_lr = lr_scheduler.step(
-                    epoch + 1, eval_metrics[cfg.eval_metric])  # :571-573
-                state = set_learning_rate(state, new_lr)
+                if lr_scheduler is not None:
+                    new_lr = lr_scheduler.step(
+                        epoch + 1, eval_metrics[cfg.eval_metric])  # :571-573
+                    state = set_learning_rate(state, new_lr)
 
-            if output_dir and rank == 0:
-                update_summary(epoch, train_metrics, eval_metrics,
-                               os.path.join(output_dir, "summary.csv"),
-                               os.path.join(output_dir, "plots"),
-                               write_header=epoch == start_epoch)
-            # sharded saver: the collective save IS the cross-host path —
-            # no gather. Otherwise multi-host TP/EP: every rank gathers
-            # model-sharded leaves so rank 0 can serialize; no-op else
-            collective = saver is not None and saver.collective
-            save_state = replicate_for_save(state) \
-                if jax.process_count() > 1 and not collective else state
-            if saver is not None:
-                best_metric, best_epoch = saver.save_checkpoint(
-                    save_state, meta, epoch,
-                    metric=eval_metrics[cfg.eval_metric])
+                if output_dir and rank == 0:
+                    csv_path = os.path.join(output_dir, "summary.csv")
+                    # header iff the file doesn't exist yet: an epoch
+                    # counter (the old rule) or a process-local flag would
+                    # append a second header mid-file on every auto-resume
+                    # relaunch, corrupting the CSV for plot_csv/pandas
+                    update_summary(epoch, train_metrics, eval_metrics,
+                                   csv_path,
+                                   os.path.join(output_dir, "plots"),
+                                   write_header=not os.path.exists(csv_path))
+                # sharded saver: the collective save IS the cross-host path
+                # — no gather. Otherwise multi-host TP/EP: every rank
+                # gathers model-sharded leaves so rank 0 can serialize;
+                # no-op else
+                collective = saver is not None and saver.collective
+                save_state = replicate_for_save(state) \
+                    if jax.process_count() > 1 and not collective else state
+                if saver is not None:
+                    best_metric, best_epoch = saver.save_checkpoint(
+                        save_state, meta, epoch,
+                        metric=eval_metrics[cfg.eval_metric])
+                resilience.heartbeat(f"epoch {epoch} done")
+                epoch += 1
+    except Preempted as e:
+        # the recovery snapshot is already on disk (written synchronously
+        # at the step boundary); exit with the distinct preemption code so
+        # scripts/train.sh's restart wrapper relaunches into --auto-resume
+        _logger.warning("%s — exiting with code %d", e, EXIT_PREEMPTED)
+        exit_code = EXIT_PREEMPTED
     except KeyboardInterrupt:                      # reference :588
         pass
     finally:
         # shm-backend loaders own worker processes + a shared-memory
-        # segment; release them even on interrupt (thread backend: no-op)
+        # segment; release them even on interrupt (thread backend: no-op),
+        # and flush any in-flight async recovery write on EVERY exit path
+        # — flushing after this block skipped it on exceptions, silently
+        # discarding the newest snapshot
         train_loader.close()
         eval_loader.close()
-    wait_pending_saves()            # flush any in-flight recovery write
+        wait_pending_saves()
+    if exit_code is not None:
+        raise SystemExit(exit_code)
     if best_metric is not None:
         _logger.info("*** Best metric: %s (epoch %s)", best_metric,
                      best_epoch)

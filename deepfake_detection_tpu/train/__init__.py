@@ -1,10 +1,15 @@
-"""Training runtime: state, jitted steps, checkpointing, epoch loops."""
+"""Training runtime: state, jitted steps, checkpointing, epoch loops,
+fault tolerance."""
 
-from .checkpoint import (CheckpointSaver, ShardedCheckpointSaver,
+from .checkpoint import (CheckpointCorrupt, CheckpointSaver,
+                         ShardedCheckpointSaver, find_resume_candidates,
                          load_checkpoint_file, replicate_for_save,
                          restore_sharded_checkpoint, restore_train_state,
                          save_checkpoint_file, save_sharded_checkpoint,
                          wait_pending_saves)
+from .resilience import (EXIT_PREEMPTED, EXIT_WATCHDOG, AnomalyGuard,
+                         Preempted, Resilience, RewindRequested,
+                         StallWatchdog)
 from .state import (TrainState, create_train_state, get_learning_rate,
                     set_learning_rate)
 from .steps import make_eval_step, make_train_step

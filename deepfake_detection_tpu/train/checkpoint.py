@@ -33,13 +33,26 @@ from flax import serialization
 
 _logger = logging.getLogger(__name__)
 
-__all__ = ["CheckpointSaver", "ShardedCheckpointSaver",
+__all__ = ["CheckpointSaver", "ShardedCheckpointSaver", "CheckpointCorrupt",
            "save_checkpoint_file", "load_checkpoint_file",
            "replicate_for_save", "restore_train_state", "wait_pending_saves",
            "save_sharded_checkpoint", "restore_sharded_checkpoint",
-           "load_sharded_for_eval"]
+           "load_sharded_for_eval", "find_resume_candidates"]
 
 _EXT = ".ckpt"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint file exists but cannot be decoded (truncated write,
+    torn copy, disk corruption).  Carries the offending path so callers
+    can fall back to an older snapshot instead of crashing."""
+
+    def __init__(self, path: str, cause: str):
+        super().__init__(
+            f"checkpoint {path} is corrupt or truncated ({cause}); "
+            "if this was a recovery snapshot, --auto-resume falls back "
+            "to the previous one automatically")
+        self.path = path
 
 
 def _recovery_key(path: str):
@@ -55,7 +68,7 @@ def _needs_gather(x: Any) -> bool:
         and not x.is_fully_replicated
 
 
-def _to_host(x: Any) -> np.ndarray:
+def _to_host(x: Any, copy: bool = False) -> np.ndarray:
     """Fetch a (possibly sharded) array to host numpy.
 
     Fully-replicated and fully-addressable arrays convert directly (the
@@ -64,13 +77,27 @@ def _to_host(x: Any) -> np.ndarray:
     would need a collective gather that every process enters; the saver runs
     on rank 0 only, so raise with the remedy instead of deadlocking in a
     one-sided all-gather.
+
+    ``copy=True`` guarantees the result OWNS its bytes.  On the CPU
+    backend ``np.asarray(jax.Array)`` is a zero-copy VIEW of the device
+    buffer — and the train step DONATES its state, so XLA reuses that
+    buffer for later steps' outputs and intermediates.  A background
+    checkpoint writer serializing such a view races the hot loop and
+    produces a silently TORN snapshot (observed: step counter from N steps
+    later, params overwritten with unrelated intermediates).  Owning the
+    bytes before handing them to the writer thread is the fix; backends
+    whose fetch already materializes fresh host memory (TPU/GPU) skip the
+    second copy via the ownership check.
     """
     if _needs_gather(x):
         raise RuntimeError(
             "checkpoint save of a multi-host model-sharded array: call "
             "replicate_for_save(state) on ALL processes before saving "
             "(rank-0-only saving cannot enter a collective)")
-    return np.asarray(x)
+    a = np.asarray(x)
+    if copy and not a.flags["OWNDATA"]:
+        a = a.copy()
+    return a
 
 
 def replicate_for_save(state: Any) -> Any:
@@ -156,7 +183,11 @@ def save_checkpoint_file(path: str, state: Any,
                 x.copy_to_host_async()
             except Exception:  # noqa: BLE001 — _to_host surfaces real errors
                 pass
-    sd = jax.tree.map(_to_host, sd_dev)
+    # async: the background writer must own its bytes (zero-copy views of
+    # donated buffers tear — see _to_host); sync serializes before the
+    # caller can dispatch another donating step, so views are safe
+    sd = jax.tree.map(
+        functools.partial(_to_host, copy=async_write), sd_dev)
     meta = stamp_qkv_layout(meta, sd)  # meta stays plain python
     payload = {"state": sd, "meta": meta}
 
@@ -174,10 +205,25 @@ def save_checkpoint_file(path: str, state: Any,
 
 
 def load_checkpoint_file(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
-    """Read a raw {state_dict, meta} pair."""
+    """Read a raw {state_dict, meta} pair.
+
+    A truncated or undecodable file raises :class:`CheckpointCorrupt`
+    naming the file — a msgpack stream cut mid-write otherwise surfaces as
+    an opaque unpacker exception deep inside flax, and the distinction
+    matters: corrupt means "fall back to an older snapshot", not "bug".
+    """
     wait_pending_saves()
     with open(path, "rb") as f:
-        payload = serialization.msgpack_restore(f.read())
+        blob = f.read()
+    if not blob:
+        raise CheckpointCorrupt(path, "empty file")
+    try:
+        payload = serialization.msgpack_restore(blob)
+    except Exception as e:  # msgpack raises several unpacker classes
+        raise CheckpointCorrupt(path, f"msgpack decode failed: {e!r}") \
+            from e
+    if not isinstance(payload, dict) or "state" not in payload:
+        raise CheckpointCorrupt(path, "payload missing 'state'")
     sd, meta = payload["state"], payload.get("meta", {})
     from ..models.helpers import check_qkv_layout
     check_qkv_layout(sd, meta, path)
@@ -417,6 +463,47 @@ def load_sharded_for_eval(path: str, variables: Dict[str, Any],
     return out
 
 
+def find_resume_candidates(checkpoint_dir: str, bak_dir: str = "",
+                           sharded: bool = False,
+                           recovery_prefix: str = "recovery") -> List[str]:
+    """Paths ``--auto-resume`` should try, best first: recovery snapshots
+    newest-first, then the ``_bak`` best-copy mirror, then ``model_best``
+    itself.  A torn newest snapshot (:class:`CheckpointCorrupt`) makes the
+    caller step down this list instead of crashing.
+
+    Standalone (no saver needed) so every rank of a multi-host run can
+    compute the same list from the shared filesystem.  ``sharded``
+    restricts to COMPLETE Orbax checkpoint directories (dfd_meta.json is
+    written last, so its presence marks completion).
+    """
+    out: List[str] = []
+    if sharded:
+        cands = [c for c in glob.glob(os.path.join(checkpoint_dir,
+                                                   recovery_prefix + "*"))
+                 if os.path.isfile(os.path.join(c, "dfd_meta.json"))]
+        out.extend(sorted(cands, key=_recovery_key, reverse=True))
+        best_ptr = os.path.join(checkpoint_dir, "model_best.json")
+        if os.path.isfile(best_ptr):
+            import json
+            try:
+                with open(best_ptr) as f:
+                    best = json.load(f).get("checkpoint", "")
+            except (OSError, ValueError):
+                best = ""
+            if best and os.path.isfile(os.path.join(best, "dfd_meta.json")):
+                out.append(best)
+        return out
+    out.extend(sorted(
+        glob.glob(os.path.join(checkpoint_dir,
+                               recovery_prefix + "*" + _EXT)),
+        key=_recovery_key, reverse=True))
+    for d in (bak_dir, checkpoint_dir):
+        best = os.path.join(d, "model_best" + _EXT) if d else ""
+        if best and os.path.isfile(best):
+            out.append(best)
+    return out
+
+
 def restore_train_state(path: str, target_state: Any,
                         load_opt: bool = True) -> Tuple[Any, Dict[str, Any]]:
     """Rebuild a TrainState from file given a freshly-built template.
@@ -512,14 +599,18 @@ class CheckpointSaver:
 
     # ------------------------------------------------------------------
     def save_recovery(self, state: Any, meta: Dict[str, Any], epoch: int,
-                      batch_idx: int = 0) -> None:
+                      batch_idx: int = 0, sync: bool = False) -> None:
         """In-epoch recovery snapshot, previous one removed (reference
-        :128-140)."""
+        :128-140).  ``sync=True`` blocks until the file is durably renamed
+        into place — the preemption path needs the snapshot ON DISK before
+        the process exits, not queued on a background writer the exit
+        would race."""
         path = os.path.join(
             self.recovery_dir,
             f"{self.recovery_prefix}-{epoch}-{batch_idx}{self._ext}")
         self._write_recovery(path, state, dict(meta, epoch=epoch,
-                                               batch_idx=batch_idx))
+                                               batch_idx=batch_idx),
+                             sync=sync)
         if os.path.exists(self.last_recovery_file):
             try:
                 _logger.debug("Cleaning recovery: %s",
@@ -544,8 +635,8 @@ class CheckpointSaver:
         save_checkpoint_file(path, state, meta)
 
     def _write_recovery(self, path: str, state: Any,
-                        meta: Dict[str, Any]) -> None:
-        save_checkpoint_file(path, state, meta, async_write=True)
+                        meta: Dict[str, Any], sync: bool = False) -> None:
+        save_checkpoint_file(path, state, meta, async_write=not sync)
 
     def _delete(self, path: str) -> None:
         os.remove(path)
@@ -573,7 +664,10 @@ class ShardedCheckpointSaver(CheckpointSaver):
     def _write(self, path: str, state: Any, meta: Dict[str, Any]) -> None:
         save_sharded_checkpoint(path, state, meta)
 
-    _write_recovery = _write
+    def _write_recovery(self, path: str, state: Any,
+                        meta: Dict[str, Any], sync: bool = False) -> None:
+        # a collective save cannot ride a background thread; always sync
+        save_sharded_checkpoint(path, state, meta)
 
     def _delete(self, path: str) -> None:
         if jax.process_index() == 0:

@@ -59,7 +59,8 @@ def make_train_step(model, tx: optax.GradientTransformation,
                     bn_mode: str = "local", ema_decay: float = 0.0,
                     clip_grad: Optional[float] = None,
                     grad_accum: int = 1,
-                    donate: bool = True) -> Callable:
+                    donate: bool = True,
+                    nonfinite_guard: bool = False) -> Callable:
     """Build ``train_step(state, x, y, rng) -> (state, metrics)``.
 
     ``x`` is the (globally) batch-sharded NHWC input, ``y`` int labels or
@@ -73,6 +74,15 @@ def make_train_step(model, tx: optax.GradientTransformation,
     TPU lever for the flagship 600²×12 config on few chips).  BN stats
     thread through the scan (each microbatch updates the running stats,
     like sequential smaller steps would).
+
+    ``nonfinite_guard`` adds a device-side all-finite check on the loss and
+    the global grad-norm: a bad step SELECTS the previous state (params,
+    BN stats, optimizer moments, EMA, step counter all unchanged — a skip,
+    not a zero-grad update, since NaN grads would still poison Adam/RMSProp
+    moments through ``tx.update``) and reports ``metrics['nonfinite']`` = 1.
+    One scalar flag rides the existing metrics fetch — no extra host syncs.
+    The reference *meter* dropped NaN losses while the poisoned update was
+    applied anyway (the exact failure this guard closes).
     """
     assert bn_mode in ("local", "global"), bn_mode
     assert grad_accum >= 1
@@ -139,7 +149,21 @@ def make_train_step(model, tx: optax.GradientTransformation,
         new_state = state.replace(step=state.step + 1, params=params,
                                   batch_stats=new_stats, opt_state=opt_state,
                                   ema=ema)
-        return new_state, {"loss": loss, "prec1": prec1}
+        metrics = {"loss": loss, "prec1": prec1}
+        if nonfinite_guard:
+            # the clipped-grad norm: clipping rescales by a finite factor
+            # (or NaN-propagates), so finiteness is unchanged vs raw grads
+            # and the norm is reused-shape-wise from the clip when present
+            gnorm = optax.global_norm(grads)
+            ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+            # scalar-pred select per leaf: cheap (one fused select each)
+            # and total — moments, EMA, BN stats and the step counter all
+            # roll back together, leaving the state exactly pre-step
+            new_state = jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o), new_state, state)
+            metrics["nonfinite"] = (~ok).astype(jnp.float32)
+            metrics["gnorm"] = gnorm
+        return new_state, metrics
 
     if bn_mode == "global" or mesh is None:
         def step(state: TrainState, x, y, rng):

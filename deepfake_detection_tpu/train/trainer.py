@@ -14,14 +14,17 @@ from __future__ import annotations
 
 import logging
 import os
+import signal
 import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..utils.metrics import AverageMeter, auc
+from .resilience import Preempted
 from .state import TrainState, get_learning_rate, set_learning_rate
 
 _logger = logging.getLogger(__name__)
@@ -54,11 +57,24 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                     loader, cfg, rng: jax.Array,
                     lr_scheduler=None, saver=None, output_dir: str = "",
                     meta: Optional[Dict[str, Any]] = None,
-                    world_size: int = 1):
+                    world_size: int = 1, start_batch: int = 0,
+                    resilience=None):
     """One epoch of the hot loop.  Returns ``(state, metrics)``.
 
     ``world_size`` is the data-parallel degree; s/image in the log line is
     per-device (the reference's ``bs`` is the per-GPU batch, train.py:658).
+
+    ``start_batch`` > 0 resumes MID-epoch: the caller has already
+    fast-forwarded the loader to that batch (loaders are deterministic in
+    ``(seed, epoch, batch_index)``, so the stream is bit-identical to an
+    uninterrupted epoch) and this loop restores the absolute batch index /
+    update count so step RNG folding and LR scheduling continue exactly.
+
+    ``resilience`` (train/resilience.py) hooks the loop into the fault-
+    tolerance layer: per-step watchdog heartbeats, the preemption stop
+    check at step boundaries (synchronous recovery snapshot + ``Preempted``),
+    the NaN/spike guard fed at drain cadence (may raise ``RewindRequested``),
+    and the env-gated chaos injection points the recovery tests drive.
     """
     if cfg.mixup > 0 and hasattr(loader, "mixup_enabled"):
         if cfg.mixup_off_epoch and epoch >= cfg.mixup_off_epoch:
@@ -70,8 +86,12 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
     end = time.time()
     num_batches = len(loader)
     last_idx = num_batches - 1
-    num_updates = epoch * num_batches
+    num_updates = epoch * num_batches + start_batch
+    nonfinite_total = 0
     lr = get_learning_rate(state)
+    chaos = getattr(resilience, "chaos", None)
+    if chaos is not None and not chaos.active:
+        chaos = None
 
     # jax.profiler window (SURVEY §5: the reference has no profiler; an MFU
     # target can't be tuned blind).  Steps [start, start+N) of epoch 0 are
@@ -92,16 +112,33 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
     # scheduler sees a loss avg that is up to log_interval steps stale.
     pending: list = []
     step_exec = None       # multi-process: AOT executable (_compile_aligned)
+    first_step = True
 
     def _drain() -> None:
-        for m, n in pending:
+        nonlocal nonfinite_total
+        for m, n, step_i in pending:
             loss_value = float(m["loss"])     # host sync, log steps only
-            if not np.isnan(loss_value):
+            # the device-side guard flag (loss OR grad-norm non-finite)
+            # rides the same fetch; absent when the guard is off
+            bad = not np.isfinite(loss_value)
+            if "nonfinite" in m:
+                bad = bad or float(m["nonfinite"]) > 0
+            if bad:
+                nonfinite_total += 1
+                _logger.warning(
+                    "non-finite training step at update %d (loss %r%s)",
+                    step_i, loss_value,
+                    "; update skipped" if "nonfinite" in m else
+                    "; UPDATE APPLIED (guard off)")
+            else:
                 losses_m.update(loss_value, n)
             prec1_m.update(float(m["prec1"]), n)
+            if resilience is not None:
+                # may raise RewindRequested after K consecutive bad steps
+                resilience.observe_step(step_i, loss_value, bad)
         pending.clear()
 
-    for batch_idx, batch in enumerate(loader):
+    for batch_idx, batch in enumerate(loader, start=start_batch):
         x, y = batch[0], batch[1]
         last_batch = batch_idx == last_idx
         data_time_m.update(time.time() - end)
@@ -110,10 +147,19 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
             jax.profiler.start_trace(os.path.join(output_dir, "profile"))
             profiling = True
 
+        if chaos is not None and chaos.fires("nanbatch", num_updates):
+            # poisoned input → non-finite loss AND grads inside the jitted
+            # step (same shape/dtype: no recompile) — exercises the
+            # device-side skip and, in a burst, the rewind path
+            _logger.warning("chaos: poisoning batch at update %d",
+                            num_updates)
+            x = jnp.full_like(x, np.nan)
+
         step_rng = jax.random.fold_in(rng, num_updates)
-        if batch_idx == 0 and step_exec is None:
+        if first_step and step_exec is None:
             step_exec = _compile_aligned(train_step, "train_step",
                                          state, x, y, step_rng)
+        first_step = False
         state, metrics = (step_exec or train_step)(state, x, y, step_rng)
 
         if profiling and (batch_idx + 1 >= profile_start + profile_n
@@ -126,7 +172,7 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
 
         bs = x.shape[0]     # GLOBAL batch: the loader assembles the global
         # sharded array even multi-host (parallel/sharding.py:69-80)
-        pending.append((metrics, bs))
+        pending.append((metrics, bs, num_updates))
         num_updates += 1
 
         if last_batch or batch_idx % cfg.log_interval == 0:
@@ -155,20 +201,12 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
 
         if cfg.recovery_interval and (
                 last_batch or (batch_idx + 1) % cfg.recovery_interval == 0):
-            # EVERY rank computes this condition. Collective (sharded)
-            # saver: the save itself is the cross-host path — all ranks
-            # drive it, no gather. Otherwise every rank enters the gather
-            # and only rank 0 (the one holding a saver) writes.
-            if saver is not None and saver.collective:
-                saver.save_recovery(state, meta or {}, epoch,
-                                    batch_idx=batch_idx)
-            else:
-                from .checkpoint import replicate_for_save
-                save_state = replicate_for_save(state) \
-                    if jax.process_count() > 1 else state
-                if saver is not None:
-                    saver.save_recovery(save_state, meta or {}, epoch,
-                                        batch_idx=batch_idx)  # ref :686-689
+            _save_recovery(saver, state, meta, epoch, batch_idx,
+                           num_updates)                     # ref :686-689
+
+        if chaos is not None and saver is not None and \
+                chaos.fires("truncate_ckpt", num_updates):
+            _chaos_truncate(saver.curr_recovery_file or saver.find_recovery())
 
         if lr_scheduler is not None:
             # no stock schedule consumes a per-update metric (plateau is
@@ -183,17 +221,89 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                                               metric=metric)
             if new_lr is not None and new_lr != lr:
                 state = set_learning_rate(state, new_lr)
+
+        if resilience is not None:
+            resilience.heartbeat(f"epoch {epoch} batch {batch_idx}/"
+                                 f"{num_batches} update {num_updates}")
+            if chaos is not None and chaos.fires("sigterm", num_updates):
+                _logger.warning("chaos: delivering SIGTERM to self at "
+                                "update %d", num_updates)
+                os.kill(os.getpid(), signal.SIGTERM)
+            if resilience.stop_requested:
+                # stop at THIS step boundary: drain buffered metrics (a
+                # host sync, so the state below is the post-step state),
+                # write a SYNCHRONOUS recovery snapshot carrying the exact
+                # loop position, and unwind — the runner exits with the
+                # preemption code so a wrapper can relaunch --auto-resume
+                _drain()
+                if jax.process_count() == 1:
+                    _save_recovery(saver, state, meta, epoch, batch_idx,
+                                   num_updates, sync=True)
+                else:
+                    # the stop flag is HOST-LOCAL: both save paths are
+                    # cross-host lockstep operations (the rank-0 gather,
+                    # or the collective Orbax write), and hosts observe
+                    # their signals at different step boundaries —
+                    # entering either one-sided deadlocks.  Rely on the
+                    # periodic snapshots (ROADMAP: cross-host
+                    # coordinated stop)
+                    _logger.warning(
+                        "multi-host preemption: skipping the in-band "
+                        "snapshot (host-local stop flag cannot drive a "
+                        "lockstep save); auto-resume will use the last "
+                        "periodic recovery checkpoint")
+                raise Preempted(epoch, batch_idx, resilience.stop_signum)
         end = time.time()
 
     return state, OrderedDict([("loss", losses_m.avg),
                                ("prec1", prec1_m.avg),
-                               ("learning_rate", lr)])
+                               ("learning_rate", lr),
+                               ("nonfinite", nonfinite_total)])
+
+
+def _save_recovery(saver, state, meta, epoch: int, batch_idx: int,
+                   num_updates: int, sync: bool = False) -> None:
+    """In-epoch recovery snapshot with exact loop position in the meta.
+
+    EVERY rank calls this. Collective (sharded) saver: the save itself is
+    the cross-host path — all ranks drive it, no gather. Otherwise every
+    rank enters the gather and only rank 0 (the one holding a saver)
+    writes.  ``num_updates`` is the update count AFTER ``batch_idx``
+    completed, i.e. the value to continue with at ``batch_idx + 1``.
+    """
+    meta = dict(meta or {}, num_updates=num_updates)
+    if saver is not None and saver.collective:
+        saver.save_recovery(state, meta, epoch, batch_idx=batch_idx)
+    else:
+        from .checkpoint import replicate_for_save
+        save_state = replicate_for_save(state) \
+            if jax.process_count() > 1 else state
+        if saver is not None:
+            saver.save_recovery(save_state, meta, epoch,
+                                batch_idx=batch_idx, sync=sync)
+
+
+def _chaos_truncate(path: str) -> None:
+    """Chaos point: tear the newest recovery file in half, as a crash mid
+    ``os.replace``-less write would (exercises the CheckpointCorrupt
+    fallback chain in --auto-resume)."""
+    from .checkpoint import wait_pending_saves
+    wait_pending_saves()            # the async write must have landed
+    if not path or not os.path.exists(path):
+        return
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+    _logger.warning("chaos: truncated checkpoint %s (%d -> %d bytes)",
+                    path, size, max(size // 2, 1))
 
 
 def validate(eval_step: Callable, state: TrainState, loader, cfg,
-             log_suffix: str = "") -> "OrderedDict[str, float]":
+             log_suffix: str = "", resilience=None
+             ) -> "OrderedDict[str, float]":
     """Full-dataset eval (reference validate, train.py:703-767), exact thanks
-    to the validity mask on padded batches."""
+    to the validity mask on padded batches.  ``resilience`` keeps the stall
+    watchdog fed during eval (eval batches are its step completions here)."""
     batch_time_m = AverageMeter()
     losses_m, prec1_m = AverageMeter(), AverageMeter()
     all_scores, all_labels, all_valid = [], [], []
@@ -227,6 +337,8 @@ def validate(eval_step: Callable, state: TrainState, loader, cfg,
                              else _host_local_rows(valid)
                              .astype(np.float32).reshape(-1))
         batch_time_m.update(time.time() - end)
+        if resilience is not None:
+            resilience.heartbeat(f"eval batch {batch_idx}/{num_batches}")
         if batch_idx == last_idx or batch_idx % cfg.log_interval == 0:
             _logger.info(
                 "%s: [%4d/%d] Time:%.3f(%.3f) "

@@ -1,0 +1,446 @@
+"""Fault-tolerance layer units (train/resilience.py, chaos.py, and the
+checkpoint/loader/step hooks it rides on).
+
+End-to-end injected-fault runs live in test_chaos_e2e.py; this file covers
+the pieces in isolation: chaos-spec parsing, the anomaly guard's robust
+spike statistics and rewind streak, the watchdog's fire/beat behavior, the
+device-side non-finite skip, torn-checkpoint errors + the resume-candidate
+ladder, and bit-exact loader fast-forward for both host backends.
+"""
+
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepfake_detection_tpu.chaos import ChaosInjector, chaos_from_env
+from deepfake_detection_tpu.train.resilience import (
+    EXIT_WATCHDOG, AnomalyGuard, PreemptionHandler, RewindRequested,
+    StallWatchdog)
+
+pytestmark = pytest.mark.smoke
+
+
+# ---------------------------------------------------------------------------
+# chaos spec
+# ---------------------------------------------------------------------------
+
+class TestChaosInjector:
+    def test_parse_forms(self):
+        c = ChaosInjector("sigterm@8,nanbatch@5x3,stall_loader@3:30.5")
+        assert c.points["sigterm"] == (8, 1, None)
+        assert c.points["nanbatch"] == (5, 3, None)
+        assert c.points["stall_loader"] == (3, 1, 30.5)
+        assert c.arg("stall_loader") == 30.5
+        assert c.arg("sigterm", 7.0) == 7.0
+
+    def test_fire_once_per_step_in_window(self):
+        c = ChaosInjector("nanbatch@5x3")
+        assert not c.fires("nanbatch", 4)
+        assert c.fires("nanbatch", 5) and c.fires("nanbatch", 6) \
+            and c.fires("nanbatch", 7)
+        # re-executed steps after a rewind see clean data
+        assert not any(c.fires("nanbatch", s) for s in (5, 6, 7, 8))
+        assert not c.fires("other", 5)
+
+    def test_empty_inactive_and_env(self, monkeypatch):
+        assert not ChaosInjector("").active
+        monkeypatch.delenv("DFD_CHAOS", raising=False)
+        assert not chaos_from_env().active
+        monkeypatch.setenv("DFD_CHAOS", "sigterm@2")
+        assert chaos_from_env().fires("sigterm", 2)
+
+    def test_bad_spec_raises(self):
+        with pytest.raises(ValueError):
+            ChaosInjector("sigterm")
+        with pytest.raises(ValueError):
+            ChaosInjector("@5")
+
+
+# ---------------------------------------------------------------------------
+# anomaly guard
+# ---------------------------------------------------------------------------
+
+class TestAnomalyGuard:
+    def test_spike_detection_robust(self):
+        g = AnomalyGuard(spike_window=8, spike_zmax=6.0, rewind_after=99)
+        for i in range(8):
+            assert not g.observe(i, 1.0 + 0.01 * (i % 3), False)
+        assert g.observe(8, 40.0, False)        # spike
+        assert g.spike_total == 1
+        # the spike did NOT enter the rolling stats: baseline unchanged
+        assert not g.observe(9, 1.01, False)
+        assert g.bad_streak == 0
+
+    def test_window_not_full_never_spikes(self):
+        g = AnomalyGuard(spike_window=16, spike_zmax=6.0)
+        assert not g.observe(0, 1.0, False)
+        assert not g.observe(1, 1e9, False)      # only 1 sample of history
+
+    def test_rewind_after_consecutive_bad(self):
+        g = AnomalyGuard(rewind_after=3)
+        assert g.observe(0, float("nan"), False)
+        assert g.observe(1, 1.0, True)           # device flag counts too
+        with pytest.raises(RewindRequested):
+            g.observe(2, float("inf"), False)
+        assert g.nonfinite_total == 3
+        g.reset_streak()
+        assert not g.observe(3, 1.0, False)
+
+    def test_isolated_bad_steps_only_count(self):
+        g = AnomalyGuard(rewind_after=2)
+        for i in range(6):
+            g.observe(2 * i, float("nan"), False)
+            assert not g.observe(2 * i + 1, 1.0, False)
+        assert g.nonfinite_total == 6
+
+
+# ---------------------------------------------------------------------------
+# watchdog + preemption handler
+# ---------------------------------------------------------------------------
+
+class TestStallWatchdog:
+    def test_fires_with_position_and_code(self, capfd):
+        # capfd, not capsys: faulthandler dumps to the stderr FD
+        fired = []
+        w = StallWatchdog(0.2, position_fn=lambda: "epoch 3 batch 7",
+                          exit_fn=fired.append)
+        w.start()
+        w.beat()                # past the first-compile grace window
+        time.sleep(1.0)
+        w.stop()
+        assert fired == [EXIT_WATCHDOG]
+        err = capfd.readouterr().err
+        assert "epoch 3 batch 7" in err
+        assert "Thread" in err                  # faulthandler stack dump
+
+    def test_first_window_has_compile_grace(self):
+        # before the first beat the window is first_grace x timeout, so a
+        # watchdog sized to step time survives first-step compilation
+        fired = []
+        w = StallWatchdog(0.15, exit_fn=fired.append, first_grace=10.0)
+        w.start()
+        time.sleep(0.8)         # > timeout, < first_grace * timeout
+        assert fired == []
+        w.beat()
+        time.sleep(0.8)         # > timeout after a beat: fires
+        w.stop()
+        assert fired == [EXIT_WATCHDOG]
+
+    def test_beats_prevent_fire(self):
+        fired = []
+        w = StallWatchdog(0.4, exit_fn=fired.append)
+        w.start()
+        for _ in range(6):
+            time.sleep(0.1)
+            w.beat()
+        w.stop()
+        assert fired == []
+
+    def test_disabled_never_starts(self):
+        w = StallWatchdog(0.0, exit_fn=lambda c: (_ for _ in ()).throw(
+            AssertionError("must not fire")))
+        w.start()
+        assert w._thread is None
+        w.stop()
+
+    def test_resilience_note_updates_position_without_beating(self):
+        # the runner's epoch-start marker must NOT count as a beat, or
+        # it would end the first-compile grace window before the first
+        # train step's compile — exactly what the grace exists to cover
+        from deepfake_detection_tpu.train.resilience import Resilience
+        w = StallWatchdog(60.0, exit_fn=lambda c: None)
+        r = Resilience(watchdog=w)
+        r.note("epoch 0 start (batch 0)")
+        assert r.position == "epoch 0 start (batch 0)"
+        assert not w._seen_beat
+        r.heartbeat("epoch 0 batch 1/10")
+        assert w._seen_beat
+
+
+def test_preemption_handler_flag_and_restore():
+    h = PreemptionHandler(signals=(signal.SIGUSR1,))
+    before = signal.getsignal(signal.SIGUSR1)
+    assert h.install()
+    try:
+        assert not h.stop_requested
+        signal.raise_signal(signal.SIGUSR1)
+        assert h.stop_requested and h.signum == signal.SIGUSR1
+    finally:
+        h.uninstall()
+    assert signal.getsignal(signal.SIGUSR1) is before
+
+
+# ---------------------------------------------------------------------------
+# device-side non-finite skip (train/steps.py nonfinite_guard)
+# ---------------------------------------------------------------------------
+
+def _tiny_setup():
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, training=False):
+            x = nn.Conv(4, (3, 3))(x)
+            x = nn.BatchNorm(use_running_average=not training,
+                             momentum=0.9)(x)
+            x = x.mean(axis=(1, 2))
+            return nn.Dense(2)(x)
+
+    from deepfake_detection_tpu.train import create_train_state
+    m = Tiny()
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(4, 8, 8, 3)), jnp.float32)
+    y = jnp.asarray([0, 1, 0, 1])
+    v = m.init({"params": jax.random.PRNGKey(0)}, x, training=True)
+    v = {"params": v["params"], "batch_stats": v["batch_stats"]}
+    tx = optax.adam(1e-2)
+    state = create_train_state(v, tx, donate=False)
+    return m, tx, state, x, y
+
+
+class TestNonfiniteGuard:
+    def test_finite_step_updates_and_flags_zero(self):
+        from deepfake_detection_tpu.train import make_train_step
+        m, tx, state, x, y = _tiny_setup()
+        step = make_train_step(m, tx, mesh=None, bn_mode="global",
+                               donate=False, nonfinite_guard=True)
+        new_state, metrics = step(state, x, y, jax.random.PRNGKey(1))
+        assert float(metrics["nonfinite"]) == 0.0
+        assert np.isfinite(float(metrics["gnorm"]))
+        assert int(new_state.step) == int(state.step) + 1
+        k = new_state.params["Dense_0"]["kernel"]
+        assert not np.array_equal(np.asarray(k),
+                                  np.asarray(state.params["Dense_0"]["kernel"]))
+
+    def test_poisoned_step_is_skipped_entirely(self):
+        from deepfake_detection_tpu.train import make_train_step
+        m, tx, state, x, y = _tiny_setup()
+        step = make_train_step(m, tx, mesh=None, bn_mode="global",
+                               donate=False, nonfinite_guard=True)
+        bad = jnp.full_like(x, np.nan)
+        new_state, metrics = step(state, bad, y, jax.random.PRNGKey(1))
+        assert float(metrics["nonfinite"]) == 1.0
+        # the ENTIRE state rolled back: params, BN stats, moments, step
+        for a, b in zip(jax.tree.leaves(new_state), jax.tree.leaves(state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_guard_off_reproduces_reference_poisoning(self):
+        from deepfake_detection_tpu.train import make_train_step
+        m, tx, state, x, y = _tiny_setup()
+        step = make_train_step(m, tx, mesh=None, bn_mode="global",
+                               donate=False, nonfinite_guard=False)
+        bad = jnp.full_like(x, np.nan)
+        new_state, metrics = step(state, bad, y, jax.random.PRNGKey(1))
+        assert "nonfinite" not in metrics
+        k = np.asarray(new_state.params["Dense_0"]["kernel"])
+        assert not np.isfinite(k).all()
+
+    def test_guarded_clean_run_matches_unguarded(self):
+        # the guard must be numerically invisible on healthy steps
+        from deepfake_detection_tpu.train import make_train_step
+        m, tx, state, x, y = _tiny_setup()
+        g = make_train_step(m, tx, mesh=None, bn_mode="global",
+                            donate=False, nonfinite_guard=True)
+        u = make_train_step(m, tx, mesh=None, bn_mode="global",
+                            donate=False, nonfinite_guard=False)
+        sg, _ = g(state, x, y, jax.random.PRNGKey(1))
+        su, _ = u(state, x, y, jax.random.PRNGKey(1))
+        for a, b in zip(jax.tree.leaves(sg), jax.tree.leaves(su)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# torn checkpoints + the resume-candidate ladder
+# ---------------------------------------------------------------------------
+
+class TestCheckpointCorrupt:
+    def _save_one(self, path):
+        from deepfake_detection_tpu.train import save_checkpoint_file
+        state = {"w": np.arange(64, dtype=np.float32)}
+        save_checkpoint_file(str(path), state, {"epoch": 3})
+        return state
+
+    def test_truncated_raises_named_error(self, tmp_path):
+        from deepfake_detection_tpu.train import (CheckpointCorrupt,
+                                                  load_checkpoint_file)
+        p = tmp_path / "recovery-3-5.ckpt"
+        self._save_one(p)
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            f.truncate(size // 2)
+        with pytest.raises(CheckpointCorrupt) as ei:
+            load_checkpoint_file(str(p))
+        assert str(p) in str(ei.value)
+
+    def test_empty_and_garbage_raise(self, tmp_path):
+        from deepfake_detection_tpu.train import (CheckpointCorrupt,
+                                                  load_checkpoint_file)
+        p = tmp_path / "empty.ckpt"
+        p.write_bytes(b"")
+        with pytest.raises(CheckpointCorrupt):
+            load_checkpoint_file(str(p))
+        p.write_bytes(b"\x00garbage-not-msgpack" * 7)
+        with pytest.raises(CheckpointCorrupt):
+            load_checkpoint_file(str(p))
+
+    def test_intact_roundtrip_unaffected(self, tmp_path):
+        from deepfake_detection_tpu.train import load_checkpoint_file
+        p = tmp_path / "ok.ckpt"
+        state = self._save_one(p)
+        sd, meta = load_checkpoint_file(str(p))
+        np.testing.assert_array_equal(sd["w"], state["w"])
+        assert meta["epoch"] == 3
+
+    def test_chaos_cli_truncate(self, tmp_path):
+        import subprocess
+        import sys
+        from deepfake_detection_tpu.train import (CheckpointCorrupt,
+                                                  load_checkpoint_file)
+        p = tmp_path / "t.ckpt"
+        self._save_one(p)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "chaos.py"),
+             "truncate", str(p)], capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        with pytest.raises(CheckpointCorrupt):
+            load_checkpoint_file(str(p))
+
+
+def test_async_snapshot_owns_its_bytes():
+    # np.asarray(jax.Array) is ZERO-COPY on the CPU backend: a background
+    # checkpoint writer serializing such a view races the donating train
+    # step and tears the snapshot (step counter from N steps later, params
+    # overwritten by reused buffers — observed in the e2e chaos runs).
+    # The async path must therefore own its bytes.
+    from deepfake_detection_tpu.train.checkpoint import _to_host
+    x = jnp.arange(1024, dtype=jnp.float32)
+    assert _to_host(x, copy=True).flags["OWNDATA"]
+    plain = np.arange(8)
+    np.testing.assert_array_equal(_to_host(plain, copy=True), plain)
+
+
+def test_find_resume_candidates_order(tmp_path):
+    from deepfake_detection_tpu.train import find_resume_candidates
+    d = tmp_path / "run"
+    bak = d / "_bak"
+    bak.mkdir(parents=True)
+    for name in ("recovery-0-5.ckpt", "recovery-1-2.ckpt",
+                 "recovery-0-999.ckpt", "model_best.ckpt"):
+        (d / name).write_bytes(b"x")
+    (bak / "model_best.ckpt").write_bytes(b"x")
+    got = find_resume_candidates(str(d), bak_dir=str(bak))
+    names = [os.path.relpath(p, tmp_path) for p in got]
+    # newest recovery first (NUMERIC ordering: 1-2 beats 0-999), then the
+    # _bak mirror, then model_best itself
+    assert names == ["run/recovery-1-2.ckpt", "run/recovery-0-999.ckpt",
+                     "run/recovery-0-5.ckpt", "run/_bak/model_best.ckpt",
+                     "run/model_best.ckpt"]
+
+
+def test_save_recovery_sync_lands_immediately(tmp_path):
+    from deepfake_detection_tpu.train import (CheckpointSaver,
+                                              load_checkpoint_file)
+    saver = CheckpointSaver(checkpoint_dir=str(tmp_path))
+    state = {"w": np.zeros(8, np.float32)}
+    saver.save_recovery(state, {"num_updates": 37}, epoch=2, batch_idx=4,
+                        sync=True)
+    p = os.path.join(str(tmp_path), "recovery-2-4.ckpt")
+    assert os.path.exists(p)        # no wait_pending_saves needed: sync
+    _, meta = load_checkpoint_file(p)
+    assert meta == {"num_updates": 37, "epoch": 2, "batch_idx": 4}
+
+
+# ---------------------------------------------------------------------------
+# loader fast-forward: bit-exact mid-epoch resume streams
+# ---------------------------------------------------------------------------
+
+def _collect(loader):
+    return [tuple(np.asarray(p) for p in item) for item in loader]
+
+
+class TestLoaderFastForward:
+    def _make(self, backend="thread", **kw):
+        from deepfake_detection_tpu.data import (SyntheticDataset,
+                                                 create_deepfake_loader_v3)
+        ds = SyntheticDataset(16, (32, 32, 3), 2, seed=0)
+        return create_deepfake_loader_v3(
+            ds, (3, 32, 32), 2, is_training=True, num_workers=1, seed=11,
+            dtype=jnp.float32, loader_backend=backend, re_prob=0.5, **kw)
+
+    def test_thread_backend_tail_is_bit_identical(self):
+        full = self._make()
+        full.set_epoch(1)
+        want = _collect(full)
+        ff = self._make()
+        ff.set_epoch(1)
+        ff.fast_forward(3)
+        got = _collect(ff)
+        assert len(want) == 8 and len(got) == 5
+        for a, b in zip(want[3:], got):
+            for xa, xb in zip(a, b):
+                np.testing.assert_array_equal(xa, xb)
+        full.close()
+        ff.close()
+
+    def test_prologue_key_stream_aligns_across_constructions(self):
+        # a FRESH loader fast-forwarded into epoch 1 must reproduce the
+        # RandomErasing draws of a loader that iterated epochs 0 and 1 —
+        # i.e. _step is a function of absolute position, not history
+        warm = self._make()
+        warm.set_epoch(0)
+        _ = _collect(warm)
+        warm.set_epoch(1)
+        want = _collect(warm)
+        cold = self._make()
+        cold.set_epoch(1)
+        cold.fast_forward(5)
+        got = _collect(cold)
+        for a, b in zip(want[5:], got):
+            np.testing.assert_array_equal(a[0], b[0])
+        warm.close()
+        cold.close()
+
+    def test_shm_backend_tail_is_bit_identical(self):
+        full = self._make(backend="shm")
+        try:
+            full.set_epoch(1)
+            want = _collect(full)
+        finally:
+            full.close()
+        ff = self._make(backend="shm")
+        try:
+            ff.set_epoch(1)
+            ff.fast_forward(3)
+            got = _collect(ff)
+        finally:
+            ff.close()
+        for a, b in zip(want[3:], got):
+            for xa, xb in zip(a, b):
+                np.testing.assert_array_equal(xa, xb)
+
+    def test_shm_chaos_worker_kill_recovers_identically(self, monkeypatch):
+        want = None
+        full = self._make(backend="shm")
+        try:
+            full.set_epoch(0)
+            want = _collect(full)
+        finally:
+            full.close()
+        monkeypatch.setenv("DFD_CHAOS", "kill_shm_worker@2")
+        hurt = self._make(backend="shm")
+        try:
+            hurt.set_epoch(0)
+            got = _collect(hurt)
+            assert hurt.loader.respawn_count >= 1
+        finally:
+            hurt.close()
+        for a, b in zip(want, got):
+            for xa, xb in zip(a, b):
+                np.testing.assert_array_equal(xa, xb)

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Fault-injection harness: run a training command under injected faults
+and verify the recovery contract end-to-end.
+
+The resilience layer (deepfake_detection_tpu/train/resilience.py) defines
+an exit-code contract — 75 = preempted with a recovery snapshot on disk,
+85 = stall-watchdog abort — and ``--auto-resume`` promises bit-continuous
+restart.  This harness launches a real training run with a ``DFD_CHAOS``
+fault spec (see deepfake_detection_tpu/chaos.py for the grammar), checks
+that the run exits with the expected code, then relaunches it (fault
+cleared, ``--auto-resume`` added) until it completes — the same loop
+scripts/train.sh's restart wrapper runs in production, but with the fault
+under test injected deliberately.
+
+Examples::
+
+    # preempt at update 8, expect exit 75, auto-resume to completion
+    python tools/chaos.py --fault sigterm@8 -- \
+        python -m deepfake_detection_tpu.runners.train \
+        --dataset synthetic --model resnet18 --model-version "" \
+        --input-size-v2 3,32,32 -b 2 --epochs 2 --opt adamw --lr 1e-3 \
+        --recovery-interval 2 --experiment chaos --output /tmp/chaos-run
+
+    # poison gradients for 3 consecutive updates: the guard must skip
+    # them and rewind; the run must finish on its own (no restart needed)
+    python tools/chaos.py --fault nanbatch@5x3 --expect 0 -- ...
+
+    # stall the loader at batch 3 for 60 s with --watchdog-timeout 5:
+    # expect the watchdog's exit 85, then a clean auto-resume
+    python tools/chaos.py --fault stall_loader@3:60 --expect 85 -- ...
+
+    # tear the newest checkpoint in half (manual corruption for testing
+    # the CheckpointCorrupt fallback ladder)
+    python tools/chaos.py truncate path/to/recovery-0-5.ckpt
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+EXIT_PREEMPTED = 75          # keep in sync with train/resilience.py
+EXIT_WATCHDOG = 85
+_RESTARTABLE = (EXIT_PREEMPTED, EXIT_WATCHDOG)
+
+
+def truncate(path: str, keep: int = -1) -> int:
+    """Tear a checkpoint file: keep ``keep`` bytes (default: half)."""
+    size = os.path.getsize(path)
+    keep = size // 2 if keep < 0 else keep
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+    print(f"truncated {path}: {size} -> {keep} bytes")
+    return 0
+
+
+def run_scenario(fault: str, cmd: list, expect: int,
+                 max_restarts: int) -> int:
+    """Launch ``cmd`` with the fault injected, then restart-loop it."""
+    env = dict(os.environ, DFD_CHAOS=fault)
+    print(f"[chaos] launch 0: DFD_CHAOS={fault!r}: {' '.join(cmd)}",
+          flush=True)
+    rc = subprocess.run(cmd, env=env).returncode
+    print(f"[chaos] launch 0 exited {rc} (expected {expect})", flush=True)
+    if rc != expect:
+        print(f"[chaos] FAIL: expected exit {expect}, got {rc}")
+        return 1
+    if rc == 0:
+        print("[chaos] PASS: run absorbed the fault without restarting")
+        return 0
+    if rc not in _RESTARTABLE:
+        print(f"[chaos] FAIL: exit {rc} is not restartable "
+              f"({_RESTARTABLE})")
+        return 1
+    # restart loop: fault cleared, --auto-resume added (idempotent)
+    resume_cmd = list(cmd)
+    if "--auto-resume" not in resume_cmd:
+        resume_cmd.append("--auto-resume")
+    env = {k: v for k, v in os.environ.items() if k != "DFD_CHAOS"}
+    for attempt in range(1, max_restarts + 1):
+        print(f"[chaos] relaunch {attempt}/{max_restarts}: "
+              f"{' '.join(resume_cmd)}", flush=True)
+        rc = subprocess.run(resume_cmd, env=env).returncode
+        print(f"[chaos] relaunch {attempt} exited {rc}", flush=True)
+        if rc == 0:
+            print("[chaos] PASS: recovered to completion")
+            return 0
+        if rc not in _RESTARTABLE:
+            print(f"[chaos] FAIL: relaunch died with non-restartable "
+                  f"exit {rc}")
+            return 1
+    print(f"[chaos] FAIL: restart budget ({max_restarts}) exhausted")
+    return 1
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "truncate":
+        p = argparse.ArgumentParser(prog="chaos.py truncate")
+        p.add_argument("path")
+        p.add_argument("--keep", type=int, default=-1,
+                       help="bytes to keep (default: half the file)")
+        ns = p.parse_args(argv[1:])
+        return truncate(ns.path, ns.keep)
+
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--fault", required=True,
+                   help="DFD_CHAOS spec, e.g. sigterm@8 or nanbatch@5x3")
+    p.add_argument("--expect", type=int, default=EXIT_PREEMPTED,
+                   help="exit code the faulted launch must produce "
+                        "(default 75; use 0 for faults the run should "
+                        "absorb in-band, 85 for watchdog aborts)")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="-- followed by the full training command")
+    ns = p.parse_args(argv)
+    cmd = ns.cmd[1:] if ns.cmd and ns.cmd[0] == "--" else ns.cmd
+    if not cmd:
+        p.error("training command missing (append: -- python -m ...)")
+    return run_scenario(ns.fault, cmd, ns.expect, ns.max_restarts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
