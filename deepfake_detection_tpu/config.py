@@ -324,6 +324,16 @@ class TrainConfig:
     # the Pallas kernels; 'ring'/'ring_flash'/'ulysses' are sequence-
     # parallel and need an sp mesh — library-level for now (models/vit.py)
     attn_impl: str = ""
+    # --- step-time optimization layer (PERF.md post-fusion roofline) ---
+    # 'pallas' routes the EfficientNet-family dw → BN → act stages through
+    # the fused VMEM-resident kernel (ops/depthwise_pallas.py); 'off' keeps
+    # the stock XLA lowering.  Numerically equivalent either way (≤2 ulp,
+    # tests/test_depthwise_pallas.py); the parameter tree is identical.
+    fused_depthwise: str = "off"
+    # rewrite the stride-2 stem as a stride-1 conv over 2×2 pixel-shuffled
+    # input (MLPerf s2d trick) — the shuffle runs in the DeviceLoader
+    # prologue; checkpoints stay bit-compatible via a pure weight reshape
+    stem_s2d: bool = False
 
     # ------------------------------------------------------------------
     def __post_init__(self):
@@ -348,6 +358,9 @@ class TrainConfig:
         if self.guard_nonfinite not in ("off", "skip"):
             raise ValueError("guard_nonfinite must be off|skip, got "
                              f"{self.guard_nonfinite!r}")
+        if self.fused_depthwise not in ("off", "pallas"):
+            raise ValueError("fused_depthwise must be off|pallas, got "
+                             f"{self.fused_depthwise!r}")
         if int(self.ring_depth) < 3:
             raise ValueError("--ring-depth must be >= 3 (double buffering "
                              f"needs one spare slab), got {self.ring_depth}")

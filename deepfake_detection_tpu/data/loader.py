@@ -204,9 +204,11 @@ class DeviceLoader:
                  re_num_splits: int = 0, re_max: float = 0.1,
                  img_num: int = 4, seed: int = 0,
                  sharding: Optional[Any] = None,
-                 color_jitter=None, flicker: float = 0.0):
+                 color_jitter=None, flicker: float = 0.0,
+                 stem_s2d: bool = False):
         self.loader = loader
         self.img_num = img_num
+        self.stem_s2d = stem_s2d
         self.dtype = dtype
         self.sharding = sharding
         self.seed = seed
@@ -225,6 +227,12 @@ class DeviceLoader:
         erasing = self.random_erasing
         from .device_augment import make_device_color_jitter
         jitter = make_device_color_jitter(color_jitter, flicker, img_num)
+        if stem_s2d:
+            # lazy: pulls flax via ops; only the consumer process (which
+            # already built the model) constructs a DeviceLoader
+            from ..ops.conv import space_to_depth
+        else:
+            space_to_depth = None
 
         def prologue(images, key):
             # jitter operates in 0..255 float space BEFORE normalize, like
@@ -236,6 +244,12 @@ class DeviceLoader:
             x = (x.astype(dtype) - mean_j.astype(dtype)) / std_j.astype(dtype)
             if erasing is not None:
                 x = erasing(ekey, x).astype(dtype)
+            if space_to_depth is not None:
+                # s2d stem (PERF.md post-fusion roofline): ship the pixel
+                # shuffle with the prologue so the (B, H/2, W/2, 4C) layout
+                # lands on device once — the model consumes it directly
+                # instead of re-shuffling every step
+                x = space_to_depth(x)
             return x
 
         # NOTE: donating the uint8 wire buffer here would be a no-op — XLA
@@ -411,7 +425,7 @@ def create_loader(
         seed: int = 42, prefetch_depth: int = 2,
         sharding: Optional[Any] = None, valid_mask: Optional[bool] = None,
         loader_backend: str = "thread", ring_depth: int = 4,
-        worker_heartbeat: float = 120.0,
+        worker_heartbeat: float = 120.0, stem_s2d: bool = False,
         ) -> DeviceLoader:
     """Generic single-image loader factory (reference loader.py:372-456).
 
@@ -446,7 +460,7 @@ def create_loader(
         dict(mean=mean, std=std, dtype=dtype,
              re_prob=re_prob if is_training else 0.0, re_mode=re_mode,
              re_count=re_count, re_num_splits=re_num_splits, re_max=re_max,
-             img_num=1, sharding=sharding),
+             img_num=1, sharding=sharding, stem_s2d=stem_s2d),
         loader_backend=loader_backend, ring_depth=ring_depth,
         worker_heartbeat=worker_heartbeat)
 
@@ -467,6 +481,7 @@ def create_deepfake_loader_v3(
         eval_crop: str = "random", device_color_jitter: bool = True,
         fused_geom: bool = True, loader_backend: str = "thread",
         ring_depth: int = 4, worker_heartbeat: float = 120.0,
+        stem_s2d: bool = False,
         ) -> DeviceLoader:
     """Loader factory (reference loader.py:724-830): builds the v3 transform,
     picks the train/eval sharded sampler, wires collate mixup and the device
@@ -531,6 +546,7 @@ def create_deepfake_loader_v3(
              re_prob=re_prob if is_training else 0.0, re_mode=re_mode,
              re_count=re_count, re_num_splits=re_num_splits, re_max=re_max,
              img_num=max(1, img_num), sharding=sharding,
-             color_jitter=device_cj, flicker=device_flicker),
+             color_jitter=device_cj, flicker=device_flicker,
+             stem_s2d=stem_s2d),
         loader_backend=loader_backend, ring_depth=ring_depth,
         worker_heartbeat=worker_heartbeat)

@@ -25,11 +25,11 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.activations import get_act_fn
-from ..ops.conv import Conv2d, dense_init_goog
+from ..ops.conv import Conv2d, dense_init_goog, space_to_depth
 from ..ops.norm import BatchNorm2d, GroupNorm, resolve_bn_args
 from ..ops.pool import SelectAdaptivePool2d, adaptive_pool_feat_mult
 from ..registry import register_model
-from .efficientnet_blocks import (ConvBnAct, CondConvResidual,
+from .efficientnet_blocks import (ConvBnAct, ConvBnActS2d, CondConvResidual,
                                   DepthwiseSeparableConv, EdgeResidual,
                                   InvertedResidual, round_channels)
 from .efficientnet_builder import build_block_configs, decode_arch_def
@@ -114,6 +114,15 @@ class EfficientNet(nn.Module):
     # flagship 12×600×600/B7 scale 'dots' trades ~⅓ more FLOPs for the HBM
     # needed to fit a useful per-chip batch.
     remat_policy: str = "none"
+    # step-time optimization layer (PERF.md post-fusion roofline):
+    # fused_depthwise 'pallas' routes every eligible dw → BN → act stage
+    # through the VMEM-resident kernel (ops/depthwise_pallas.py);
+    # stem_s2d rewrites the stride-2 stem as a stride-1 conv over 2×2
+    # pixel-shuffled input (accepts raw NHWC — shuffles in-model — or
+    # loader-preshuffled (B, H/2, W/2, 4C) batches).  Both default off and
+    # keep the parameter tree identical to the stock paths.
+    fused_depthwise: str = "off"
+    stem_s2d: bool = False
     dtype: Any = None
     default_cfg: Any = None
 
@@ -125,17 +134,28 @@ class EfficientNet(nn.Module):
     @nn.compact
     def __call__(self, x, training: bool = False, features_only: bool = False,
                  pool: bool = True):
-        assert x.shape[-1] == self.in_chans, \
-            f"expected {self.in_chans} input channels (NHWC), got {x.shape}"
+        if self.stem_s2d and x.shape[-1] == 4 * self.in_chans:
+            pass            # loader prologue already pixel-shuffled
+        else:
+            assert x.shape[-1] == self.in_chans, \
+                f"expected {self.in_chans} input channels (NHWC), got {x.shape}"
+            if self.stem_s2d:
+                x = space_to_depth(x)
         act = get_act_fn(self.act)
         bnk = self._bn_kwargs()
         from .helpers import maybe_remat
         block_types = {k: maybe_remat(v, self.remat_policy)
                        for k, v in _BLOCK_TYPES.items()}
-        # stem: conv 3x3 s2 (reference efficientnet.py:275-279)
-        x = ConvBnAct(self.stem_size, 3, stride=2, act=self.act,
-                      pad_type=self.pad_type, **bnk,
-                      name="conv_stem")(x, training=training)
+        # stem: conv 3x3 s2 (reference efficientnet.py:275-279), or its
+        # space-to-depth rewrite — same conv_stem parameter either way
+        if self.stem_s2d:
+            x = ConvBnActS2d(self.stem_size, act=self.act,
+                             pad_type=self.pad_type, **bnk,
+                             name="conv_stem")(x, training=training)
+        else:
+            x = ConvBnAct(self.stem_size, 3, stride=2, act=self.act,
+                          pad_type=self.pad_type, **bnk,
+                          name="conv_stem")(x, training=training)
         stage_feats: List[Any] = []
         for si, stage in enumerate(self.block_configs):
             for bi, cfg in enumerate(stage):
@@ -150,6 +170,8 @@ class EfficientNet(nn.Module):
                         cfg.pop(k, None)
                 elif self.se_kwargs is not None:
                     cfg.setdefault("se_kwargs", self.se_kwargs)
+                if btype in ("ir", "ds"):
+                    cfg.setdefault("fused_depthwise", self.fused_depthwise)
                 block = block_types[btype](**cfg, **bnk, act=block_act,
                                            name=f"blocks_{si}_{bi}")
                 x = block(x, training)
@@ -227,6 +249,8 @@ def _make(arch_def, channel_multiplier=1.0, depth_multiplier=1.0,
                  head_type=kwargs.pop("head_type", "efficientnet"),
                  head_bias=kwargs.pop("head_bias", True),
                  pad_type=kwargs.pop("pad_type", ""),
+                 fused_depthwise=kwargs.pop("fused_depthwise", "off"),
+                 stem_s2d=kwargs.pop("stem_s2d", False),
                  se_kwargs=kwargs.pop("se_kwargs", None))
     kwargs.pop("strict", None)
     if kwargs:

@@ -54,6 +54,17 @@ def create_model(model_name: str, pretrained: bool = False,
             logging.getLogger(__name__).warning(
                 "remat_policy=%r is only consumed by the %s families; "
                 "ignored for %s", v, _REMAT_MODULES, model_name)
+    if not is_model_in_modules(model_name, _BN_KWARG_MODULES):
+        # the step-time optimization layer rewrites MBConv dw stages and the
+        # 3x3-s2 stem — EfficientNet-family-only by construction
+        fd = kwargs.pop("fused_depthwise", None)
+        s2d = kwargs.pop("stem_s2d", None)
+        if fd not in (None, "off") or s2d:
+            raise ValueError(
+                f"--fused-depthwise/--stem-s2d rewrite the EfficientNet-"
+                f"family hot path ({_BN_KWARG_MODULES}); {model_name} has no "
+                "depthwise/s2d-stem equivalent — silently training the stock "
+                "path would invalidate the perf comparison")
     if (ai := kwargs.get("attn_impl")) is not None:
         if ai not in _ATTN_IMPLS:
             # a typo must not silently fall back to dense attention

@@ -5,7 +5,9 @@ from .attention import (CbamModule, CecaModule, ChannelAttn, EcaModule,
                         LightCbamModule, SEModule, SelectiveKernelConv,
                         SpatialAttn, create_attn, make_divisible)
 from .conv import (CondConv2d, Conv2d, MixedConv2d, conv_kernel_init_goog,
-                   create_conv2d, dense_init_goog, resolve_padding)
+                   create_conv2d, dense_init_goog, resolve_padding,
+                   space_to_depth, space_to_depth_stem_kernel)
+from .depthwise_pallas import FUSED_DW_ACTS, fused_depthwise
 from .drop import DropBlock2d, DropPath, Dropout, drop_block_2d, drop_path
 from .flash_attention import flash_attention
 from .norm import (BN_EPS_TF_DEFAULT, BN_MOMENTUM_TF_DEFAULT, BatchNorm2d,

@@ -132,7 +132,19 @@ class MixedConv2d(nn.Module):
         for i, (ks, idx, out_c) in enumerate(zip(self.kernel_size, in_splits, out_sizes)):
             chunk = x[..., start:start + len(idx)]
             start += len(idx)
-            groups = out_c if self.depthwise else 1
+            # depthwise grouping derives from the INPUT split: groups must
+            # equal the split's input channels (flax maps groups onto
+            # feature_group_count, whose contract is per-input-channel).
+            # Deriving it from out_c silently mis-grouped any depthwise
+            # mixed conv whose split had in != out.
+            if self.depthwise and len(idx) != out_c:
+                raise ValueError(
+                    f"MixedConv2d depthwise split {i}: input split has "
+                    f"{len(idx)} channels but the output split has {out_c} "
+                    f"— depthwise requires in == out per split "
+                    f"(in_chs={in_chs}, out_chs={self.out_chs}, "
+                    f"kernels={tuple(self.kernel_size)})")
+            groups = len(idx) if self.depthwise else 1
             outs.append(Conv2d(out_c, ks, self.stride, self.dilation,
                                groups=groups, padding=self.padding,
                                use_bias=self.use_bias, dtype=self.dtype,
@@ -192,6 +204,68 @@ class CondConv2d(nn.Module):
                               (self.num_experts, self.out_chs))
             y = y + jnp.einsum("be,eo->bo", routing_weights, bias)[:, None, None, :]
         return y
+
+
+# ---------------------------------------------------------------------------
+# Space-to-depth stem rewrite (MLPerf TPU-pod ResNet trick, Kumar et al. 2019)
+# ---------------------------------------------------------------------------
+
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """NHWC pixel-shuffle: ``(B, H, W, C) → (B, H/b, W/b, b²·C)``.
+
+    Channel layout is ``(di, dj, c)``-major — the layout
+    :func:`space_to_depth_stem_kernel` assumes.  Pure reshape/transpose: XLA
+    lowers it to a copy (loader prologue) or fuses it (in-model fallback).
+    """
+    b, h, w, c = x.shape
+    assert h % block == 0 and w % block == 0, \
+        f"space_to_depth needs H, W divisible by {block}, got {(h, w)}"
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, h // block, w // block, block * block * c)
+
+
+def depth_to_space(x, block: int = 2):
+    """Inverse of :func:`space_to_depth` (same ``(di, dj, c)``-major channel
+    layout); works on jax or numpy arrays."""
+    b, h, w, c = x.shape
+    assert c % (block * block) == 0, \
+        f"depth_to_space needs C divisible by {block * block}, got {c}"
+    x = x.reshape(b, h, w, block, block, c // (block * block))
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, h * block, w * block, c // (block * block))
+
+
+def space_to_depth_stem_kernel(kernel: jnp.ndarray, pad_type: str = ""):
+    """Rewrite a 3×3 stride-2 stem kernel for space-to-depth input.
+
+    ``kernel`` is HWIO ``(3, 3, C, O)``; returns ``(k2, pad)`` where ``k2``
+    is the ``(2, 2, 4C, O)`` stride-1 kernel over the s2d input and ``pad``
+    the matching block-space padding config.  The rewrite embeds the 3×3
+    taps into a zero 4×4 at the offset the original padding dictates (torch
+    static-symmetric ``''`` pads 1 low → offset 1 + block-pad (1, 0); TF
+    ``'same'`` at even input pads 1 high → offset 0 + block-pad (0, 1)), then
+    regroups the 4×4 into 2×2 pixel blocks.  A pure, lossless, invertible
+    scatter of the original weights: converted torch checkpoints keep their
+    exact values, only the conv's window arithmetic changes (the conv output
+    differs from the stride-2 original by float reassociation only — the
+    taps and products are identical).
+    """
+    kh, kw, cin, cout = kernel.shape
+    if (kh, kw) != (3, 3):
+        raise ValueError(
+            f"s2d stem rewrite covers the 3x3 stride-2 stem, got {(kh, kw)}")
+    if str(pad_type).lower() == "same":
+        off, pad = 0, (0, 1)
+    elif pad_type in ("", None):
+        off, pad = 1, (1, 0)
+    else:
+        raise ValueError(
+            f"s2d stem supports pad_type ''|'same', got {pad_type!r}")
+    k4 = jnp.zeros((4, 4, cin, cout), kernel.dtype)
+    k4 = k4.at[off:off + 3, off:off + 3].set(kernel)
+    k2 = k4.reshape(2, 2, 2, 2, cin, cout).transpose(0, 2, 1, 3, 4, 5)
+    return k2.reshape(2, 2, 4 * cin, cout), [pad, pad]
 
 
 def create_conv2d(out_chs: int, kernel_size, **kwargs) -> nn.Module:

@@ -1,0 +1,410 @@
+"""Fused depthwise-conv → scale/shift → activation as Pallas TPU kernels.
+
+PERF.md's roofline puts the EfficientNet family within 1.5% of the bf16-VPU
+ceiling: the depthwise stages are the binding term, and XLA executes each as
+``dw-conv (VPU) → write HBM → read HBM → BN normalize → act → write HBM``
+when the epilogue does not fuse cleanly (separate fusions around the conv).
+This module collapses the whole stage into one VMEM-resident pass: the conv
+accumulator never leaves VMEM between the k²-tap multiply-adds and the
+per-channel affine + activation epilogue, so the stage's HBM traffic drops
+to the unavoidable ``read x, write y``.
+
+Kernel structure (same conventions as ``ops/flash_attention.py``):
+
+* grid ``(B, C tiles, H tiles)`` with the H-tile axis innermost so Pallas
+  pipelines one ``(th_in, W, Ct)`` input block at a time through VMEM.
+  Depthwise halos (``th_in = th_out·stride + k − stride``) overlap between
+  consecutive H tiles, which plain blocked BlockSpecs cannot express — the
+  input spec uses **unblocked (element-offset) indexing** over an input the
+  wrapper has already padded in XLA (one pad op; XLA materializes conv
+  padding anyway).
+* the k² taps unroll as static Python loops of strided ``lax.slice`` +
+  multiply-accumulate on the VPU, f32 accumulation regardless of input
+  dtype; the affine + activation epilogue runs on the accumulator while it
+  is still VMEM-resident.
+* backward is a custom VJP: ``dx`` REUSES the forward kernel (a depthwise
+  transposed conv is the same kernel over the interior-dilated, re-padded
+  upstream gradient with a flipped kernel), ``dw`` is a second Pallas
+  reduction kernel accumulating the k²-tap correlation into VMEM scratch
+  across the (B, H-tile) grid steps, and the tiny per-channel
+  ``dscale``/``dbias`` reductions stay in XLA where they fuse with the
+  activation-gradient elementwise pass.
+
+On non-TPU backends the kernels run under the Pallas interpreter
+(``interpret=True``), which is how the CPU suite checks forward AND
+gradient parity against the XLA lowering (tests/test_depthwise_pallas.py).
+Outputs declare their varying-mesh-axes set from the input operand
+(``_out_struct``), so the op is check_vma-safe under ``shard_map``
+(parallel/_compat.py) exactly like the flash kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover - exercised only on exotic installs
+    pltpu = None
+
+from .conv import resolve_padding
+
+__all__ = ["fused_depthwise", "FUSED_DW_ACTS"]
+
+#: epilogue activations the kernel fuses; anything else runs act in XLA
+FUSED_DW_ACTS = ("none", "silu", "relu")
+
+_LANES = 128
+
+
+def _vmem_spec(block_shape, index_map, unblocked: bool = False):
+    kwargs = {}
+    if pltpu is not None:
+        kwargs["memory_space"] = pltpu.VMEM
+    if unblocked:
+        kwargs["indexing_mode"] = pl.Unblocked()
+    return pl.BlockSpec(block_shape, index_map, **kwargs)
+
+
+def _scratch(shape):
+    if pltpu is not None:
+        return pltpu.VMEM(shape, jnp.float32)
+    return pl.MemoryRef(shape, jnp.float32)  # interpreter fallback
+
+
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct inheriting ``like``'s varying-mesh-axes set so the
+    same kernels work standalone and inside ``shard_map`` (check_vma)."""
+    typeof = getattr(jax, "typeof", None)
+    vma = getattr(typeof(like), "vma", None) if typeof is not None else None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _act_f32(name: str):
+    if name == "silu":
+        return jax.nn.silu
+    if name == "relu":
+        return lambda u: jnp.maximum(u, 0.0)
+    return lambda u: u
+
+
+def _act_grad_f32(name: str):
+    """d act(u) / du, evaluated in f32."""
+    if name == "silu":
+        def g(u):
+            s = jax.nn.sigmoid(u)
+            return s * (1.0 + u * (1.0 - s))
+        return g
+    if name == "relu":
+        return lambda u: (u > 0.0).astype(jnp.float32)
+    return lambda u: jnp.ones_like(u)
+
+
+def _to_tuple(v) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _pick_block_h(w: int, ct: int, kh: int, stride: int,
+                  ho: int, budget: int = 2 * 1024 * 1024) -> int:
+    """Largest output-rows-per-tile whose f32 input halo block fits the VMEM
+    budget (Pallas double-buffers, so stay well under the 16 MB arena)."""
+    th = max(1, min(ho, 8))
+    while th > 1 and (th * stride + kh - stride) * w * ct * 4 > budget:
+        th -= 1
+    return th
+
+
+def _channel_tile(c: int) -> int:
+    """Lane-friendly channel tile: full lanes when divisible, else the whole
+    (padded) channel extent for small C."""
+    if c % _LANES == 0:
+        return _LANES
+    return c
+
+
+# ---------------------------------------------------------------------------
+# forward kernel (also computes dx in the backward via kernel reuse)
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(x_ref, w_ref, s_ref, b_ref, y_ref, *z_ref, stride, kh, kw,
+                th_out, wo, act):
+    """One (b, c-tile, h-tile) grid cell: k²-tap MAC + affine + act, all on
+    the VPU with the accumulator VMEM-resident.  ``z_ref`` (the f32
+    pre-affine output the backward consumes) exists only on the
+    residual-saving call — the primal never allocates it."""
+    ct = x_ref.shape[-1]
+    xv = x_ref[0].astype(jnp.float32)
+    acc = jnp.zeros((th_out, wo, ct), jnp.float32)
+    for r in range(kh):
+        for s in range(kw):
+            tap = lax.slice(
+                xv, (r, s, 0),
+                (r + (th_out - 1) * stride + 1, s + (wo - 1) * stride + 1,
+                 ct),
+                (stride, stride, 1))
+            acc = acc + tap * w_ref[r, s][None, None, :].astype(jnp.float32)
+    if z_ref:
+        z_ref[0][0] = acc
+    u = acc * s_ref[0][None, None, :] + b_ref[0][None, None, :]
+    y_ref[0] = _act_f32(act)(u).astype(y_ref.dtype)
+
+
+def _dw_call(xp, w, scale, bias, *, stride, act, ho, wo, out_dtype,
+             want_z, interpret):
+    """Padded-layout forward: ``xp (B, Hp, Wp, C)`` pre-padded so that every
+    H tile's halo block is in-bounds; returns ``y (B, Ho, Wo, C)`` and (when
+    ``want_z``) the f32 pre-affine conv output for the backward."""
+    b, hp, wp, c = xp.shape
+    kh, kw = w.shape[0], w.shape[1]
+    ct = _channel_tile(c)
+    th_out = _pick_block_h(wp, ct, kh, stride, ho)
+    n_h = -(-ho // th_out)
+    th_in = th_out * stride + kh - stride
+    # tiling may overshoot Ho (last tile) — pad H so every halo block is
+    # in-bounds; the overshoot rows are sliced off below
+    need_hp = (n_h * th_out - 1) * stride + kh
+    if need_hp > hp:
+        xp = jnp.pad(xp, ((0, 0), (0, need_hp - hp), (0, 0), (0, 0)))
+        hp = need_hp
+    ho_p = n_h * th_out
+
+    grid = (b, c // ct, n_h)
+    in_specs = [
+        _vmem_spec((1, th_in, wp, ct),
+                   lambda bi, ci, hi: (bi, hi * th_out * stride, 0, ci * ct),
+                   unblocked=True),
+        _vmem_spec((kh, kw, ct), lambda bi, ci, hi: (0, 0, ci)),
+        _vmem_spec((1, ct), lambda bi, ci, hi: (0, ci)),
+        _vmem_spec((1, ct), lambda bi, ci, hi: (0, ci)),
+    ]
+    out_spec = _vmem_spec((1, th_out, wo, ct),
+                          lambda bi, ci, hi: (bi, hi, 0, ci))
+    out_specs = [out_spec]
+    out_shape = [_out_struct((b, ho_p, wo, c), out_dtype, xp)]
+    if want_z:
+        # f32 pre-affine conv output, saved as the backward's residual —
+        # only the residual-saving forward pays for this buffer
+        out_specs.append(out_spec)
+        out_shape.append(_out_struct((b, ho_p, wo, c), jnp.float32, xp))
+    kern = functools.partial(_fwd_kernel, stride=stride, kh=kh, kw=kw,
+                             th_out=th_out, wo=wo, act=act)
+    out = pl.pallas_call(
+        kern, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=interpret,
+    )(xp, w, scale, bias)
+    if want_z:
+        y, z = out
+        return y[:, :ho], z[:, :ho]
+    return out[0][:, :ho], None
+
+
+# ---------------------------------------------------------------------------
+# backward dw kernel: k²-tap correlation reduced over (B, H tiles)
+# ---------------------------------------------------------------------------
+
+def _dwgrad_kernel(x_ref, dz_ref, dw_ref, acc_ref, *, stride, kh, kw, th_out,
+                   wo):
+    """One (c-tile, b, h-tile) grid cell accumulating ``dw[r·kw+s, c] +=
+    Σ_{rows,cols} dz ⊙ x_shift(r,s)`` into VMEM scratch; written once at the
+    last (b, h) step."""
+    ct = x_ref.shape[-1]
+    bi = pl.program_id(1)
+    hi = pl.program_id(2)
+    nb = pl.num_programs(1)
+    nh = pl.num_programs(2)
+
+    @pl.when(jnp.logical_and(bi == 0, hi == 0))
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    xv = x_ref[0].astype(jnp.float32)
+    dzv = dz_ref[0].astype(jnp.float32)
+    for r in range(kh):
+        for s in range(kw):
+            tap = lax.slice(
+                xv, (r, s, 0),
+                (r + (th_out - 1) * stride + 1, s + (wo - 1) * stride + 1,
+                 ct),
+                (stride, stride, 1))
+            acc_ref[r * kw + s, :] += jnp.sum(tap * dzv, axis=(0, 1))
+
+    @pl.when(jnp.logical_and(bi == nb - 1, hi == nh - 1))
+    def _finalize():
+        dw_ref[:] = acc_ref[:]
+
+
+def _dwgrad_call(xp, dz, kh, kw, *, stride, ho, wo, interpret):
+    """dw (kh, kw, C) from the padded input and the (zero-padded to the tile
+    grid) upstream conv-output gradient."""
+    b, hp, wp, c = xp.shape
+    ct = _channel_tile(c)
+    th_out = _pick_block_h(wp, ct, kh, stride, ho)
+    n_h = -(-ho // th_out)
+    th_in = th_out * stride + kh - stride
+    need_hp = (n_h * th_out - 1) * stride + kh
+    if need_hp > hp:
+        xp = jnp.pad(xp, ((0, 0), (0, need_hp - hp), (0, 0), (0, 0)))
+    ho_p = n_h * th_out
+    if ho_p > ho:
+        # zero rows contribute nothing to the correlation
+        dz = jnp.pad(dz, ((0, 0), (0, ho_p - ho), (0, 0), (0, 0)))
+
+    kern = functools.partial(_dwgrad_kernel, stride=stride, kh=kh, kw=kw,
+                             th_out=th_out, wo=wo)
+    dw = pl.pallas_call(
+        kern,
+        grid=(c // ct, b, n_h),
+        in_specs=[
+            _vmem_spec((1, th_in, wp, ct),
+                       lambda ci, bi, hi: (bi, hi * th_out * stride, 0,
+                                           ci * ct),
+                       unblocked=True),
+            _vmem_spec((1, th_out, wo, ct),
+                       lambda ci, bi, hi: (bi, hi, 0, ci)),
+        ],
+        out_specs=_vmem_spec((kh * kw, ct), lambda ci, bi, hi: (0, ci)),
+        out_shape=_out_struct((kh * kw, c), jnp.float32, xp),
+        scratch_shapes=[_scratch((kh * kw, ct))],
+        interpret=interpret,
+    )(xp, dz)
+    return dw.reshape(kh, kw, c)
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+def fused_depthwise(x: jnp.ndarray, w: jnp.ndarray,
+                    scale: Optional[jnp.ndarray] = None,
+                    bias: Optional[jnp.ndarray] = None,
+                    stride: Union[int, Tuple[int, int]] = 1,
+                    padding: Union[str, int, None, Sequence] = "",
+                    act: str = "silu",
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """``act(depthwise_conv(x, w) · scale + bias)`` in one VMEM pass.
+
+    ``x`` is NHWC ``(B, H, W, C)``; ``w`` is ``(kh, kw, C)`` or the HWIO
+    depthwise layout ``(kh, kw, 1, C)``; ``scale``/``bias`` are per-channel
+    ``(C,)`` (None → identity affine).  ``padding`` takes the same values as
+    :func:`ops.conv.resolve_padding` (``''`` = the reference's static
+    symmetric torch padding, ``'same'`` = TF SAME, int, or an explicit
+    ``[(lo, hi), (lo, hi)]``).  Equal H/W stride only (the EfficientNet
+    families never use anisotropic depthwise strides).  Accumulation and the
+    epilogue run in f32; the output is cast back to ``x.dtype``.
+
+    Gradients flow through a custom VJP whose ``dx``/``dw`` are also Pallas
+    (see module docstring).  ``interpret`` defaults to True off-TPU so the
+    CPU suite runs the kernels under the Pallas interpreter.
+    """
+    assert x.ndim == 4, f"expected NHWC (B, H, W, C), got {x.shape}"
+    if w.ndim == 4:  # HWIO depthwise (kh, kw, 1, C)
+        assert w.shape[2] == 1, f"not a depthwise kernel: {w.shape}"
+        w = w.reshape(w.shape[0], w.shape[1], w.shape[3])
+    assert w.shape[-1] == x.shape[-1], (w.shape, x.shape)
+    assert act in FUSED_DW_ACTS, f"act must be one of {FUSED_DW_ACTS}"
+    sh, sw = _to_tuple(stride)
+    assert sh == sw, f"anisotropic depthwise stride unsupported ({sh},{sw})"
+    stride = int(sh)
+    kh, kw = int(w.shape[0]), int(w.shape[1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    pad = resolve_padding(padding, (kh, kw), 1, stride)
+    if pad == "SAME":
+        def _same(n, k):
+            need = max((-(-n // stride) - 1) * stride + k - n, 0)
+            return (need // 2, need - need // 2)
+        pad = [_same(x.shape[1], kh), _same(x.shape[2], kw)]
+    elif pad == "VALID":
+        pad = [(0, 0), (0, 0)]
+    (ph0, ph1), (pw0, pw1) = [tuple(int(p) for p in pr) for pr in pad]
+
+    b, h, wdim, c = x.shape
+    hp, wp = h + ph0 + ph1, wdim + pw0 + pw1
+    ho = (hp - kh) // stride + 1
+    wo = (wp - kw) // stride + 1
+    assert ho > 0 and wo > 0, (x.shape, pad, stride)
+
+    out_dtype = x.dtype
+    w32 = w.astype(jnp.float32)
+    has_affine = scale is not None or bias is not None
+    scale32 = (jnp.ones((c,), jnp.float32) if scale is None
+               else scale.astype(jnp.float32))
+    bias32 = (jnp.zeros((c,), jnp.float32) if bias is None
+              else bias.astype(jnp.float32))
+    # the backward reads the pre-affine conv output z only through the act
+    # gradient and dscale — with an identity epilogue (exactly the training
+    # call: stats are computed OUTSIDE the kernel) dz == dy and the affine
+    # cotangents are gradients of internal constants, so saving z would
+    # re-add the full-size f32 HBM write the fusion exists to remove
+    needs_z = has_affine or act != "none"
+
+    def _pad_x(xv):
+        return jnp.pad(xv, ((0, 0), (ph0, ph1), (pw0, pw1), (0, 0)))
+
+    @jax.custom_vjp
+    def _op(xv, wv, sv, bv):
+        y, _ = _dw_call(_pad_x(xv), wv, sv.reshape(1, c), bv.reshape(1, c),
+                        stride=stride, act=act, ho=ho, wo=wo,
+                        out_dtype=out_dtype, want_z=False,
+                        interpret=interpret)
+        return y
+
+    def _op_fwd(xv, wv, sv, bv):
+        y, z = _dw_call(_pad_x(xv), wv, sv.reshape(1, c), bv.reshape(1, c),
+                        stride=stride, act=act, ho=ho, wo=wo,
+                        out_dtype=out_dtype, want_z=needs_z,
+                        interpret=interpret)
+        return y, (xv, wv, sv, bv, z)
+
+    def _op_bwd(res, g):
+        xv, wv, sv, bv, z = res
+        g32 = g.astype(jnp.float32)
+        if needs_z:
+            u = z * sv[None, None, None, :] + bv[None, None, None, :]
+            du = g32 * _act_grad_f32(act)(u) if act != "none" else g32
+            # per-channel reductions fuse with the du pass in XLA
+            dbias = jnp.sum(du, axis=(0, 1, 2))
+            dscale = jnp.sum(du * z, axis=(0, 1, 2))
+            dz = du * sv[None, None, None, :]
+        else:
+            # identity epilogue: dz == dy; the affine params are internal
+            # constants, their cotangents are discarded upstream
+            dz = g32
+            dscale = jnp.zeros_like(sv)
+            dbias = jnp.zeros_like(bv)
+        # dx: transposed depthwise conv == the SAME forward kernel over the
+        # interior-dilated dz padded by (k-1), with the kernel flipped
+        dzd = lax.pad(dz, jnp.float32(0),
+                      ((0, 0, 0),
+                       (kh - 1, kh - 1, stride - 1),
+                       (kw - 1, kw - 1, stride - 1),
+                       (0, 0, 0)))
+        wf = wv[::-1, ::-1].astype(jnp.float32)
+        ones = jnp.ones((1, c), jnp.float32)
+        zeros = jnp.zeros((1, c), jnp.float32)
+        dxh = (ho - 1) * stride + kh      # rows of xp that received taps
+        dxw = (wo - 1) * stride + kw
+        dx_p, _ = _dw_call(dzd, wf, ones, zeros, stride=1, act="none",
+                           ho=dxh, wo=dxw, out_dtype=jnp.float32,
+                           want_z=False, interpret=interpret)
+        # rows/cols of the padded input beyond the last tap window got no
+        # gradient; re-inflate to (Hp, Wp) then strip the conv padding
+        dx_p = jnp.pad(dx_p, ((0, 0), (0, hp - dxh), (0, wp - dxw), (0, 0)))
+        dx = dx_p[:, ph0:ph0 + h, pw0:pw0 + wdim]
+        dw = _dwgrad_call(_pad_x(xv.astype(jnp.float32)), dz, kh, kw,
+                          stride=stride, ho=ho, wo=wo, interpret=interpret)
+        return (dx.astype(xv.dtype), dw.astype(wv.dtype),
+                dscale.astype(sv.dtype), dbias.astype(bv.dtype))
+
+    _op.defvjp(_op_fwd, _op_bwd)
+    return _op(x, w32, scale32, bias32)

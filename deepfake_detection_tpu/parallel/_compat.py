@@ -27,8 +27,27 @@ try:
 except ImportError:                      # pre-0.6: experimental home
     from jax.experimental.shard_map import shard_map  # noqa: F401
 
-__all__ = ["axis_size", "pcast_varying", "shard_map",
-           "shard_map_check_kwargs"]
+__all__ = ["axis_size", "coordination_client", "distributed_is_initialized",
+           "pcast_varying", "shard_map", "shard_map_check_kwargs"]
+
+
+def coordination_client():
+    """The process's jax.distributed coordination-service client, or None
+    when uninitialized.  The only sanctioned accessor for the private
+    ``jax._src.distributed.global_state`` surface — version drift lands
+    here, not in callers."""
+    from jax._src import distributed
+    return distributed.global_state.client
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()``; absent pre-0.5 — fall back to
+    probing the coordination-service client the initialize() call owns."""
+    import jax
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    return coordination_client() is not None
 
 
 def axis_size(axis_name: str) -> int:

@@ -9,7 +9,7 @@ from .checkpoint import (CheckpointCorrupt, CheckpointSaver,
                          wait_pending_saves)
 from .resilience import (EXIT_PREEMPTED, EXIT_WATCHDOG, AnomalyGuard,
                          Preempted, Resilience, RewindRequested,
-                         StallWatchdog)
+                         StallWatchdog, allreduce_flags)
 from .state import (TrainState, create_train_state, get_learning_rate,
                     set_learning_rate)
 from .steps import make_eval_step, make_train_step

@@ -30,23 +30,32 @@ hangs forever.  This module gives the runner four coordinated defenses:
   budget, and the watchdog.
 
 Multi-host notes: guard decisions are deterministic functions of the
-*replicated* loss/nonfinite scalars, so every host raises the same rewind
-at the same step and the collective (sharded) restore stays in lockstep.
-The preemption flag however is host-local — on multi-host deployments the
-watchdog + restart-wrapper path (whole-job relaunch into ``--auto-resume``)
-is the supported preemption story; see ROADMAP open items.
+*replicated* loss/nonfinite scalars, so under normal operation every host
+computes the same verdict — but "normal operation" is exactly what a fault
+layer must not assume, and the preemption flag is genuinely host-local (each
+host gets its own SIGTERM, at its own step boundary).  Multi-process runs
+therefore agree on the verdicts IN-BAND: the guard defers its rewind raise
+(``coordinated=True``) and :meth:`Resilience.sync_verdicts` max-reduces the
+``[stop, rewind]`` flag pair across processes at the trainer's drain cadence
+(a deterministic boundary every host reaches, so the collective cannot
+one-side).  Any host's verdict wins everywhere, and every host raises
+:class:`Preempted` / :class:`RewindRequested` at the SAME boundary — which is
+what makes the lockstep recovery snapshot and the collective sharded restore
+safe to enter.
 """
 
 from __future__ import annotations
 
 import faulthandler
+import itertools
 import logging
+import os
 import signal
 import sys
 import threading
 import time
 from collections import deque
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 import numpy as np
 
@@ -55,7 +64,8 @@ from ..chaos import ChaosInjector, chaos_from_env
 _logger = logging.getLogger(__name__)
 
 __all__ = ["EXIT_PREEMPTED", "EXIT_WATCHDOG", "Preempted", "RewindRequested",
-           "PreemptionHandler", "AnomalyGuard", "StallWatchdog", "Resilience"]
+           "PreemptionHandler", "AnomalyGuard", "StallWatchdog", "Resilience",
+           "allreduce_flags"]
 
 #: exit code after a signal-requested stop with a recovery snapshot on disk
 #: (os.EX_TEMPFAIL: "try again later" — the restart wrapper relaunches)
@@ -81,6 +91,67 @@ class Preempted(Exception):
 class RewindRequested(Exception):
     """Raised by the guard when training should rewind to the last
     recovery snapshot instead of continuing on suspect state."""
+
+
+#: lockstep round counter for :func:`allreduce_flags` key namespacing —
+#: advances identically on every host because the trainer only syncs at
+#: deterministic loop boundaries
+_sync_round = itertools.count()
+#: how long one host waits for a peer's verdict before declaring the job
+#: wedged; generous — peers reach the same LOOP boundary at skewed wall
+#: times (compile variance, straggler steps)
+SYNC_TIMEOUT_MS = int(os.environ.get("DFD_VERDICT_SYNC_TIMEOUT_MS",
+                                     str(10 * 60 * 1000)))
+
+
+def allreduce_flags(flags: np.ndarray) -> np.ndarray:
+    """Max-reduce a small int32 flag vector across all jax processes.
+
+    The in-band agreement primitive for the host-local verdict scalars
+    (preemption stop, guard rewind): any host's 1 becomes every host's 1.
+    Runs over the jax.distributed coordination-service KV store — a few
+    bytes of gRPC, no XLA computation — so it works on every backend
+    (CPU cross-process XLA computations are unimplemented in some jaxlib
+    builds) and never competes with the step for device time.
+
+    COLLECTIVE in cadence: every process must call it the same number of
+    times, at the same boundary; the trainer guarantees that by syncing
+    only at the metric-drain cadence (``last_batch or batch_idx %
+    log_interval == 0``), a pure function of loop indices every host walks
+    identically.  Single-process runs return the input unchanged without
+    touching the runtime.
+    """
+    import jax                          # lazy: keep this module jax-light
+    flags = np.asarray(flags, np.int32)
+    if jax.process_count() == 1:
+        return flags
+    from ..parallel._compat import coordination_client
+    client = coordination_client()
+    if client is None:  # pragma: no cover - pod runtimes init elsewhere
+        raise RuntimeError(
+            "multi-process run without a jax.distributed coordination "
+            "client: verdict agreement needs the KV store")
+    rnd = next(_sync_round)
+    me = jax.process_index()
+    client.key_value_set(f"dfd/verdict/{rnd}/{me}",
+                         ",".join(str(int(v)) for v in flags))
+    out = flags.copy()
+    for r in range(jax.process_count()):
+        if r == me:
+            continue
+        peer = client.blocking_key_value_get(f"dfd/verdict/{rnd}/{r}",
+                                             SYNC_TIMEOUT_MS)
+        out = np.maximum(out, np.fromiter(
+            (int(v) for v in peer.split(",")), np.int32, len(flags)))
+    # a long run syncs every drain boundary — drop a FINISHED round's keys
+    # or the coordination service leaks a key per process per round.  The
+    # previous round is complete by construction (every peer answered it
+    # before writing this one); deleting our own rnd key would race a slow
+    # peer's pending get.
+    delete = getattr(client, "key_value_delete", None)
+    if rnd > 0 and delete is not None:
+        delete(f"dfd/verdict/{rnd - 1}/{me}")
+    return out
 
 
 class PreemptionHandler:
@@ -146,10 +217,16 @@ class AnomalyGuard:
     """
 
     def __init__(self, spike_window: int = 0, spike_zmax: float = 8.0,
-                 rewind_after: int = 3):
+                 rewind_after: int = 3, coordinated: bool = False):
         self.spike_window = int(spike_window)
         self.spike_zmax = float(spike_zmax)
         self.rewind_after = max(1, int(rewind_after))
+        # multi-process: defer the rewind raise — the verdict scalar is
+        # max-reduced across hosts (Resilience.sync_verdicts) so every host
+        # raises at the same boundary, or none does
+        self.coordinated = bool(coordinated)
+        self.rewind_wanted = False
+        self.rewind_reason = ""
         self._hist: deque = deque(maxlen=max(self.spike_window, 1))
         self.bad_streak = 0
         self.nonfinite_total = 0
@@ -184,13 +261,20 @@ class AnomalyGuard:
             return False
         self.bad_streak += 1
         if self.bad_streak >= self.rewind_after:
-            raise RewindRequested(
-                f"{self.bad_streak} consecutive bad steps "
-                f"(last at update {step_index}, loss {loss!r})")
+            reason = (f"{self.bad_streak} consecutive bad steps "
+                      f"(last at update {step_index}, loss {loss!r})")
+            if not self.coordinated:
+                raise RewindRequested(reason)
+            # multi-process: record the verdict; sync_verdicts raises it on
+            # EVERY host at the next drain boundary
+            self.rewind_wanted = True
+            self.rewind_reason = reason
         return True
 
     def reset_streak(self) -> None:
         self.bad_streak = 0
+        self.rewind_wanted = False
+        self.rewind_reason = ""
 
 
 class StallWatchdog:
@@ -294,11 +378,13 @@ class Resilience:
 
     @classmethod
     def from_config(cls, cfg) -> "Resilience":
+        import jax                      # lazy: keep this module jax-light
         guard = None
         if cfg.guard_nonfinite != "off" or cfg.guard_spike_window > 0:
             guard = AnomalyGuard(spike_window=cfg.guard_spike_window,
                                  spike_zmax=cfg.guard_spike_zmax,
-                                 rewind_after=cfg.guard_rewind_after)
+                                 rewind_after=cfg.guard_rewind_after,
+                                 coordinated=jax.process_count() > 1)
         self = cls(preemption=PreemptionHandler(), guard=guard,
                    chaos=chaos_from_env(),
                    rewind_limit=cfg.guard_rewind_limit)
@@ -353,6 +439,34 @@ class Resilience:
         if self.guard is None:
             return bool(nonfinite) or not np.isfinite(loss)
         return self.guard.observe(step_index, loss, nonfinite)
+
+    def sync_verdicts(self) -> Tuple[bool, bool]:
+        """Multi-host in-band agreement on the ``[stop, rewind]`` verdicts.
+
+        Max-reduces the host-local preemption flag and the guard's deferred
+        rewind verdict across processes and returns the agreed ``(stop,
+        rewind)`` pair — any host's verdict wins everywhere.  COLLECTIVE:
+        call only at a boundary every process reaches (the trainer's drain
+        cadence).  A remote host's stop is adopted locally (so this host
+        also exits :data:`EXIT_PREEMPTED` and the restart wrapper relaunches
+        the whole job), and an agreed rewind resets every host's streak so
+        the replayed span starts clean.
+        """
+        want_stop = self.stop_requested
+        want_rewind = self.guard is not None and self.guard.rewind_wanted
+        stop, rewind = (bool(v) for v in
+                        allreduce_flags(np.array([want_stop, want_rewind],
+                                                 np.int32)))
+        if stop and not want_stop:
+            # adopt the remote stop so stop_signum/exit-code logic runs
+            # exactly as if this host had been signalled itself
+            if self.preemption is None:
+                self.preemption = PreemptionHandler()   # uninstalled is fine
+            self.preemption.stop_requested = True
+            _logger.warning("adopting a remote host's preemption stop")
+        if rewind and self.guard is not None and not self.guard.rewind_reason:
+            self.guard.rewind_reason = "remote host requested rewind"
+        return stop, rewind
 
     def start_rewind(self, reason: str) -> None:
         """Account one rewind; raises when the budget is exhausted."""

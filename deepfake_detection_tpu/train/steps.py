@@ -173,6 +173,7 @@ def make_train_step(model, tx: optax.GradientTransformation,
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 
     # ---- local-BN shard_map over the data axis -------------------------
+    from ..parallel import _compat
     from ..parallel._compat import shard_map
 
     def local_step(state: TrainState, x, y, rng):
@@ -184,11 +185,23 @@ def make_train_step(model, tx: optax.GradientTransformation,
             (loss, grads, new_stats, prec1), axis)
         return apply_updates(state, grads, new_stats, loss, prec1)
 
+    # The fused depthwise path embeds pallas_call in the step: the legacy
+    # check_rep machinery has no replication rule for that primitive AT ALL,
+    # and off-TPU the Pallas *interpreter* mixes its non-varying block
+    # counters with varying refs, which even the modern vma checker rejects
+    # (same reason ring_flash disables it, parallel/ring_attention.py).  On
+    # compiled Mosaic under a check_vma jax the vma-typed out_shapes keep
+    # the check satisfied, so it stays on there.
+    check = True
+    if getattr(model, "fused_depthwise", "off") == "pallas":
+        legacy = "check_rep" in _compat.shard_map_check_kwargs(True)
+        check = not legacy and jax.default_backend() == "tpu"
     data_spec = P(axis)
     sharded = shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), data_spec, data_spec, P()),
-        out_specs=(P(), P()))
+        out_specs=(P(), P()),
+        **_compat.shard_map_check_kwargs(check))
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.metrics import AverageMeter, auc
-from .resilience import Preempted
+from .resilience import Preempted, RewindRequested
 from .state import TrainState, get_learning_rate, set_learning_rate
 
 _logger = logging.getLogger(__name__)
@@ -194,9 +194,16 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                 batch_time_m.avg / max(bs // world_size, 1),
                 lr, data_time_m.val, data_time_m.avg, ets_time)
             if cfg.save_images and output_dir and jax.process_index() == 0:
+                xd = x
+                if getattr(cfg, "stem_s2d", False):
+                    # the loader prologue pixel-shuffled the batch for the
+                    # s2d stem — un-shuffle so the dump shows real frames,
+                    # not 2x2 subpixel phases
+                    from ..ops.conv import depth_to_space
+                    xd = depth_to_space(np.asarray(x, np.float32))
                 save_image_batch(
-                    x, os.path.join(output_dir,
-                                    f"train-batch-{batch_idx}.jpg"),
+                    xd, os.path.join(output_dir,
+                                     f"train-batch-{batch_idx}.jpg"),
                     img_num=max(1, cfg.resolved_in_chans // 3))
 
         if cfg.recovery_interval and (
@@ -229,29 +236,36 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                 _logger.warning("chaos: delivering SIGTERM to self at "
                                 "update %d", num_updates)
                 os.kill(os.getpid(), signal.SIGTERM)
-            if resilience.stop_requested:
+            stop = resilience.stop_requested
+            rewind = False
+            if jax.process_count() > 1:
+                # host-local verdicts (each host gets its own SIGTERM at
+                # its own boundary; a guard streak could in principle
+                # diverge) cannot drive lockstep actions one-sidedly.
+                # Agree IN-BAND at the drain cadence — a pure function of
+                # loop indices every host walks identically, so the
+                # collective cannot one-side — then every host stops /
+                # rewinds at the SAME boundary, which is what makes the
+                # snapshot below and the collective restore safe.
+                if last_batch or batch_idx % cfg.log_interval == 0:
+                    stop, rewind = resilience.sync_verdicts()
+                else:
+                    stop = rewind = False   # defer to the next boundary
+            if rewind:
+                raise RewindRequested(resilience.guard.rewind_reason
+                                      or "coordinated rewind")
+            if stop:
                 # stop at THIS step boundary: drain buffered metrics (a
                 # host sync, so the state below is the post-step state),
                 # write a SYNCHRONOUS recovery snapshot carrying the exact
                 # loop position, and unwind — the runner exits with the
-                # preemption code so a wrapper can relaunch --auto-resume
+                # preemption code so a wrapper can relaunch --auto-resume.
+                # Multi-host both save paths (rank-0 gather / collective
+                # Orbax write) are lockstep ops — safe exactly because the
+                # agreement above put every host here together.
                 _drain()
-                if jax.process_count() == 1:
-                    _save_recovery(saver, state, meta, epoch, batch_idx,
-                                   num_updates, sync=True)
-                else:
-                    # the stop flag is HOST-LOCAL: both save paths are
-                    # cross-host lockstep operations (the rank-0 gather,
-                    # or the collective Orbax write), and hosts observe
-                    # their signals at different step boundaries —
-                    # entering either one-sided deadlocks.  Rely on the
-                    # periodic snapshots (ROADMAP: cross-host
-                    # coordinated stop)
-                    _logger.warning(
-                        "multi-host preemption: skipping the in-band "
-                        "snapshot (host-local stop flag cannot drive a "
-                        "lockstep save); auto-resume will use the last "
-                        "periodic recovery checkpoint")
+                _save_recovery(saver, state, meta, epoch, batch_idx,
+                               num_updates, sync=True)
                 raise Preempted(epoch, batch_idx, resilience.stop_signum)
         end = time.time()
 

@@ -1,0 +1,51 @@
+"""tools/bench_blocks.py --smoke: the bench harness itself cannot rot.
+
+One fresh-interpreter run of the full row matrix at seconds-scale shapes;
+asserts every row family emits both implementations with sane numbers.
+Performance is NOT asserted (CPU, interpreter Pallas) — the doc tables
+only admit TPU-stamped rows, which is exactly what the ``interpret`` /
+``device`` fields in each row exist to gate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.smoke, pytest.mark.pallas]
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def test_bench_blocks_smoke_emits_full_matrix():
+    # share the suite's persistent compilation cache (conftest.py): the
+    # XLA step/stem programs dominate the smoke's runtime and cache across
+    # runs; only the interpret-mode Pallas tracing re-pays every time
+    cache = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                           os.path.join(_REPO, ".jax_cache"))
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu",
+               JAX_COMPILATION_CACHE_DIR=cache)
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "bench_blocks.py"),
+         "--smoke"],
+        capture_output=True, text=True, env=env, timeout=600, check=True)
+    rows = [json.loads(ln) for ln in out.stdout.splitlines() if ln.strip()]
+    assert any("note" in r for r in rows)       # CPU rows are flagged
+
+    blocks = [r for r in rows if r.get("row") == "block"]
+    assert {r["impl"] for r in blocks} == {"xla", "pallas"}
+    assert not any("error" in r for r in blocks), blocks
+    for r in blocks:
+        assert r["fwd_ms"] > 0 and r["fwd_bwd_ms"] > 0
+        # the interpreter stamp gates these rows out of the doc tables
+        assert r["interpret"] == (r["impl"] == "pallas")
+
+    stems = [r for r in rows if r.get("row") == "stem"]
+    assert {r["impl"] for r in stems} == {"stride2", "s2d"}
+
+    steps = [r for r in rows if r.get("row") == "step"]
+    assert {r["impl"] for r in steps} == \
+        {"baseline", "fused", "s2d", "fused+s2d"}
+    assert not any("error" in r for r in steps), steps

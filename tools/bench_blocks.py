@@ -1,0 +1,245 @@
+"""Fused-depthwise / s2d-stem microbenchmark matrix (PERF.md post-fusion).
+
+Three row families, one JSON line each:
+
+* ``block`` — per-MBConv-stage ``dw-conv → BN affine → SiLU`` latency,
+  XLA lowering vs the Pallas fused kernel (ops/depthwise_pallas.py),
+  fwd and fwd+bwd, at the B4/flagship stage shapes the PERF.md roofline
+  says bind step time;
+* ``stem`` — the stride-2 stem conv vs its space-to-depth rewrite
+  (ops/conv.py ``space_to_depth_stem_kernel``), the MXU-starvation fix;
+* ``step`` — a full forward+backward model step with the flags off vs on,
+  the before/after number the per-block rows must explain.
+
+CPU-runnable end-to-end (that is what ``--smoke`` and the fast-tier test
+exercise: the harness itself cannot rot), but Pallas rows run under the
+interpreter off-TPU — orders of magnitude slow and NOT a performance
+signal, so every row is stamped ``device``/``interpret`` and the doc
+tables only admit rows measured on a real TPU, the same verified-rows
+gate INPUT_BENCH.md / SERVE_BENCH.md use.  Usage::
+
+    python tools/bench_blocks.py                  # full matrix
+    python tools/bench_blocks.py --smoke          # seconds-scale CI row
+    python tools/bench_blocks.py --rows block,step --iters 50   # on TPU
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (name, H, W, C, kernel, stride): the depthwise stages of the families the
+# roofline says are VPU-bound — B4 380² resolutions and the flagship's
+# 600²×12 first stages (channel counts after the 2.0 width multiplier)
+BLOCK_SHAPES = [
+    ("b4_s1_k3", 190, 190, 48, 3, 1),
+    ("b4_s2_k3", 190, 190, 144, 3, 2),
+    ("b4_s3_k5", 95, 95, 192, 5, 2),
+    ("b4_s5_k5", 24, 24, 960, 5, 1),
+    ("flagship_s1_k3", 300, 300, 256, 3, 1),
+    ("flagship_s2_k3", 300, 300, 384, 3, 2),
+]
+SMOKE_SHAPES = [("smoke_k3", 16, 16, 32, 3, 1), ("smoke_k5s2", 16, 16, 32, 5, 2)]
+
+
+def _bench(fn, iters, *xs) -> float:
+    import jax
+    out = fn(*xs)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*xs)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1000
+
+
+def _emit(row: dict) -> None:
+    print(json.dumps(row), flush=True)
+
+
+def bench_blocks(args, dev, interpret: bool) -> None:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from deepfake_detection_tpu.ops.depthwise_pallas import fused_depthwise
+
+    dtype = getattr(jnp, args.dtype)
+    rng = np.random.default_rng(0)
+    shapes = SMOKE_SHAPES if args.smoke else BLOCK_SHAPES
+    for name, h, w, c, k, stride in shapes:
+        x = jnp.asarray(rng.standard_normal((args.batch, h, w, c)), dtype)
+        kern = jnp.asarray(rng.standard_normal((k, k, 1, c)) * 0.1,
+                           jnp.float32)
+        scale = jnp.asarray(rng.uniform(0.5, 1.5, c), jnp.float32)
+        bias = jnp.asarray(rng.uniform(-0.1, 0.1, c), jnp.float32)
+
+        def xla_stage(x, kern, scale, bias):
+            pad = (k - 1) // 2
+            z = lax.conv_general_dilated(
+                x, kern.astype(x.dtype), (stride, stride),
+                [(pad, pad), (pad, pad)], feature_group_count=c,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            return jax.nn.silu(z * scale.astype(z.dtype)
+                               + bias.astype(z.dtype))
+
+        def pallas_stage(x, kern, scale, bias):
+            return fused_depthwise(x, kern, scale, bias, stride=stride,
+                                   padding=(k - 1) // 2, act="silu",
+                                   interpret=interpret or None)
+
+        for impl, fn in (("xla", xla_stage), ("pallas", pallas_stage)):
+            try:
+                jfn = jax.jit(fn)
+                fwd_ms = _bench(jfn, args.iters, x, kern, scale, bias)
+
+                def loss(x, kern, scale, bias, _fn=fn):
+                    return _fn(x, kern, scale, bias).astype(
+                        jnp.float32).sum()
+
+                grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))
+                bwd_ms = _bench(grad, args.iters, x, kern, scale, bias)
+            except Exception as e:  # noqa: BLE001 — record, continue
+                _emit({"row": "block", "name": name, "impl": impl,
+                       "error": repr(e)[:300], "device": dev.device_kind})
+                continue
+            ho, wo = -(-h // stride), -(-w // stride)
+            gflop = 2.0 * args.batch * ho * wo * c * k * k / 1e9
+            _emit({"row": "block", "name": name, "impl": impl,
+                   "shape": f"{args.batch}x{h}x{w}x{c}", "k": k,
+                   "stride": stride, "fwd_ms": round(fwd_ms, 3),
+                   "fwd_bwd_ms": round(bwd_ms, 3),
+                   "fwd_gflops_per_s": round(gflop / fwd_ms * 1000, 1),
+                   "dtype": args.dtype, "device": dev.device_kind,
+                   "interpret": bool(interpret and impl == "pallas")})
+
+
+def bench_stem(args, dev) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from deepfake_detection_tpu.ops.conv import (space_to_depth,
+                                                 space_to_depth_stem_kernel)
+
+    dtype = getattr(jnp, args.dtype)
+    rng = np.random.default_rng(1)
+    size, chans, stem = (64, 3, 16) if args.smoke else (600, 12, 256)
+    x = jnp.asarray(rng.standard_normal((args.batch, size, size, chans)),
+                    dtype)
+    kern = jnp.asarray(rng.standard_normal((3, 3, chans, stem)) * 0.1,
+                       jnp.float32)
+
+    def stride2(x, kern):
+        return lax.conv_general_dilated(
+            x, kern.astype(x.dtype), (2, 2), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def s2d(x, kern):
+        k2, pad = space_to_depth_stem_kernel(kern)
+        return lax.conv_general_dilated(
+            space_to_depth(x), k2.astype(x.dtype), (1, 1), pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    for impl, fn in (("stride2", stride2), ("s2d", s2d)):
+        fwd_ms = _bench(jax.jit(fn), args.iters, x, kern)
+        _emit({"row": "stem", "impl": impl,
+               "shape": f"{args.batch}x{size}x{size}x{chans}",
+               "stem_chs": stem, "fwd_ms": round(fwd_ms, 3),
+               "dtype": args.dtype, "device": dev.device_kind})
+
+
+def bench_step(args, dev, interpret: bool) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepfake_detection_tpu.models import create_model, init_model
+
+    model_name = args.model
+    size = 32 if args.smoke else args.size
+    batch = 1 if args.smoke else args.batch
+    dtype = getattr(jnp, args.dtype)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((batch, size, size, 3)), dtype)
+
+    variants = [("baseline", {}), ("fused", {"fused_depthwise": "pallas"}),
+                ("s2d", {"stem_s2d": True}),
+                ("fused+s2d", {"fused_depthwise": "pallas",
+                               "stem_s2d": True})]
+    variables = None
+    for name, kw in variants:
+        model = create_model(model_name, num_classes=2, in_chans=3, **kw)
+        if variables is None:   # identical tree across variants, init once
+            variables = init_model(model, jax.random.PRNGKey(0),
+                                   (1, size, size, 3))
+
+        def loss(params, x, _m=model):
+            y = _m.apply({"params": params,
+                          "batch_stats": variables["batch_stats"]},
+                         x, training=False)
+            return y.astype(jnp.float32).sum()
+
+        try:
+            step = jax.jit(jax.grad(loss))
+            ms = _bench(step, args.iters, variables["params"], x)
+        except Exception as e:  # noqa: BLE001 — record, continue
+            _emit({"row": "step", "impl": name, "model": model_name,
+                   "error": repr(e)[:300], "device": dev.device_kind})
+            continue
+        _emit({"row": "step", "impl": name, "model": model_name,
+               "shape": f"{batch}x{size}x{size}x3",
+               "fwd_bwd_ms": round(ms, 3), "dtype": args.dtype,
+               "device": dev.device_kind,
+               "interpret": bool(interpret and "fused" in name)})
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--size", type=int, default=380)
+    ap.add_argument("--model", default=None,
+                    help="step-row model (default: efficientnet_b0, or "
+                         "mnasnet_small under --smoke)")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--rows", default="block,stem,step",
+                    help="comma list of row families to run")
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale CI mode: tiny shapes, 2 iters, "
+                         "f32 (the harness-can't-rot row)")
+    args = ap.parse_args()
+    if args.smoke:
+        args.iters, args.batch, args.dtype = 2, 2, "float32"
+    if args.model is None:
+        args.model = "mnasnet_small" if args.smoke else "efficientnet_b0"
+
+    import jax
+
+    dev = jax.devices()[0]
+    interpret = jax.default_backend() != "tpu"
+    if interpret:
+        _emit({"note": "non-TPU backend: Pallas rows run under the "
+                       "interpreter and are NOT a performance signal "
+                       "(doc tables only admit device='TPU *' rows)",
+               "device": dev.device_kind})
+    rows = set(args.rows.split(","))
+    if "block" in rows:
+        bench_blocks(args, dev, interpret)
+    if "stem" in rows:
+        bench_stem(args, dev)
+    if "step" in rows:
+        bench_step(args, dev, interpret)
+
+
+if __name__ == "__main__":
+    main()
