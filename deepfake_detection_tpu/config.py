@@ -177,6 +177,13 @@ class TrainConfig:
     loader_backend: str = "thread"
     ring_depth: int = 4                  # shm backend: batch slabs in flight
     worker_heartbeat: float = 120.0      # shm backend: stalled-worker kill (s)
+    # packed pre-decoded dataset cache (tools/pack_dataset.py): mmap-read
+    # fixed-stride uint8 clips instead of decoding JPEGs every epoch.
+    # Replaces the decode STAGE only — composes with either loader backend,
+    # and batches are bit-identical to the decode path at matching pack
+    # resolution (data/packed.py)
+    data_packed: str = ""                # pack dir ("" = decode JPEGs)
+    pack_image_size: int = 0             # expected pack resolution (0 = any)
 
     # --- model ---
     model: str = "efficientnet_deepfake_v4"
@@ -364,6 +371,13 @@ class TrainConfig:
         if int(self.ring_depth) < 3:
             raise ValueError("--ring-depth must be >= 3 (double buffering "
                              f"needs one spare slab), got {self.ring_depth}")
+        if int(self.pack_image_size) < 0:
+            raise ValueError("--pack-image-size must be >= 0, got "
+                             f"{self.pack_image_size}")
+        if self.pack_image_size and not self.data_packed:
+            raise ValueError("--pack-image-size only makes sense with "
+                             "--data-packed (it asserts the pack's "
+                             "resolution, not a resize)")
 
     # ------------------------------------------------------------------
     @property

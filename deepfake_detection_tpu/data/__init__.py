@@ -19,6 +19,8 @@ from .constants import (DEFAULT_CROP_PCT, IMAGENET_DEFAULT_MEAN,
 from .dataset import (ConcatDataset, DatasetTar, DeepFakeClipDataset,
                       FolderDataset, SyntheticDataset,
                       read_clip_list, split_clips)
+from .packed import (PackedCacheStale, PackedDataset, PackedShardCorrupt,
+                     verify_pack, write_pack)
 from .samplers import (OrderedShardedSampler, ShardedTrainSampler,
                        epoch_batches)
 from .shm_ring import ShmRing, ShmRingLoader

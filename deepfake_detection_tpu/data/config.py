@@ -113,6 +113,13 @@ def resolve_data_config(args: Dict[str, Any],
     elif default_cfg.get("crop_pct"):
         new_config["crop_pct"] = default_cfg["crop_pct"]
 
+    # packed pre-decoded cache (data/packed.py): the dir replaces the JPEG
+    # decode stage; pack_image_size (0/None = accept the pack's stored
+    # resolution) is the loud-mismatch assertion, never a resize knob
+    new_config["pack_dir"] = args.get("data_packed") or None
+    new_config["pack_image_size"] = int(args.get("pack_image_size") or 0) \
+        or None
+
     if verbose:
         _logger.info("Data processing configuration:")
         for n, v in new_config.items():

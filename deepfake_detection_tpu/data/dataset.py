@@ -42,7 +42,8 @@ from . import native
 
 __all__ = ["AugMixDataset", "ConcatDataset", "DatasetTar",
            "DeepFakeClipDataset", "FolderDataset",
-           "SyntheticDataset", "read_clip_list", "split_clips"]
+           "SyntheticDataset", "clip_frame_paths", "read_clip_list",
+           "split_clips"]
 
 _IMG_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp")
 
@@ -91,6 +92,26 @@ def read_clip_list(list_file: str, root_index: int = 0
     return out
 
 
+def clip_frame_paths(roots: Sequence[str], kind: str,
+                     clip: Tuple[str, int, int],
+                     frames_per_clip: int) -> List[str]:
+    """Frame paths for one clip, front-padded with frame 0 (reference
+    :496-512).  Clips longer than ``frames_per_clip`` use the first
+    ``frames_per_clip`` frames (the reference would emit a ragged channel
+    count and crash downstream; clamping is the sane reading).  Module-level
+    so the dataset cache packer (tools/pack_dataset.py) resolves the exact
+    frames the runtime decode path would."""
+    name, num, root_index = clip
+    num = int(num)
+    base = os.path.join(roots[int(root_index)], kind, name)
+    k = frames_per_clip
+    if num >= k:
+        idxs: List[int] = list(range(k))
+    else:
+        idxs = [0] * (k - num) + list(range(num))
+    return [os.path.join(base, f"{i}.jpg") for i in idxs]
+
+
 def split_clips(clips: Sequence[Tuple], train_ratio: float, seed: int,
                 is_training: bool) -> List[Tuple]:
     """Deterministic seeded train/val split.
@@ -136,9 +157,10 @@ class DeepFakeClipDataset:
 
         real: List[Tuple[str, int, int]] = []
         fake: List[Tuple[str, int, int]] = []
-        for ri, root in enumerate(self.roots):
-            real += read_clip_list(os.path.join(root, "real_list.txt"), ri)
-            fake += read_clip_list(os.path.join(root, "fake_list.txt"), ri)
+        for ri in range(self._num_roots()):
+            r, f = self._read_root_lists(ri)
+            real += r
+            fake += f
 
         if train_split:
             real = split_clips(real, train_ratio, split_seed, is_training)
@@ -176,6 +198,27 @@ class DeepFakeClipDataset:
             self.fake_buckets = []
 
     # ------------------------------------------------------------------
+    # hooks subclasses override to swap the clip SOURCE (the packed-cache
+    # dataset replaces both with index-file/mmap lookups, data/packed.py)
+    def _num_roots(self) -> int:
+        return len(self.roots)
+
+    def _read_root_lists(self, root_index: int
+                         ) -> Tuple[List[Tuple[str, int, int]],
+                                    List[Tuple[str, int, int]]]:
+        """(real, fake) clip lists for one root, in list-file order (the
+        seeded split/bucketing downstream is order-sensitive)."""
+        root = self.roots[root_index]
+        return (read_clip_list(os.path.join(root, "real_list.txt"),
+                               root_index),
+                read_clip_list(os.path.join(root, "fake_list.txt"),
+                               root_index))
+
+    def _load_clip(self, kind: str, clip: Tuple[str, int, int]):
+        """Decode one clip's frames (front-padded to ``frames_per_clip``)."""
+        return _load_images(self._clip_paths(kind, clip))
+
+    # ------------------------------------------------------------------
     def set_epoch(self, epoch: int) -> None:
         """Advance the stateless bucket-rotation cursor."""
         self.epoch = epoch
@@ -188,39 +231,32 @@ class DeepFakeClipDataset:
 
     # ------------------------------------------------------------------
     def _clip_paths(self, kind: str, clip: Tuple[str, int, int]) -> List[str]:
-        """Frame paths for one clip, front-padded with frame 0 (reference
-        :496-512).  Clips longer than ``frames_per_clip`` use the first
-        ``frames_per_clip`` frames (the reference would emit a ragged channel
-        count and crash downstream; clamping is the sane reading)."""
-        name, num, root_index = clip
-        num = int(num)
-        root = self.roots[int(root_index)]
-        base = os.path.join(root, kind, name)
-        k = self.frames_per_clip
-        if num >= k:
-            idxs = list(range(k))
-        else:
-            idxs = [0] * (k - num) + list(range(num))
-        return [os.path.join(base, f"{i}.jpg") for i in idxs]
+        return clip_frame_paths(self.roots, kind, clip, self.frames_per_clip)
+
+    def sample_clip(self, index: int, epoch: Optional[int] = None
+                    ) -> Tuple[str, Tuple[str, int, int], int]:
+        """(kind, clip tuple, label) for one index — pure function of
+        (index, epoch): fake buckets rotate their cursor with the epoch,
+        reals are direct."""
+        epoch = self.epoch if epoch is None else epoch
+        if index < len(self.fake_buckets):
+            bucket = self.fake_buckets[index]
+            return "fake", bucket[epoch % len(bucket)], 0
+        return "real", self.real_clips[index - len(self.fake_buckets)], 1
 
     def sample_paths(self, index: int, epoch: Optional[int] = None
                      ) -> Tuple[List[str], int]:
         """(frame paths, label) for one index — pure function of
         (index, epoch)."""
-        epoch = self.epoch if epoch is None else epoch
-        if index < len(self.fake_buckets):
-            bucket = self.fake_buckets[index]
-            cursor = epoch % len(bucket)
-            return self._clip_paths("fake", bucket[cursor]), 0
-        clip = self.real_clips[index - len(self.fake_buckets)]
-        return self._clip_paths("real", clip), 1
+        kind, clip, target = self.sample_clip(index, epoch)
+        return self._clip_paths(kind, clip), target
 
     def __getitem__(self, index: int,
                     rng: Optional[np.random.Generator] = None):
         rng = rng if rng is not None else np.random.default_rng(
             np.random.SeedSequence([self.epoch, index]))
-        paths, target = self.sample_paths(index)
-        imgs = _load_images(paths)
+        kind, clip, target = self.sample_clip(index)
+        imgs = self._load_clip(kind, clip)
         if self.transform is not None:
             imgs = self.transform(imgs, rng)
         if target == 0 and self.noise_fake:

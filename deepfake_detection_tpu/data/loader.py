@@ -368,6 +368,20 @@ def _build_loader(dataset, transform, batch_size: int, is_training: bool,
     """Shared factory tail: AugMix wrap, transform attach, sharded sampler
     selection, host loader backend, device prologue.  Both
     :func:`create_loader` and :func:`create_deepfake_loader_v3` end here."""
+    hw = getattr(dataset, "packed_hw", None)
+    if hw is not None:
+        # packed pre-decoded cache: the pack replaces the decode STAGE
+        # only — transform, sampler, collate and transport below are the
+        # shared code paths.  A pack smaller than the crop would make
+        # pad_if_needed silently diverge from the decode path: warn loud.
+        crop = getattr(transform.transforms[0], "size", None) \
+            if getattr(transform, "transforms", None) else None
+        if crop is not None and isinstance(crop, tuple) and \
+                (crop[0] > hw[0] or crop[1] > hw[1]):
+            _logger.warning(
+                "packed cache resolution %s is below the crop %s: crops "
+                "will pad, diverging from the decode path — re-pack with "
+                "a larger --pack-image-size", hw, crop)
     if is_training and num_aug_splits > 1:
         # clean + (num_aug_splits-1) AugMix views per sample, feeding the
         # JSD consistency loss (reference dataset.py:633-670)

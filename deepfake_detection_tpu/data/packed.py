@@ -1,0 +1,558 @@
+"""Packed pre-decoded dataset cache: mmap-backed clips, no JPEG decode.
+
+INPUT_BENCH.md prices the flagship input pipeline at ≈23 host cores per
+chip of decode+augment demand — and every epoch re-decodes every JPEG
+from scratch.  This module is the steady-state half of the fix (the
+one-time half is ``tools/pack_dataset.py``): a pack directory holds the
+dataset's clips **decoded once** to a canonical pre-augment resolution
+and written as fixed-stride ``(H, W, 3·frames)`` uint8 samples in sharded
+files — exactly the channel-packed layout ``MultiConcate`` produces — plus
+a JSON index carrying shape/dtype/label/clip-id per sample, a per-shard
+sha256, and a staleness fingerprint (source lists + pack resolution +
+interpolation).  :class:`PackedDataset` then serves clips as zero-copy
+``np.frombuffer`` views over the mmapped shards (FFCV's packed-record
+idea, tf.data's snapshot stage), turning a CPU-bound decode problem into
+a sequential-read bandwidth problem.
+
+Drop-in contract: ``PackedDataset`` subclasses ``DeepFakeClipDataset`` and
+overrides only the clip *source* (index-file lists instead of
+``real_list.txt``/``fake_list.txt``, mmap lookup instead of JPEG decode),
+so the seeded train/val split, fake-bucket rotation, ``set_epoch``,
+``noise_fake`` and the absolute ``(seed, epoch, index)`` RNG stream are
+the inherited code paths — batches are **bit-identical** to the decode
+backend whenever the source frames are at the pack resolution (the packer
+skips its resample then; tests/test_packed_data.py locks this across
+epochs, worker counts and both thread/shm transports).
+
+Failure modes are loud, never silent skew:
+
+* :class:`PackedCacheStale` — the source lists changed since the pack was
+  built, or the requested resolution / frame count / root layout doesn't
+  match the index.
+* :class:`PackedShardCorrupt` — a shard file is truncated (size checked at
+  construction AND at mmap time) or fails its checksum (``verify=True`` /
+  :func:`verify_pack`), identified by shard file and sample range.
+
+No jax imports here (PR 1's worker-import discipline): spawned shm-ring
+workers unpickle a ``PackedDataset`` and reopen the mmaps lazily in their
+own process, importing only numpy/PIL/this package.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import mmap
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .dataset import (DeepFakeClipDataset, _load_images, clip_frame_paths,
+                      read_clip_list)
+from .transforms import PackedFrames, pil_interp
+
+__all__ = ["PackedDataset", "PackedShardCorrupt", "PackedCacheStale",
+           "PACK_INDEX", "PACK_PARTIAL", "canonical_clip_array",
+           "load_index", "pack_fingerprint", "read_source_lists",
+           "verify_pack", "write_pack"]
+
+PACK_INDEX = "index.json"
+PACK_PARTIAL = "index.partial.json"
+PACK_VERSION = 1
+
+_REQUIRED_KEYS = ("version", "frames_per_clip", "sample_hw", "interpolation",
+                  "roots", "lists", "fingerprint", "shards", "clips")
+
+
+class PackedShardCorrupt(RuntimeError):
+    """A packed shard's bytes don't match its index entry — truncated
+    mmap or checksum mismatch.  The message names the shard file and the
+    global sample range it holds (the ``CheckpointCorrupt`` contract of
+    train/checkpoint.py, applied to data shards)."""
+
+
+class PackedCacheStale(RuntimeError):
+    """The pack disagrees with the source lists or the requested pack
+    parameters (resolution / frames per clip / roots).  Re-run
+    ``tools/pack_dataset.py`` rather than training on skewed data."""
+
+
+# ---------------------------------------------------------------------------
+# Shared pack arithmetic (packer + reader + validators)
+# ---------------------------------------------------------------------------
+
+def read_source_lists(roots: Sequence[str]) -> List[Dict[str, list]]:
+    """Each root's ``real``/``fake`` lists parsed to the JSON shape the
+    index stores: ``[{"real": [[name, num], ...], "fake": [...]}, ...]``,
+    in list-file order (the seeded split downstream is order-sensitive)."""
+    out = []
+    for ri, root in enumerate(roots):
+        out.append({kind: [[name, int(num)] for name, num, _ in
+                           read_clip_list(os.path.join(
+                               root, f"{kind}_list.txt"), ri)]
+                    for kind in ("real", "fake")})
+    return out
+
+
+def pack_fingerprint(lists: List[Dict[str, list]],
+                     image_size: Optional[int], interpolation: str,
+                     frames_per_clip: int) -> str:
+    """Staleness fingerprint: source-list content + pack resolution +
+    interpolation + frame count.  Any drift in these means the packed
+    bytes no longer reproduce the decode path."""
+    payload = json.dumps(
+        {"lists": lists, "image_size": image_size or None,
+         "interpolation": interpolation, "frames_per_clip": frames_per_clip},
+        sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def canonical_clip_array(imgs, image_size: Optional[int],
+                         interpolation: str = "bilinear") -> np.ndarray:
+    """Decoded PIL frames → ONE ``(H, W, 3·k)`` channel-packed uint8 clip
+    at the canonical pre-augment resolution.  Frames already at the target
+    size are NOT resampled — the condition under which packed batches are
+    bit-identical to the decode path."""
+    interp = pil_interp(interpolation)
+    arrs = []
+    for im in imgs:
+        if image_size and im.size != (image_size, image_size):
+            im = im.resize((image_size, image_size), interp)
+        a = np.asarray(im, dtype=np.uint8)
+        if a.ndim < 3:
+            a = np.expand_dims(a, axis=-1)
+        arrs.append(a)
+    return np.concatenate(arrs, axis=-1)
+
+
+def _sample_stride(index: Dict[str, Any]) -> int:
+    h, w = index["sample_hw"]
+    return int(h) * int(w) * 3 * int(index["frames_per_clip"])
+
+
+def _atomic_json(path: str, obj: Dict[str, Any]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_index(pack_dir: str) -> Dict[str, Any]:
+    """Read + structurally validate a pack index; loud on anything off."""
+    path = os.path.join(pack_dir, PACK_INDEX)
+    if not os.path.isfile(path):
+        if os.path.isfile(os.path.join(pack_dir, PACK_PARTIAL)):
+            raise PackedCacheStale(
+                f"{pack_dir}: pack is incomplete (only {PACK_PARTIAL} "
+                f"present) — re-run tools/pack_dataset.py to finish it")
+        raise FileNotFoundError(
+            f"{os.path.join(pack_dir, PACK_INDEX)}: no pack index "
+            f"(build one with tools/pack_dataset.py)")
+    try:
+        with open(path) as f:
+            index = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise PackedCacheStale(f"{path}: unreadable pack index ({e})")
+    missing = [k for k in _REQUIRED_KEYS if k not in index]
+    if missing or int(index.get("version", -1)) != PACK_VERSION:
+        raise PackedCacheStale(
+            f"{path}: pack index version/schema mismatch "
+            f"(version {index.get('version')!r}, missing keys {missing}) — "
+            f"re-pack with this build's tools/pack_dataset.py")
+    if sum(int(s["num_samples"]) for s in index["shards"]) != \
+            len(index["clips"]):
+        raise PackedCacheStale(
+            f"{path}: shard sample counts disagree with the clip table")
+    return index
+
+
+def _shard_size_problems(pack_dir: str, index: Dict[str, Any],
+                         checksums: bool = False) -> List[str]:
+    """The one shard audit every consumer shares (reader constructor,
+    offline verify, packer resume): size per shard, optionally sha256,
+    each problem naming the shard file and its global sample range."""
+    problems = []
+    stride = _sample_stride(index)
+    start = 0
+    for sh in index["shards"]:
+        path = os.path.join(pack_dir, sh["file"])
+        n = int(sh["num_samples"])
+        want = n * stride
+        rng_txt = f"samples [{start}, {start + n})"
+        try:
+            got = os.path.getsize(path)
+        except OSError:
+            problems.append(f"{path}: shard file missing ({rng_txt})")
+            start += n
+            continue
+        if got != want:
+            problems.append(f"{path}: {got} bytes, expected {want} "
+                            f"({rng_txt})")
+        elif checksums:
+            h = hashlib.sha256()
+            with open(path, "rb") as f:
+                for block in iter(lambda: f.read(1 << 20), b""):
+                    h.update(block)
+            if h.hexdigest() != sh["sha256"]:
+                problems.append(f"{path}: checksum mismatch ({rng_txt})")
+        start += n
+    return problems
+
+
+def verify_pack(pack_dir: str, checksums: bool = True) -> List[str]:
+    """Full offline audit: index schema, shard sizes and (optionally)
+    shard checksums.  Returns human-readable problem strings (empty =
+    clean); used by ``tools/make_lists.py --validate --packed`` and the
+    packer's ``--verify``."""
+    try:
+        index = load_index(pack_dir)
+    except (FileNotFoundError, PackedCacheStale) as e:
+        return [str(e)]
+    return _shard_size_problems(pack_dir, index, checksums=checksums)
+
+
+# ---------------------------------------------------------------------------
+# Reader
+# ---------------------------------------------------------------------------
+
+class PackedDataset(DeepFakeClipDataset):
+    """``DeepFakeClipDataset`` whose clip source is a pack directory.
+
+    Same constructor knobs as the decode dataset (split, balance,
+    noise_fake, frac/n subsetting) — those run on the *index-recorded*
+    lists, which :func:`pack_fingerprint` ties to the source list files —
+    plus:
+
+    * ``pack_dir`` — the directory ``tools/pack_dataset.py`` wrote.
+    * ``roots`` — optional; when given (the trainer always passes
+      ``--data``), the CURRENT list files are re-read and compared against
+      the index so a pack that drifted from its source fails loudly.
+    * ``image_size`` — optional expected pack resolution
+      (``--pack-image-size``); mismatch is a :class:`PackedCacheStale`.
+    * ``verify`` — full shard checksum pass at construction (size checks
+      always run; checksums cost one sequential read of the pack).
+
+    ``__getitem__`` returns :class:`PackedFrames` views into the mmapped
+    shard — zero-copy until the collate — feeding the inherited transform
+    chain, so crop/flip/mixup/AugMix and the ``(seed, epoch, index)`` RNG
+    stream are untouched.
+    """
+
+    def __init__(self, pack_dir: str, roots=None,
+                 frames_per_clip: Optional[int] = None,
+                 transform=None, train_split: bool = False,
+                 train_ratio: float = 0.0, is_training: bool = False,
+                 label_balance: bool = False, noise_fake: bool = False,
+                 split_seed: int = 0, frac: float = 1.0,
+                 n: Optional[int] = None,
+                 image_size: Optional[int] = None, verify: bool = False):
+        self.pack_dir = os.fspath(pack_dir)
+        self.index = load_index(self.pack_dir)
+        k = int(self.index["frames_per_clip"])
+        if frames_per_clip is not None and int(frames_per_clip) != k:
+            raise PackedCacheStale(
+                f"{self.pack_dir}: packed at {k} frames/clip, the run "
+                f"requests {frames_per_clip} — re-pack with "
+                f"--frames {frames_per_clip}")
+        hw = [int(v) for v in self.index["sample_hw"]]
+        if image_size and [int(image_size)] * 2 != hw:
+            raise PackedCacheStale(
+                f"{self.pack_dir}: packed at {hw[1]}x{hw[0]}, "
+                f"--pack-image-size requests {image_size} — re-pack or "
+                f"drop the flag")
+        self._lists = self.index["lists"]
+        if roots is not None:
+            if isinstance(roots, str):
+                roots = [r for r in roots.split(":") if r]
+            roots = list(roots)
+            if len(roots) != len(self._lists):
+                raise PackedCacheStale(
+                    f"{self.pack_dir}: packed from {len(self._lists)} "
+                    f"root(s), the run passes {len(roots)}")
+            current = read_source_lists(roots)
+            if current != self._lists:
+                raise PackedCacheStale(
+                    f"{self.pack_dir}: source list files under {roots} "
+                    f"changed since the pack was built (fingerprint "
+                    f"{self.index['fingerprint'][:12]}…) — re-run "
+                    f"tools/pack_dataset.py")
+        self._sample_shape = (hw[0], hw[1], 3 * k)
+        self._stride = _sample_stride(self.index)
+        # sample lookup: (kind, root_index, name) → (shard, slot)
+        self._records: Dict[Tuple[str, int, str], Tuple[int, int]] = {}
+        pos = 0
+        for si, sh in enumerate(self.index["shards"]):
+            for slot in range(int(sh["num_samples"])):
+                kind, ri, name = self.index["clips"][pos][:3]
+                self._records[(kind, int(ri), name)] = (si, slot)
+                pos += 1
+        # shard audit up front: a truncated pack must fail at
+        # construction, not yield garbage pixels mid-epoch (checksums
+        # cost one sequential read of the pack — opt-in via verify)
+        problems = _shard_size_problems(self.pack_dir, self.index,
+                                        checksums=verify)
+        if problems:
+            raise PackedShardCorrupt("; ".join(problems))
+        self._mmaps: Dict[int, np.ndarray] = {}
+        self._open_lock: Optional[threading.Lock] = threading.Lock()
+        super().__init__(
+            roots if roots is not None else list(self.index["roots"]),
+            frames_per_clip=k, transform=transform, train_split=train_split,
+            train_ratio=train_ratio, is_training=is_training,
+            label_balance=label_balance, noise_fake=noise_fake,
+            split_seed=split_seed, frac=frac, n=n)
+
+    # -- clip-source hooks ---------------------------------------------
+    def _read_root_lists(self, root_index: int):
+        ls = self._lists[root_index]
+        return ([(name, int(num), root_index) for name, num in ls["real"]],
+                [(name, int(num), root_index) for name, num in ls["fake"]])
+
+    def _load_clip(self, kind: str, clip: Tuple[str, int, int]):
+        name, _num, ri = clip
+        rec = self._records.get((kind, int(ri), name))
+        if rec is None:
+            raise PackedCacheStale(
+                f"{self.pack_dir}: clip {kind}/{name} (root {ri}) is not "
+                f"in the pack index")
+        si, slot = rec
+        base = self._shard_arrays(si)[slot]
+        k = self.frames_per_clip
+        return PackedFrames([base[..., 3 * i:3 * i + 3] for i in range(k)],
+                            base)
+
+    # -- mmap management ------------------------------------------------
+    def _shard_arrays(self, si: int) -> np.ndarray:
+        arr = self._mmaps.get(si)
+        if arr is None:
+            if self._open_lock is None:            # post-unpickle safety
+                self._open_lock = threading.Lock()
+            with self._open_lock:
+                arr = self._mmaps.get(si)
+                if arr is None:
+                    sh = self.index["shards"][si]
+                    path = os.path.join(self.pack_dir, sh["file"])
+                    n_s = int(sh["num_samples"])
+                    want = n_s * self._stride
+                    with open(path, "rb") as f:
+                        got = os.fstat(f.fileno()).st_size
+                        if got != want:
+                            raise PackedShardCorrupt(
+                                f"{path}: {got} bytes at mmap time, "
+                                f"expected {want} ({n_s} samples)")
+                        mm = mmap.mmap(f.fileno(), want,
+                                       access=mmap.ACCESS_READ)
+                    arr = np.frombuffer(mm, np.uint8, count=want).reshape(
+                        (n_s,) + self._sample_shape)
+                    self._mmaps[si] = arr
+        return arr
+
+    def __getstate__(self):
+        # shm-ring workers unpickle the dataset in a spawned process: mmap
+        # handles and locks don't cross; each process reopens lazily
+        d = dict(self.__dict__)
+        d["_mmaps"] = {}
+        d["_open_lock"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._open_lock = threading.Lock()
+
+    # -- introspection --------------------------------------------------
+    @property
+    def packed_hw(self) -> Tuple[int, int]:
+        """(H, W) of the stored pre-augment frames."""
+        return self._sample_shape[0], self._sample_shape[1]
+
+    def sample_array(self, index: int,
+                     epoch: Optional[int] = None) -> np.ndarray:
+        """Zero-copy ``(H, W, 3·frames)`` uint8 view of one sample's
+        packed bytes (no transform, no RNG)."""
+        kind, clip, _ = self.sample_clip(index, epoch)
+        return self._load_clip(kind, clip).base
+
+
+# ---------------------------------------------------------------------------
+# Writer (driven by tools/pack_dataset.py; importable for tests/benches)
+# ---------------------------------------------------------------------------
+
+def _wipe_pack(out_dir: str) -> None:
+    for fn in os.listdir(out_dir):
+        if fn in (PACK_INDEX, PACK_PARTIAL) or (
+                fn.startswith("shard-") and
+                (fn.endswith(".bin") or ".bin.tmp" in fn)):
+            try:
+                os.remove(os.path.join(out_dir, fn))
+            except OSError:
+                pass
+
+
+def write_pack(roots, out_dir: str, image_size: int = 0,
+               frames_per_clip: int = 4, interpolation: str = "bilinear",
+               shard_size: int = 256, workers: int = 4, max_shards: int = 0,
+               force: bool = False, log=None) -> Dict[str, Any]:
+    """One-time decode-and-pack pass; resumable at shard granularity.
+
+    Walks every clip of every root's v3 lists in deterministic order
+    (root-major, fakes before reals — the dataset's own index-space
+    convention), decodes through the same ``_load_images`` path the
+    runtime uses (native C++ pool when available), resamples to
+    ``image_size``² unless the frame already is that size (``0`` keeps the
+    native resolution, which must then be uniform), and streams
+    fixed-stride samples into ``shard-NNNNN.bin`` files.  After each shard
+    lands (write → fsync → atomic rename) the partial index is rewritten
+    atomically, so a killed packer resumes from the first missing shard;
+    the final ``index.json`` only appears when every clip is packed.
+
+    ``max_shards`` stops early after N shards (testing/smoke hook).
+    Returns the index dict (partial if stopped early).
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    if log is None:
+        log = lambda *_: None                                    # noqa: E731
+    shard_size = int(shard_size)
+    if shard_size < 1:
+        # entries[done:done+0] would loop forever writing empty shards
+        raise ValueError(f"shard_size must be >= 1, got {shard_size}")
+    if isinstance(roots, str):
+        roots = [r for r in roots.split(":") if r]
+    roots = [os.fspath(r) for r in roots]
+    lists = read_source_lists(roots)
+    entries = []
+    for ri in range(len(roots)):
+        for kind in ("fake", "real"):
+            entries += [(kind, ri, name, int(num))
+                        for name, num in lists[ri][kind]]
+    if not entries:
+        raise ValueError(f"no clips listed under roots {roots}")
+    image_size = int(image_size or 0)
+    fp = pack_fingerprint(lists, image_size or None, interpolation,
+                          frames_per_clip)
+    os.makedirs(out_dir, exist_ok=True)
+    idx_path = os.path.join(out_dir, PACK_INDEX)
+    partial_path = os.path.join(out_dir, PACK_PARTIAL)
+
+    if os.path.isfile(idx_path):
+        try:
+            existing = load_index(out_dir)
+        except PackedCacheStale:
+            existing = None
+        if existing is not None and existing["fingerprint"] == fp \
+                and not force:
+            log(f"{out_dir}: pack is up to date "
+                f"({len(existing['clips'])} clips); nothing to do")
+            return existing
+        if not force:
+            raise PackedCacheStale(
+                f"{out_dir} already holds a pack built from different "
+                f"sources or parameters — pass force/--force to rebuild")
+        _wipe_pack(out_dir)
+
+    state: Optional[Dict[str, Any]] = None
+    if os.path.isfile(partial_path):
+        try:
+            with open(partial_path) as f:
+                state = json.load(f)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            state = None
+        if state is not None and (state.get("fingerprint") != fp or force):
+            if not force:
+                raise PackedCacheStale(
+                    f"{partial_path}: partial pack was built from "
+                    f"different sources or parameters — pass force/--force "
+                    f"to restart")
+            state = None
+        if state is not None and state["shards"] and not state["sample_hw"]:
+            # a partial that records shards but no geometry is torn
+            if not force:
+                raise PackedCacheStale(
+                    f"{partial_path}: torn partial index — pass "
+                    f"force/--force to restart the pack")
+            state = None
+        if state is None:
+            _wipe_pack(out_dir)
+        else:
+            # recorded shards landed before their partial-index write; a
+            # size mismatch means on-disk damage, not a torn resume point
+            problems = _shard_size_problems(out_dir, state)
+            if problems:
+                raise PackedShardCorrupt(
+                    "; ".join(problems) + " — remove the pack dir (or "
+                    "pass force/--force) to rebuild")
+            log(f"{out_dir}: resuming after "
+                f"{sum(int(s['num_samples']) for s in state['shards'])}/"
+                f"{len(entries)} packed clips")
+    if state is None:
+        state = {"version": PACK_VERSION, "frames_per_clip": frames_per_clip,
+                 "image_size": image_size or None, "sample_hw": None,
+                 "dtype": "uint8", "interpolation": interpolation,
+                 "roots": roots, "lists": lists, "fingerprint": fp,
+                 "shards": [], "clips": []}
+
+    done = sum(int(s["num_samples"]) for s in state["shards"])
+
+    def _decode(entry):
+        kind, ri, name, num = entry
+        imgs = _load_images(clip_frame_paths(
+            roots, kind, (name, num, ri), frames_per_clip))
+        return canonical_clip_array(imgs, image_size, interpolation)
+
+    with ThreadPoolExecutor(max(1, int(workers))) as pool:
+        si = len(state["shards"])
+        while done < len(entries):
+            if max_shards and si >= int(max_shards):
+                log(f"{out_dir}: stopping after {si} shards (max-shards); "
+                    f"{done}/{len(entries)} clips packed")
+                break
+            chunk = entries[done:done + int(shard_size)]
+            arrs = list(pool.map(_decode, chunk))
+            for e, a in zip(chunk, arrs):
+                if state["sample_hw"] is None:
+                    state["sample_hw"] = [int(a.shape[0]), int(a.shape[1])]
+                want = tuple(state["sample_hw"]) + (3 * frames_per_clip,)
+                if a.shape != want:
+                    raise ValueError(
+                        f"clip {e[0]}/{e[2]}: decoded shape {a.shape} != "
+                        f"pack stride {want} — sources are mixed-resolution;"
+                        f" set --pack-image-size to a fixed size")
+            fname = f"shard-{si:05d}.bin"
+            tmp = os.path.join(out_dir, f"{fname}.tmp.{os.getpid()}")
+            h = hashlib.sha256()
+            with open(tmp, "wb") as f:
+                for a in arrs:
+                    b = np.ascontiguousarray(a).tobytes()
+                    h.update(b)
+                    f.write(b)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(out_dir, fname))
+            state["shards"].append({"file": fname,
+                                    "num_samples": len(chunk),
+                                    "sha256": h.hexdigest()})
+            state["clips"] += [[kind, ri, name, num,
+                                0 if kind == "fake" else 1]
+                               for kind, ri, name, num in chunk]
+            _atomic_json(partial_path, state)
+            done += len(chunk)
+            si += 1
+            log(f"{fname}: {done}/{len(entries)} clips "
+                f"({done * _sample_stride(state) / 1e9:.2f} GB)")
+
+    if done >= len(entries):
+        state["complete"] = True
+        _atomic_json(idx_path, state)
+        try:
+            os.remove(partial_path)
+        except OSError:
+            pass
+        log(f"{out_dir}: pack complete — {done} clips, "
+            f"{len(state['shards'])} shards, "
+            f"{done * _sample_stride(state) / 1e9:.2f} GB, "
+            f"fingerprint {fp[:12]}…")
+    return state

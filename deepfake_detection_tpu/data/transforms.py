@@ -115,9 +115,19 @@ class CenterCrop:
         return img.crop((left, top, left + tw, top + th))
 
 
+def _wh(img) -> Tuple[int, int]:
+    """(width, height) for PIL frames and uint8 ndarray frames alike (the
+    native warp and the packed-cache mmap path both emit arrays)."""
+    if isinstance(img, np.ndarray):
+        return img.shape[1], img.shape[0]
+    return img.size
+
+
 def _pad_to(img: Image.Image, tw: int, th: int, fill=0) -> Image.Image:
     """Pad the right/bottom only when needed (torchvision RandomCrop
     ``pad_if_needed`` pads symmetric-ish via (delta, 0); we center-pad)."""
+    if isinstance(img, np.ndarray):
+        return _pad_to_np(img, tw, th, fill)
     w, h = img.size
     if w >= tw and h >= th:
         return img
@@ -128,6 +138,28 @@ def _pad_to(img: Image.Image, tw: int, th: int, fill=0) -> Image.Image:
                         img.getbands()) > 1 else fill)
     out.paste(img, ((nw - w) // 2, (nh - h) // 2))
     return out
+
+
+def _pad_to_np(a: np.ndarray, tw: int, th: int, fill=0) -> np.ndarray:
+    """ndarray twin of :func:`_pad_to` — same center offsets, same fill —
+    so array frames (packed cache / native warp) pad to the exact bytes
+    the PIL path produces."""
+    h, w = a.shape[:2]
+    if w >= tw and h >= th:
+        return a
+    nw, nh = max(w, tw), max(h, th)
+    out = np.full((nh, nw) + a.shape[2:], fill, np.uint8)
+    out[(nh - h) // 2:(nh - h) // 2 + h,
+        (nw - w) // 2:(nw - w) // 2 + w] = a
+    return out
+
+
+def _crop_frame(img, top: int, left: int, th: int, tw: int):
+    """One frame crop: zero-copy slice for arrays, ``Image.crop`` for PIL
+    (identical bytes — both are pure windowing on in-bounds coords)."""
+    if isinstance(img, np.ndarray):
+        return img[top:top + th, left:left + tw]
+    return img.crop((left, top, left + tw, top + th))
 
 
 class RandomCrop:
@@ -142,7 +174,7 @@ class RandomCrop:
 
     def get_params(self, img, rng: np.random.Generator) -> Tuple[int, int]:
         th, tw = self.size
-        w, h = img.size
+        w, h = _wh(img)
         top = int(rng.integers(0, h - th + 1)) if h > th else 0
         left = int(rng.integers(0, w - tw + 1)) if w > tw else 0
         return top, left
@@ -152,7 +184,7 @@ class RandomCrop:
             img = _pad_to(img, self.size[1], self.size[0], self.fill)
         top, left = self.get_params(img, rng)
         th, tw = self.size
-        return img.crop((left, top, left + tw, top + th))
+        return _crop_frame(img, top, left, th, tw)
 
 
 class RandomHorizontalFlip:
@@ -235,7 +267,7 @@ class RandomResize:
 
     def _target_size(self, img, rng: np.random.Generator) -> Tuple[int, int]:
         s = rng.uniform(self.scale[0], self.scale[1])
-        w, h = img.size
+        w, h = _wh(img)
         return int(w * s), int(h * s)
 
     def __call__(self, img, rng: np.random.Generator):
@@ -343,7 +375,8 @@ class MultiRandomHorizontalFlip:
 
     def __call__(self, imgs, rng: np.random.Generator):
         if rng.random() < self.p:
-            return [img.transpose(Image.FLIP_LEFT_RIGHT) for img in imgs]
+            return [_as_pil(img).transpose(Image.FLIP_LEFT_RIGHT)
+                    for img in imgs]
         return imgs
 
 
@@ -357,7 +390,7 @@ class MultiRotate:
 
     def __call__(self, imgs, rng: np.random.Generator):
         deg = int(rng.integers(-self.rotate_range, self.rotate_range + 1))
-        return [img.rotate(deg, expand=True) for img in imgs]
+        return [_as_pil(img).rotate(deg, expand=True) for img in imgs]
 
 
 class MultiRandomResize(RandomResize):
@@ -366,20 +399,42 @@ class MultiRandomResize(RandomResize):
     def __call__(self, imgs, rng: np.random.Generator):
         interp = _resolve_interp(self.interpolation, rng)
         tw, th = self._target_size(imgs[0], rng)
-        return [img.resize((tw, th), interp) for img in imgs]
+        return [_as_pil(img).resize((tw, th), interp) for img in imgs]
+
+
+def _crop_packed(imgs: "PackedFrames", top: int, left: int,
+                 th: int, tw: int) -> "PackedFrames":
+    """Window a packed clip by slicing its ONE base buffer: the result is
+    again a PackedFrames whose views alias the (possibly mmap-backed)
+    base, so MultiConcate stays copy-free on the packed-cache hot path."""
+    nb = imgs.base[top:top + th, left:left + tw]
+    n = nb.shape[-1] // 3
+    return PackedFrames([nb[..., 3 * i:3 * i + 3] for i in range(n)], nb)
 
 
 class MultiRandomCrop(RandomCrop):
     """One crop window shared by all frames, pad_if_needed (reference
-    :311-330)."""
+    :311-330).  Packed/array frames (native warp output, mmap-backed
+    packed-cache clips) crop as zero-copy base-buffer slices — same rng
+    draw order and identical bytes as the PIL path."""
 
     def __call__(self, imgs, rng: np.random.Generator):
-        if self.pad_if_needed:
+        packed = isinstance(imgs, PackedFrames) and imgs.untouched()
+        if packed and self.pad_if_needed:
+            base = _pad_to_np(imgs.base, self.size[1], self.size[0],
+                              self.fill)
+            if base is not imgs.base:
+                n = base.shape[-1] // 3
+                imgs = PackedFrames(
+                    [base[..., 3 * i:3 * i + 3] for i in range(n)], base)
+        elif self.pad_if_needed:
             imgs = [_pad_to(img, self.size[1], self.size[0], self.fill)
                     for img in imgs]
         top, left = self.get_params(imgs[0], rng)
         th, tw = self.size
-        return [img.crop((left, top, left + tw, top + th)) for img in imgs]
+        if packed:
+            return _crop_packed(imgs, top, left, th, tw)
+        return [_crop_frame(img, top, left, th, tw) for img in imgs]
 
 
 class MultiCenterCrop(CenterCrop):
@@ -395,8 +450,16 @@ class MultiCenterCrop(CenterCrop):
 
     def __call__(self, imgs, rng=None):
         th, tw = self.size
+        if isinstance(imgs, PackedFrames) and imgs.untouched():
+            base = _pad_to_np(imgs.base, tw, th, self.fill)
+            w, h = base.shape[1], base.shape[0]
+            return _crop_packed(
+                PackedFrames([base[..., 3 * i:3 * i + 3]
+                              for i in range(base.shape[-1] // 3)], base),
+                int(round((h - th) / 2.0)), int(round((w - tw) / 2.0)),
+                th, tw)
         imgs = [_pad_to(img, tw, th, self.fill) for img in imgs]
-        return [CenterCrop.__call__(self, img) for img in imgs]
+        return [CenterCrop.__call__(self, _as_pil(img)) for img in imgs]
 
 
 class MultiColorJitter(ColorJitter):
@@ -467,7 +530,7 @@ class MultiFusedGeometric:
 
     def __call__(self, imgs, rng: np.random.Generator):
         th, tw = self.size
-        w, h = imgs[0].size
+        w, h = _wh(imgs[0])
         # identical draw order to the sequential chain
         deg = (int(rng.integers(-self.rotate_range, self.rotate_range + 1))
                if self.rotate_range else 0)
@@ -532,9 +595,9 @@ class MultiFusedGeometric:
         # (DFD_NO_NATIVE_DECODE=1 parametrization)
         pil_coeffs = (A, B, C - (A + B) / 2 + 0.5,
                       D, E, F - (D + E) / 2 + 0.5)
-        return [img.transform((tw, th), Image.AFFINE, pil_coeffs,
-                              resample=Image.BILINEAR,
-                              fillcolor=(self.fill,) * 3)
+        return [_as_pil(img).transform((tw, th), Image.AFFINE, pil_coeffs,
+                                       resample=Image.BILINEAR,
+                                       fillcolor=(self.fill,) * 3)
                 for img in imgs]
 
 

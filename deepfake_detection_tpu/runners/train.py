@@ -111,10 +111,21 @@ def build_model(cfg: TrainConfig, in_chans: int):
     return factory(cfg.model, **kwargs)
 
 
-def build_datasets(cfg: TrainConfig, input_size) -> Tuple[Any, Any]:
-    """Train/eval dataset construction (reference train.py:422-504)."""
+def build_datasets(cfg: TrainConfig, input_size, pack_dir=None,
+                   pack_image_size=None) -> Tuple[Any, Any]:
+    """Train/eval dataset construction (reference train.py:422-504).
+
+    ``pack_dir`` (``--data-packed``, resolved through
+    ``data/config.py::resolve_data_config``) swaps the JPEG-decode clip
+    source for the packed pre-decoded cache (``data/packed.py``) — the
+    split/balance/RNG machinery is shared, so downstream batches are
+    bit-identical at matching pack resolution.  A stale or mismatched
+    pack raises at construction, never trains on skewed data.
+    """
     c, h, w = input_size
     if cfg.dataset == "synthetic":
+        if pack_dir:
+            raise ValueError("--data-packed requires --dataset deepfake_v3")
         n = max(cfg.batch_size * 8, 16)
         return (SyntheticDataset(n, (h, w, c), cfg.num_classes, cfg.seed),
                 SyntheticDataset(max(n // 2, 8), (h, w, c), cfg.num_classes,
@@ -124,17 +135,29 @@ def build_datasets(cfg: TrainConfig, input_size) -> Tuple[Any, Any]:
                       label_balance=cfg.label_balance,
                       noise_fake=cfg.noise_fake > 0,
                       split_seed=cfg.split_seed)
+        if pack_dir:
+            from ..data import PackedDataset
+            packed = dict(roots=cfg.data or None,
+                          image_size=pack_image_size)
+
+            def make_train(**kw):
+                return PackedDataset(pack_dir, **packed, **kw)
+        else:
+            def make_train(**kw):
+                return DeepFakeClipDataset(cfg.data, **kw)
         if cfg.eval_data:
-            train_ds = DeepFakeClipDataset(cfg.data, **common)
+            # a separate eval root always reads through the decode path:
+            # the pack is fingerprinted against the TRAIN lists only
+            train_ds = make_train(**common)
             eval_ds = DeepFakeClipDataset(cfg.eval_data,
                                           frames_per_clip=max(1, c // 3),
                                           split_seed=cfg.split_seed)
         else:  # seeded split out of the train roots (reference :424-438)
-            train_ds = DeepFakeClipDataset(
-                cfg.data, train_split=True, train_ratio=cfg.train_split,
+            train_ds = make_train(
+                train_split=True, train_ratio=cfg.train_split,
                 is_training=True, **common)
-            eval_ds = DeepFakeClipDataset(
-                cfg.data, train_split=True, train_ratio=cfg.train_split,
+            eval_ds = make_train(
+                train_split=True, train_ratio=cfg.train_split,
                 is_training=False, frames_per_clip=max(1, c // 3),
                 split_seed=cfg.split_seed)
         return train_ds, eval_ds
@@ -363,7 +386,9 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         else:
             _logger.info("--auto-resume: nothing to resume in %s; "
                          "starting fresh", output_dir)
-    train_ds, eval_ds = build_datasets(cfg, input_size)
+    train_ds, eval_ds = build_datasets(
+        cfg, input_size, pack_dir=data_config.get("pack_dir"),
+        pack_image_size=data_config.get("pack_image_size"))
     sharding = batch_sharding(mesh)
     # loaders produce the *per-process* slice of the global batch; the device
     # prologue assembles the global sharded array

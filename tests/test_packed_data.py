@@ -1,0 +1,416 @@
+"""Packed pre-decoded dataset cache (data/packed.py + tools/pack_dataset.py).
+
+The contract under test mirrors PR 1's thread↔shm parity bar: the packed
+backend is a drop-in for the JPEG-decode clip source — batches
+bit-identical across epochs, worker counts, both transports, every
+collate variant and mid-epoch fast-forward — plus the loud-failure
+contracts (stale fingerprint, truncated/corrupt shards) and the jax-free
+import discipline spawned workers rely on.
+
+Source frames are generated AT the pack resolution so the packer's
+canonical resample is a no-op — the documented condition for bit-identity
+with the decode path.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from deepfake_detection_tpu.data import (DeepFakeClipDataset,
+                                         FastCollateMixup, PackedCacheStale,
+                                         PackedDataset, PackedShardCorrupt,
+                                         verify_pack, write_pack)
+from deepfake_detection_tpu.data.dataset import AugMixDataset
+from deepfake_detection_tpu.data.loader import HostLoader
+from deepfake_detection_tpu.data.packed import PACK_INDEX, PACK_PARTIAL
+from deepfake_detection_tpu.data.samplers import ShardedTrainSampler
+from deepfake_detection_tpu.data.shm_ring import ShmRingLoader
+from deepfake_detection_tpu.data.transforms_factory import (
+    transforms_deepfake_eval_v3, transforms_deepfake_train_v3)
+
+pytestmark = [pytest.mark.smoke, pytest.mark.packed]
+
+SIZE = 40          # source == pack resolution: resample is a no-op
+CROP = 32
+
+
+def _make_clip_tree(root, n_real=3, n_fake=3, size=SIZE, frames=4,
+                    short=False):
+    os.makedirs(root, exist_ok=True)
+    g = np.random.default_rng(0)
+    for kind, n in (("real", n_real), ("fake", n_fake)):
+        lines = []
+        for i in range(n):
+            d = os.path.join(root, kind, f"{kind}clip{i}")
+            os.makedirs(d, exist_ok=True)
+            nf = 2 if (short and i == 0) else frames
+            for j in range(nf):
+                Image.fromarray(g.integers(0, 255, (size, size, 3),
+                                           dtype=np.uint8)).save(
+                    os.path.join(d, f"{j}.jpg"))
+            lines.append(f"{kind}clip{i}:{nf}")
+        with open(os.path.join(root, f"{kind}_list.txt"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+@pytest.fixture()
+def tree_and_pack(tmp_path):
+    root = str(tmp_path / "clips")
+    # a short clip exercises the front-padding path through the packer
+    _make_clip_tree(root, short=True)
+    pack = str(tmp_path / "pack")
+    state = write_pack([root], pack, image_size=SIZE, shard_size=2)
+    assert state.get("complete")
+    return root, pack
+
+
+def _drain(loader, epochs=2):
+    out = []
+    for e in range(epochs):
+        loader.set_epoch(e)
+        out.append([tuple(np.array(part) for part in item)
+                    for item in loader])
+    return out
+
+
+def _assert_epochs_equal(a, b):
+    assert len(a) == len(b)
+    for ea, eb in zip(a, b):
+        assert len(ea) == len(eb) and len(ea) > 0
+        for ia, ib in zip(ea, eb):
+            assert len(ia) == len(ib)
+            for xa, xb in zip(ia, ib):
+                np.testing.assert_array_equal(xa, xb)
+
+
+# ---------------------------------------------------------------------------
+# Pack → load round trip
+# ---------------------------------------------------------------------------
+
+class TestRoundTrip:
+    def test_pack_load_smoke(self, tree_and_pack):
+        root, pack = tree_and_pack
+        ds = DeepFakeClipDataset([root])
+        pk = PackedDataset(pack, roots=[root])
+        assert len(pk) == len(ds) == 6
+        assert pk.packed_hw == (SIZE, SIZE)
+        assert verify_pack(pack) == []
+        v = pk.sample_array(0)
+        assert v.shape == (SIZE, SIZE, 12) and v.dtype == np.uint8
+        assert not v.flags.writeable and v.base is not None   # mmap view
+
+    @pytest.mark.parametrize("chain", ["train", "eval"])
+    def test_getitem_bit_identical(self, tree_and_pack, chain):
+        """Raw per-sample parity across epochs — fake-bucket rotation,
+        front-padding and the per-sample RNG stream all shared."""
+        root, pack = tree_and_pack
+        tf = (transforms_deepfake_train_v3(CROP, color_jitter=None,
+                                           rotate_range=5)
+              if chain == "train" else transforms_deepfake_eval_v3(CROP))
+        ds = DeepFakeClipDataset([root], transform=tf)
+        pk = PackedDataset(pack, roots=[root], transform=tf)
+        for e in range(3):
+            ds.set_epoch(e)
+            pk.set_epoch(e)
+            for i in range(len(ds)):
+                r1 = np.random.default_rng(
+                    np.random.SeedSequence([7, e, i]))
+                r2 = np.random.default_rng(
+                    np.random.SeedSequence([7, e, i]))
+                a, la = ds.__getitem__(i, rng=r1)
+                b, lb = pk.__getitem__(i, rng=r2)
+                assert la == lb
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_getitem_parity_reference_chain_and_no_native(self,
+                                                          tree_and_pack):
+        """The sequential reference-exact chain (host jitter/flicker/blur,
+        fused_geom=False) and the no-native PIL fallback both lift packed
+        array views to PIL exactly where the decode path holds PIL — same
+        bytes, same rng draw order."""
+        root, pack = tree_and_pack
+        chains = [transforms_deepfake_train_v3(
+            CROP, color_jitter=0.4, rotate_range=5, blur_radiu=1,
+            blur_prob=0.3, flicker=0.3, fused_geom=False)]
+        os.environ["DFD_NO_NATIVE_DECODE"] = "1"
+        try:
+            chains.append(transforms_deepfake_train_v3(
+                CROP, color_jitter=None, rotate_range=5))
+            for tf in chains:
+                ds = DeepFakeClipDataset([root], transform=tf)
+                pk = PackedDataset(pack, roots=[root], transform=tf)
+                for i in range(len(ds)):
+                    r1 = np.random.default_rng(
+                        np.random.SeedSequence([9, 0, i]))
+                    r2 = np.random.default_rng(
+                        np.random.SeedSequence([9, 0, i]))
+                    a, _ = ds.__getitem__(i, rng=r1)
+                    b, _ = pk.__getitem__(i, rng=r2)
+                    np.testing.assert_array_equal(np.asarray(a),
+                                                  np.asarray(b))
+        finally:
+            os.environ.pop("DFD_NO_NATIVE_DECODE", None)
+
+    def test_split_and_balance_knobs_match(self, tree_and_pack):
+        """The seeded train/val split and fake bucketing run on the
+        index-recorded lists — selection must match the decode dataset's
+        for every knob combination."""
+        root, pack = tree_and_pack
+        for kw in (dict(train_split=True, train_ratio=0.5,
+                        is_training=True, split_seed=3),
+                   dict(train_split=True, train_ratio=0.5,
+                        is_training=False, split_seed=3),
+                   dict(label_balance=True)):
+            ds = DeepFakeClipDataset([root], **kw)
+            pk = PackedDataset(pack, roots=[root], **kw)
+            assert len(ds) == len(pk)
+            for e in (0, 1):
+                for i in range(len(ds)):
+                    assert ds.sample_clip(i, e) == pk.sample_clip(i, e)
+
+
+# ---------------------------------------------------------------------------
+# Loader-level bit-identity: decode ↔ packed, both transports
+# ---------------------------------------------------------------------------
+
+class TestLoaderBitIdentity:
+    def _pair(self, root, pack, tf):
+        ds = DeepFakeClipDataset([root], transform=tf)
+        pk = PackedDataset(pack, roots=[root], transform=tf)
+        return ds, pk
+
+    @pytest.mark.parametrize("workers", [1, 2])
+    def test_thread_across_epochs_and_workers(self, tree_and_pack, workers):
+        root, pack = tree_and_pack
+        tf = transforms_deepfake_train_v3(CROP, color_jitter=None,
+                                          rotate_range=5, blur_radiu=1,
+                                          blur_prob=0.2)
+        ds, pk = self._pair(root, pack, tf)
+        mk = lambda d: HostLoader(
+            d, ShardedTrainSampler(len(d), batch_size=3, seed=0), 3,
+            seed=0, num_workers=workers)
+        _assert_epochs_equal(_drain(mk(ds)), _drain(mk(pk)))
+
+    def test_thread_mixup(self, tree_and_pack):
+        root, pack = tree_and_pack
+        tf = transforms_deepfake_eval_v3(CROP)
+        ds, pk = self._pair(root, pack, tf)
+        mk = lambda d: HostLoader(
+            d, ShardedTrainSampler(len(d), batch_size=3, seed=1), 3,
+            seed=1, num_workers=2,
+            collate_mixup=FastCollateMixup(1.0, 0.1, num_classes=2))
+        a, b = _drain(mk(ds)), _drain(mk(pk))
+        _assert_epochs_equal(a, b)
+        assert a[0][0][1].dtype == np.float32          # soft targets
+
+    def test_thread_augmix_split_major(self, tree_and_pack):
+        root, pack = tree_and_pack
+        tf = transforms_deepfake_train_v3(CROP, color_jitter=None)
+        ds, pk = self._pair(root, pack, tf)
+        mk = lambda d: HostLoader(
+            AugMixDataset(d, num_splits=2),
+            ShardedTrainSampler(len(d), batch_size=2, seed=2), 2,
+            seed=2, num_workers=2)
+        a, b = _drain(mk(ds), epochs=1), _drain(mk(pk), epochs=1)
+        _assert_epochs_equal(a, b)
+        assert a[0][0][0].shape == (4, CROP, CROP, 12)  # split-major rows
+
+    def test_shm_transport(self, tree_and_pack):
+        """Packed composes with the shm transport: spawned workers
+        unpickle the dataset, reopen the mmaps lazily, and reproduce the
+        thread-decode batches bit-for-bit."""
+        root, pack = tree_and_pack
+        tf = transforms_deepfake_train_v3(CROP, color_jitter=None,
+                                          rotate_range=5)
+        ds, pk = self._pair(root, pack, tf)
+        h = HostLoader(ds, ShardedTrainSampler(len(ds), batch_size=3,
+                                               seed=4), 3, seed=4,
+                       num_workers=1)
+        s = ShmRingLoader(pk, ShardedTrainSampler(len(pk), batch_size=3,
+                                                  seed=4), 3, seed=4,
+                          num_workers=2)
+        try:
+            _assert_epochs_equal(_drain(h), _drain(s))
+        finally:
+            s.close()
+
+    def test_fast_forward_resume_parity(self, tree_and_pack):
+        """Mid-epoch resume on the packed backend (PR 3's bit-continuity
+        contract): the fast-forwarded tail — device prologue included —
+        equals the uninterrupted epoch's."""
+        import jax.numpy as jnp
+
+        from deepfake_detection_tpu.data import create_deepfake_loader_v3
+        root, pack = tree_and_pack
+
+        def mk():
+            return create_deepfake_loader_v3(
+                PackedDataset(pack, roots=[root]), (12, CROP, CROP), 2,
+                is_training=True, num_workers=1, seed=11,
+                dtype=jnp.float32, re_prob=0.5, rotate_range=5)
+
+        full = mk()
+        full.set_epoch(1)
+        want = [tuple(np.asarray(p) for p in item) for item in full]
+        full.close()
+        ff = mk()
+        ff.set_epoch(1)
+        ff.fast_forward(1)
+        got = [tuple(np.asarray(p) for p in item) for item in ff]
+        ff.close()
+        assert len(want) == 3 and len(got) == 2
+        for a, b in zip(want[1:], got):
+            for xa, xb in zip(a, b):
+                np.testing.assert_array_equal(xa, xb)
+
+
+# ---------------------------------------------------------------------------
+# Loud failure modes
+# ---------------------------------------------------------------------------
+
+class TestFailureModes:
+    def test_truncated_shard_named(self, tree_and_pack):
+        root, pack = tree_and_pack
+        victim = os.path.join(pack, "shard-00001.bin")
+        with open(victim, "r+b") as f:
+            f.truncate(17)
+        with pytest.raises(PackedShardCorrupt,
+                           match=r"shard-00001\.bin.*\[2, 4\)"):
+            PackedDataset(pack)
+        assert any("shard-00001.bin" in p for p in verify_pack(pack))
+
+    def test_bit_flip_checksum(self, tree_and_pack):
+        root, pack = tree_and_pack
+        victim = os.path.join(pack, "shard-00000.bin")
+        raw = bytearray(open(victim, "rb").read())
+        raw[11] ^= 0x40
+        with open(victim, "wb") as f:
+            f.write(bytes(raw))
+        PackedDataset(pack)                      # size-only check passes
+        with pytest.raises(PackedShardCorrupt, match="checksum"):
+            PackedDataset(pack, verify=True)
+
+    def test_stale_source_lists(self, tree_and_pack):
+        root, pack = tree_and_pack
+        with open(os.path.join(root, "fake_list.txt"), "a") as f:
+            f.write("phantom:4\n")
+        with pytest.raises(PackedCacheStale, match="changed since"):
+            PackedDataset(pack, roots=[root])
+        # and the packer refuses to resume over the drift without --force
+        with pytest.raises(PackedCacheStale):
+            write_pack([root], pack, image_size=SIZE, shard_size=2)
+
+    def test_parameter_mismatches(self, tree_and_pack):
+        root, pack = tree_and_pack
+        with pytest.raises(PackedCacheStale, match="pack-image-size"):
+            PackedDataset(pack, image_size=SIZE * 2)
+        with pytest.raises(PackedCacheStale, match="frames/clip"):
+            PackedDataset(pack, frames_per_clip=2)
+
+    def test_incomplete_pack_is_loud(self, tmp_path):
+        root = str(tmp_path / "clips")
+        _make_clip_tree(root)
+        pack = str(tmp_path / "pack")
+        state = write_pack([root], pack, image_size=SIZE, shard_size=2,
+                           max_shards=1)
+        assert not state.get("complete")
+        with pytest.raises(PackedCacheStale, match="incomplete"):
+            PackedDataset(pack)
+
+
+# ---------------------------------------------------------------------------
+# Packer: resumability
+# ---------------------------------------------------------------------------
+
+class TestPackerResume:
+    def test_resume_equals_one_shot(self, tmp_path):
+        root = str(tmp_path / "clips")
+        _make_clip_tree(root)
+        resumed = str(tmp_path / "resumed")
+        state = write_pack([root], resumed, image_size=SIZE, shard_size=2,
+                           max_shards=1)
+        assert os.path.isfile(os.path.join(resumed, PACK_PARTIAL))
+        assert len(state["shards"]) == 1
+        state = write_pack([root], resumed, image_size=SIZE, shard_size=2)
+        assert state.get("complete")
+        assert not os.path.exists(os.path.join(resumed, PACK_PARTIAL))
+        oneshot = str(tmp_path / "oneshot")
+        ref = write_pack([root], oneshot, image_size=SIZE, shard_size=2)
+        assert [s["sha256"] for s in state["shards"]] == \
+            [s["sha256"] for s in ref["shards"]]
+
+    def test_shard_size_validated(self, tree_and_pack, tmp_path):
+        """shard_size < 1 would loop forever writing empty shards —
+        rejected up front."""
+        root, _ = tree_and_pack
+        with pytest.raises(ValueError, match="shard_size"):
+            write_pack([root], str(tmp_path / "bad"), image_size=SIZE,
+                       shard_size=0)
+
+    def test_noop_when_up_to_date(self, tree_and_pack):
+        root, pack = tree_and_pack
+        before = os.path.getmtime(os.path.join(pack, PACK_INDEX))
+        state = write_pack([root], pack, image_size=SIZE, shard_size=2)
+        assert state.get("complete")
+        assert os.path.getmtime(os.path.join(pack, PACK_INDEX)) == before
+
+
+# ---------------------------------------------------------------------------
+# Satellites: jax-free imports, make_lists cross-check
+# ---------------------------------------------------------------------------
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def test_packed_modules_import_jax_free():
+    """data/packed.py and tools/pack_dataset.py must not pull jax into
+    sys.modules (PR 1's spawned-worker import-cost discipline): shm decode
+    workers and data-prep hosts unpickle/import these with no accelerator
+    stack."""
+    code = (
+        "import sys; sys.path.insert(0, '.');\n"
+        "import deepfake_detection_tpu.data.packed\n"
+        "import tools.pack_dataset\n"
+        "bad = sorted(m for m in sys.modules if m == 'jax' or "
+        "m.startswith('jax.'))\n"
+        "assert not bad, f'jax leaked: {bad[:5]}'\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-800:]
+
+
+def test_make_lists_validate_packed(tree_and_pack):
+    sys.path.insert(0, REPO)
+    from tools import make_lists
+    root, pack = tree_and_pack
+    assert make_lists.main([root, "--validate", "--packed", pack,
+                            "--strict", "--min-frames", "2"]) == 0
+    # a clip added after packing → missing-from-pack, strict exit 1
+    d = os.path.join(root, "fake", "late")
+    os.makedirs(d)
+    for j in range(4):
+        Image.fromarray(np.zeros((SIZE, SIZE, 3), np.uint8)).save(
+            os.path.join(d, f"{j}.jpg"))
+    assert make_lists.main([root, "--validate", "--packed", pack,
+                            "--strict", "--min-frames", "2"]) == 1
+
+
+def test_pack_dataset_cli(tmp_path):
+    root = str(tmp_path / "clips")
+    _make_clip_tree(root)
+    pack = str(tmp_path / "pack")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pack_dataset.py"),
+         root, "--out", pack, "--pack-image-size", str(SIZE),
+         "--shard-size", "3", "--verify"],
+        capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-800:]
+    with open(os.path.join(pack, PACK_INDEX)) as f:
+        index = json.load(f)
+    assert index["complete"] and len(index["clips"]) == 6

@@ -48,6 +48,32 @@ def test_measure_shm_backend(tmp_path):
         os.environ.pop("DFD_NO_NATIVE_DECODE", None)
 
 
+def test_packed_matrix_smoke(tmp_path):
+    """--packed matrix: packs the synthetic set, measures decode vs packed
+    (fetch + both chains), emits backend=packed provenance rows, and the
+    budget gate skips rows with <60s left instead of starting them."""
+    import json
+    root = str(tmp_path / "clips")
+    os.makedirs(root)
+    bench_input.build_dataset(root, n_clips=6, size=40, frames=4)
+    out = str(tmp_path / "rows.jsonl")
+    args = SimpleNamespace(clips=6, size=32, frames=4, batch=2, workers=2,
+                           epochs=1, budget=0.0, json=out)
+    rows = bench_input.run_packed(root, args)
+    packed_rows = [r for r in rows if r["backend"] == "packed"]
+    assert {r["row"] for r in rows} == {"fetch", "eval", "train"}
+    assert len(packed_rows) == 3
+    assert all(r["clips_per_s"] > 0 for r in rows)
+    with open(out) as f:
+        emitted = [json.loads(line) for line in f]
+    assert sum(r.get("backend") == "packed" for r in emitted) == 3
+    # an exhausted budget records skips, never starts a row
+    args2 = SimpleNamespace(clips=6, size=32, frames=4, batch=2, workers=2,
+                            epochs=1, budget=0.001, json="")
+    rows2 = bench_input.run_packed(root, args2)
+    assert rows2 and all("skipped" in r for r in rows2)
+
+
 def test_gil_pause_methodology():
     """tools/bench_gil.py: the PyDLL control must read as GIL-held and the
     production CDLL decode as GIL-free — the measured basis for

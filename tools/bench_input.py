@@ -13,13 +13,18 @@ Usage::
                                 [--batch 8] [--workers 4] [--epochs 2]
                                 [--backend thread|shm|all]
                                 [--scaling 1,2,4]
+                                [--packed [--budget 600]]
 
 Prints clips/s, frames/s, and achieved GB/s (decoded output bytes staged
 for the device).  ``--backend`` selects the host-loader backend(s): the
 in-process thread pool or the multi-process shared-memory ring
 (``data/shm_ring.py``).  ``--scaling`` runs the thread-vs-shm matrix over
 the given worker counts — the measured (not extrapolated) basis for
-INPUT_BENCH.md's scaling table.
+INPUT_BENCH.md's scaling table.  ``--packed`` packs the synthetic set
+once (``tools/pack_dataset.py`` machinery) and measures the
+decode-vs-packed matrix — the isolated fetch stage plus the eval and
+train chains — under an optional ``--budget`` that skips (and records)
+rows when <60 s remain.
 """
 
 from __future__ import annotations
@@ -62,29 +67,41 @@ def build_dataset(root: str, n_clips: int, size: int, frames: int,
 
 
 def measure(root: str, args, native: bool, fast: bool = True,
-            backend: str = "thread") -> float:
+            backend: str = "thread", chain: str = "train",
+            packed_dir: str = "") -> float:
     """clips/s through the host pipeline.
 
     ``fast`` = the production split (fused native geometric warp; color
     jitter/flicker live in the device prologue, so the host skips them);
     ``fast=False`` = the reference-exact chain (sequential PIL geometric
     ops + host PIL jitter).  ``backend`` picks the host loader: 'thread'
-    (in-process pool) or 'shm' (worker processes + shared-memory ring)."""
+    (in-process pool) or 'shm' (worker processes + shared-memory ring).
+    ``chain`` picks the transform: 'train' (augment) or 'eval' (crop
+    only — the serving/eval steady state).  ``packed_dir`` swaps the
+    JPEG-decode clip source for the packed pre-decoded cache."""
     os.environ.pop("DFD_NO_NATIVE_DECODE", None)
     if not native:
         os.environ["DFD_NO_NATIVE_DECODE"] = "1"
     # import after the env var so the dataset sees the right decode path
     from deepfake_detection_tpu.data.dataset import DeepFakeClipDataset
     from deepfake_detection_tpu.data.loader import HostLoader
+    from deepfake_detection_tpu.data.packed import PackedDataset
     from deepfake_detection_tpu.data.samplers import ShardedTrainSampler
-    from deepfake_detection_tpu.data.transforms_factory import \
-        transforms_deepfake_train_v3
+    from deepfake_detection_tpu.data.transforms_factory import (
+        transforms_deepfake_eval_v3, transforms_deepfake_train_v3)
 
-    ds = DeepFakeClipDataset([root], frames_per_clip=args.frames)
-    ds.set_transform(transforms_deepfake_train_v3(
-        img_size=args.size, color_jitter=None if fast else 0.4,
-        rotate_range=5, blur_radiu=1, blur_prob=0.05,
-        flicker=0.0 if fast else 0.05, fused_geom=fast))
+    if packed_dir:
+        ds = PackedDataset(packed_dir, roots=[root],
+                           frames_per_clip=args.frames)
+    else:
+        ds = DeepFakeClipDataset([root], frames_per_clip=args.frames)
+    if chain == "eval":
+        ds.set_transform(transforms_deepfake_eval_v3(args.size))
+    else:
+        ds.set_transform(transforms_deepfake_train_v3(
+            img_size=args.size, color_jitter=None if fast else 0.4,
+            rotate_range=5, blur_radiu=1, blur_prob=0.05,
+            flicker=0.0 if fast else 0.05, fused_geom=fast))
     sampler = ShardedTrainSampler(len(ds), batch_size=args.batch, seed=0)
     if backend == "shm":
         from deepfake_detection_tpu.data.shm_ring import ShmRingLoader
@@ -196,6 +213,120 @@ def run_scaling(root: str, args, workers_list) -> list:
     return rows
 
 
+def measure_fetch(root: str, args, packed_dir: str = "") -> float:
+    """clips/s of the raw *decode stage* in isolation — exactly the work
+    the packed cache replaces: JPEG decode + resample-to-canonical vs one
+    mmap-view memcpy.  No augment, no loader: this is the stage ratio the
+    5x pre-registration is about; the chain rows above show how much of
+    it survives augment+collate overhead."""
+    from deepfake_detection_tpu.data import packed as packed_mod
+    from deepfake_detection_tpu.data.dataset import (DeepFakeClipDataset,
+                                                     _load_images)
+
+    ds = DeepFakeClipDataset([root], frames_per_clip=args.frames)
+    if packed_dir:
+        pds = packed_mod.PackedDataset(packed_dir, roots=[root],
+                                       frames_per_clip=args.frames)
+
+        def fetch(i):
+            # np.array = ONE memcpy of the mmap view: the same bytes the
+            # collate would pull, so both sides deliver owned pixels
+            return np.array(pds.sample_array(i))
+    else:
+        def fetch(i):
+            paths, _ = ds.sample_paths(i)
+            return packed_mod.canonical_clip_array(
+                _load_images(paths), args.size)
+    n_idx = len(ds)
+    fetch(0)                                   # warm file cache / pool
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(args.epochs):
+        for i in range(n_idx):
+            fetch(i)
+            n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def run_packed(root: str, args) -> list:
+    """decode-vs-packed matrix: the fetch stage, then the eval and train
+    chains end-to-end through the host loader.
+
+    Budget-skip (PR 1 bench-watchdog precedent): with ``--budget S`` the
+    remaining allowance is checked before every row and a row starting
+    with <60 s left is recorded as skipped instead of overrunning an
+    outer supervisor's grant.  Packed rows land in the JSONL with
+    ``backend=packed`` provenance (plus the transport that carried them).
+    """
+    t0 = time.perf_counter()
+    budget = float(getattr(args, "budget", 0) or 0)
+
+    def budget_left() -> float:
+        return budget - (time.perf_counter() - t0) if budget else float("inf")
+
+    rows = []
+    # the one-time pack is the longest stage of a cold run — it rides
+    # under the SAME gate as the rows (a stage that starts runs to
+    # completion, bench.py semantics, but never starts with <60s left)
+    if budget_left() < 60.0:
+        row = {"kind": "packed_matrix", "row": "pack", "backend": "packed",
+               "crop_size": args.size, "host_cpus": os.cpu_count(),
+               "skipped": f"budget {budget:.0f}s: <60s remain before "
+                          f"packing"}
+        print(f"| pack | skipped ({row['skipped']}) |")
+        rows.append(row)
+        _emit(args, row)
+        return rows
+    # per-resolution cache dir: a --keep re-run at another --size packs
+    # fresh instead of tripping the (intentional) fingerprint error
+    pack_dir = os.path.join(root, f"_packed_cache_{args.size}")
+    from deepfake_detection_tpu.data.packed import write_pack
+    t_pack = time.perf_counter()
+    write_pack([root], pack_dir, image_size=args.size,
+               frames_per_clip=args.frames, shard_size=64,
+               workers=args.workers)
+    t_pack = time.perf_counter() - t_pack
+    print(f"| row | decode clips/s | packed clips/s | packed/decode | "
+          f"[one-time pack: {t_pack:.1f}s]")
+    print("|---|---|---|---|")
+    matrix = [("fetch", dict(fn="fetch")),
+              ("eval", dict(fn="measure", chain="eval")),
+              ("train", dict(fn="measure", chain="train"))]
+    for name, spec in matrix:
+        res = {}
+        for source in ("decode", "packed"):
+            row = {"kind": "packed_matrix", "row": name, "source": source,
+                   "backend": "packed" if source == "packed" else "thread",
+                   "transport": "thread", "crop_size": args.size,
+                   "pack_size": args.size, "frames": args.frames,
+                   "batch": args.batch, "workers": args.workers,
+                   "host_cpus": os.cpu_count()}
+            if budget_left() < 60.0:
+                # the <60s skip: never start a row the budget cannot fit
+                # (mirrors bench.py's retry-budget gate)
+                row["skipped"] = f"budget {budget:.0f}s: <60s remain"
+                print(f"| {name}/{source} | skipped ({row['skipped']}) |")
+                rows.append(row)
+                _emit(args, row)
+                continue
+            pd = pack_dir if source == "packed" else ""
+            if spec["fn"] == "fetch":
+                cps = measure_fetch(root, args, packed_dir=pd)
+            else:
+                cps = measure(root, args, native=True, fast=True,
+                              chain=spec["chain"], packed_dir=pd)
+            res[source] = cps
+            row.update(clips_per_s=round(cps, 2),
+                       frames_per_s=round(cps * args.frames, 2),
+                       gbps=round(_gbps(cps, args), 3))
+            rows.append(row)
+            _emit(args, row)
+        if "decode" in res and "packed" in res:
+            print(f"| {name} | {res['decode']:.2f} | {res['packed']:.2f} | "
+                  f"{res['packed'] / max(res['decode'], 1e-9):.2f}x |")
+    return rows
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--clips", type=int, default=64)
@@ -221,6 +352,13 @@ def main() -> None:
                     help="--scaling pipeline: 'fast' = production split "
                          "(native warp + device jitter), 'reference' = "
                          "reference-exact PIL chain (the GIL-bound case)")
+    ap.add_argument("--packed", action="store_true",
+                    help="run the decode-vs-packed matrix (packs the "
+                         "synthetic set once, then fetch/eval/train rows)")
+    ap.add_argument("--budget", type=float, default=0.0,
+                    help="total seconds for the --packed matrix; a row is "
+                         "skipped (and recorded as such) when <60s remain "
+                         "(0 = unlimited)")
     ap.add_argument("--keep", default="", help="reuse/keep dataset dir")
     ap.add_argument("--json", default="",
                     help="append one JSON result line per impl to this file")
@@ -233,6 +371,9 @@ def main() -> None:
               f"...", file=sys.stderr)
         build_dataset(root, args.clips, src, args.frames)
 
+    if args.packed:
+        run_packed(root, args)
+        return
     if args.scaling:
         run_scaling(root, args,
                     [int(w) for w in args.scaling.split(",") if w])

@@ -22,6 +22,13 @@ unreachable and ``--validate`` flags them.
   loader front-pads these with frame 0, which is legal but worth eyes;
 * **corrupt JPEGs** — files PIL cannot fully decode.
 
+``--validate --packed DIR`` cross-checks a packed pre-decoded cache
+(``tools/pack_dataset.py``) against the freshly scanned tree in the same
+pass: clips missing from the pack, stale extras only the pack still
+holds, frame-count mismatches, and truncated/corrupt shards
+(``data/packed.py::verify_pack``) — one command audits both
+representations.
+
 Exit code is 1 when ``--validate --strict`` finds problems.
 
 Usage (see README "Data lists" recipe)::
@@ -105,6 +112,44 @@ def validate_clips(class_dir: str, clips: Dict[str, List[int]],
     return problems
 
 
+def validate_packed(pack_dir: str, scanned: Dict[str, Dict[str, List[int]]],
+                    checksums: bool = True) -> List[str]:
+    """Cross-check a pack index against the scanned frame tree.
+
+    ``scanned`` maps kind → {clip_name: frame indices} (the same structure
+    the list writer consumes, so list files and pack are audited against
+    ONE scan).  Import is deferred and jax-free (data/packed.py)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from deepfake_detection_tpu.data.packed import load_index, verify_pack
+    problems = verify_pack(pack_dir, checksums=checksums)
+    try:
+        index = load_index(pack_dir)
+    except Exception:          # unreadable index already reported above
+        return problems
+    packed: Dict[str, Dict[str, int]] = {k: {} for k in KINDS}
+    for entry in index["clips"]:
+        kind, _ri, name, num = entry[0], entry[1], entry[2], int(entry[3])
+        packed.setdefault(kind, {})[name] = num
+    for kind in KINDS:
+        tree = {name: contiguous_count(idxs)
+                for name, idxs in scanned.get(kind, {}).items()
+                if contiguous_count(idxs) > 0}
+        for name in sorted(set(tree) - set(packed[kind])):
+            problems.append(f"{pack_dir}: {kind}/{name} is in the tree "
+                            f"but not in the pack — re-run "
+                            f"tools/pack_dataset.py")
+        for name in sorted(set(packed[kind]) - set(tree)):
+            problems.append(f"{pack_dir}: {kind}/{name} is packed but no "
+                            f"longer in the tree (stale pack)")
+        for name in sorted(set(tree) & set(packed[kind])):
+            if tree[name] != packed[kind][name]:
+                problems.append(
+                    f"{pack_dir}: {kind}/{name} frame count changed "
+                    f"(tree {tree[name]}, pack {packed[kind][name]})")
+    return problems
+
+
 def write_list(path: str, clips: Dict[str, List[int]]) -> int:
     """Write ``name:num`` lines (dense-prefix counts, deterministic
     order); returns the number of listed clips."""
@@ -131,6 +176,9 @@ def main(argv=None) -> int:
                     help="short-clip threshold for --validate (img_num)")
     ap.add_argument("--validate", action="store_true",
                     help="flag missing frames, short clips, corrupt JPEGs")
+    ap.add_argument("--packed", default="", metavar="DIR",
+                    help="with --validate: cross-check this packed cache "
+                         "(tools/pack_dataset.py) against the scanned tree")
     ap.add_argument("--strict", action="store_true",
                     help="with --validate: exit 1 when problems found")
     args = ap.parse_args(argv)
@@ -138,6 +186,7 @@ def main(argv=None) -> int:
     out_dir = args.out_dir or args.root
     problems: List[str] = []
     totals: List[Tuple[str, int, int]] = []
+    scanned: Dict[str, Dict[str, List[int]]] = {}
     for kind in KINDS:
         class_dir = os.path.join(args.root, kind)
         if not os.path.isdir(class_dir):
@@ -146,6 +195,7 @@ def main(argv=None) -> int:
             clips = {}
         else:
             clips = scan_clips(class_dir)
+        scanned[kind] = clips
         if args.validate and clips:
             problems += validate_clips(class_dir, clips, args.min_frames,
                                        check_decode=True)
@@ -153,6 +203,8 @@ def main(argv=None) -> int:
                               clips)
         frames = sum(contiguous_count(v) for v in clips.values())
         totals.append((kind, n_listed, frames))
+    if args.validate and args.packed:
+        problems += validate_packed(args.packed, scanned)
 
     for kind, n, frames in totals:
         print(f"{kind}: {n} clips, {frames} reachable frames "
