@@ -299,6 +299,19 @@ class TrainConfig:
     # thread stacks and aborts with exit code 85 (0 = off)
     watchdog_timeout: float = 0.0
 
+    # --- observability (deepfake_detection_tpu/obs) ---
+    # the telemetry tracker (per-step time breakdown, throughput/MFU
+    # gauges, JSONL event log in the run dir) is DEFAULT ON — it rides the
+    # existing drain cadence with zero extra device syncs; this opts out
+    no_telemetry: bool = False
+    # stdlib trainer HTTP endpoint: GET /metrics (Prometheus text) +
+    # /healthz while the run is live (0 = off)
+    metrics_port: int = 0
+    # on-demand profiler capture window, in steps: SIGUSR2 or
+    # `touch <outdir>/PROFILE` traces the next N steps on a RUNNING job,
+    # rank-0-gated (0 disables the triggers)
+    profile_capture: int = 20
+
     # --- misc / infra ---
     seed: int = 42
     log_interval: int = 50
@@ -378,6 +391,12 @@ class TrainConfig:
             raise ValueError("--pack-image-size only makes sense with "
                              "--data-packed (it asserts the pack's "
                              "resolution, not a resize)")
+        if not 0 <= int(self.metrics_port) <= 65535:
+            raise ValueError(f"--metrics-port must be 0..65535, got "
+                             f"{self.metrics_port}")
+        if int(self.profile_capture) < 0:
+            raise ValueError(f"--profile-capture must be >= 0, got "
+                             f"{self.profile_capture}")
 
     # ------------------------------------------------------------------
     @property

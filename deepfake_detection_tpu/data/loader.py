@@ -46,10 +46,40 @@ from .samplers import (OrderedShardedSampler, ShardedTrainSampler,
 from .transforms_factory import (transforms_deepfake_eval_v3,
                                  transforms_deepfake_train_v3)
 
-__all__ = ["fast_collate", "HostLoader", "DeviceLoader", "create_loader",
-           "create_deepfake_loader_v3"]
+__all__ = ["fast_collate", "HostLoader", "DeviceLoader", "LoaderStats",
+           "HostLoaderStats", "create_loader", "create_deepfake_loader_v3"]
 
 LOADER_BACKENDS = ("thread", "shm")
+
+
+class LoaderStats:
+    """Monotonic DeviceLoader wait counters (obs/telemetry.py input gauges).
+
+    Two ``time.monotonic`` deltas per batch around blocks the loader
+    ALREADY performs — no new syncs, no locks (single writer: the consumer
+    thread; telemetry reads are torn-proof float loads under the GIL).
+    """
+
+    __slots__ = ("batches", "host_wait_s", "stage_block_s")
+
+    def __init__(self):
+        self.batches = 0        # batches staged to device
+        self.host_wait_s = 0.0  # blocked in next(host_loader) — input starved
+        self.stage_block_s = 0.0  # blocked in the slab-recycle
+        # block_until_ready — prologue/staging backpressure (device busy)
+
+
+class HostLoaderStats:
+    """Producer-side thread-backend counters (written by the producer
+    thread; same single-writer torn-proof contract as LoaderStats)."""
+
+    __slots__ = ("batches", "fetch_s", "put_wait_s")
+
+    def __init__(self):
+        self.batches = 0        # batches collated
+        self.fetch_s = 0.0      # decode+transform+collate time
+        self.put_wait_s = 0.0   # blocked on the full prefetch queue
+        # (consumer slower than the pipeline — healthy backpressure)
 
 
 def _loader_chaos():
@@ -103,6 +133,7 @@ class HostLoader:
         self.collate_mixup = collate_mixup
         self.valid_mask = valid_mask
         self.epoch = 0
+        self.stats = HostLoaderStats()
         # mid-epoch resume: skip producing batches < start_batch while
         # keeping their ABSOLUTE indices for every per-batch RNG, so a
         # fast-forwarded epoch's remaining batches are bit-identical to an
@@ -136,13 +167,17 @@ class HostLoader:
         def put(item) -> bool:
             """Bounded put that keeps observing ``stop`` (an abandoned
             consumer otherwise deadlocks the producer on the full queue)."""
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+            t0 = time.monotonic()
+            try:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
+            finally:
+                self.stats.put_wait_s += time.monotonic() - t0
 
         def produce():
             with ThreadPoolExecutor(self.num_workers) as pool:
@@ -159,6 +194,7 @@ class HostLoader:
                                         "batch %d",
                                         chaos.arg("stall_loader", 120.0), bi)
                         time.sleep(chaos.arg("stall_loader", 120.0))
+                    t_fetch = time.monotonic()
                     samples = list(pool.map(self._load_one, batch_idx))
                     images, targets = fast_collate(samples)
                     if self.collate_mixup is not None:
@@ -166,6 +202,8 @@ class HostLoader:
                             [self.seed, self.epoch, bi, 0x77]))
                         images, targets = self.collate_mixup(images, targets,
                                                              mrng)
+                    self.stats.fetch_s += time.monotonic() - t_fetch
+                    self.stats.batches += 1
                     if vms is not None:
                         item: Any = (images, targets, vms[bi])
                     else:
@@ -212,6 +250,7 @@ class DeviceLoader:
         self.dtype = dtype
         self.sharding = sharding
         self.seed = seed
+        self.stats = LoaderStats()
         mean = np.tile(np.asarray(mean, np.float32) * 255.0, img_num)
         std = np.tile(np.asarray(std, np.float32) * 255.0, img_num)
         self._mean = mean.reshape(1, 1, 1, -1)
@@ -336,19 +375,25 @@ class DeviceLoader:
         # dispatch equivalent of the reference's CUDA-stream prefetcher.
         pending = None
         prev_x = None
+        stats = self.stats
         while True:
             if prev_x is not None:
                 # the shm ring recycles batch k's slab once batch k+2 is
                 # requested; jax CPU device_put zero-copies aligned host
                 # buffers, so batch k's prologue (the only reader of the
                 # slab) must have RUN before we pull the next host batch
+                t0 = time.monotonic()
                 jax.block_until_ready(prev_x)
+                stats.stage_block_s += time.monotonic() - t0
                 prev_x = None
             try:
+                t0 = time.monotonic()
                 item = next(it)
+                stats.host_wait_s += time.monotonic() - t0
             except StopIteration:
                 break
             staged = self._stage(item, base_key)
+            stats.batches += 1
             if pending is not None:
                 prev_x = staged[0]
                 yield pending

@@ -97,7 +97,7 @@ def _load() -> Optional[ctypes.CDLL]:
         return _lib
 
 
-_ABI_VERSION = 2           # must match dfd_abi_version() in dfd_native.cc
+_ABI_VERSION = 3           # must match dfd_abi_version() in dfd_native.cc
 
 
 def _bind_symbols(lib) -> None:
@@ -129,12 +129,13 @@ def _bind_symbols(lib) -> None:
         ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_int)]
     lib.dfd_warp_affine.argtypes = [
-        u8p, ctypes.c_int, ctypes.c_int,
+        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_double)]
     lib.dfd_pool_warp_affine.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(u8p),
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(u8p), ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_double)]
 
@@ -225,6 +226,42 @@ class DecodePool:
             pass
 
 
+# Source-staging counters (process-local; telemetry's input-pipeline
+# gauges read them via warp_copy_stats).  Plain ints under the GIL; shm-
+# backend loader workers warp in their own processes and count there.
+_warp_copies_elided = 0     # strided sources passed copy-free (pre-ABI-3
+#                             these paid an ascontiguousarray copy each)
+_warp_copies = 0            # sources that still needed the staging copy
+
+
+def warp_copy_stats() -> dict:
+    """Lifetime warp source-staging counts for this process."""
+    return {"elided": _warp_copies_elided, "copied": _warp_copies}
+
+
+def _stage_warp_src(f) -> tuple:
+    """(array, src_pixel_stride) for one warp source frame.
+
+    The ABI-3 kernel reads sources at an arbitrary pixel stride as long
+    as rows are dense (``row_stride == width * pixel_stride``) and the 3
+    channels are adjacent — exactly the layout of a channel-slice view
+    ``base[..., 3i:3i+3]`` of a C-contiguous (H, W, 3·F) packed clip (the
+    packed-cache mmap views).  Such views pass through copy-free; anything
+    else (PIL images, casts, exotic strides) pays the contiguous staging
+    copy it always did.
+    """
+    global _warp_copies_elided, _warp_copies
+    a = f if isinstance(f, np.ndarray) else np.asarray(f)
+    if a.dtype == np.uint8 and a.ndim == 3 and a.shape[2] == 3 and \
+            a.strides[2] == 1 and a.strides[1] >= 3 and \
+            a.strides[0] == a.shape[1] * a.strides[1]:
+        if a.strides[1] != 3:
+            _warp_copies_elided += 1
+        return a, int(a.strides[1])
+    _warp_copies += 1
+    return np.ascontiguousarray(a, dtype=np.uint8), 3
+
+
 def warp_affine_batch(frames: Sequence[np.ndarray], coeffs: Sequence[float],
                       out_size, pool: Optional["DecodePool"] = None,
                       packed: bool = False):
@@ -250,7 +287,8 @@ def warp_affine_batch(frames: Sequence[np.ndarray], coeffs: Sequence[float],
     n = len(frames)
     if n == 0:
         return np.empty((th, tw, 0), np.uint8) if packed else []
-    frames = [np.ascontiguousarray(f, dtype=np.uint8) for f in frames]
+    staged = [_stage_warp_src(f) for f in frames]
+    frames = [a for a, _ in staged]
     u8p = ctypes.POINTER(ctypes.c_uint8)
     if packed:
         out = np.empty((th, tw, 3 * n), np.uint8)
@@ -265,6 +303,7 @@ def warp_affine_batch(frames: Sequence[np.ndarray], coeffs: Sequence[float],
     srcs = (u8p * n)(*[f.ctypes.data_as(u8p) for f in frames])
     sws = (ctypes.c_int * n)(*[f.shape[1] for f in frames])
     shs = (ctypes.c_int * n)(*[f.shape[0] for f in frames])
+    sss = (ctypes.c_int * n)(*[ss for _, ss in staged])
     # INDEX-SPACE convention: output pixel index (x, y) samples source
     # INDEX (A·x+B·y+C, D·x+E·y+F).  PIL's Image.transform differs by a
     # half-pixel term (it maps continuous coords: index A·x+B·y+
@@ -273,12 +312,12 @@ def warp_affine_batch(frames: Sequence[np.ndarray], coeffs: Sequence[float],
     c = (ctypes.c_double * 6)(*[float(v) for v in coeffs])
     p = pool or default_pool()
     if p is not None:
-        lib.dfd_pool_warp_affine(p._pool, n, srcs, sws, shs, dsts, tw, th,
-                                 stride, c)
+        lib.dfd_pool_warp_affine(p._pool, n, srcs, sws, shs, sss, dsts,
+                                 tw, th, stride, c)
     else:
         for i in range(n):
-            lib.dfd_warp_affine(srcs[i], sws[i], shs[i], dsts[i], tw, th,
-                                stride, c)
+            lib.dfd_warp_affine(srcs[i], sws[i], shs[i], sss[i], dsts[i],
+                                tw, th, stride, c)
     return out if packed else outs
 
 

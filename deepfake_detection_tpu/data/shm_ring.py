@@ -301,6 +301,12 @@ class ShmRingLoader:
         self.respawn_count = 0          # lifetime total: observability/tests
         self._iter_respawns = 0         # windowed: crash-loop abort guard
         self._slow_tasks: Set[Tuple[int, int]] = set()  # kill-once ledger
+        # telemetry counters (obs/telemetry.py loader_collector): lifetime
+        # totals, single-writer (the consumer thread), torn-proof reads
+        self.stall_sweeps = 0           # lost-ack re-dispatch sweeps fired
+        self.collect_wait_s = 0.0       # consumer blocked waiting on a batch
+        self.inflight_batches = 0       # dispatched, not yet yielded (ring
+        # occupancy = inflight_batches / ring_depth)
 
         self._ctx = mp.get_context("spawn")
         self._ring: Optional[ShmRing] = None
@@ -487,7 +493,8 @@ class ShmRingLoader:
     def _collect(self, bi: int, done: Dict[int, Set[int]],
                  batches: List[List[int]], epoch: int, gen: int) -> None:
         need = len(batches[bi])
-        last_progress = time.monotonic()
+        t_enter = time.monotonic()
+        last_progress = t_enter
         sweeps = 0
         while len(done.get(bi, ())) < need:
             try:
@@ -505,6 +512,7 @@ class ShmRingLoader:
                 now = time.monotonic()
                 if now - last_progress > max(5.0, self.heartbeat_timeout / 8):
                     sweeps += 1
+                    self.stall_sweeps += 1
                     if sweeps > 20:
                         raise RuntimeError(
                             f"shm loader: batch {bi} stalled "
@@ -529,6 +537,7 @@ class ShmRingLoader:
                 raise RuntimeError(
                     f"shm worker failed on sample {j} of batch {dbi}: {err}")
             done.setdefault(dbi, set()).add(j)
+        self.collect_wait_s += time.monotonic() - t_enter
 
     def __iter__(self):
         batches, vms = epoch_batches(self.sampler, self.batch_size,
@@ -573,6 +582,7 @@ class ShmRingLoader:
             self._owner[slot] = _owner_token(gen, bi)
             for j, idx in enumerate(batches[bi]):
                 self._task_q.put((slot, j, int(idx), epoch, bi, gen))
+            self.inflight_batches = len(done)
 
         for bi in range(start, min(start + D, nb)):
             dispatch(bi)
@@ -591,6 +601,7 @@ class ShmRingLoader:
                     [self.seed, epoch, bi, 0x77]))
                 images, targets = self.collate_mixup(images, targets, mrng)
             done.pop(bi, None)
+            self.inflight_batches = len(done)
             if vms is not None:
                 yield images, targets, np.asarray(vms[bi])
             else:

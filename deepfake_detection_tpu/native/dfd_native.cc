@@ -135,7 +135,9 @@ void dfd_free(uint8_t* p) { std::free(p); }
 // Bumped on any signature change; the python bridge refuses to drive a
 // stale .so whose symbols still resolve but whose argument layout moved
 // (extern "C" has no mangling to catch that).
-int dfd_abi_version(void) { return 2; }
+// v3: warp functions take source pixel strides, so packed-cache mmap
+// channel-slice views ((H, W, 3k) clips) warp without a contiguous copy.
+int dfd_abi_version(void) { return 3; }
 
 // ---------------------------------------------------------------------------
 // affine warp (bilinear, RGB8, black fill)
@@ -151,8 +153,14 @@ namespace {
 // dst_stride: bytes between consecutive output PIXELS (3 for a tight RGB
 // buffer; 3*num_frames when each frame writes its channel slice of a packed
 // (H, W, 3*F) clip so the loader never pays a concat copy).
-void warp_affine_rgb8(const uint8_t* src, int sw, int sh, uint8_t* dst,
-                      int dw, int dh, int dst_stride, const double* coef) {
+// src_stride: same, for the SOURCE — 3 for a tight buffer, 3*num_frames
+// when the source is a channel-slice view of a packed clip (e.g. the
+// packed-cache mmap views), so reading pays no ascontiguousarray copy
+// either.  Source rows are assumed dense: row stride == sw * src_stride,
+// which holds for any channel slice of a C-contiguous (H, W, 3*F) array.
+void warp_affine_rgb8(const uint8_t* src, int sw, int sh, int src_stride,
+                      uint8_t* dst, int dw, int dh, int dst_stride,
+                      const double* coef) {
   // 16.16 fixed point: source coords step by a constant per output x, so
   // the whole inner loop is integer adds/shifts; weights use 8 fractional
   // bits (wx*wy fits 16) — ±1 LSB vs float bilinear, invisible after the
@@ -160,6 +168,8 @@ void warp_affine_rgb8(const uint8_t* src, int sw, int sh, uint8_t* dst,
   const int64_t kOne = 1 << 16;
   const int64_t Ai = static_cast<int64_t>(std::llround(coef[0] * kOne));
   const int64_t Di = static_cast<int64_t>(std::llround(coef[3] * kOne));
+  const size_t ss = static_cast<size_t>(src_stride > 0 ? src_stride : 3);
+  const size_t src_row = static_cast<size_t>(sw) * ss;
   for (int y = 0; y < dh; ++y) {
     int64_t sx = static_cast<int64_t>(
         std::llround((coef[1] * y + coef[2]) * kOne));
@@ -172,20 +182,21 @@ void warp_affine_rgb8(const uint8_t* src, int sw, int sh, uint8_t* dst,
       uint8_t* px = row + static_cast<size_t>(dst_stride) * x;
       const uint32_t wx1 = (sx >> 8) & 0xff, wx0 = 256 - wx1;
       const uint32_t wy1 = (sy >> 8) & 0xff, wy0 = 256 - wy1;
-      const uint8_t* r0 = src + (static_cast<size_t>(y0) * sw + x0) * 3;
+      const uint8_t* r0 = src + static_cast<size_t>(y0) * src_row +
+                          static_cast<size_t>(x0) * ss;
       if (x0 >= 0 && y0 >= 0 && x0 + 1 < sw && y0 + 1 < sh) {
         // fast path: all four taps in bounds (the vast majority)
-        const uint8_t* r1 = r0 + static_cast<size_t>(sw) * 3;
+        const uint8_t* r1 = r0 + src_row;
         const uint32_t w00 = wx0 * wy0, w10 = wx1 * wy0;
         const uint32_t w01 = wx0 * wy1, w11 = wx1 * wy1;
-        px[0] = static_cast<uint8_t>((w00 * r0[0] + w10 * r0[3] +
-                                      w01 * r1[0] + w11 * r1[3] +
+        px[0] = static_cast<uint8_t>((w00 * r0[0] + w10 * r0[ss] +
+                                      w01 * r1[0] + w11 * r1[ss] +
                                       32768) >> 16);
-        px[1] = static_cast<uint8_t>((w00 * r0[1] + w10 * r0[4] +
-                                      w01 * r1[1] + w11 * r1[4] +
+        px[1] = static_cast<uint8_t>((w00 * r0[1] + w10 * r0[ss + 1] +
+                                      w01 * r1[1] + w11 * r1[ss + 1] +
                                       32768) >> 16);
-        px[2] = static_cast<uint8_t>((w00 * r0[2] + w10 * r0[5] +
-                                      w01 * r1[2] + w11 * r1[5] +
+        px[2] = static_cast<uint8_t>((w00 * r0[2] + w10 * r0[ss + 2] +
+                                      w01 * r1[2] + w11 * r1[ss + 2] +
                                       32768) >> 16);
         continue;
       }
@@ -196,16 +207,16 @@ void warp_affine_rgb8(const uint8_t* src, int sw, int sh, uint8_t* dst,
       // boundary: taps outside read as black
       const bool in_x0 = x0 >= 0, in_x1 = x0 + 1 < sw;
       const bool in_y0 = y0 >= 0, in_y1 = y0 + 1 < sh;
-      const uint8_t* r1 = r0 + static_cast<size_t>(sw) * 3;
-      for (int c = 0; c < 3; ++c) {
+      const uint8_t* r1 = r0 + src_row;
+      for (size_t c = 0; c < 3; ++c) {
         uint32_t v = 0;
         if (in_y0) {
           if (in_x0) v += wx0 * wy0 * r0[c];
-          if (in_x1) v += wx1 * wy0 * r0[3 + c];
+          if (in_x1) v += wx1 * wy0 * r0[ss + c];
         }
         if (in_y1) {
           if (in_x0) v += wx0 * wy1 * r1[c];
-          if (in_x1) v += wx1 * wy1 * r1[3 + c];
+          if (in_x1) v += wx1 * wy1 * r1[ss + c];
         }
         px[c] = static_cast<uint8_t>((v + 32768) >> 16);
       }
@@ -215,10 +226,11 @@ void warp_affine_rgb8(const uint8_t* src, int sw, int sh, uint8_t* dst,
 
 }  // namespace
 
-void dfd_warp_affine(const uint8_t* src, int sw, int sh, uint8_t* dst,
-                     int dw, int dh, int dst_stride, const double* coef) {
-  warp_affine_rgb8(src, sw, sh, dst, dw, dh,
-                   dst_stride > 0 ? dst_stride : 3, coef);
+void dfd_warp_affine(const uint8_t* src, int sw, int sh, int src_stride,
+                     uint8_t* dst, int dw, int dh, int dst_stride,
+                     const double* coef) {
+  warp_affine_rgb8(src, sw, sh, src_stride > 0 ? src_stride : 3, dst, dw,
+                   dh, dst_stride > 0 ? dst_stride : 3, coef);
 }
 
 uint8_t* dfd_decode_jpeg(const uint8_t* data, size_t size, int scale_denom,
@@ -342,9 +354,13 @@ void dfd_pool_decode_buffers(void* pool, int n, const uint8_t** datas,
 // Warp n same-coef frames in parallel (one clip's frames share the draw).
 // dsts[i] must be preallocated writable buffers honoring dst_stride: tight
 // dw*dh*3 allocations with dst_stride=3, or interior pointers (base + 3*i)
-// into ONE dw*dh*3*n packed clip with dst_stride=3*n.
+// into ONE dw*dh*3*n packed clip with dst_stride=3*n.  src_strides[i] is
+// the per-frame SOURCE pixel stride (nullptr or 0 entries mean tight RGB):
+// channel-slice views of a packed (H, W, 3*F) clip pass 3*F and skip the
+// contiguous staging copy.
 void dfd_pool_warp_affine(void* pool, int n, const uint8_t** srcs,
-                          const int* sws, const int* shs, uint8_t** dsts,
+                          const int* sws, const int* shs,
+                          const int* src_strides, uint8_t** dsts,
                           int dw, int dh, int dst_stride,
                           const double* coef) {
   Pool* p = static_cast<Pool*>(pool);
@@ -352,8 +368,11 @@ void dfd_pool_warp_affine(void* pool, int n, const uint8_t** srcs,
   Latch latch(n);
   for (int i = 0; i < n; ++i) {
     p->Submit([&, i] {
-      warp_affine_rgb8(srcs[i], sws[i], shs[i], dsts[i], dw, dh, stride,
-                       coef);
+      const int ss = src_strides != nullptr && src_strides[i] > 0
+                         ? src_strides[i]
+                         : 3;
+      warp_affine_rgb8(srcs[i], sws[i], shs[i], ss, dsts[i], dw, dh,
+                       stride, coef);
       latch.Done();
     });
   }

@@ -1,0 +1,430 @@
+"""Per-step training telemetry: time breakdown, throughput, MFU, catalog.
+
+The tracker rides the trainer's existing metric-drain cadence and adds
+**zero device syncs**: every input it receives is a host float the trainer
+already materialized (the buffered ``float(m["loss"])`` reads at drain),
+or a ``time.monotonic`` delta around work the loop already does.  The
+breakdown attributes a drain window's wall time to three places:
+
+* **data wait** — the loop blocked on ``next(loader)`` (host pipeline
+  starving the chip); the per-step ``data_time`` the trainer logs.
+* **device wait** — the loop blocked materializing the buffered metric
+  scalars at the drain boundary (the device still executing its step
+  backlog).  Because metric reads are the ONLY host syncs in the loop,
+  this is the async-dispatch measurement of "the chip is the bottleneck".
+* **host time** — the remainder: dispatch, collate hand-off, Python.
+
+The :class:`~deepfake_detection_tpu.data.loader.DeviceLoader` double-buffer
+boundaries add two more counters (``input_*``): time blocked in
+``next()`` on the host loader and time blocked in the slab-recycle
+``block_until_ready`` (prologue/staging backpressure) — both are waits the
+loader already performed; the tracker only timestamps them.
+
+Throughput (img/s over the drain window) times the per-sample forward
+FLOP count from ``tools/flops_breakdown.py`` (× 3 for fwd+bwd, the
+standard training approximation) against the device's peak rate to give a
+**live MFU gauge** — the in-run counterpart of bench.py's offline MFU row
+and of the PERF.md §6 accept/revert criterion.
+
+Rendering goes through the shared :mod:`..utils.prometheus` text renderer
+(the serving subsystem's ``GET /metrics`` sibling); obs/server.py exposes
+it on ``--metrics-port``.  Each drain also appends one ``metrics`` record
+to the run's JSONL event log (obs/events.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.metrics import LatencyHistogram
+from ..utils.prometheus import PromText
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["TrainTelemetry", "forward_flops_per_sample", "peak_flops",
+           "loader_collector", "native_warp_collector",
+           "resilience_collector"]
+
+_PREFIX = "dfd_train"
+
+#: step/data-wait histogram bounds: 1 ms .. 60 s (first-step compile tails
+#: land in the top buckets; steady-state steps resolve at ms granularity)
+_STEP_BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+# bf16 peak per chip by device_kind (bench.py's table; the MFU gauge and
+# the offline bench rows must agree on the denominator)
+_PEAK_FLOPS = {
+    "TPU v2": 22.5e12, "TPU v3": 61.5e12 / 2, "TPU v4": 137.5e12 * 2,
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5": 229.5e12 * 2,
+    "TPU v5p": 459e12, "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+    "TPU v7": 2307e12,
+}
+
+_COUNTER_CATALOG = (
+    ("steps_total", "Train steps dispatched"),
+    ("samples_total", "Training samples consumed"),
+    ("drains_total", "Metric drain boundaries (telemetry records)"),
+    ("step_seconds_total", "Wall seconds spent in the train loop"),
+    ("data_wait_seconds_total", "Seconds the loop blocked on next(loader)"),
+    ("device_wait_seconds_total", "Seconds the drain blocked materializing "
+     "buffered device scalars (device-bound time)"),
+    ("nonfinite_steps_total", "Steps whose loss/grad-norm was non-finite"),
+    ("guard_spike_steps_total", "Steps the anomaly guard flagged as loss "
+     "spikes"),
+    ("rewinds_total", "Guard rewinds to a recovery snapshot"),
+    ("recovery_snapshots_total", "In-epoch recovery snapshots written"),
+    ("preemptions_total", "Preemption stops honored at a step boundary"),
+    ("profile_captures_total", "On-demand profiler trace windows captured"),
+    ("watchdog_beats_total", "Stall-watchdog heartbeats received"),
+    ("watchdog_near_misses_total", "Heartbeats older than 0.5x the "
+     "watchdog timeout when they landed"),
+    ("events_total", "Lifecycle events recorded to the JSONL log"),
+)
+
+_GAUGE_CATALOG = (
+    ("up", "1 while the trainer's telemetry is live"),
+    ("epoch", "Current epoch"),
+    ("update", "Global update counter at the last drain"),
+    ("loss", "Train loss, epoch-running average at the last drain (the "
+     "trainer log line's avg — spikes show in nonfinite/spike counters)"),
+    ("prec1", "Train top-1 precision, epoch-running average at the last "
+     "drain"),
+    ("learning_rate", "Current learning rate"),
+    ("throughput_imgs_per_s", "Images/sec over the last drain window"),
+    ("step_time_ms", "Mean step wall time over the last drain window"),
+    ("data_wait_frac", "Fraction of the last window blocked on input"),
+    ("device_wait_frac", "Fraction of the last window blocked on the "
+     "device backlog"),
+    ("host_frac", "Fraction of the last window in host-side dispatch"),
+    ("mfu", "Live model FLOPs utilization (0 when peak rate unknown, "
+     "e.g. CPU)"),
+    ("model_fwd_gflops_per_sample", "Per-sample forward GFLOPs feeding "
+     "the MFU gauge (tools/flops_breakdown.py)"),
+    ("restart_count", "Restart-wrapper relaunches of this run "
+     "(DFD_RESTART_COUNT)"),
+    ("watchdog_beat_age_s", "Seconds since the last watchdog heartbeat"),
+)
+
+
+class TrainTelemetry:
+    """One registry per training process.
+
+    Hot-path contract: :meth:`on_step` and :meth:`on_drain` take host
+    floats only and never touch a ``jax.Array`` — the overhead-guard test
+    asserts a telemetry-on run performs exactly the device syncs a
+    telemetry-off run does.
+    """
+
+    def __init__(self, event_log: Optional[Any] = None,
+                 flops_per_sample: float = 0.0,
+                 peak_flops: float = 0.0,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.event_log = event_log
+        self.flops_per_sample = float(flops_per_sample)
+        self.peak = float(peak_flops)
+        self.meta = dict(meta or {})
+        self.profiler = None          # optional obs.profiler.ProfilerCapture
+        self._lock = threading.RLock()
+        self._c: "OrderedDict[str, float]" = OrderedDict()
+        self._g: "OrderedDict[str, float]" = OrderedDict()
+        self._help: Dict[str, str] = {}
+        for name, help_ in _COUNTER_CATALOG:
+            self._c[name] = 0.0
+            self._help[name] = help_
+        for name, help_ in _GAUGE_CATALOG:
+            self._g[name] = 0.0
+            self._help[name] = help_
+        self._g["up"] = 1.0
+        self._g["model_fwd_gflops_per_sample"] = round(
+            self.flops_per_sample / 1e9, 3)
+        self._g["restart_count"] = float(
+            os.environ.get("DFD_RESTART_COUNT", 0) or 0)
+        self.h_step = LatencyHistogram(_STEP_BOUNDS)
+        self.h_data_wait = LatencyHistogram(_STEP_BOUNDS)
+        self._collectors: List[Callable[[], Dict[str, Dict[str, float]]]] = []
+        # drain-window accumulators (single-writer: the train loop).  The
+        # window length is the SUM of per-step wall times, not a monotonic
+        # anchor: per-step wall (trainer batch_time) already covers the
+        # loop end-to-end including data wait and the drain block, so the
+        # breakdown fractions are consistent by construction and the
+        # tracker is a pure function of its inputs (testable without
+        # sleeping).
+        self._win_steps = 0
+        self._win_samples = 0
+        self._win_wall = 0.0
+        self._win_data_wait = 0.0
+
+    # -- registry ------------------------------------------------------
+    def inc(self, name: str, n: float = 1.0) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0.0) + n
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._g[name] = value
+
+    def register_collector(
+            self, fn: Callable[[], Dict[str, Dict[str, float]]]) -> None:
+        """``fn`` returns ``{"counters": {...}, "gauges": {...}}`` of
+        already-monotonic totals / current values; called at every drain
+        and render so names appear in the catalog from registration on."""
+        self._collectors.append(fn)
+        self._run_collectors()
+
+    def _run_collectors(self) -> None:
+        for fn in self._collectors:
+            try:
+                out = fn()
+            except Exception as e:          # noqa: BLE001 — never kill a run
+                _logger.warning("telemetry collector failed: %r", e)
+                continue
+            with self._lock:
+                for k, v in out.get("counters", {}).items():
+                    self._c[k] = float(v)
+                for k, v in out.get("gauges", {}).items():
+                    self._g[k] = float(v)
+
+    # -- hot-loop hooks ------------------------------------------------
+    def on_step(self, n_samples: int, data_wait_s: float,
+                step_wall_s: float) -> None:
+        """Once per loop iteration; host floats only."""
+        self._win_steps += 1
+        self._win_wall += step_wall_s
+        self._win_samples += int(n_samples)
+        self._win_data_wait += data_wait_s
+        self.h_step.observe(step_wall_s)
+        self.h_data_wait.observe(data_wait_s)
+        with self._lock:
+            self._c["steps_total"] += 1
+            self._c["samples_total"] += n_samples
+            self._c["step_seconds_total"] += step_wall_s
+            self._c["data_wait_seconds_total"] += data_wait_s
+
+    def on_drain(self, *, epoch: int, batch_idx: int, num_updates: int,
+                 loss: float, prec1: float, lr: float,
+                 drain_wait_s: float, nonfinite_steps: int = 0) -> None:
+        """Once per drain boundary, AFTER the trainer materialized the
+        buffered scalars (``drain_wait_s`` is how long that block took;
+        ``nonfinite_steps`` is this window's bad-step count)."""
+        wall = max(self._win_wall, 1e-9)
+        steps, samples = self._win_steps, self._win_samples
+        if steps == 0:
+            return
+        data_wait = self._win_data_wait
+        imgs_per_s = samples / wall
+        mfu = 0.0
+        if self.peak > 0 and self.flops_per_sample > 0:
+            mfu = imgs_per_s * self.flops_per_sample * 3.0 / self.peak
+        with self._lock:
+            self._c["drains_total"] += 1
+            self._c["device_wait_seconds_total"] += drain_wait_s
+            self._c["nonfinite_steps_total"] += max(int(nonfinite_steps), 0)
+            g = self._g
+            g["epoch"] = float(epoch)
+            g["update"] = float(num_updates)
+            g["loss"] = float(loss)
+            g["prec1"] = float(prec1)
+            g["learning_rate"] = float(lr)
+            g["throughput_imgs_per_s"] = round(imgs_per_s, 3)
+            g["step_time_ms"] = round(wall / steps * 1e3, 3)
+            g["data_wait_frac"] = round(min(data_wait / wall, 1.0), 4)
+            g["device_wait_frac"] = round(min(drain_wait_s / wall, 1.0), 4)
+            g["host_frac"] = round(
+                max(1.0 - (data_wait + drain_wait_s) / wall, 0.0), 4)
+            g["mfu"] = round(mfu, 4)
+        self._run_collectors()
+        if self.event_log is not None:
+            with self._lock:
+                counters = dict(self._c)
+                gauges = {k: v for k, v in self._g.items()
+                          if k not in ("up",)}
+            self.event_log.metrics(
+                epoch=epoch, batch=batch_idx, update=num_updates,
+                imgs_per_s=round(imgs_per_s, 3),
+                step_ms=gauges["step_time_ms"],
+                data_wait_frac=gauges["data_wait_frac"],
+                device_wait_frac=gauges["device_wait_frac"],
+                host_frac=gauges["host_frac"],
+                loss=float(loss), prec1=float(prec1), lr=float(lr),
+                mfu=gauges["mfu"], counters=counters)
+        # reset the window
+        self._win_steps = 0
+        self._win_samples = 0
+        self._win_wall = 0.0
+        self._win_data_wait = 0.0
+
+    # -- lifecycle -----------------------------------------------------
+    def event(self, name: str, **fields: Any) -> None:
+        self.inc("events_total")
+        if name == "rewind":
+            self.inc("rewinds_total")
+        elif name == "preempted":
+            self.inc("preemptions_total")
+        elif name == "profile_capture":
+            self.inc("profile_captures_total")
+        if self.event_log is not None:
+            self.event_log.event(name, **fields)
+
+    def close(self) -> None:
+        self.set_gauge("up", 0.0)
+        if self.event_log is not None:
+            self.event_log.close()
+
+    # -- exposition ----------------------------------------------------
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """One consistent view of the whole registry."""
+        self._run_collectors()
+        with self._lock:
+            return {"counters": dict(self._c), "gauges": dict(self._g)}
+
+    def render_prometheus(self) -> str:
+        snap = self.snapshot()
+        doc = PromText(_PREFIX)
+        for name, value in snap["counters"].items():
+            doc.counter(name, self._help.get(name, name), _num(value))
+        for name, value in snap["gauges"].items():
+            doc.gauge(name, self._help.get(name, name), _num(value))
+        doc.histogram("step_seconds", "Per-step wall time", self.h_step)
+        doc.histogram("data_wait_seconds",
+                      "Per-step input wait", self.h_data_wait)
+        return doc.render()
+
+
+def _num(v: float):
+    """Integral values render without a trailing .0 (counter idiom)."""
+    return int(v) if float(v).is_integer() else v
+
+
+# ---------------------------------------------------------------------------
+# MFU inputs
+# ---------------------------------------------------------------------------
+
+def peak_flops(device=None) -> float:
+    """Per-chip bf16 peak for the MFU denominator; 0.0 when unknown (CPU —
+    the gauge then reads 0 rather than a meaningless ratio)."""
+    if device is None:
+        try:
+            import jax
+            device = jax.devices()[0]
+        except Exception:               # noqa: BLE001 — backend-less callers
+            return 0.0
+    kind = getattr(device, "device_kind", "cpu")
+    for k, v in _PEAK_FLOPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    for k, v in _PEAK_FLOPS.items():
+        if k.lower() in kind.lower():
+            return v
+    return 0.0
+
+
+def forward_flops_per_sample(model, variables, input_shape) -> float:
+    """Per-sample forward FLOPs via tools/flops_breakdown.py's jaxpr walk.
+
+    ``input_shape`` is the (1, H, W, C) shape the LOADER feeds the model
+    (already pixel-shuffled under ``--stem-s2d``).  Returns 0.0 when the
+    tools/ directory is not present (installed-package layout) or the walk
+    fails — the MFU gauge then stays 0 instead of lying.
+    """
+    import importlib.util
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "tools")
+    path = os.path.join(tools_dir, "flops_breakdown.py")
+    if not os.path.isfile(path):
+        return 0.0
+    try:
+        import jax.numpy as jnp
+        spec = importlib.util.spec_from_file_location(
+            "_dfd_flops_breakdown", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        x = jnp.zeros(tuple(input_shape), jnp.float32)
+        buckets, _, _ = mod.analyze(model, variables, x,
+                                    in_chans=int(input_shape[-1]))
+        return float(sum(buckets.values()))
+    except Exception as e:              # noqa: BLE001 — telemetry is optional
+        _logger.warning("forward-FLOPs analysis failed (%r); "
+                        "MFU gauge disabled", e)
+        return 0.0
+
+
+# ---------------------------------------------------------------------------
+# Collectors: input pipeline, native warp, resilience
+# ---------------------------------------------------------------------------
+
+def loader_collector(device_loader, name: str = "train"):
+    """Input-pipeline counters/gauges off a DeviceLoader and its host
+    loader (thread or shm backend) — attribute reads only, no locking
+    against the producer (floats are single-writer, torn reads impossible
+    under the GIL)."""
+
+    def collect() -> Dict[str, Dict[str, float]]:
+        st = device_loader.stats
+        c = {
+            f"input_{name}_batches_total": st.batches,
+            f"input_{name}_host_wait_seconds_total": st.host_wait_s,
+            f"input_{name}_stage_block_seconds_total": st.stage_block_s,
+        }
+        g: Dict[str, float] = {}
+        host = device_loader.loader
+        hstats = getattr(host, "stats", None)
+        if hstats is not None:           # thread backend producer stats
+            c[f"input_{name}_fetch_seconds_total"] = hstats.fetch_s
+            c[f"input_{name}_backpressure_seconds_total"] = hstats.put_wait_s
+        if hasattr(host, "ring_depth"):  # shm backend
+            c[f"input_{name}_worker_respawns_total"] = host.respawn_count
+            c[f"input_{name}_ring_stall_sweeps_total"] = getattr(
+                host, "stall_sweeps", 0)
+            c[f"input_{name}_ring_collect_wait_seconds_total"] = getattr(
+                host, "collect_wait_s", 0.0)
+            workers = [p for p in getattr(host, "_workers", [])
+                       if p is not None]
+            g[f"input_{name}_workers_alive"] = float(
+                sum(1 for p in workers if p.is_alive())) if workers else 0.0
+            depth = float(host.ring_depth)
+            g[f"input_{name}_ring_occupancy"] = round(
+                min(getattr(host, "inflight_batches", 0) / depth, 1.0), 4)
+        return {"counters": c, "gauges": g}
+
+    return collect
+
+
+def native_warp_collector():
+    """Fused-warp source-copy counters (data/native.py): elided = packed
+    mmap views handed to the strided kernel with no ``ascontiguousarray``
+    copy; copied = frames that still needed the contiguous staging copy."""
+
+    def collect() -> Dict[str, Dict[str, float]]:
+        from ..data import native
+        stats = native.warp_copy_stats()
+        return {"counters": {
+            "input_warp_src_copies_elided_total": stats["elided"],
+            "input_warp_src_copies_total": stats["copied"],
+        }, "gauges": {}}
+
+    return collect
+
+
+def resilience_collector(resilience):
+    """Fault-layer counters off a train.resilience.Resilience handle."""
+
+    def collect() -> Dict[str, Dict[str, float]]:
+        c: Dict[str, float] = {}
+        g: Dict[str, float] = {}
+        guard = resilience.guard
+        if guard is not None:
+            c["guard_spike_steps_total"] = guard.spike_total
+        wd = resilience.watchdog
+        if wd is not None:
+            c["watchdog_beats_total"] = wd.beats_total
+            c["watchdog_near_misses_total"] = wd.near_miss_total
+            g["watchdog_beat_age_s"] = round(wd.beat_age(), 3)
+        return {"counters": c, "gauges": g}
+
+    return collect

@@ -211,6 +211,18 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     n_params = sum(x.size for x in jax.tree.leaves(variables["params"]))
     _logger.info("Model %s created, param count: %d", cfg.model, n_params)
 
+    # per-sample forward FLOPs for the live MFU gauge (obs/telemetry.py):
+    # an abstract jaxpr walk, so it must run while ``variables`` is alive
+    # (create_train_state donates the buffers).  The shape is what the
+    # LOADER feeds the model — pixel-shuffled under --stem-s2d.
+    fwd_flops = 0.0
+    if not cfg.no_telemetry:
+        from ..obs import forward_flops_per_sample
+        flop_shape = (1, input_size[1] // 2, input_size[2] // 2,
+                      4 * in_chans) if cfg.stem_s2d else \
+            (1, input_size[1], input_size[2], in_chans)
+        fwd_flops = forward_flops_per_sample(model, variables, flop_shape)
+
     if cfg.initial_checkpoint:
         # pretrained weights into the fresh tree (reference train.py:316 /
         # helpers.py:31-44): non-strict — head/in_chans mismatches drop,
@@ -363,6 +375,7 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         return None
 
     resume_batch = 0
+    resumed_from = ""
     if cfg.resume:
         state, meta = _restore_any(cfg.resume, state)
         start_epoch = cfg.start_epoch if cfg.start_epoch is not None \
@@ -375,6 +388,7 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         restored = _restore_with_fallback(state)
         if restored is not None:
             state, meta_r, path = restored
+            resumed_from = path
             if "batch_idx" in meta_r:
                 # recovery snapshot: exact mid-epoch loop position
                 start_epoch = int(meta_r["epoch"])
@@ -472,9 +486,49 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     best_metric, best_epoch = None, None
     eval_metrics: Dict[str, float] = {}
     exit_code: Optional[int] = None
-    resilience = Resilience.from_config(cfg)
+    resilience = Resilience.from_config(cfg, output_dir=output_dir)
+
+    # observability (obs/): default-on telemetry tracker + JSONL event log
+    # (rank 0 — one coherent stream per run dir), optional --metrics-port
+    # Prometheus endpoint, on-demand profiler capture triggers
+    telemetry, obs_server, profiler = None, None, None
+    if not cfg.no_telemetry:
+        from ..obs import (EventLog, ProfilerCapture, TrainTelemetry,
+                           loader_collector, native_warp_collector,
+                           peak_flops, resilience_collector,
+                           start_metrics_server)
+        event_log = EventLog(os.path.join(output_dir, "telemetry.jsonl")) \
+            if output_dir and rank == 0 else None
+        telemetry = TrainTelemetry(
+            event_log=event_log, flops_per_sample=fwd_flops,
+            # throughput is measured on the GLOBAL batch (the loader
+            # assembles the global sharded array), so the MFU denominator
+            # must be the whole mesh's peak, not one chip's
+            peak_flops=peak_flops() * n_dev,
+            meta=dict(model=cfg.model, global_batch=global_batch))
+        telemetry.register_collector(loader_collector(train_loader))
+        telemetry.register_collector(native_warp_collector())
+        telemetry.register_collector(resilience_collector(resilience))
+        if cfg.metrics_port:
+            obs_server = start_metrics_server(telemetry,
+                                              port=cfg.metrics_port)
+        if output_dir and cfg.profile_capture > 0:
+            profiler = ProfilerCapture(output_dir,
+                                       num_steps=cfg.profile_capture,
+                                       telemetry=telemetry)
+            telemetry.profiler = profiler
+        telemetry.event("run_start", model=cfg.model, epochs=num_epochs,
+                        start_epoch=start_epoch, global_batch=global_batch,
+                        world_size=n_dev)
+        if resumed_from:
+            telemetry.event("resume", path=resumed_from,
+                            epoch=start_epoch, batch=resume_batch)
     try:
         with resilience:
+            if profiler is not None and not profiler.install():
+                _logger.warning("not in the main thread: SIGUSR2 profiler "
+                                "trigger not installed (the PROFILE file "
+                                "trigger still works)")
             epoch = start_epoch
             while epoch < num_epochs:
                 train_loader.set_epoch(epoch)      # reference :549
@@ -490,7 +544,8 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
                         epoch, train_step, state, train_loader, cfg,
                         epoch_rng, lr_scheduler=lr_scheduler, saver=saver,
                         output_dir=output_dir, meta=meta, world_size=n_dev,
-                        start_batch=resume_batch, resilience=resilience)
+                        start_batch=resume_batch, resilience=resilience,
+                        telemetry=telemetry)
                 except RewindRequested as e:
                     # K consecutive bad steps: continuing would train on
                     # (or EMA-blend in) corrupted state — reload the last
@@ -528,6 +583,9 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
                         ) from e
                     state, meta_r, path = restored
                     _logger.warning("rewound to %s", path)
+                    if telemetry is not None:
+                        telemetry.event("rewind", reason=str(e),
+                                        restored_from=path)
                     if "batch_idx" in meta_r:
                         epoch = int(meta_r["epoch"])
                         resume_batch = int(meta_r["batch_idx"]) + 1
@@ -581,6 +639,10 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
                     best_metric, best_epoch = saver.save_checkpoint(
                         save_state, meta, epoch,
                         metric=eval_metrics[cfg.eval_metric])
+                if telemetry is not None:
+                    telemetry.event("epoch_end", epoch=epoch,
+                                    train=dict(train_metrics),
+                                    eval=dict(eval_metrics))
                 resilience.heartbeat(f"epoch {epoch} done")
                 epoch += 1
     except Preempted as e:
@@ -589,6 +651,9 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         # scripts/train.sh's restart wrapper relaunches into --auto-resume
         _logger.warning("%s — exiting with code %d", e, EXIT_PREEMPTED)
         exit_code = EXIT_PREEMPTED
+        if telemetry is not None:
+            telemetry.event("preempted", epoch=e.epoch, batch=e.batch_idx,
+                            signum=e.signum)
     except KeyboardInterrupt:                      # reference :588
         pass
     finally:
@@ -600,6 +665,15 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         train_loader.close()
         eval_loader.close()
         wait_pending_saves()
+        if profiler is not None:
+            profiler.close()            # stops a live trace, restores SIGUSR2
+        if obs_server is not None:
+            obs_server.shutdown()
+            obs_server.server_close()
+        if telemetry is not None:
+            telemetry.event("run_end", exit_code=exit_code,
+                            best_metric=best_metric, best_epoch=best_epoch)
+            telemetry.close()
     if exit_code is not None:
         raise SystemExit(exit_code)
     if best_metric is not None:

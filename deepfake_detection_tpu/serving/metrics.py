@@ -3,9 +3,11 @@ throughput, and a Prometheus text-format renderer.
 
 Built on :class:`deepfake_detection_tpu.utils.metrics.LatencyHistogram` —
 the host-side sibling of the train loop's ``AverageMeter``.  Everything is
-stdlib: no prometheus_client dependency, just the text exposition format
-(https://prometheus.io/docs/instrumenting/exposition_formats/), which is
-what ``GET /metrics`` serves.
+stdlib: no prometheus_client dependency; the text exposition format lives
+in the shared :mod:`deepfake_detection_tpu.utils.prometheus` renderer
+(also used by the trainer's ``--metrics-port`` endpoint, obs/telemetry.py),
+which is what ``GET /metrics`` serves — output is byte-identical to the
+pre-refactor inline renderer (locked by tests/test_obs.py).
 
 Stages mirror a request's life: ``queue`` (submit → batch dispatch),
 ``preprocess`` (decode+resize on the HTTP thread), ``device`` (padded
@@ -17,9 +19,11 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from typing import Deque, Dict, List, Tuple
+from typing import Deque, Dict, Tuple
 
 from ..utils.metrics import LatencyHistogram
+from ..utils.prometheus import Counter as _Counter
+from ..utils.prometheus import PromText
 
 __all__ = ["ServingMetrics"]
 
@@ -71,19 +75,6 @@ _BOUNDS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
            0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
 STAGES = ("queue", "preprocess", "device", "total")
-
-
-class _Counter:
-    """Monotonic counter; int ops under the GIL are atomic enough, the lock
-    is for the read-modify-write of labeled maps."""
-
-    def __init__(self):
-        self.value = 0
-        self._lock = threading.Lock()
-
-    def inc(self, n: int = 1) -> None:
-        with self._lock:
-            self.value += n
 
 
 class ServingMetrics:
@@ -146,28 +137,15 @@ class ServingMetrics:
 
     # ------------------------------------------------------------------
     def render_prometheus(self) -> str:
-        lines: List[str] = []
+        doc = PromText(_PREFIX)
+        counter, gauge = doc.counter, doc.gauge
 
-        def counter(name: str, help_: str, value: int,
-                    labels: str = "") -> None:
-            lines.append(f"# HELP {_PREFIX}_{name} {help_}")
-            lines.append(f"# TYPE {_PREFIX}_{name} counter")
-            lines.append(f"{_PREFIX}_{name}{labels} {value}")
-
-        def gauge(name: str, help_: str, value) -> None:
-            lines.append(f"# HELP {_PREFIX}_{name} {help_}")
-            lines.append(f"# TYPE {_PREFIX}_{name} gauge")
-            lines.append(f"{_PREFIX}_{name} {value}")
-
-        lines.append(f"# HELP {_PREFIX}_requests_total Requests by HTTP "
-                     "status")
-        lines.append(f"# TYPE {_PREFIX}_requests_total counter")
+        doc.header("requests_total", "Requests by HTTP status", "counter")
         with self._requests_lock:
             items = sorted((k, c.value) for k, c in
                            self.requests_total.items())
         for status, value in items:
-            lines.append(
-                f'{_PREFIX}_requests_total{{status="{status}"}} {value}')
+            doc.sample("requests_total", f'{{status="{status}"}}', value)
         counter("shed_total", "Requests rejected 429 (queue full)",
                 self.shed_total.value)
         counter("deadline_total", "Requests failed 504 (deadline exceeded)",
@@ -199,22 +177,7 @@ class ServingMetrics:
               round(self.throughput(), 3))
 
         for stage in STAGES:
-            h = self.latency[stage]
-            name = f"{_PREFIX}_latency_seconds"
-            lines.append(f"# HELP {name} Per-stage request latency")
-            lines.append(f"# TYPE {name} histogram")
-            # ONE snapshot per stage: buckets, sum and count must come
-            # from the same consistent view or the +Inf bucket can exceed
-            # _count within a single exposition (spec violation that
-            # breaks histogram_quantile exactly under load)
-            counts, s, c = h.snapshot()
-            acc = 0
-            for bound, n in zip(h.bounds, counts):
-                acc += n
-                lines.append(f'{name}_bucket{{stage="{stage}",'
-                             f'le="{bound!r}"}} {acc}')
-            lines.append(
-                f'{name}_bucket{{stage="{stage}",le="+Inf"}} {c}')
-            lines.append(f'{name}_sum{{stage="{stage}"}} {s}')
-            lines.append(f'{name}_count{{stage="{stage}"}} {c}')
-        return "\n".join(lines) + "\n"
+            # one-snapshot consistency per stage lives in PromText.histogram
+            doc.histogram("latency_seconds", "Per-stage request latency",
+                          self.latency[stage], labels=f'stage="{stage}"')
+        return doc.render()

@@ -293,24 +293,43 @@ class StallWatchdog:
     loop that never completes a step.  Size ``timeout`` itself to cover
     the post-warmup stragglers (a first *eval* compile, a slow epoch
     boundary) — a few multiples of step time is too tight.
+
+    ``dump_path``: the all-thread stack dump also lands in this file
+    (``<outdir>/watchdog_dump.txt``) — stderr is routinely lost when the
+    restart wrapper relaunches, and a post-mortem needs the stacks.
+    Telemetry counters: ``beats_total``; ``near_miss_total`` counts beats
+    that arrived with the previous beat older than half the timeout — a
+    run skating toward an abort shows up as a rising gauge before it dies.
     """
 
     def __init__(self, timeout: float,
                  position_fn: Optional[Callable[[], str]] = None,
                  exit_fn: Optional[Callable[[int], None]] = None,
-                 first_grace: float = 10.0):
+                 first_grace: float = 10.0,
+                 dump_path: Optional[str] = None):
         self.timeout = float(timeout)
         self.first_grace = max(1.0, float(first_grace))
         self._position_fn = position_fn or (lambda: "<unknown>")
         self._exit_fn = exit_fn
+        self.dump_path = dump_path
         self._last = time.monotonic()
         self._seen_beat = False
+        self.beats_total = 0
+        self.near_miss_total = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def beat(self) -> None:
-        self._last = time.monotonic()
+        now = time.monotonic()
+        if self._seen_beat and now - self._last > 0.5 * self.timeout:
+            self.near_miss_total += 1
+        self._last = now
         self._seen_beat = True
+        self.beats_total += 1
+
+    def beat_age(self) -> float:
+        """Seconds since the last heartbeat (telemetry gauge)."""
+        return time.monotonic() - self._last
 
     def start(self) -> None:
         if self.timeout <= 0 or self._thread is not None:
@@ -349,6 +368,15 @@ class StallWatchdog:
             sys.stderr.flush()
         except Exception:  # noqa: BLE001 — the abort must still happen
             pass
+        if self.dump_path:
+            # stderr is routinely lost when the restart wrapper relaunches
+            # — persist the same dump where --auto-resume will find it
+            try:
+                with open(self.dump_path, "w") as f:
+                    f.write(msg + "\n")
+                    faulthandler.dump_traceback(file=f, all_threads=True)
+            except Exception:  # noqa: BLE001 — the abort must still happen
+                pass
         if self._exit_fn is not None:
             self._exit_fn(EXIT_WATCHDOG)
         else:
@@ -377,7 +405,7 @@ class Resilience:
         self.position = "<not started>"
 
     @classmethod
-    def from_config(cls, cfg) -> "Resilience":
+    def from_config(cls, cfg, output_dir: str = "") -> "Resilience":
         import jax                      # lazy: keep this module jax-light
         guard = None
         if cfg.guard_nonfinite != "off" or cfg.guard_spike_window > 0:
@@ -389,8 +417,11 @@ class Resilience:
                    chaos=chaos_from_env(),
                    rewind_limit=cfg.guard_rewind_limit)
         if cfg.watchdog_timeout > 0:
+            dump = os.path.join(output_dir, "watchdog_dump.txt") \
+                if output_dir else None
             self.watchdog = StallWatchdog(
-                cfg.watchdog_timeout, position_fn=lambda: self.position)
+                cfg.watchdog_timeout, position_fn=lambda: self.position,
+                dump_path=dump)
         return self
 
     # -- lifecycle -----------------------------------------------------

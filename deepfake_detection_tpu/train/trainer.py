@@ -58,7 +58,7 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                     lr_scheduler=None, saver=None, output_dir: str = "",
                     meta: Optional[Dict[str, Any]] = None,
                     world_size: int = 1, start_batch: int = 0,
-                    resilience=None):
+                    resilience=None, telemetry=None):
     """One epoch of the hot loop.  Returns ``(state, metrics)``.
 
     ``world_size`` is the data-parallel degree; s/image in the log line is
@@ -75,6 +75,13 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
     check at step boundaries (synchronous recovery snapshot + ``Preempted``),
     the NaN/spike guard fed at drain cadence (may raise ``RewindRequested``),
     and the env-gated chaos injection points the recovery tests drive.
+
+    ``telemetry`` (obs/telemetry.py TrainTelemetry) rides the same
+    cadences with host floats only — per-step wall/data-wait deltas and,
+    at each drain, the time the drain itself blocked (the device-bound
+    share) — so enabling it adds NO device syncs; its optional
+    ``.profiler`` (obs/profiler.py) gets a per-step window check and a
+    per-drain trigger-file poll for on-demand trace capture.
     """
     if cfg.mixup > 0 and hasattr(loader, "mixup_enabled"):
         if cfg.mixup_off_epoch and epoch >= cfg.mixup_off_epoch:
@@ -113,9 +120,16 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
     pending: list = []
     step_exec = None       # multi-process: AOT executable (_compile_aligned)
     first_step = True
+    # telemetry window accumulators: how long drains blocked (device-bound
+    # time) and how many buffered steps were bad, since the last record
+    drain_wait_acc = 0.0
+    drain_bad_acc = 0
+    profiler = getattr(telemetry, "profiler", None)
 
     def _drain() -> None:
-        nonlocal nonfinite_total
+        nonlocal nonfinite_total, drain_wait_acc, drain_bad_acc
+        t_drain = time.monotonic()
+        window_bad = 0
         for m, n, step_i in pending:
             loss_value = float(m["loss"])     # host sync, log steps only
             # the device-side guard flag (loss OR grad-norm non-finite)
@@ -125,6 +139,7 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                 bad = bad or float(m["nonfinite"]) > 0
             if bad:
                 nonfinite_total += 1
+                window_bad += 1
                 _logger.warning(
                     "non-finite training step at update %d (loss %r%s)",
                     step_i, loss_value,
@@ -137,6 +152,10 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                 # may raise RewindRequested after K consecutive bad steps
                 resilience.observe_step(step_i, loss_value, bad)
         pending.clear()
+        # the scalar reads above are the loop's ONLY host syncs, so their
+        # block time IS the device-bound share of the window
+        drain_wait_acc += time.monotonic() - t_drain
+        drain_bad_acc += window_bad
 
     for batch_idx, batch in enumerate(loader, start=start_batch):
         x, y = batch[0], batch[1]
@@ -178,6 +197,12 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
         if last_batch or batch_idx % cfg.log_interval == 0:
             _drain()
         batch_time_m.update(time.time() - end)
+        if telemetry is not None:
+            # host floats the loop already holds — no device access
+            telemetry.on_step(bs, data_time_m.val, batch_time_m.val)
+        if profiler is not None:
+            # cheap flag check when idle; manages an active trace window
+            profiler.on_step(num_updates, metrics.get("loss"))
 
         if last_batch or batch_idx % cfg.log_interval == 0:
             lr = get_learning_rate(state) or 0.0
@@ -193,6 +218,16 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                 batch_time_m.val / max(bs // world_size, 1),
                 batch_time_m.avg / max(bs // world_size, 1),
                 lr, data_time_m.val, data_time_m.avg, ets_time)
+            if telemetry is not None:
+                # one record per drain cadence: breakdown + JSONL
+                telemetry.on_drain(
+                    epoch=epoch, batch_idx=batch_idx,
+                    num_updates=num_updates, loss=losses_m.avg,
+                    prec1=prec1_m.avg, lr=lr, drain_wait_s=drain_wait_acc,
+                    nonfinite_steps=drain_bad_acc)
+                drain_wait_acc, drain_bad_acc = 0.0, 0
+            if profiler is not None:
+                profiler.poll()         # PROFILE trigger file: 1 stat/drain
             if cfg.save_images and output_dir and jax.process_index() == 0:
                 xd = x
                 if getattr(cfg, "stem_s2d", False):
@@ -210,6 +245,8 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                 last_batch or (batch_idx + 1) % cfg.recovery_interval == 0):
             _save_recovery(saver, state, meta, epoch, batch_idx,
                            num_updates)                     # ref :686-689
+            if telemetry is not None:
+                telemetry.inc("recovery_snapshots_total")
 
         if chaos is not None and saver is not None and \
                 chaos.fires("truncate_ckpt", num_updates):
@@ -266,6 +303,8 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
                 _drain()
                 _save_recovery(saver, state, meta, epoch, batch_idx,
                                num_updates, sync=True)
+                if telemetry is not None:
+                    telemetry.inc("recovery_snapshots_total")
                 raise Preempted(epoch, batch_idx, resilience.stop_signum)
         end = time.time()
 

@@ -1,0 +1,86 @@
+"""Prometheus text-exposition rendering, shared by serving and training.
+
+The serving subsystem grew the first renderer inline (serving/metrics.py);
+the training telemetry subsystem (obs/telemetry.py) exposes the same
+``GET /metrics`` surface, so the formatting lives here once.  Everything
+is stdlib — no prometheus_client dependency, just the text format
+(https://prometheus.io/docs/instrumenting/exposition_formats/).
+
+:class:`PromText` is a line accumulator: callers append counter/gauge/
+histogram families in catalog order and :meth:`render` joins them.  The
+helpers reproduce the serving renderer's byte layout exactly (HELP/TYPE
+re-emitted per histogram label set, ``le`` bounds formatted with
+``repr``), locked by the byte-identity test in tests/test_obs.py — a
+scrape-side dashboard must not notice the refactor.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List
+
+__all__ = ["Counter", "PromText"]
+
+
+class Counter:
+    """Monotonic counter; int ops under the GIL are atomic enough, the lock
+    is for the read-modify-write of labeled maps."""
+
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class PromText:
+    """Accumulates one exposition document under a metric-name prefix."""
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+        self.lines: List[str] = []
+
+    # -- raw pieces (labeled families interleave header and samples) ----
+    def header(self, name: str, help_: str, type_: str) -> None:
+        self.lines.append(f"# HELP {self.prefix}_{name} {help_}")
+        self.lines.append(f"# TYPE {self.prefix}_{name} {type_}")
+
+    def sample(self, name: str, labels: str, value) -> None:
+        self.lines.append(f"{self.prefix}_{name}{labels} {value}")
+
+    # -- one-shot families ---------------------------------------------
+    def counter(self, name: str, help_: str, value, labels: str = "") -> None:
+        self.header(name, help_, "counter")
+        self.sample(name, labels, value)
+
+    def gauge(self, name: str, help_: str, value) -> None:
+        self.header(name, help_, "gauge")
+        self.sample(name, "", value)
+
+    def histogram(self, name: str, help_, hist, labels: str = "") -> None:
+        """One ``histogram`` family block from a LatencyHistogram.
+
+        ``labels`` is the pre-formatted inner label list (e.g.
+        ``'stage="queue"'``).  Buckets, sum and count come from ONE
+        snapshot: mixing live reads could make the +Inf bucket exceed
+        ``_count`` within a single exposition (spec violation that breaks
+        ``histogram_quantile`` exactly under load).
+        """
+        full = f"{self.prefix}_{name}"
+        self.lines.append(f"# HELP {full} {help_}")
+        self.lines.append(f"# TYPE {full} histogram")
+        counts, s, c = hist.snapshot()
+        pre = f"{labels}," if labels else ""
+        acc = 0
+        for bound, n in zip(hist.bounds, counts):
+            acc += n
+            self.lines.append(f'{full}_bucket{{{pre}le="{bound!r}"}} {acc}')
+        self.lines.append(f'{full}_bucket{{{pre}le="+Inf"}} {c}')
+        self.lines.append(f'{full}_sum{{{labels}}} {s}')
+        self.lines.append(f'{full}_count{{{labels}}} {c}')
+
+    # ------------------------------------------------------------------
+    def render(self) -> str:
+        return "\n".join(self.lines) + "\n"

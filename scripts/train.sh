@@ -19,6 +19,8 @@ max_restarts="${DFD_MAX_RESTARTS:-5}"
 trap 'echo "train.sh: interrupted; not relaunching (snapshot on disk)" >&2;
       exit 130' INT
 while :; do
+  # the trainer telemetry surfaces this as the restart_count gauge
+  export DFD_RESTART_COUNT="$attempt"
   python -m deepfake_detection_tpu.runners.train \
     --data "$1" \
     --model efficientnet_deepfake_v4 --model-version v4 \

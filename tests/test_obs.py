@@ -1,0 +1,817 @@
+"""Training telemetry subsystem (obs/): renderer parity, JSONL coherence,
+endpoint scrapes, the no-new-device-syncs overhead guard, watchdog dump,
+strided warp elision."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.obs
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+# ---------------------------------------------------------------------------
+# Shared Prometheus renderer: serving output byte-identical pre/post refactor
+# ---------------------------------------------------------------------------
+
+def _old_serving_render(self) -> str:
+    """The pre-refactor serving/metrics.py renderer, verbatim — the golden
+    the shared utils/prometheus.py renderer must reproduce byte-for-byte."""
+    from deepfake_detection_tpu.serving.metrics import (STAGES,
+                                                        backend_compile_count)
+    _PREFIX = "dfd_serving"
+    lines = []
+
+    def counter(name, help_, value, labels=""):
+        lines.append(f"# HELP {_PREFIX}_{name} {help_}")
+        lines.append(f"# TYPE {_PREFIX}_{name} counter")
+        lines.append(f"{_PREFIX}_{name}{labels} {value}")
+
+    def gauge(name, help_, value):
+        lines.append(f"# HELP {_PREFIX}_{name} {help_}")
+        lines.append(f"# TYPE {_PREFIX}_{name} gauge")
+        lines.append(f"{_PREFIX}_{name} {value}")
+
+    lines.append(f"# HELP {_PREFIX}_requests_total Requests by HTTP "
+                 "status")
+    lines.append(f"# TYPE {_PREFIX}_requests_total counter")
+    with self._requests_lock:
+        items = sorted((k, c.value) for k, c in self.requests_total.items())
+    for status, value in items:
+        lines.append(
+            f'{_PREFIX}_requests_total{{status="{status}"}} {value}')
+    counter("shed_total", "Requests rejected 429 (queue full)",
+            self.shed_total.value)
+    counter("deadline_total", "Requests failed 504 (deadline exceeded)",
+            self.deadline_total.value)
+    counter("batches_total", "Device batches executed",
+            self.batches_total.value)
+    counter("batch_rows_total", "Real rows across executed batches",
+            self.batch_rows_total.value)
+    counter("padded_rows_total", "Padding rows across executed batches",
+            self.padded_rows_total.value)
+    counter("compiles_total", "Bucket executables built by the engine "
+            "(startup warmup only)", self.compiles_total.value)
+    counter("backend_compiles_total", "Real XLA backend compiles "
+            "observed process-wide (jax monitoring hook; growth after "
+            "ready=1 means something recompiled)",
+            backend_compile_count())
+    counter("reloads_total", "Successful hot weight reloads",
+            self.reloads_total.value)
+    counter("reload_errors_total", "Rejected/failed hot reloads",
+            self.reload_errors_total.value)
+    counter("worker_restarts_total", "Engine worker crash recoveries",
+            self.worker_restarts_total.value)
+    gauge("queue_depth", "Requests waiting in the micro-batch queue",
+          self.queue_depth)
+    gauge("inflight", "Requests staged on device", self.inflight)
+    gauge("ready", "1 once all buckets are warmed", int(self.ready))
+    gauge("throughput_rps",
+          f"Scored requests/sec, trailing {self._window_s:.0f}s window",
+          round(self.throughput(), 3))
+    for stage in STAGES:
+        h = self.latency[stage]
+        name = f"{_PREFIX}_latency_seconds"
+        lines.append(f"# HELP {name} Per-stage request latency")
+        lines.append(f"# TYPE {name} histogram")
+        counts, s, c = h.snapshot()
+        acc = 0
+        for bound, n in zip(h.bounds, counts):
+            acc += n
+            lines.append(f'{name}_bucket{{stage="{stage}",'
+                         f'le="{bound!r}"}} {acc}')
+        lines.append(
+            f'{name}_bucket{{stage="{stage}",le="+Inf"}} {c}')
+        lines.append(f'{name}_sum{{stage="{stage}"}} {s}')
+        lines.append(f'{name}_count{{stage="{stage}"}} {c}')
+    return "\n".join(lines) + "\n"
+
+
+def _parse_prom(text):
+    """{family: type} and [(name, labels, value)] from an exposition doc."""
+    types, samples = {}, []
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# TYPE "):
+            _, _, fam, t = line.split(" ", 3)
+            types[fam] = t
+        elif not line.startswith("#"):
+            lhs, value = line.rsplit(" ", 1)
+            name, _, labels = lhs.partition("{")
+            samples.append((name, "{" + labels if labels else "", value))
+    return types, samples
+
+
+class TestSharedRenderer:
+    def _populated(self):
+        from deepfake_detection_tpu.serving.metrics import ServingMetrics
+        m = ServingMetrics()
+        for status in (200, 200, 400, 429, 504):
+            m.count_request(status)
+        for stage, v in (("queue", 0.0002), ("queue", 0.004),
+                         ("preprocess", 0.012), ("device", 0.3),
+                         ("total", 31.0)):
+            m.latency[stage].observe(v)
+        m.shed_total.inc(2)
+        m.batches_total.inc(7)
+        m.batch_rows_total.inc(19)
+        m.padded_rows_total.inc(9)
+        m.compiles_total.inc(4)
+        m.reloads_total.inc()
+        m.queue_depth = 5
+        m.inflight = 2
+        m.ready = True
+        m.count_completion(16, now=time.monotonic())
+        return m
+
+    def test_serving_output_byte_identical_pre_post_refactor(self):
+        m = self._populated()
+        # throughput() is time-dependent: freeze it for the comparison
+        m.throughput = lambda now=None: 12.345
+        assert m.render_prometheus() == _old_serving_render(m)
+
+    def test_serving_conformance(self):
+        m = self._populated()
+        types, samples = _parse_prom(m.render_prometheus())
+        assert types["dfd_serving_requests_total"] == "counter"
+        assert types["dfd_serving_latency_seconds"] == "histogram"
+        # every sample belongs to a declared family
+        fams = set(types)
+        for name, _, _ in samples:
+            base = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix):
+                    base = name[: -len(suffix)]
+            assert base in fams, name
+
+
+class TestTrainTelemetryRenderer:
+    def _telemetry(self, **kw):
+        from deepfake_detection_tpu.obs import TrainTelemetry
+        return TrainTelemetry(**kw)
+
+    def test_catalog_and_breakdown(self):
+        t = self._telemetry(flops_per_sample=1e9, peak_flops=1e12)
+        for _ in range(4):
+            t.on_step(8, data_wait_s=0.01, step_wall_s=0.05)
+        t.on_drain(epoch=1, batch_idx=3, num_updates=4, loss=0.5,
+                   prec1=75.0, lr=1e-3, drain_wait_s=0.02,
+                   nonfinite_steps=1)
+        snap = t.snapshot()
+        c, g = snap["counters"], snap["gauges"]
+        assert c["steps_total"] == 4 and c["samples_total"] == 32
+        assert c["nonfinite_steps_total"] == 1
+        assert g["epoch"] == 1 and g["update"] == 4
+        assert g["throughput_imgs_per_s"] > 0
+        # fractions live in [0, 1] and cover the window
+        assert 0 <= g["data_wait_frac"] <= 1
+        assert 0 <= g["device_wait_frac"] <= 1
+        assert 0 <= g["host_frac"] <= 1
+        assert g["data_wait_frac"] + g["device_wait_frac"] + \
+            g["host_frac"] <= 1.01
+        # mfu = imgs/s * flops * 3 / peak
+        assert g["mfu"] == pytest.approx(
+            g["throughput_imgs_per_s"] * 1e9 * 3 / 1e12, rel=1e-3)
+
+    def test_prometheus_conformance(self):
+        t = self._telemetry()
+        t.on_step(4, 0.001, 0.01)
+        t.on_drain(epoch=0, batch_idx=0, num_updates=1, loss=1.0,
+                   prec1=50.0, lr=0.1, drain_wait_s=0.0)
+        types, samples = _parse_prom(t.render_prometheus())
+        # the full catalog is present even for never-touched families
+        for fam in ("dfd_train_steps_total", "dfd_train_rewinds_total",
+                    "dfd_train_recovery_snapshots_total",
+                    "dfd_train_watchdog_near_misses_total",
+                    "dfd_train_mfu", "dfd_train_data_wait_frac",
+                    "dfd_train_step_seconds"):
+            assert fam in types, fam
+        # histogram invariants: cumulative buckets, +Inf == _count
+        buckets = [(labels, float(v)) for n, labels, v in samples
+                   if n == "dfd_train_step_seconds_bucket"]
+        count = next(float(v) for n, _, v in samples
+                     if n == "dfd_train_step_seconds_count")
+        acc = -1.0
+        for labels, v in buckets:
+            assert v >= acc, "bucket counts must be cumulative"
+            acc = v
+        assert buckets[-1][0].endswith('le="+Inf"}') and \
+            buckets[-1][1] == count
+
+    def test_collector_names_enter_catalog(self):
+        t = self._telemetry()
+        t.register_collector(lambda: {"counters": {"input_train_x_total": 3},
+                                      "gauges": {"input_train_occ": 0.5}})
+        snap = t.snapshot()
+        assert snap["counters"]["input_train_x_total"] == 3
+        assert snap["gauges"]["input_train_occ"] == 0.5
+        assert "dfd_train_input_train_x_total" in t.render_prometheus()
+
+    def test_failing_collector_never_raises(self):
+        t = self._telemetry()
+
+        def bad():
+            raise RuntimeError("collector exploded")
+
+        t.register_collector(bad)
+        assert "dfd_train_up 1" in t.render_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# JSONL event log
+# ---------------------------------------------------------------------------
+
+class TestEventLog:
+    def test_round_trip_schema(self, tmp_path):
+        from deepfake_detection_tpu.obs import (SCHEMA_VERSION, EventLog,
+                                                read_records)
+        p = str(tmp_path / "telemetry.jsonl")
+        with EventLog(p) as log:
+            log.event("run_start", model="m", epochs=2)
+            log.metrics(epoch=0, update=10, imgs_per_s=123.4,
+                        counters={"steps_total": 10})
+            log.event("epoch_end", epoch=0, train={"loss": 0.5})
+        recs = read_records(p)
+        assert [r["type"] for r in recs] == ["event", "metrics", "event"]
+        assert all(r["v"] == SCHEMA_VERSION for r in recs)
+        assert all("t" in r for r in recs)
+        assert recs[1]["counters"]["steps_total"] == 10
+        # strict JSON (consumable by jq): every line parses with a strict
+        # parser and non-finite floats were nulled
+        with EventLog(p) as log:
+            log.metrics(epoch=0, loss=float("nan"), inf=float("inf"))
+        for line in open(p):
+            rec = json.loads(line, parse_constant=lambda c: pytest.fail(
+                f"non-strict JSON constant {c} in stream"))
+        assert rec["loss"] is None and rec["inf"] is None
+
+    def test_torn_tail_repaired_and_append_coherent(self, tmp_path):
+        """SIGTERM mid-write → one torn line; the auto-resume relaunch's
+        reopen must truncate it so the stream stays coherent (no torn, no
+        duplicate records)."""
+        from deepfake_detection_tpu.obs import EventLog, read_records
+        p = str(tmp_path / "telemetry.jsonl")
+        with EventLog(p) as log:
+            log.event("run_start")
+            log.metrics(epoch=0, update=1)
+        with open(p, "a") as f:                 # simulate the torn write
+            f.write('{"v":1,"t":123.0,"type":"metrics","update":2,"im')
+        log2 = EventLog(p)                      # the relaunch
+        assert log2.torn_bytes_dropped > 0
+        log2.event("resume", epoch=0, batch=2)
+        log2.metrics(epoch=0, update=2)
+        log2.close()
+        recs = read_records(p)
+        assert [r["type"] for r in recs] == \
+            ["event", "metrics", "event", "metrics"]
+        updates = [r["update"] for r in recs if r["type"] == "metrics"]
+        assert updates == [1, 2]                # no torn, no duplicate
+        # clean reopen drops nothing
+        assert EventLog(p).torn_bytes_dropped == 0
+
+    def test_events_module_is_jax_free(self):
+        """tools/obs_report.py must read logs without importing jax (the
+        data/ worker-import discipline, PR 1)."""
+        code = ("import sys; import deepfake_detection_tpu.obs as o; "
+                "o.read_records; o.EventLog; "
+                "assert not any(m == 'jax' or m.startswith('jax.') "
+                "for m in sys.modules), 'jax leaked into obs import'")
+        subprocess.run([sys.executable, "-c", code], check=True,
+                       env=dict(os.environ, PYTHONPATH=_REPO), timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# /metrics endpoint e2e
+# ---------------------------------------------------------------------------
+
+class TestMetricsEndpoint:
+    def test_scrape_and_healthz(self):
+        from deepfake_detection_tpu.obs import (TrainTelemetry,
+                                                start_metrics_server)
+        t = TrainTelemetry()
+        t.on_step(8, 0.001, 0.02)
+        t.on_drain(epoch=3, batch_idx=5, num_updates=17, loss=0.25,
+                   prec1=90.0, lr=1e-4, drain_wait_s=0.001)
+        server = start_metrics_server(t, host="127.0.0.1", port=0)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            body = urllib.request.urlopen(base + "/metrics",
+                                          timeout=10).read().decode()
+            types, samples = _parse_prom(body)
+            assert types["dfd_train_steps_total"] == "counter"
+            assert types["dfd_train_throughput_imgs_per_s"] == "gauge"
+            assert types["dfd_train_step_seconds"] == "histogram"
+            values = {n: v for n, labels, v in samples if not labels}
+            assert float(values["dfd_train_update"]) == 17
+            health = urllib.request.urlopen(base + "/healthz",
+                                            timeout=10).read().decode()
+            assert health.startswith("ok") and "epoch=3" in health
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(base + "/nope", timeout=10)
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Overhead guard: telemetry adds no device syncs to the train loop
+# ---------------------------------------------------------------------------
+
+class _ListLoader:
+    """Minimal loader: pre-staged host batches, like a DeviceLoader that
+    already ran (the overhead guard isolates the LOOP's sync behavior)."""
+
+    def __init__(self, batches):
+        self.batches = batches
+
+    def __len__(self):
+        return len(self.batches)
+
+    def __iter__(self):
+        return iter(self.batches)
+
+
+def _loop_cfg(**kw):
+    base = dict(mixup=0.0, mixup_off_epoch=0, log_interval=2,
+                save_images=False, recovery_interval=0, profile=0,
+                stem_s2d=False, resolved_in_chans=3)
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+class TestOverheadGuard:
+    def _run_epoch(self, telemetry, devices):
+        from deepfake_detection_tpu.losses import cross_entropy
+        from deepfake_detection_tpu.models import create_model, init_model
+        from deepfake_detection_tpu.optim import create_optimizer
+        from deepfake_detection_tpu.train import (create_train_state,
+                                                  make_train_step,
+                                                  train_one_epoch)
+        model = create_model("mnasnet_small", num_classes=2, in_chans=3)
+        variables = init_model(model, jax.random.PRNGKey(0), (2, 32, 32, 3),
+                               training=True)
+        tx = create_optimizer(SimpleNamespace(
+            opt="sgd", opt_eps=1e-8, momentum=0.9, weight_decay=0.0,
+            lr=1e-3), inject=True)
+        state = create_train_state(variables, tx)
+        step = make_train_step(model, tx, cross_entropy, mesh=None,
+                               bn_mode="global")
+        rng = np.random.default_rng(0)
+        batches = [(jnp.asarray(rng.normal(size=(4, 32, 32, 3)),
+                                jnp.float32),
+                    jnp.asarray(np.arange(4) % 2))
+                   for _ in range(5)]
+        state, metrics = train_one_epoch(
+            0, step, state, _ListLoader(batches), _loop_cfg(),
+            jax.random.PRNGKey(1), telemetry=telemetry)
+        return metrics
+
+    def test_no_new_device_syncs_and_no_array_touches(self, devices,
+                                                      monkeypatch):
+        """block_until_ready count must be IDENTICAL with telemetry on/off,
+        and every value entering the tracker must already be a host float —
+        the zero-extra-syncs contract of the tracker."""
+        from deepfake_detection_tpu.obs import TrainTelemetry
+        calls = {"n": 0}
+        real = jax.block_until_ready
+
+        def counting(x):
+            calls["n"] += 1
+            return real(x)
+
+        monkeypatch.setattr(jax, "block_until_ready", counting)
+
+        calls["n"] = 0
+        self._run_epoch(None, devices)
+        baseline = calls["n"]
+
+        seen_types = []
+
+        class Checked(TrainTelemetry):
+            def on_step(self, n, data_wait_s, step_wall_s):
+                seen_types.extend([type(n), type(data_wait_s),
+                                   type(step_wall_s)])
+                super().on_step(n, data_wait_s, step_wall_s)
+
+        t = Checked()
+        calls["n"] = 0
+        self._run_epoch(t, devices)
+        assert calls["n"] == baseline, \
+            "telemetry changed the loop's block_until_ready count"
+        assert not any(issubclass(tp, jax.Array) for tp in seen_types), \
+            "a jax.Array leaked into the telemetry hot path"
+        snap = t.snapshot()
+        assert snap["counters"]["steps_total"] == 5
+        assert snap["counters"]["drains_total"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# Watchdog dump file + near-miss counter (satellite)
+# ---------------------------------------------------------------------------
+
+class TestWatchdogObservability:
+    def test_dump_file_written_on_fire(self, tmp_path):
+        from deepfake_detection_tpu.train.resilience import (EXIT_WATCHDOG,
+                                                             StallWatchdog)
+        dump = str(tmp_path / "watchdog_dump.txt")
+        fired = []
+        wd = StallWatchdog(0.2, position_fn=lambda: "epoch 9 batch 99",
+                           exit_fn=fired.append, first_grace=1.0,
+                           dump_path=dump)
+        wd.start()
+        try:
+            deadline = time.monotonic() + 10
+            while not fired and time.monotonic() < deadline:
+                time.sleep(0.05)
+        finally:
+            wd.stop()
+        assert fired == [EXIT_WATCHDOG]
+        text = open(dump).read()
+        assert "epoch 9 batch 99" in text
+        assert "Thread" in text or "thread" in text   # stack dump present
+
+    def test_near_miss_and_beat_counters(self):
+        from deepfake_detection_tpu.train.resilience import StallWatchdog
+        wd = StallWatchdog(1.0)
+        wd.beat()                    # first beat: no previous age
+        assert wd.near_miss_total == 0
+        time.sleep(0.6)              # > 0.5 * timeout
+        wd.beat()
+        assert wd.near_miss_total == 1
+        wd.beat()                    # immediate: healthy
+        assert wd.near_miss_total == 1
+        assert wd.beats_total == 3
+        assert wd.beat_age() < 0.5
+
+    def test_from_config_wires_dump_path(self, tmp_path):
+        from deepfake_detection_tpu.config import TrainConfig
+        from deepfake_detection_tpu.train import Resilience
+        cfg = TrainConfig(watchdog_timeout=60.0)
+        r = Resilience.from_config(cfg, output_dir=str(tmp_path))
+        assert r.watchdog.dump_path == str(tmp_path / "watchdog_dump.txt")
+
+
+# ---------------------------------------------------------------------------
+# Strided warp source (satellite): parity + elision counter
+# ---------------------------------------------------------------------------
+
+class TestStridedWarpSource:
+    @pytest.fixture(autouse=True)
+    def _need_native(self):
+        from deepfake_detection_tpu.data import native
+        if not native.available():
+            pytest.skip("native library unavailable")
+
+    def test_packed_views_warp_copy_free_and_bit_identical(self):
+        from deepfake_detection_tpu.data import native
+        rng = np.random.default_rng(7)
+        base = rng.integers(0, 256, (90, 70, 12), dtype=np.uint8)
+        views = [base[..., 3 * i:3 * i + 3] for i in range(4)]
+        copies = [np.ascontiguousarray(v) for v in views]
+        coeffs = (0.9, -0.08, 4.0, 0.12, 1.05, -2.5)
+        before = native.warp_copy_stats()
+        out_views = native.warp_affine_batch(views, coeffs, (48, 64),
+                                             packed=True)
+        mid = native.warp_copy_stats()
+        out_copies = native.warp_affine_batch(copies, coeffs, (48, 64),
+                                              packed=True)
+        after = native.warp_copy_stats()
+        np.testing.assert_array_equal(out_views, out_copies)
+        # the 4 strided views were elided; contiguous frames pass with
+        # neither counter moving (no copy was ever due)
+        assert mid["elided"] - before["elided"] == 4
+        assert mid["copied"] == before["copied"]
+        assert after["elided"] == mid["elided"]
+        assert after["copied"] == mid["copied"]
+
+    def test_non_dense_rows_fall_back_to_copy(self):
+        """A windowed (cropped) view has non-dense rows — the kernel
+        assumption fails, so it must take the staging copy and still be
+        bit-identical."""
+        from deepfake_detection_tpu.data import native
+        rng = np.random.default_rng(3)
+        base = rng.integers(0, 256, (90, 70, 12), dtype=np.uint8)
+        win = base[5:85, 4:68]
+        views = [win[..., 3 * i:3 * i + 3] for i in range(4)]
+        copies = [np.ascontiguousarray(v) for v in views]
+        coeffs = (1.1, 0.0, -1.0, 0.0, 0.95, 1.5)
+        before = native.warp_copy_stats()
+        o1 = native.warp_affine_batch(views, coeffs, (40, 52), packed=True)
+        after = native.warp_copy_stats()
+        o2 = native.warp_affine_batch(copies, coeffs, (40, 52), packed=True)
+        np.testing.assert_array_equal(o1, o2)
+        assert after["copied"] - before["copied"] == 4
+
+    def test_fused_geometric_on_packed_frames_elides(self):
+        """The real hot path: MultiFusedGeometric over PackedFrames-style
+        mmap views must hit the strided kernel."""
+        from deepfake_detection_tpu.data import native
+        from deepfake_detection_tpu.data.transforms import \
+            MultiFusedGeometric
+        rng = np.random.default_rng(11)
+        base = rng.integers(0, 256, (120, 110, 12), dtype=np.uint8)
+        views = [base[..., 3 * i:3 * i + 3] for i in range(4)]
+        t = MultiFusedGeometric(64, rotate_range=5)
+        before = native.warp_copy_stats()
+        out = t(views, np.random.default_rng(0))
+        after = native.warp_copy_stats()
+        assert after["elided"] - before["elided"] == 4
+        assert np.asarray(out[0]).shape == (64, 64, 3)
+
+
+# ---------------------------------------------------------------------------
+# Profiler capture + obs_report CLI
+# ---------------------------------------------------------------------------
+
+class TestProfileRankGating:
+    def test_profile_window_is_rank0_only(self, tmp_path, devices,
+                                          monkeypatch):
+        """Non-zero ranks must never start_trace into the shared run dir
+        (the --profile window's rank-0 gate, regression-pinned)."""
+        from deepfake_detection_tpu.losses import cross_entropy
+        from deepfake_detection_tpu.models import create_model, init_model
+        from deepfake_detection_tpu.optim import create_optimizer
+        from deepfake_detection_tpu.train import (create_train_state,
+                                                  make_train_step,
+                                                  train_one_epoch)
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        model = create_model("mnasnet_small", num_classes=2, in_chans=3)
+        variables = init_model(model, jax.random.PRNGKey(0), (2, 32, 32, 3),
+                               training=True)
+        tx = create_optimizer(SimpleNamespace(
+            opt="sgd", opt_eps=1e-8, momentum=0.9, weight_decay=0.0,
+            lr=1e-3), inject=True)
+        state = create_train_state(variables, tx)
+        step = make_train_step(model, tx, cross_entropy, mesh=None,
+                               bn_mode="global")
+        batches = [(jnp.zeros((2, 32, 32, 3), jnp.float32),
+                    jnp.asarray(np.arange(2) % 2)) for _ in range(2)]
+        train_one_epoch(0, step, state, _ListLoader(batches),
+                        _loop_cfg(profile=2, save_images=False),
+                        jax.random.PRNGKey(1), output_dir=str(tmp_path))
+        assert not (tmp_path / "profile").exists()
+
+    def test_ondemand_capture_is_rank0_only(self, tmp_path, monkeypatch):
+        from deepfake_detection_tpu.obs import ProfilerCapture
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        cap = ProfilerCapture(str(tmp_path), num_steps=1)
+        (tmp_path / "PROFILE").touch()
+        cap.poll()
+        cap.on_step(0)
+        assert not cap.active and cap.captures_total == 0
+        # the trigger file is left for rank 0 to consume
+        assert (tmp_path / "PROFILE").exists()
+
+
+class TestProfilerCapture:
+    def test_file_trigger_bounded_capture(self, tmp_path, devices):
+        from deepfake_detection_tpu.obs import ProfilerCapture
+        cap = ProfilerCapture(str(tmp_path), num_steps=1)
+        trigger = tmp_path / "PROFILE"
+        trigger.touch()
+        cap.poll()
+        x = jnp.ones((4,))
+        cap.on_step(10, x)           # starts the window
+        assert cap.active
+        assert not trigger.exists(), "trigger file must be consumed"
+        cap.on_step(11, x)           # 11 >= 10 + 1: stops + writes
+        assert not cap.active
+        assert cap.captures_total == 1
+        trace = tmp_path / "profile" / "ondemand-10"
+        assert trace.is_dir()
+        assert [p for p in trace.rglob("*") if p.is_file()], \
+            "profiler produced no trace files"
+
+    def test_idle_is_cheap_and_inert(self, tmp_path):
+        from deepfake_detection_tpu.obs import ProfilerCapture
+        cap = ProfilerCapture(str(tmp_path), num_steps=5)
+        for i in range(100):
+            cap.on_step(i)
+        cap.poll()
+        assert not cap.active and cap.captures_total == 0
+
+
+class TestObsReport:
+    def test_summarizes_run_dir(self, tmp_path):
+        from deepfake_detection_tpu.obs import EventLog
+        with EventLog(str(tmp_path / "telemetry.jsonl")) as log:
+            log.event("run_start", model="m")
+            for u in range(1, 4):
+                log.metrics(epoch=0, batch=u - 1, update=u,
+                            imgs_per_s=100.0 + u, step_ms=10.0,
+                            data_wait_frac=0.2, device_wait_frac=0.5,
+                            host_frac=0.3, loss=1.0 / u, prec1=50.0,
+                            lr=0.1, mfu=0.41,
+                            counters={"steps_total": u,
+                                      "recovery_snapshots_total": 1})
+            log.event("rewind", reason="3 consecutive bad steps")
+            log.event("epoch_end", epoch=0, train={"loss": 0.33})
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "obs_report.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, timeout=120, check=True,
+            env=dict(os.environ, PYTHONPATH=_REPO))
+        assert "imgs/s" in out.stdout and "ms/step" in out.stdout
+        assert "| 0 |" in out.stdout          # the epoch row
+        assert "rewind" in out.stdout         # resilience event surfaced
+        assert "recovery_snapshots_total = 1" in out.stdout
+        tail = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "obs_report.py"),
+             str(tmp_path), "--tail", "2"],
+            capture_output=True, text=True, timeout=120, check=True,
+            env=dict(os.environ, PYTHONPATH=_REPO))
+        lines = [json.loads(l) for l in tail.stdout.strip().split("\n")]
+        assert len(lines) == 2 and lines[-1]["event"] == "epoch_end"
+
+
+# ---------------------------------------------------------------------------
+# Acceptance e2e: SIGTERM kill + auto-resume → ONE coherent JSONL stream
+# ---------------------------------------------------------------------------
+
+_CLI_DRIVER = """
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+cache = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+if cache:
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+from deepfake_detection_tpu.runners.train import launch_main
+launch_main(sys.argv[1:])
+"""
+
+_E2E_BASE = ["--dataset", "synthetic", "--model", "vit_tiny_patch16_224",
+             "--model-version", "", "--input-size-v2", "3,32,32",
+             "--batch-size", "2", "--epochs", "2", "--opt", "adamw",
+             "--lr", "1e-3", "--sched", "step", "--log-interval", "2",
+             "--workers", "1", "--compute-dtype", "float32",
+             "--seed", "42", "--recovery-interval", "4"]
+
+
+def _launch_cli(args, chaos=""):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("DFD_CHAOS", None)
+    if chaos:
+        env["DFD_CHAOS"] = chaos
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_COMPILATION_CACHE_DIR"] = str(
+        jax.config.jax_compilation_cache_dir or "")
+    return subprocess.run([sys.executable, "-c", _CLI_DRIVER, *args],
+                          cwd=_REPO, env=env, capture_output=True,
+                          text=True, timeout=600)
+
+
+@pytest.mark.slow
+class TestLiveRunScrape:
+    """CLI e2e smoke (slow tier, the test_train launch_main precedent —
+    fresh-interpreter subprocess runs; the fast tier covers the same
+    endpoint semantics in TestMetricsEndpoint)."""
+
+    def test_metrics_port_scrapes_during_live_run(self, tmp_path):
+        """--metrics-port serves the full catalog while the run is live."""
+        import socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("DFD_CHAOS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_COMPILATION_CACHE_DIR"] = str(
+            jax.config.jax_compilation_cache_dir or "")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _CLI_DRIVER, *_E2E_BASE,
+             "--experiment", "run", "--metrics-port", str(port),
+             "--output", str(tmp_path / "out")],
+            cwd=_REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        try:
+            body = None
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline and proc.poll() is None:
+                try:
+                    body = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=5).read().decode()
+                    break
+                except OSError:
+                    time.sleep(0.25)
+            assert proc.poll() is None or proc.returncode == 0, \
+                proc.stderr.read()[-2000:]
+            assert body is not None, "endpoint never came up"
+            types, _ = _parse_prom(body)
+            for fam in ("dfd_train_steps_total", "dfd_train_mfu",
+                        "dfd_train_rewinds_total",
+                        "dfd_train_step_seconds",
+                        "dfd_train_input_train_batches_total"):
+                assert fam in types, fam
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+
+@pytest.mark.slow
+class TestJsonlAcrossAutoResume:
+    """CLI e2e smokes (slow tier): the kill/resume/rewind JSONL coherence
+    criterion over REAL fresh-interpreter training runs.  The fast tier
+    proves the same torn-tail/append mechanics at unit level
+    (TestEventLog.test_torn_tail_repaired_and_append_coherent)."""
+
+    def test_sigterm_kill_resume_single_coherent_stream(self, tmp_path):
+        """The acceptance criterion: kill mid-epoch, relaunch with
+        --auto-resume — the run dir's telemetry.jsonl must be one strictly
+        parseable stream carrying the preempted + resume lifecycle."""
+        from deepfake_detection_tpu.obs import read_records
+        args = _E2E_BASE + ["--experiment", "run", "--auto-resume",
+                            "--output", str(tmp_path / "out")]
+        r = _launch_cli(args, chaos="sigterm@11")
+        assert r.returncode == 75, \
+            f"rc={r.returncode}\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+        r2 = _launch_cli(args)
+        assert r2.returncode == 0, \
+            f"rc={r2.returncode}\n{r2.stdout[-2000:]}\n{r2.stderr[-2000:]}"
+        log_path = tmp_path / "out" / "run" / "telemetry.jsonl"
+        # every line strictly parseable (no torn, no NaN constants)
+        for line in open(log_path):
+            json.loads(line, parse_constant=lambda c: pytest.fail(
+                f"non-strict constant {c}"))
+        recs = read_records(str(log_path))
+        events = [r["event"] for r in recs if r["type"] == "event"]
+        assert events.count("run_start") == 2      # launch + relaunch
+        assert "preempted" in events
+        assert "resume" in events
+        assert events[-1] == "run_end"
+        # the resume event points at the recovery snapshot's position
+        resume = next(r for r in recs if r.get("event") == "resume")
+        assert "recovery" in resume["path"]
+        # metrics records exist on both sides of the kill and carry the
+        # breakdown schema
+        metrics = [r for r in recs if r["type"] == "metrics"]
+        assert len(metrics) >= 2
+        for m in metrics:
+            for key in ("imgs_per_s", "step_ms", "data_wait_frac",
+                        "device_wait_frac", "host_frac", "counters"):
+                assert key in m, key
+
+    def test_rewind_event_recorded(self, tmp_path):
+        """A nanbatch burst triggers the guard rewind; the stream must
+        carry the rewind event with its reason."""
+        from deepfake_detection_tpu.obs import read_records
+        args = list(_E2E_BASE)
+        args[args.index("--epochs") + 1] = "1"
+        r = _launch_cli(args + ["--experiment", "run",
+                                "--output", str(tmp_path / "out")],
+                        chaos="nanbatch@4x3")
+        assert r.returncode == 0, \
+            f"rc={r.returncode}\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+        recs = read_records(str(tmp_path / "out" / "run" /
+                                "telemetry.jsonl"))
+        rewinds = [r for r in recs if r.get("event") == "rewind"]
+        assert len(rewinds) == 1
+        assert "consecutive bad steps" in rewinds[0]["reason"]
+        assert "recovery" in rewinds[0]["restored_from"]
+        # the window that saw the poisoned steps counted them
+        last = [r for r in recs if r["type"] == "metrics"][-1]
+        assert last["counters"]["nonfinite_steps_total"] >= 1
+        assert last["counters"]["rewinds_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Loader stats plumbing
+# ---------------------------------------------------------------------------
+
+class TestLoaderStats:
+    def test_device_loader_counts_waits(self, devices):
+        from deepfake_detection_tpu.data import SyntheticDataset
+        from deepfake_detection_tpu.data.loader import create_loader
+        from deepfake_detection_tpu.obs import loader_collector
+        ds = SyntheticDataset(16, (32, 32, 3), 2, 0)
+        loader = create_loader(ds, (3, 32, 32), batch_size=4,
+                               is_training=False, num_workers=1,
+                               dtype=jnp.float32)
+        n = sum(1 for _ in loader)
+        assert n == len(loader)
+        st = loader.stats
+        assert st.batches == n
+        assert st.host_wait_s >= 0.0
+        out = loader_collector(loader)()
+        assert out["counters"]["input_train_batches_total"] == n
+        assert out["counters"]["input_train_fetch_seconds_total"] > 0
+        loader.close()

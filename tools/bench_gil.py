@@ -121,11 +121,13 @@ def main() -> None:
     idle_ms = sp.max_gap * 1000
 
     # positive control: SAME .so via PyDLL = ctypes keeps the GIL held.
-    # dfd_warp_affine has the simplest ABI; replicate the argtypes binding.
+    # dfd_warp_affine has the simplest ABI; replicate the argtypes binding
+    # (ABI v3: src pixel stride sits between the source dims and the dst).
     pylib = ctypes.PyDLL(native._LIB)
     u8p = ctypes.POINTER(ctypes.c_uint8)
     pylib.dfd_warp_affine.argtypes = [
-        u8p, ctypes.c_int, ctypes.c_int, u8p, ctypes.c_int, ctypes.c_int,
+        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        u8p, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.POINTER(ctypes.c_double)]
     src_c = np.ascontiguousarray(frame)
     dst = np.empty((args.src, args.src, 3), np.uint8)
@@ -133,7 +135,7 @@ def main() -> None:
 
     def warp_gil_held():
         pylib.dfd_warp_affine(
-            src_c.ctypes.data_as(u8p), args.src, args.src,
+            src_c.ctypes.data_as(u8p), args.src, args.src, 3,
             dst.ctypes.data_as(u8p), args.src, args.src, 3, c6)
 
     stages = {

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Summarize a run's telemetry JSONL into the INPUT_BENCH/PERF table shape.
+
+The live telemetry (obs/) and the offline bench docs (INPUT_BENCH.md,
+PERF.md, bench.py rows) should speak one vocabulary — imgs/s, ms/step,
+MFU, wait fractions — so a run's in-flight numbers drop straight into the
+same tables the chip-gated verification items use.  Usage::
+
+    python tools/obs_report.py <run_dir | telemetry.jsonl>        # summary
+    python tools/obs_report.py <run_dir> --tail 5                 # raw tail
+    python tools/obs_report.py <run_dir> --events                 # lifecycle
+
+jax-free: reads through deepfake_detection_tpu.obs.events only (the obs
+package lazy-imports its jax-touching modules), so this works as a cheap
+reporting subprocess next to a running job.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepfake_detection_tpu.obs.events import iter_records  # noqa: E402
+
+
+def _resolve(path: str) -> str:
+    if os.path.isdir(path):
+        path = os.path.join(path, "telemetry.jsonl")
+    if not os.path.isfile(path):
+        raise SystemExit(f"no telemetry log at {path}")
+    return path
+
+
+def _fmt(v, nd=1):
+    if v is None:
+        return "-"
+    return f"{v:.{nd}f}"
+
+
+def _epoch_rows(metrics):
+    """Aggregate metrics records per epoch (weighted by window steps via
+    the monotonic counters where available, else record-average)."""
+    by_epoch = {}
+    for m in metrics:
+        by_epoch.setdefault(int(m.get("epoch", 0)), []).append(m)
+    rows = []
+    for epoch in sorted(by_epoch):
+        recs = by_epoch[epoch]
+        n = len(recs)
+
+        def avg(key):
+            vals = [r[key] for r in recs if r.get(key) is not None]
+            return sum(vals) / len(vals) if vals else None
+
+        rows.append({
+            "epoch": epoch, "records": n,
+            "imgs_per_s": avg("imgs_per_s"), "step_ms": avg("step_ms"),
+            "data_wait_frac": avg("data_wait_frac"),
+            "device_wait_frac": avg("device_wait_frac"),
+            "host_frac": avg("host_frac"), "mfu": avg("mfu"),
+            "loss": recs[-1].get("loss"),
+        })
+    return rows
+
+
+def summarize(path: str) -> None:
+    metrics, events = [], []
+    for rec in iter_records(path):
+        (metrics if rec.get("type") == "metrics" else events).append(rec)
+    if not metrics and not events:
+        raise SystemExit(f"{path}: no records")
+    print(f"# {path}: {len(metrics)} metrics records, "
+          f"{len(events)} events\n")
+    if metrics:
+        print("| epoch | imgs/s | ms/step | data-wait | device | host | "
+              "mfu | loss |")
+        print("|---|---|---|---|---|---|---|---|")
+        for r in _epoch_rows(metrics):
+            print(f"| {r['epoch']} | {_fmt(r['imgs_per_s'])} "
+                  f"| {_fmt(r['step_ms'])} "
+                  f"| {_fmt((r['data_wait_frac'] or 0) * 100)}% "
+                  f"| {_fmt((r['device_wait_frac'] or 0) * 100)}% "
+                  f"| {_fmt((r['host_frac'] or 0) * 100)}% "
+                  f"| {_fmt(r['mfu'], 4) if r['mfu'] else '-'} "
+                  f"| {_fmt(r['loss'], 4)} |")
+        last = metrics[-1].get("counters", {})
+        interesting = {k: v for k, v in last.items()
+                       if v and not k.endswith("seconds_total")}
+        if interesting:
+            print("\ncounters (latest):")
+            for k, v in sorted(interesting.items()):
+                print(f"  {k} = {int(v) if float(v).is_integer() else v}")
+    resil = [e for e in events if e.get("event") in
+             ("rewind", "preempted", "resume")]
+    if resil:
+        print("\nresilience events:")
+        for e in resil:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("v", "t", "type", "event")}
+            print(f"  {e['event']}: {extra}")
+
+
+def show_events(path: str) -> None:
+    for rec in iter_records(path):
+        if rec.get("type") == "event":
+            print(json.dumps(rec))
+
+
+def show_tail(path: str, n: int) -> None:
+    recs = list(iter_records(path))
+    for rec in recs[-n:]:
+        print(json.dumps(rec))
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        description="summarize a training run's telemetry JSONL")
+    p.add_argument("path", help="run dir or telemetry.jsonl")
+    p.add_argument("--tail", type=int, default=0, metavar="N",
+                   help="print the last N raw records instead")
+    p.add_argument("--events", action="store_true",
+                   help="print lifecycle events only")
+    args = p.parse_args(argv)
+    path = _resolve(args.path)
+    if args.tail:
+        show_tail(path, args.tail)
+    elif args.events:
+        show_events(path)
+    else:
+        summarize(path)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BrokenPipeError:       # `obs_report ... | head` is a normal use
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)
