@@ -489,6 +489,11 @@ class ServeConfig:
     # the uint8 canvas and normalizes/replicates inside the batched device
     # call (4·img_num× less transfer; ulp-level drift vs the CLI)
     wire: str = "float32"
+    # multi-frame clips on the uint8 wire need a SECOND compiled
+    # executable per bucket (≈2× warmup); a deployment that only ever
+    # scores single frames can opt out (float32 wire serves clips for
+    # free either way, so this flag is a no-op there)
+    single_frame_only: bool = False
 
     # --- micro-batching / compile cache ---
     buckets: Tuple[int, ...] = (1, 4, 16, 64)
@@ -564,3 +569,86 @@ class ServeConfig:
         """Two-stage parse: YAML resets defaults, CLI overrides (the
         TrainConfig.from_args semantics)."""
         return _two_stage_parse(cls, argv, cls.argument_parser())
+
+
+# ---------------------------------------------------------------------------
+# Streaming config (runners/stream.py)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class StreamConfig(ServeConfig):
+    """Knob surface of the streaming-video scoring server.
+
+    Extends :class:`ServeConfig` (the engine/batcher knobs are the same
+    machinery) with the stream-pipeline stages: face localization +
+    tracking, temporal windowing, per-stream verdict hysteresis, and
+    session lifecycle.  ``from_dict``/``from_yaml``/``from_args`` are
+    inherited — every new field is a ``--dashed-flag``.
+    """
+    port: int = 8378                     # one above the serving default
+
+    # --- face localization + tracking (streaming/tracker.py) ---
+    # 'full_frame' (deterministic built-in, pre-cropped parity) or
+    # 'callable:<module>:<attr>' plugging in a model-backed detector
+    localizer: str = "full_frame"
+    track_iou_min: float = 0.3           # greedy-IoU association floor
+    track_ema_alpha: float = 0.6         # box smoothing (1.0 = raw boxes)
+    track_max_coast: int = 10            # missed frames before track death
+    track_min_hits: int = 1              # detections before a track scores
+    crop_margin: float = 0.15            # face-box expansion before crop
+
+    # --- temporal windowing (streaming/windows.py) ---
+    window_stride: int = 1               # in-window frame spacing
+    window_hop: int = 0                  # pushes between windows (0 = tile:
+    # img_num*stride, non-overlapping)
+    max_inflight_windows: int = 4        # per-stream bound; beyond it the
+    # OLDEST pending window is dropped (drop-oldest backpressure)
+
+    # --- verdict hysteresis (streaming/verdict.py) ---
+    verdict_ema_alpha: float = 0.3       # EMA over window scores
+    suspect_enter: float = 0.5
+    suspect_exit: float = 0.35
+    fake_enter: float = 0.8
+    fake_exit: float = 0.65
+    verdict_min_windows: int = 1         # EMA warmup before verdicts move
+
+    # --- session lifecycle (streaming/ingest.py) ---
+    max_streams: int = 64
+    stream_ttl_s: float = 120.0          # idle eviction (0 = never)
+    event_log_dir: str = ""              # per-stream verdict-event JSONL
+
+    # --- bench/test instrumentation ---
+    # planted per-window scores ("0.05*8,0.95*12"): windows still ride the
+    # engine (load/latency are real) but the VERDICT machines consume the
+    # planted sequence, so transition tests are deterministic
+    verdict_vector: str = ""
+
+    # ------------------------------------------------------------------
+    def __post_init__(self):
+        super().__post_init__()
+        from .streaming.verdict import VerdictThresholds
+        VerdictThresholds(self.suspect_enter, self.suspect_exit,
+                          self.fake_enter, self.fake_exit)  # validates
+        if not 0.0 < self.verdict_ema_alpha <= 1.0:
+            raise ValueError(f"--verdict-ema-alpha must be in (0, 1], got "
+                             f"{self.verdict_ema_alpha}")
+        if not 0.0 < self.track_ema_alpha <= 1.0:
+            raise ValueError(f"--track-ema-alpha must be in (0, 1], got "
+                             f"{self.track_ema_alpha}")
+        if not 0.0 <= self.track_iou_min <= 1.0:
+            raise ValueError(f"--track-iou-min must be in [0, 1], got "
+                             f"{self.track_iou_min}")
+        for name in ("window_stride", "max_inflight_windows", "max_streams",
+                     "verdict_min_windows", "track_min_hits"):
+            if int(getattr(self, name)) < 1:
+                raise ValueError(f"--{name.replace('_', '-')} must be "
+                                 f">= 1, got {getattr(self, name)}")
+        if int(self.window_hop) < 0 or int(self.track_max_coast) < 0 or \
+                float(self.crop_margin) < 0 or float(self.stream_ttl_s) < 0:
+            raise ValueError("window-hop / track-max-coast / crop-margin / "
+                             "stream-ttl-s must be >= 0")
+
+    @classmethod
+    def argument_parser(cls) -> argparse.ArgumentParser:
+        return _dataclass_parser(
+            cls, "streaming-video deepfake-detection scoring server")

@@ -8,7 +8,7 @@ Parity with ``/root/reference/dfd/params.py``: ImageNet mean/std ×255
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -17,7 +17,7 @@ from PIL import Image
 
 __all__ = ["img_mean", "img_std", "image_max_height", "image_max_width",
            "img_num", "resize", "padding_image", "prepare_canvas",
-           "normalize_replicate", "make_score_fn"]
+           "normalize_replicate", "normalize_concat", "make_score_fn"]
 
 img_mean = np.asarray([0.485, 0.456, 0.406], np.float32) * 255.0
 img_std = np.asarray([0.229, 0.224, 0.225], np.float32) * 255.0
@@ -80,6 +80,26 @@ def normalize_replicate(image: np.ndarray, num: int = img_num) -> np.ndarray:
     if num > 1:
         image = np.concatenate([image] * num, axis=-1)
     return image
+
+
+def normalize_concat(frames, num: Optional[int] = None) -> np.ndarray:
+    """Photometric half for ``num`` *distinct* frames: normalize each uint8
+    HWC canvas and channel-concatenate → ``(H, W, 3·num)`` float32 — the
+    temporal clip layout the multi-frame models train on (``MultiConcate``).
+
+    Identical frames reproduce :func:`normalize_replicate` byte-for-byte
+    (same per-frame arithmetic, same concat), which is the parity contract
+    of the serving/streaming multi-frame wire: a clip of ``num`` copies of
+    one frame scores bit-identically to the single-frame replicate path.
+    """
+    frames = list(frames)
+    if num is not None and len(frames) != num:
+        raise ValueError(f"expected {num} frames, got {len(frames)}")
+    if not frames:
+        raise ValueError("normalize_concat needs at least one frame")
+    return np.concatenate(
+        [(f.astype(np.float32) - img_mean) / img_std for f in frames],
+        axis=-1)
 
 
 def make_score_fn(model, variables):

@@ -33,7 +33,7 @@ from typing import Optional, Sequence
 
 _logger = logging.getLogger(__name__)
 
-__all__ = ["build_server", "main"]
+__all__ = ["build_engine", "build_server", "main"]
 
 
 def _load_variables(model, cfg):
@@ -57,13 +57,14 @@ def _load_variables(model, cfg):
     return variables
 
 
-def build_server(cfg):
-    """Wire model → engine → batcher → HTTP server; returns the (not yet
-    started) :class:`ServingServer` with engine/batcher attached."""
+def build_engine(cfg):
+    """Model → warmed engine + micro-batcher + metrics — the device half
+    every front end shares (``runners/serve.py``'s single-request HTTP
+    server and ``runners/stream.py``'s streaming pipeline both sit on
+    exactly this stack)."""
     from ..models import create_model
     from ..serving.batcher import MicroBatcher
     from ..serving.engine import InferenceEngine
-    from ..serving.http import make_server
     from ..serving.metrics import ServingMetrics
 
     _logger.info("building %s (in_chans=%d, canvas %d²)", cfg.model,
@@ -75,19 +76,28 @@ def build_server(cfg):
     _logger.info("AOT-warming buckets %s ...", list(cfg.buckets))
     engine = InferenceEngine(
         model, variables, image_size=cfg.image_size, img_num=cfg.img_num,
-        buckets=cfg.buckets, metrics=metrics, wire=cfg.wire)
+        buckets=cfg.buckets, metrics=metrics, wire=cfg.wire,
+        multi_frame=not cfg.single_frame_only)
     batcher = MicroBatcher(max_batch=cfg.max_batch_size,
                            deadline_ms=cfg.batch_deadline_ms,
                            max_queue=cfg.max_queue, metrics=metrics)
-    server = make_server(cfg.host, cfg.port, engine, batcher, metrics,
-                         request_timeout_s=cfg.request_timeout_ms / 1000.0)
     if cfg.reload_dir:
         engine.start_reload_watcher(cfg.reload_dir,
                                     interval_s=cfg.reload_interval_s,
                                     use_ema=cfg.use_ema)
         _logger.info("hot-reload watcher on %s (every %.1fs)",
                      cfg.reload_dir, cfg.reload_interval_s)
-    return server
+    return engine, batcher, metrics
+
+
+def build_server(cfg):
+    """Wire model → engine → batcher → HTTP server; returns the (not yet
+    started) :class:`ServingServer` with engine/batcher attached."""
+    from ..serving.http import make_server
+
+    engine, batcher, metrics = build_engine(cfg)
+    return make_server(cfg.host, cfg.port, engine, batcher, metrics,
+                       request_timeout_s=cfg.request_timeout_ms / 1000.0)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:

@@ -26,9 +26,9 @@ from PIL import Image
 from ..models import create_deepfake_model_v4, init_model
 from ..models.helpers import load_checkpoint
 from ..params import (image_max_height, img_num, make_score_fn,
-                      normalize_replicate, prepare_canvas)
+                      normalize_concat, normalize_replicate, prepare_canvas)
 
-__all__ = ["test_img", "preprocess"]
+__all__ = ["test_img", "preprocess", "preprocess_clip"]
 
 
 def preprocess(img_file, size: int = image_max_height,
@@ -41,9 +41,29 @@ def preprocess(img_file, size: int = image_max_height,
     return normalize_replicate(prepare_canvas(img, size), num)[None]
 
 
+def preprocess_clip(img_files, size: int = image_max_height,
+                    num: int = img_num) -> np.ndarray:
+    """``num`` frame files → ONE (1, H, W, 3*num) temporal clip: each frame
+    gets the geometric canvas, then the frames channel-concatenate
+    (``params.normalize_concat``) instead of replicating one frame — the
+    multi-frame wire the streaming windower and ``--clip`` mode score.
+    ``num`` identical files reproduce :func:`preprocess` bit-for-bit."""
+    canvases = [prepare_canvas(
+        np.asarray(Image.open(f).convert("RGB"), np.uint8), size)
+        for f in img_files]
+    return normalize_concat(canvases, num)[None]
+
+
 def test_img(model_path: Optional[str], img_files: Sequence[str],
-             size: int = image_max_height) -> List[float]:
+             size: int = image_max_height, clip: bool = False) -> List[float]:
+    """Score images one at a time (replicate ×img_num, reference parity),
+    or — with ``clip=True`` — in groups of ``img_num`` distinct frames
+    channel-concatenated into temporal clips (the streaming windower's
+    layout; scores are bit-identical to the serving float32 wire)."""
     assert all(os.path.isfile(f) for f in img_files), "file not exist!"
+    if clip and len(img_files) % img_num:
+        raise ValueError(f"--clip needs a multiple of img_num={img_num} "
+                         f"images, got {len(img_files)}")
     print(f"To load model from {model_path}")
     model = create_deepfake_model_v4("efficientnet_deepfake_v4",
                                      num_classes=2, in_chans=12)
@@ -59,6 +79,15 @@ def test_img(model_path: Optional[str], img_files: Sequence[str],
     print("Model loaded!")
     score_fn = make_score_fn(model, variables)
     scores_out: List[float] = []
+    if clip:
+        for i in range(0, len(img_files), img_num):
+            group = list(img_files[i:i + img_num])
+            scores = np.asarray(score_fn(jnp.asarray(
+                preprocess_clip(group, size))))
+            fake_score = float(scores[0, 0])                # P(fake)
+            scores_out.append(fake_score)
+            print(f"clip {group}'s fake score:{fake_score}")
+        return scores_out
     for img_file in img_files:
         scores = np.asarray(score_fn(jnp.asarray(preprocess(img_file, size))))
         fake_score = float(scores[0, 0])                    # P(fake)
@@ -72,12 +101,17 @@ def main(argv=None) -> None:
     p.add_argument("images", nargs="*")
     p.add_argument("--model-path", default="")
     p.add_argument("--image-size", type=int, default=image_max_height)
+    p.add_argument("--clip", action="store_true",
+                   help=f"score groups of img_num={img_num} distinct "
+                        f"frames as temporal clips instead of replicating "
+                        f"each image")
     args = p.parse_args(argv)
     if not args.images:
         print("Please input your images. e.g. python -m "
               "deepfake_detection_tpu.runners.test image1 image2")
         return
-    test_img(args.model_path or None, args.images, size=args.image_size)
+    test_img(args.model_path or None, args.images, size=args.image_size,
+             clip=args.clip)
 
 
 if __name__ == "__main__":

@@ -83,6 +83,7 @@ class InferenceEngine:
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
                  metrics: Optional[ServingMetrics] = None,
                  wire: str = "float32",
+                 multi_frame: bool = True,
                  warmup: bool = True):
         self.model = model
         self.image_size = int(image_size)
@@ -107,7 +108,8 @@ class InferenceEngine:
             lambda a: (tuple(np.shape(a)), np.asarray(a).dtype),
             self._host_template)
         self._compiled: Dict[int, Any] = {}
-        self._pending: Optional[_Staged] = None
+        self._compiled_multi: Dict[int, Any] = {}
+        self._pending: List[_Staged] = []
         self._reload_box: List[Tuple[Any, str]] = []   # [(host_tree, path)]
         self._reload_lock = threading.Lock()
         self._last_reload_key: Optional[Tuple[str, float, int]] = None
@@ -133,7 +135,20 @@ class InferenceEngine:
         #   drift vs the CLI, so this mode is "allclose", not bit-equal.
         self._mean = jax.device_put(jnp.asarray(img_mean))
         self._std = jax.device_put(jnp.asarray(img_std))
+        # multi-frame wire: mean/std tiled to the 3·img_num clip channels
+        # so the SAME per-element arithmetic runs whether the channels came
+        # from replication or from img_num distinct frames
+        self._mean_multi = jax.device_put(jnp.asarray(
+            np.tile(img_mean, self.img_num)))
+        self._std_multi = jax.device_put(jnp.asarray(
+            np.tile(img_std, self.img_num)))
         n_rep = self.img_num
+        # uint8 wire with img_num == 1 needs no second program: a 1-frame
+        # "clip" IS the single-frame sample.  float32 wire never needs one
+        # (replicate and concat payloads share the (·, ·, 3·img_num)
+        # float32 shape, so the CLI-parity program serves both).
+        self.multi_frame = bool(multi_frame) and self.wire == "uint8" \
+            and self.img_num > 1
 
         if self.wire == "uint8":
             def _score(variables, x_u8, mean, std):
@@ -142,24 +157,45 @@ class InferenceEngine:
                     x = jnp.tile(x, (1, 1, 1, n_rep))
                 logits = self.model.apply(variables, x, training=False)
                 return jax.nn.softmax(logits, axis=-1)
+
+            def _score_multi(variables, x_u8, mean, std):
+                # x_u8 already carries img_num distinct frames channel-
+                # concatenated; normalize elementwise (tiled mean/std), no
+                # replication
+                x = (x_u8.astype(jnp.float32) - mean) / std
+                logits = self.model.apply(variables, x, training=False)
+                return jax.nn.softmax(logits, axis=-1)
         else:
             def _score(variables, x):
                 logits = self.model.apply(variables, x, training=False)
                 return jax.nn.softmax(logits, axis=-1)
 
+            _score_multi = None
+
         self._score = _score
+        self._score_multi = _score_multi
         if warmup:
             self.warmup()
 
     @property
     def _wire_spec(self) -> Tuple[int, Any]:
-        """(channels, dtype) of one wire-format sample."""
+        """(channels, dtype) of one SINGLE-frame wire sample."""
         if self.wire == "uint8":
             return 3, np.uint8
         return 3 * self.img_num, np.float32
 
-    def _run(self, bucket: int, variables, x):
+    def allowed_chans(self) -> Tuple[int, ...]:
+        """Channel counts a request array may carry on this wire."""
+        base, _ = self._wire_spec
+        if self.multi_frame:
+            return (base, 3 * self.img_num)
+        return (base,)
+
+    def _run(self, bucket: int, variables, x, multi: bool = False):
         if self.wire == "uint8":
+            if multi:
+                return self._compiled_multi[bucket](
+                    variables, x, self._mean_multi, self._std_multi)
             return self._compiled[bucket](variables, x, self._mean,
                                           self._std)
         return self._compiled[bucket](variables, x)
@@ -176,8 +212,9 @@ class InferenceEngine:
         return self.metrics.ready
 
     def warmup(self) -> None:
-        """AOT-compile every bucket and execute each once (primes any
-        first-run allocation paths), then flip ready."""
+        """AOT-compile every bucket (plus, on a multi-frame uint8 wire,
+        every bucket's multi-frame executable) and execute each once
+        (primes any first-run allocation paths), then flip ready."""
         s = self.image_size
         chans, dtype = self._wire_spec
         for b in self.buckets:
@@ -199,16 +236,48 @@ class InferenceEngine:
             jax.block_until_ready(out)
             _logger.info("bucket %d compiled + warmed in %.1fs", b,
                          time.monotonic() - t0)
+        if self.multi_frame:
+            mchans = 3 * self.img_num
+            for b in self.buckets:
+                if b in self._compiled_multi:
+                    continue
+                t0 = time.monotonic()
+                x_spec = jax.ShapeDtypeStruct((b, s, s, mchans),
+                                              jnp.dtype(np.uint8))
+                lowered = jax.jit(self._score_multi).lower(
+                    self._variables, x_spec, self._mean_multi,
+                    self._std_multi)
+                self._compiled_multi[b] = lowered.compile()
+                self.metrics.compiles_total.inc()
+                out = self._run(b, self._variables,
+                                jnp.zeros((b, s, s, mchans), np.uint8),
+                                multi=True)
+                jax.block_until_ready(out)
+                _logger.info("bucket %d (multi-frame) compiled + warmed "
+                             "in %.1fs", b, time.monotonic() - t0)
         self.metrics.ready = True
 
     # ------------------------------------------------------------------
     # scoring
     # ------------------------------------------------------------------
-    def _pad_batch(self, arrays: List[np.ndarray]) -> Tuple[np.ndarray, int]:
+    def _chans_of(self, array) -> int:
+        """Wire channel count of one request array, validated against the
+        engine's compiled programs (unknown widths must fail loudly here,
+        never reach an uncompiled shape)."""
+        chans = int(np.shape(array)[-1]) if np.ndim(array) else 0
+        if chans not in self.allowed_chans():
+            raise ValueError(
+                f"request carries {chans} channels; this engine accepts "
+                f"{self.allowed_chans()} (wire={self.wire}, "
+                f"img_num={self.img_num}, multi_frame={self.multi_frame})")
+        return chans
+
+    def _pad_batch(self, arrays: List[np.ndarray],
+                   chans: int) -> Tuple[np.ndarray, int]:
         n = len(arrays)
         bucket = pick_bucket(n, self.buckets)
         s = self.image_size
-        chans, dtype = self._wire_spec
+        _, dtype = self._wire_spec
         # fresh buffer every batch: jax CPU device_put zero-copies aligned
         # host memory, so reusing one buffer would race the still-executing
         # previous batch (same hazard data/loader.py guards with
@@ -218,22 +287,56 @@ class InferenceEngine:
             buf[i] = a
         return buf, bucket
 
+    def _is_multi(self, chans: int) -> bool:
+        return self.multi_frame and chans == 3 * self.img_num
+
     def score_batch(self, arrays: List[np.ndarray]) -> np.ndarray:
         """Synchronous scoring of up to max-bucket wire-format samples
-        (tests, warm checks); the serving path goes through
-        stage/complete instead."""
-        buf, bucket = self._pad_batch(arrays)
-        out = self._run(bucket, self._variables, jax.device_put(buf))
+        (tests, warm checks); one uniform channel width per call — the
+        serving path goes through stage/complete instead and may mix."""
+        chans = self._chans_of(arrays[0])
+        for a in arrays[1:]:
+            if self._chans_of(a) != chans:
+                raise ValueError("score_batch arrays must share one "
+                                 "channel width; the async path handles "
+                                 "mixed single/multi-frame traffic")
+        buf, bucket = self._pad_batch(arrays, chans)
+        out = self._run(bucket, self._variables, jax.device_put(buf),
+                        multi=self._is_multi(chans))
         return np.asarray(out)[:len(arrays)]
 
-    def _stage(self, requests: List[Request]) -> _Staged:
-        buf, bucket = self._pad_batch([r.array for r in requests])
-        out = self._run(bucket, self._variables, jax.device_put(buf))
-        self.metrics.inflight += len(requests)
-        now = time.monotonic()
+    def _stage(self, requests: List[Request]) -> List[_Staged]:
+        """Dispatch requests as one device batch per channel width.
+
+        Single-frame and multi-frame requests ride different compiled
+        programs, so a coalesced batch that mixes them splits into (at
+        most two) staged sub-batches — each still a pre-compiled bucket,
+        dispatched back-to-back so both overlap the previous batch's
+        completion."""
+        groups: Dict[int, List[Request]] = {}
         for r in requests:
-            r.timings["queue"] = now - r.enqueue_t
-        return _Staged(requests, out, bucket, now)
+            groups.setdefault(self._chans_of(r.array), []).append(r)
+        staged: List[_Staged] = []
+        try:
+            for chans, grp in groups.items():
+                buf, bucket = self._pad_batch([r.array for r in grp],
+                                              chans)
+                out = self._run(bucket, self._variables,
+                                jax.device_put(buf),
+                                multi=self._is_multi(chans))
+                self.metrics.inflight += len(grp)
+                now = time.monotonic()
+                for r in grp:
+                    r.timings["queue"] = now - r.enqueue_t
+                staged.append(_Staged(grp, out, bucket, now))
+        except Exception:
+            # a later group poisoned the stage: the caller fails EVERY
+            # request of the coalesced batch, so unwind the sub-batches
+            # already dispatched (their device work is wasted, not leaked)
+            for st in staged:
+                self.metrics.inflight -= len(st.requests)
+            raise
+        return staged
 
     def _complete(self, staged: _Staged) -> None:
         scores = np.asarray(staged.out)          # blocks on the device
@@ -270,7 +373,7 @@ class InferenceEngine:
 
     def _loop_once(self, batcher: MicroBatcher) -> None:
         self._maybe_apply_reload()
-        if self._pending is None:
+        if not self._pending:
             # device idle: block for the first request, then coalesce
             # within the deadline window
             requests = batcher.next_batch(timeout=0.05)
@@ -292,7 +395,7 @@ class InferenceEngine:
         # small-batch equilibrium (tiny batch → short exec → short gather
         # → tiny batch again).
         requests: List[Request] = []
-        out = self._pending.out
+        out = self._pending[-1].out        # last sub-batch lands last
         flush_at = time.monotonic() + batcher.deadline_s
         while len(requests) < batcher.max_batch:
             if self._out_ready(out) and time.monotonic() >= flush_at:
@@ -307,22 +410,25 @@ class InferenceEngine:
             requests.append(r)
         # dispatch k+1 (async) BEFORE blocking on k: transfer + compute of
         # k+1 overlap k's completion — the DeviceLoader double buffer
-        staged = None
+        staged: List[_Staged] = []
         if requests:
             try:
                 staged = self._stage(requests)
             except Exception as e:                 # noqa: BLE001
                 self._fail(requests, e)
                 raise
-        pending, self._pending = self._pending, None
-        try:
-            self._complete(pending)
-        except Exception as e:                     # noqa: BLE001
-            self.metrics.inflight -= len(pending.requests)
-            self._fail(pending.requests, e)
-            raise
-        finally:
-            self._pending = staged
+        pending, self._pending = self._pending, []
+        err: Optional[Exception] = None
+        for st in pending:
+            try:
+                self._complete(st)
+            except Exception as e:                 # noqa: BLE001
+                self.metrics.inflight -= len(st.requests)
+                self._fail(st.requests, e)
+                err = e
+        self._pending = staged
+        if err is not None:
+            raise err
 
     def serve_loop(self, batcher: MicroBatcher) -> None:
         """Run until stop(); never lets an exception strand requests or
@@ -350,10 +456,9 @@ class InferenceEngine:
         if self._worker is not None:
             self._worker.join(timeout=5.0)
             self._worker = None
-        if self._pending is not None:
-            self._fail(self._pending.requests,
-                       RuntimeError("server shutting down"))
-            self._pending = None
+        for st in self._pending:
+            self._fail(st.requests, RuntimeError("server shutting down"))
+        self._pending = []
 
     # ------------------------------------------------------------------
     # hot weight reload

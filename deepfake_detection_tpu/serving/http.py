@@ -10,11 +10,17 @@ device calls.
 
 Endpoints:
 
-* ``POST /score`` — body is either raw image bytes (``Content-Type:
-  image/*`` or ``application/octet-stream``) or JSON
-  ``{"image_b64": "..."}``.  Responds ``{"fake_score": p, "scores":
-  [...], "timings_ms": {...}}``; 400 undecodable, 429 + ``Retry-After``
-  when load-shedding, 503 before warmup, 504 past the request deadline.
+* ``POST /score`` — body is raw image bytes (``Content-Type: image/*``
+  or ``application/octet-stream``), JSON ``{"image_b64": "..."}``, or a
+  MULTI-FRAME clip: JSON ``{"frames_b64": [f1, ..., f_img_num]}`` or a
+  ``multipart/*`` body with one image per part.  A single frame is
+  replicated ×``img_num`` (the reference CLI's semantics); ``img_num``
+  distinct frames are channel-concatenated into one temporal clip — and
+  a clip of identical frames scores bit-identically to the replicate
+  path (tests/test_serving.py).  Responds ``{"fake_score": p, "scores":
+  [...], "frames": n, "timings_ms": {...}}``; 400 undecodable or a frame
+  count other than 1/``img_num``, 429 + ``Retry-After`` when
+  load-shedding, 503 before warmup, 504 past the request deadline.
 * ``GET /healthz`` — process liveness (200 while the process serves).
 * ``GET /readyz`` — 200 only after every bucket is compiled+warmed.
 * ``GET /metrics`` — Prometheus text format (serving/metrics.py).
@@ -34,16 +40,68 @@ from typing import Optional, Tuple
 import numpy as np
 from PIL import Image
 
-from ..params import normalize_replicate, prepare_canvas
+from ..params import normalize_concat, normalize_replicate, prepare_canvas
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
 from .engine import InferenceEngine
 from .metrics import ServingMetrics
 
 _logger = logging.getLogger(__name__)
 
-__all__ = ["ServingServer", "make_server", "serve_forever_in_thread"]
+__all__ = ["ServingServer", "make_server", "serve_forever_in_thread",
+           "multipart_boundary", "split_multipart"]
 
 _MAX_BODY = 32 * 1024 * 1024            # 32 MiB: generous for one image
+
+
+def multipart_boundary(ctype_full: str) -> Optional[str]:
+    """Boundary token from a full Content-Type header value, or None.
+    The one parser both ``POST /score`` and the stream ingest use."""
+    import re
+    m = re.search(r'boundary="?([^";]+)"?', ctype_full)
+    return m.group(1) if m else None
+
+
+def split_multipart(body: bytes, boundary: str) -> list:
+    """MJPEG/multipart chunk → list of part payloads.
+
+    Handles both ``multipart/x-mixed-replace`` (MJPEG-over-HTTP's
+    framing) and ``multipart/form-data`` bodies: parts are delimited by
+    ``--<boundary>``, each part's payload starts after its blank line.
+    Lives here (not streaming/) because streaming is built ON TOP of
+    serving — the dependency only points one way.
+    """
+    delim = b"--" + boundary.encode()
+    parts = []
+    for raw in body.split(delim)[1:]:      # [0] is the preamble
+        if raw.startswith(b"--"):          # closing terminator
+            break
+        # one CRLF (or bare LF) follows the boundary line ...
+        if raw.startswith(b"\r\n"):
+            raw = raw[2:]
+        elif raw.startswith(b"\n"):
+            raw = raw[1:]
+        # ... then an (optionally EMPTY) header block ends at the first
+        # blank line.  Locate it before touching any payload bytes — a
+        # JPEG legally contains 0d0a0d0a, so trimming first (the old
+        # strip()) could eat the real delimiter and truncate the frame.
+        if raw.startswith(b"\r\n"):
+            payload = raw[2:]
+        elif raw.startswith(b"\n"):
+            payload = raw[1:]
+        else:
+            head_end = raw.find(b"\r\n\r\n")
+            if head_end >= 0:
+                payload = raw[head_end + 4:]
+            else:
+                head_end = raw.find(b"\n\n")
+                payload = raw[head_end + 2:] if head_end >= 0 else raw
+        if payload.endswith(b"\r\n"):
+            payload = payload[:-2]
+        elif payload.endswith(b"\n"):
+            payload = payload[:-1]
+        if payload:
+            parts.append(payload)
+    return parts
 
 
 class ServingServer(ThreadingHTTPServer):
@@ -131,20 +189,39 @@ class _Handler(BaseHTTPRequestHandler):
         return self.rfile.read(length)
 
     @staticmethod
-    def _decode_image(body: bytes, ctype: str) -> Optional[np.ndarray]:
-        """Body bytes → uint8 RGB array, or None if undecodable."""
+    def _decode_frames(body: bytes,
+                       ctype_full: str) -> Optional[list]:
+        """Body bytes → list of uint8 RGB arrays (one per frame), or None
+        if any frame is undecodable."""
+        ctype = ctype_full.split(";")[0].strip()
         if ctype == "application/json":
             try:
                 payload = json.loads(body)
-                b64 = payload.get("image_b64") or payload.get("image")
-                body = base64.b64decode(b64, validate=True)
-            except (ValueError, TypeError, KeyError, AttributeError):
-                return None        # AttributeError: valid non-dict JSON
-        try:
-            img = Image.open(io.BytesIO(body))
-            return np.asarray(img.convert("RGB"), np.uint8)
-        except Exception:                          # noqa: BLE001 — 400 path
-            return None
+                if not isinstance(payload, dict):
+                    return None
+                if "frames_b64" in payload:
+                    blobs = [base64.b64decode(b, validate=True)
+                             for b in payload["frames_b64"]]
+                else:
+                    b64 = payload.get("image_b64") or payload.get("image")
+                    blobs = [base64.b64decode(b64, validate=True)]
+            except (ValueError, TypeError, KeyError):
+                return None
+        elif ctype.startswith("multipart/"):
+            boundary = multipart_boundary(ctype_full)
+            if not boundary:
+                return None
+            blobs = split_multipart(body, boundary)
+        else:
+            blobs = [body]
+        frames = []
+        for blob in blobs:
+            try:
+                img = Image.open(io.BytesIO(blob))
+                frames.append(np.asarray(img.convert("RGB"), np.uint8))
+            except Exception:                      # noqa: BLE001 — 400 path
+                return None
+        return frames or None
 
     def do_POST(self) -> None:                    # noqa: N802 (stdlib API)
         t0 = time.monotonic()
@@ -159,17 +236,38 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond_json(503, {"error": "model warming up"},
                                extra_headers={"Retry-After": 1})
             return
-        ctype = (self.headers.get("Content-Type") or "") \
-            .split(";")[0].strip()
-        img = self._decode_image(body, ctype) if body else None
-        if img is None:
+        ctype_full = self.headers.get("Content-Type") or ""
+        frames = self._decode_frames(body, ctype_full) if body else None
+        if frames is None:
             self._respond_json(400, {"error": "undecodable image payload"})
             return
-        payload = prepare_canvas(img, srv.engine.image_size)
+        if len(frames) not in (1, srv.engine.img_num):
+            self._respond_json(
+                400, {"error": f"need 1 or img_num={srv.engine.img_num} "
+                               f"frames, got {len(frames)}"})
+            return
+        canvases = [prepare_canvas(f, srv.engine.image_size)
+                    for f in frames]
         if srv.engine.wire == "float32":
             # full CLI preprocess on the handler thread (bit-exact parity
-            # mode); the uint8 wire defers this to the device prologue
-            payload = normalize_replicate(payload, srv.engine.img_num)
+            # mode); the uint8 wire defers this to the device prologue.
+            # One frame replicates ×img_num (reference CLI semantics),
+            # img_num distinct frames concatenate into one temporal clip
+            # — both land on the same (·, ·, 3·img_num) float32 program.
+            if len(canvases) == 1:
+                payload = normalize_replicate(canvases[0],
+                                              srv.engine.img_num)
+            else:
+                payload = normalize_concat(canvases)
+        elif len(canvases) == 1:
+            payload = canvases[0]
+        else:
+            if not srv.engine.multi_frame:
+                self._respond_json(
+                    400, {"error": "multi-frame clips are disabled on "
+                                   "this uint8-wire engine"})
+                return
+            payload = np.concatenate(canvases, axis=-1)
         t_pre = time.monotonic() - t_body     # decode+canvas only
         srv.metrics.latency["preprocess"].observe(t_pre)
         try:
@@ -198,6 +296,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._respond_json(200, {
             "fake_score": float(scores[0]),
             "scores": [float(s) for s in scores],
+            "frames": len(frames),
             "timings_ms": {
                 "preprocess": round(t_pre * 1000, 3),
                 "queue": round(req.timings.get("queue", 0.0) * 1000, 3),

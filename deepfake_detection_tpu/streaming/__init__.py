@@ -1,0 +1,53 @@
+"""Streaming-video scoring subsystem: live streams in, verdicts out.
+
+Pipeline (one process, in front of the serving engine):
+
+``POST /streams/<id>/frames`` chunks → decode (native pool) →
+face localize + greedy-IoU track (``tracker``) → per-track temporal
+windows of ``img_num`` distinct frames (``windows``) → serving engine's
+AOT-warmed buckets → EMA + hysteresis verdict machines (``verdict``) →
+schema-versioned events + ``/metrics``.
+
+Entry point: ``python -m deepfake_detection_tpu.runners.stream``.
+
+PEP-562 lazy exports (the ``obs/`` idiom): importing the package does not
+pull jax/PIL — ``tracker``/``verdict``/``windows`` unit tests stay cheap
+and jax-free.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "FaceLocalizer": "tracker",
+    "FullFrameLocalizer": "tracker",
+    "CallableLocalizer": "tracker",
+    "GreedyIouTracker": "tracker",
+    "make_localizer": "tracker",
+    "register_localizer": "tracker",
+    "iou": "tracker",
+    "crop_box": "tracker",
+    "VerdictMachine": "verdict",
+    "VerdictThresholds": "verdict",
+    "TrackWindower": "windows",
+    "WindowDispatcher": "windows",
+    "WindowJob": "windows",
+    "build_payload": "windows",
+    "StreamingMetrics": "metrics",
+    "StreamManager": "ingest",
+    "StreamSession": "ingest",
+    "StreamServer": "ingest",
+    "make_stream_server": "ingest",
+    "FfmpegDemuxer": "ingest",
+    "parse_verdict_vector": "ingest",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Streaming-video scoring server (ISSUE 8; conventions mirror
+# scripts/serve.sh: MODEL_PATH env overrides the checkpoint, extra flags
+# pass through).
+python -m deepfake_detection_tpu.runners.stream \
+    --model-path "${MODEL_PATH:-../models/model_best.ckpt}" "$@"

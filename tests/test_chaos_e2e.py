@@ -154,6 +154,8 @@ def test_nanbatch_burst_skips_then_rewinds(tmp_path, uninterrupted):
         "skip+rewind diverged from the uninterrupted run")
 
 
+@pytest.mark.slow   # tier-1 budget: subprocess CLI run (~25s);
+# the sigterm + nanbatch tests keep the core recovery paths fast
 def test_loader_stall_trips_watchdog(tmp_path):
     out = tmp_path / "out"
     r = _launch(_one_epoch(_BASE) + ["--experiment", "run",
@@ -167,6 +169,7 @@ def test_loader_stall_trips_watchdog(tmp_path):
     assert "Thread" in r.stderr               # the all-threads stack dump
 
 
+@pytest.mark.slow   # tier-1 budget: two subprocess CLI runs (~42s)
 def test_truncated_recovery_falls_back_to_previous(tmp_path,
                                                    uninterrupted):
     out = tmp_path / "out"

@@ -321,6 +321,8 @@ class TestModelEquivalence:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-3, atol=1e-5)
 
+    @pytest.mark.slow   # tier-1 budget: whole-model interpret-mode grads (~39s);
+    # the per-kernel vjp parity sweep keeps gradient coverage fast
     def test_train_grads_match(self, setup):
         stock, fused, _, v, _ = setup
         x = self._XTRAIN

@@ -89,7 +89,9 @@ def test_train_step_on_mesh(mesh8):
     assert np.isfinite(float(metrics["loss"]))
 
 
-@pytest.mark.parametrize("policy", ["full", "dots"])
+@pytest.mark.parametrize("policy", [
+    pytest.param("full", marks=pytest.mark.slow),   # tier-1 budget
+    "dots"])
 def test_remat_matches_baseline(policy):
     base = create_model("timesformer_tiny_patch16_224", num_classes=2,
                         in_chans=12)

@@ -103,7 +103,9 @@ def test_vit_synthetic_e2e_train(tmp_path, devices):
     assert out["best_metric"] is not None
 
 
-@pytest.mark.parametrize("policy", ["full", "dots"])
+@pytest.mark.parametrize("policy", [
+    pytest.param("full", marks=pytest.mark.slow),   # tier-1 budget
+    "dots"])
 def test_vit_remat_matches_baseline(policy):
     """remat changes the backward schedule, not the math."""
     base = create_model("vit_tiny_patch16_224", num_classes=2)

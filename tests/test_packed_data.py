@@ -414,3 +414,99 @@ def test_pack_dataset_cli(tmp_path):
     with open(os.path.join(pack, PACK_INDEX)) as f:
         index = json.load(f)
     assert index["complete"] and len(index["clips"]) == 6
+
+
+# ---------------------------------------------------------------------------
+# Resolution-generic pack format (ISSUE 8 satellite): the ROADMAP claims
+# the pack layout works at ANY uniform frame geometry (needed later for
+# detector-training face crops) — pin it with non-square / odd
+# resolutions through the full pack → load → transform round trip.
+# ---------------------------------------------------------------------------
+
+def _make_rect_clip_tree(root, h, w, n_real=2, n_fake=2, frames=4):
+    os.makedirs(root, exist_ok=True)
+    g = np.random.default_rng(5)
+    for kind, n in (("real", n_real), ("fake", n_fake)):
+        lines = []
+        for i in range(n):
+            d = os.path.join(root, kind, f"{kind}clip{i}")
+            os.makedirs(d, exist_ok=True)
+            for j in range(frames):
+                Image.fromarray(g.integers(0, 255, (h, w, 3),
+                                           dtype=np.uint8)).save(
+                    os.path.join(d, f"{j}.jpg"))
+            lines.append(f"{kind}clip{i}:{frames}")
+        with open(os.path.join(root, f"{kind}_list.txt"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+class TestResolutionGeneric:
+    # (H, W): landscape, portrait, both odd — none square, none the
+    # flagship 600
+    @pytest.mark.parametrize("hw", [(36, 52), (29, 23), (37, 41)])
+    def test_nonsquare_pack_load_round_trip_bit_identical(self, tmp_path,
+                                                          hw):
+        h, w = hw
+        root = str(tmp_path / "clips")
+        _make_rect_clip_tree(root, h, w)
+        pack = str(tmp_path / "pack")
+        # image_size=0: keep the native (uniform) resolution — the
+        # bit-identity condition, at a geometry the flagship never uses
+        state = write_pack([root], pack, image_size=0, shard_size=3)
+        assert state.get("complete")
+        assert [int(v) for v in state["sample_hw"]] == [h, w]
+        assert verify_pack(pack) == []
+
+        ds = DeepFakeClipDataset([root])
+        pk = PackedDataset(pack, roots=[root])
+        assert pk.packed_hw == (h, w)
+        assert len(pk) == len(ds) == 4
+        v = pk.sample_array(0)
+        assert v.shape == (h, w, 12) and v.dtype == np.uint8
+        assert not v.flags.writeable and v.base is not None   # mmap view
+
+        crop = min(h, w) - 5                   # odd crop inside both dims
+        for chain in ("eval", "train"):
+            tf = (transforms_deepfake_eval_v3(crop) if chain == "eval"
+                  else transforms_deepfake_train_v3(crop, color_jitter=None,
+                                                    rotate_range=5))
+            dsx = DeepFakeClipDataset([root], transform=tf)
+            pkx = PackedDataset(pack, roots=[root], transform=tf)
+            for e in range(2):
+                dsx.set_epoch(e)
+                pkx.set_epoch(e)
+                for i in range(len(dsx)):
+                    r1 = np.random.default_rng(
+                        np.random.SeedSequence([3, e, i]))
+                    r2 = np.random.default_rng(
+                        np.random.SeedSequence([3, e, i]))
+                    a, la = dsx.__getitem__(i, rng=r1)
+                    b, lb = pkx.__getitem__(i, rng=r2)
+                    assert la == lb
+                    np.testing.assert_array_equal(
+                        np.asarray(a), np.asarray(b),
+                        err_msg=f"hw={hw} chain={chain} e={e} i={i}")
+
+    def test_mixed_resolution_sources_rejected_loudly(self, tmp_path):
+        """image_size=0 requires a uniform source geometry — drift inside
+        one tree must fail the pack, not write skewed strides."""
+        root = str(tmp_path / "clips")
+        _make_rect_clip_tree(root, 36, 52, n_real=1, n_fake=1)
+        odd = os.path.join(root, "real", "realclip0", "0.jpg")
+        Image.fromarray(np.zeros((20, 52, 3), np.uint8)).save(odd)
+        with pytest.raises(Exception) as ei:
+            write_pack([root], str(tmp_path / "pack"), image_size=0,
+                       shard_size=3)
+        assert "resolution" in str(ei.value).lower() or \
+            "size" in str(ei.value).lower()
+
+    def test_pack_image_size_flag_mismatch_names_geometry(self, tmp_path):
+        """--pack-image-size asserts a SQUARE pack; against a non-square
+        pack it must fail loudly naming the packed geometry."""
+        root = str(tmp_path / "clips")
+        _make_rect_clip_tree(root, 36, 52)
+        pack = str(tmp_path / "pack")
+        write_pack([root], pack, image_size=0, shard_size=3)
+        with pytest.raises(PackedCacheStale) as ei:
+            PackedDataset(pack, roots=[root], image_size=36)
+        assert "52x36" in str(ei.value) or "36x52" in str(ei.value)

@@ -425,6 +425,7 @@ class TestLoaderFastForward:
             for xa, xb in zip(a, b):
                 np.testing.assert_array_equal(xa, xb)
 
+    @pytest.mark.slow   # tier-1 budget: spawned-worker kill/respawn (~18s)
     def test_shm_chaos_worker_kill_recovers_identically(self, monkeypatch):
         want = None
         full = self._make(backend="shm")

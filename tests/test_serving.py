@@ -222,6 +222,167 @@ def test_zero_recompiles_across_mixed_batch_sizes(stack):
 
 
 # ---------------------------------------------------------------------------
+# multi-frame wire path (ISSUE 8 satellite): img_num DISTINCT frames
+# channel-concatenate into one temporal clip
+# ---------------------------------------------------------------------------
+
+def test_float32_wire_concat_of_identical_bit_identical_to_replicate():
+    """The parity contract: a clip of img_num copies of one frame must
+    score bit-identically to the single-frame replicate path.  On the
+    float32 wire this is structural — ``normalize_concat`` of identical
+    frames IS ``normalize_replicate`` byte-for-byte, and both payloads
+    ride the same compiled bucket program."""
+    from deepfake_detection_tpu.params import normalize_concat
+
+    size, num = 24, 2
+    model = create_model(_MODEL, num_classes=2, in_chans=3 * num)
+    variables = _perturbed_variables(model, size, 3 * num, seed=3)
+    engine = InferenceEngine(model, variables, image_size=size,
+                             img_num=num, buckets=(1, 2), wire="float32")
+    canvas = prepare_canvas(np.random.default_rng(0).integers(
+        0, 255, (48, 40, 3), dtype=np.uint8), size)
+    np.testing.assert_array_equal(normalize_concat([canvas] * num),
+                                  normalize_replicate(canvas, num))
+    rep = engine.score_batch([normalize_replicate(canvas, num)])
+    cat = engine.score_batch([normalize_concat([canvas] * num)])
+    np.testing.assert_array_equal(rep, cat)
+    # distinct frames actually flow into distinct channels
+    other = prepare_canvas(np.random.default_rng(9).integers(
+        0, 255, (48, 40, 3), dtype=np.uint8), size)
+    distinct = engine.score_batch([normalize_concat([canvas, other])])
+    assert not np.array_equal(rep, distinct)
+
+
+def test_uint8_wire_multi_frame_program_bit_identical_to_replicate():
+    """uint8 wire: the multi-frame executable (normalize with ×img_num
+    tiled mean/std, no in-program replication) must reproduce the
+    replicate executable bit-for-bit on a clip of identical frames —
+    the prologues are elementwise-identical arithmetic, and the model
+    subprogram is the same HLO."""
+    size, num = 24, 2
+    model = create_model(_MODEL, num_classes=2, in_chans=3 * num)
+    variables = _perturbed_variables(model, size, 3 * num, seed=3)
+    engine = InferenceEngine(model, variables, image_size=size,
+                             img_num=num, buckets=(1, 2), wire="uint8")
+    assert engine.multi_frame
+    assert engine.compile_count == 4          # 2 buckets × {rep, multi}
+    canvas = prepare_canvas(np.random.default_rng(1).integers(
+        0, 255, (48, 40, 3), dtype=np.uint8), size)
+    rep = engine.score_batch([canvas])
+    cat = engine.score_batch([np.concatenate([canvas] * num, axis=-1)])
+    np.testing.assert_array_equal(rep, cat)
+    # unknown channel widths are a hard error, never a silent compile
+    with pytest.raises(ValueError):
+        engine.score_batch([np.zeros((size, size, 9), np.uint8)])
+
+
+def test_uint8_wire_mixed_single_and_multi_batch_splits_correctly():
+    """A coalesced batch mixing single-frame and multi-frame requests
+    splits into per-width sub-batches; every request resolves with the
+    scores of its own group's bucket (bitwise — same bucket, same
+    program; solo bucket-1 calls may differ in the last ulp, which is the
+    documented cross-bucket caveat)."""
+    size, num = 24, 2
+    model = create_model(_MODEL, num_classes=2, in_chans=3 * num)
+    variables = _perturbed_variables(model, size, 3 * num, seed=3)
+    engine = InferenceEngine(model, variables, image_size=size,
+                             img_num=num, buckets=(1, 2, 4), wire="uint8")
+    batcher = MicroBatcher(max_batch=4, deadline_ms=20.0, max_queue=16,
+                           metrics=engine.metrics)
+    try:
+        rng = np.random.default_rng(5)
+        singles = [prepare_canvas(rng.integers(0, 255, (40, 36, 3),
+                                               dtype=np.uint8), size)
+                   for _ in range(2)]
+        multis = [np.concatenate(
+            [prepare_canvas(rng.integers(0, 255, (40, 36, 3),
+                                         dtype=np.uint8), size)
+             for _ in range(num)], axis=-1) for _ in range(2)]
+        want = list(engine.score_batch(singles)) + \
+            list(engine.score_batch(multis))
+        # queue everything BEFORE the worker starts so all four coalesce
+        # into ONE mixed batch deterministically
+        reqs = [batcher.submit(a, timeout_s=10)
+                for a in singles + multis]
+        engine.start(batcher)
+        got = [r.result(timeout=10) for r in reqs]
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+    finally:
+        engine.stop()
+        batcher.close()
+
+
+def test_http_multi_frame_clip_scoring(stack):
+    """JSON ``frames_b64`` transport: img_num identical frames reproduce
+    the single-frame score exactly; a wrong frame count is a 400.
+    (The module stack runs img_num=1, so 'multi' degenerates to a
+    1-element list — the dedicated engines above cover img_num>1; here
+    the wire plumbing + validation are under test.)"""
+    jpeg = _jpeg_bytes(seed=21)
+    status, single = _post(stack.port, "/score", jpeg, "image/jpeg")
+    assert status == 200 and single["frames"] == 1
+    payload = json.dumps(
+        {"frames_b64": [base64.b64encode(jpeg).decode()]}).encode()
+    status, multi = _post(stack.port, "/score", payload,
+                          "application/json")
+    assert status == 200 and multi["frames"] == 1
+    assert multi["fake_score"] == single["fake_score"]
+    # frame count must be 1 or img_num (=1 here): 2 frames is a 400
+    bad = json.dumps({"frames_b64": [base64.b64encode(jpeg).decode()] * 2
+                      }).encode()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(stack.port, "/score", bad, "application/json")
+    assert ei.value.code == 400
+
+
+def test_http_multipart_clip_matches_json_clip():
+    """End-to-end multi-frame HTTP parity on an img_num=2 float32 server:
+    multipart parts and JSON frames_b64 land identical scores, and a clip
+    of identical frames equals the replicate path exactly."""
+    size, num = 24, 2
+    model = create_model(_MODEL, num_classes=2, in_chans=3 * num)
+    variables = _perturbed_variables(model, size, 3 * num, seed=11)
+    metrics = ServingMetrics()
+    engine = InferenceEngine(model, variables, image_size=size,
+                             img_num=num, buckets=(1, 2), metrics=metrics,
+                             wire="float32")
+    batcher = MicroBatcher(max_batch=2, deadline_ms=10.0, max_queue=8,
+                           metrics=metrics)
+    engine.start(batcher)
+    server = make_server("127.0.0.1", 0, engine, batcher, metrics,
+                         request_timeout_s=10.0)
+    serve_forever_in_thread(server)
+    port = server.server_address[1]
+    try:
+        j1, j2 = _jpeg_bytes(seed=1), _jpeg_bytes(seed=2)
+        payload = json.dumps({"frames_b64": [
+            base64.b64encode(j).decode() for j in (j1, j2)]}).encode()
+        status, via_json = _post(port, "/score", payload,
+                                 "application/json")
+        assert status == 200 and via_json["frames"] == 2
+        body = b"".join(
+            b"--clip\r\nContent-Type: image/jpeg\r\n\r\n" + j + b"\r\n"
+            for j in (j1, j2)) + b"--clip--\r\n"
+        status, via_mp = _post(port, "/score", body,
+                               "multipart/form-data; boundary=clip")
+        assert status == 200 and via_mp["frames"] == 2
+        assert via_mp["fake_score"] == via_json["fake_score"]
+        # identical-frames clip == replicate path, over HTTP
+        rep_status, rep = _post(port, "/score", j1, "image/jpeg")
+        same = json.dumps({"frames_b64": [
+            base64.b64encode(j1).decode()] * 2}).encode()
+        status, cat = _post(port, "/score", same, "application/json")
+        assert cat["fake_score"] == rep["fake_score"]
+        assert cat["scores"] == rep["scores"]
+    finally:
+        server.shutdown()
+        engine.stop()
+        batcher.close()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
 # micro-batching behavior
 # ---------------------------------------------------------------------------
 

@@ -19,6 +19,7 @@ pytestmark = [pytest.mark.smoke, pytest.mark.pallas]
 _REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 
 
+@pytest.mark.slow   # tier-1 budget: subprocess bench smoke (~33s)
 def test_bench_blocks_smoke_emits_full_matrix():
     # share the suite's persistent compilation cache (conftest.py): the
     # XLA step/stem programs dominate the smoke's runtime and cache across

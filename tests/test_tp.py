@@ -130,6 +130,7 @@ def _launch_cli(args):
     return json.loads(line[len("RESULT "):])
 
 
+@pytest.mark.slow   # tier-1 budget: fresh-interpreter CLI phases (~33s)
 def test_tp_cli_e2e(tmp_path, devices):
     """--tp-size from the CLI: dp(2)xtp(4) synthetic smoke train."""
     out = _launch_cli([

@@ -356,6 +356,8 @@ def test_attn_impl_cli_flag():
         build_model(cfg, 3)
 
 
+@pytest.mark.slow   # tier-1 budget: full profiled training run (~40s);
+# the obs profiler-capture units keep trigger coverage fast
 def test_profile_flag_writes_trace(tmp_path, devices):
     """--profile N produces a jax.profiler trace directory (SURVEY §5)."""
     from deepfake_detection_tpu.runners.train import launch_main
