@@ -262,6 +262,14 @@ class TrainConfig:
     rotate_range: float = 0.0
     blur_prob: float = 0.0
     flicker: float = 0.0
+    # 'on' moves the remaining host augment — the fused geometric warp,
+    # per-frame Gaussian blur, and the mixup blend — into the loader's
+    # jitted device prologue, keyed by the same absolute (seed, epoch,
+    # index) RNG streams (data/device_augment.py); the host then only
+    # memcpys raw source clips into slabs.  'off' keeps the host chain
+    # (the parity escape hatch).  Host-only stages (AugMix aug-splits,
+    # hue jitter) fall back to the host chain with a log line.
+    augment_device: str = "off"
 
     # --- batch norm ---
     sync_bn: bool = False
@@ -378,6 +386,17 @@ class TrainConfig:
         if self.guard_nonfinite not in ("off", "skip"):
             raise ValueError("guard_nonfinite must be off|skip, got "
                              f"{self.guard_nonfinite!r}")
+        if self.augment_device not in ("off", "on"):
+            raise ValueError("augment_device must be off|on, got "
+                             f"{self.augment_device!r}")
+        if self.augment_device == "on" and self.host_geom:
+            raise ValueError("--augment-device on renders the geometric "
+                             "warp on device; it conflicts with the "
+                             "--host-geom parity escape hatch — pick one")
+        if self.augment_device == "on" and self.host_color_jitter:
+            raise ValueError("--augment-device on leaves no host transform "
+                             "stage for --host-color-jitter to run in — "
+                             "pick one")
         if self.fused_depthwise not in ("off", "pallas"):
             raise ValueError("fused_depthwise must be off|pallas, got "
                              f"{self.fused_depthwise!r}")

@@ -25,6 +25,7 @@ from .samplers import (OrderedShardedSampler, ShardedTrainSampler,
                        epoch_batches)
 from .shm_ring import ShmRing, ShmRingLoader
 from .transforms_factory import (create_transform, transforms_deepfake_eval_v3,
+                                 transforms_deepfake_train_passthrough,
                                  transforms_deepfake_train_v3,
                                  transforms_imagenet_eval,
                                  transforms_imagenet_train)

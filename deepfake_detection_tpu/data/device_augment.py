@@ -1,4 +1,4 @@
-"""Device-side color augmentation (the host PIL jitter moved into the step).
+"""Device-side augmentation (host PIL/native stages moved into the prologue).
 
 The reference applies ColorJitter/Flicker on the host with PIL
 (``dfd/timm/data/transforms.py:332-350``) — per-pixel python-driven work
@@ -28,15 +28,234 @@ is kept fractional, and the PRNG stream differs (explicit-PRNG design).
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence
+import dataclasses
+import math
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-__all__ = ["make_device_color_jitter"]
+__all__ = ["make_device_color_jitter", "DeviceAugmentSpec",
+           "derive_geometric_batch", "derive_mixup_lam",
+           "make_device_geometric", "make_device_blur", "device_mixup_blend"]
 
 _LUMA = (0.299, 0.587, 0.114)          # PIL convert("L"), ITU-R 601-2
+
+
+# ---------------------------------------------------------------------------
+# Full device-side augmentation (--augment-device on)
+#
+# The geometric warp, per-frame Gaussian blur, and the mixup blend leave
+# the host transform chain and run inside the DeviceLoader's single jitted
+# prologue.  Parameters stay keyed by the SAME absolute numpy RNG streams
+# the host chain draws from — per-sample ``(seed, epoch, index)`` for
+# warp/blur, per-batch ``(seed, epoch, batch_index, 0x77)`` for mixup —
+# derived on the consumer side (derive_* below) while the host passthrough
+# transform consumes the identical draws for stream-position parity
+# (transforms.DeviceAugmentPassthrough).  That keying is what makes PR 3's
+# bit-continuous mid-epoch resume and ``fast_forward`` survive unchanged:
+# every parameter is a pure function of absolute position, never of
+# iteration history.
+#
+# Numerics, pinned by tests/test_device_augment.py:
+#
+# * warp — float32 bilinear gather, taps outside the source read 0 (the
+#   native kernel's black fill), output rounded to the integer grid like
+#   the uint8 host path.  Integer-coefficient affines (flip/crop/pad, the
+#   scale==1/rotate==0 case) are BIT-exact vs the host chain; fractional
+#   coords differ from the native fixed-point kernel (8-bit weights) by
+#   the documented resampling tolerance only.
+# * blur — true separable Gaussian (sigma = radius, the documented PIL
+#   parameter semantics), clamp-to-edge, 3σ support, rounded.  PIL itself
+#   approximates the Gaussian with a 3-pass extended box filter whose
+#   fixed-point internals vary across Pillow versions, so parity here is
+#   tolerance-based by design (documented in the parity suite).
+# * mixup — bit-exact vs FastCollateMixup: each scalar is split into
+#   high/low mantissa halves so every product is exactly representable
+#   and XLA's fma contraction cannot change the rounded sum
+#   (device_mixup_blend below).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceAugmentSpec:
+    """Static description of the device-side train augmentation.
+
+    Built by the loader factory; consumed by the DeviceLoader both on the
+    host (parameter derivation from the absolute RNG streams) and inside
+    the jitted prologue (warp/blur/mixup rendering).  ``mixup_blocks`` is
+    the number of process-local sub-batches the mixup flip must respect:
+    the host collate mixes within each process's local batch, so the
+    device blend flips within the matching global-batch blocks.
+    """
+    size: Tuple[int, int]                # (th, tw) output crop
+    rotate_range: int = 0
+    scale: Tuple[float, float] = (2.0 / 3, 3.0 / 2.0)
+    p_flip: float = 0.5
+    blur_prob: float = 0.0
+    blur_radius: float = 1.0
+    img_num: int = 4
+    mixup: bool = False                  # device-side blend active
+    mixup_alpha: float = 0.0
+    mixup_blocks: int = 1
+
+    @property
+    def host_stages_elided(self) -> int:
+        """Host-chain stages this spec moves on device, per sample (the
+        telemetry counter's increment): geometric warp, blur, mixup."""
+        return 1 + (1 if self.blur_prob > 0.0 else 0) + \
+            (1 if self.mixup else 0)
+
+
+def derive_geometric_batch(spec: DeviceAugmentSpec, indices, seed: int,
+                           epoch: int, src_hw: Tuple[int, int]
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+    """(coeffs (B, 6) f32, blur mask (B, F) bool) for one batch.
+
+    Must draw exactly what the host chain would: one
+    ``fused_geometric_params`` + ``blur_mask_draws`` per sample from the
+    per-sample ``(seed, epoch, index)`` generator — the same calls the
+    host passthrough consumes worker-side, so the two cannot drift.
+    """
+    from .transforms import blur_mask_draws, fused_geometric_params
+    h, w = src_hw
+    coeffs = np.empty((len(indices), 6), np.float32)
+    blur = np.zeros((len(indices), spec.img_num), bool)
+    for i, idx in enumerate(indices):
+        rng = np.random.default_rng(
+            np.random.SeedSequence([seed, epoch, int(idx)]))
+        coeffs[i] = fused_geometric_params(
+            w, h, spec.size, spec.rotate_range, spec.scale, spec.p_flip,
+            rng)
+        if spec.blur_prob > 0.0:
+            blur[i] = blur_mask_draws(spec.img_num, spec.blur_prob, rng)
+    return coeffs, blur
+
+
+def derive_mixup_lam(seed: int, epoch: int, batch_index: int, alpha: float,
+                     enabled: bool) -> Tuple[np.float32, np.float32]:
+    """(lam, 1-lam) from FastCollateMixup's exact per-batch stream.
+
+    The generator seed ``[seed, epoch, batch_index, 0x77]`` and the
+    single beta draw are byte-for-byte the host collate's (loader.py /
+    shm_ring.py), so the device blend and the host-computed soft targets
+    share one lambda.  ``1 - lam`` is formed in float64 BEFORE the f32
+    cast, matching numpy's scalar arithmetic in the host blend.
+    """
+    lam = 1.0
+    if enabled:
+        rng = np.random.default_rng(np.random.SeedSequence(
+            [seed, epoch, batch_index, 0x77]))
+        lam = float(rng.beta(alpha, alpha))
+    return np.float32(lam), np.float32(1.0 - lam)
+
+
+def make_device_geometric(spec: DeviceAugmentSpec) -> Callable:
+    """``fn(x_uint8 (B, Hs, Ws, 3F), coeffs (B, 6)) -> f32 (B, th, tw, 3F)``.
+
+    One bilinear gather per output pixel — rotate, flip, resize, crop and
+    pad_if_needed composed into the index-space affine the host chain
+    computes (transforms.fused_geometric_params).  Out-of-bounds taps
+    contribute 0 (native kernel black fill); output is rounded onto the
+    integer grid like every uint8 host stage.
+    """
+    th, tw = spec.size
+    yy, xx = np.mgrid[0:th, 0:tw].astype(np.float32)
+
+    def one(img, coef):                    # (Hs, Ws, C), (6,)
+        hs, ws = img.shape[0], img.shape[1]
+        sx = coef[0] * xx + coef[1] * yy + coef[2]
+        sy = coef[3] * xx + coef[4] * yy + coef[5]
+        x0 = jnp.floor(sx)
+        y0 = jnp.floor(sy)
+        fx = sx - x0
+        fy = sy - y0
+        x0i = x0.astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+
+        def tap(yi, xi):
+            inb = (yi >= 0) & (yi < hs) & (xi >= 0) & (xi < ws)
+            v = img[jnp.clip(yi, 0, hs - 1),
+                    jnp.clip(xi, 0, ws - 1)].astype(jnp.float32)
+            return jnp.where(inb[..., None], v, 0.0)
+
+        out = (tap(y0i, x0i) * ((1 - fx) * (1 - fy))[..., None]
+               + tap(y0i, x0i + 1) * (fx * (1 - fy))[..., None]
+               + tap(y0i + 1, x0i) * ((1 - fx) * fy)[..., None]
+               + tap(y0i + 1, x0i + 1) * (fx * fy)[..., None])
+        return jnp.round(out)
+
+    return jax.vmap(one)
+
+
+def _gaussian_taps(radius: float) -> np.ndarray:
+    """Normalized 1-D Gaussian taps, sigma = radius (PIL's documented
+    parameter semantics), support 3σ."""
+    sigma = max(float(radius), 1e-3)
+    r = max(1, int(math.ceil(3.0 * sigma)))
+    xs = np.arange(-r, r + 1, dtype=np.float64)
+    k = np.exp(-(xs * xs) / (2.0 * sigma * sigma))
+    return (k / k.sum()).astype(np.float32)
+
+
+def make_device_blur(spec: DeviceAugmentSpec) -> Callable:
+    """``fn(x f32 (B, H, W, 3F), mask (B, F) bool) -> f32`` — separable
+    Gaussian per frame where the per-frame host coin fired, clamp-to-edge
+    padding (PIL extends edge pixels), rounded like the uint8 host stage;
+    unblurred frames pass through untouched (bit-exactness preserved)."""
+    taps = _gaussian_taps(spec.blur_radius)
+    r = (len(taps) - 1) // 2
+    fr = spec.img_num
+
+    def apply(x, mask):                    # (B, H, W, 3F), (B, F)
+        b, h, w, c = x.shape
+        xp = jnp.pad(x, ((0, 0), (r, r), (0, 0), (0, 0)), mode="edge")
+        blurred = sum(taps[i] * lax.dynamic_slice_in_dim(xp, i, h, axis=1)
+                      for i in range(len(taps)))
+        xp = jnp.pad(blurred, ((0, 0), (0, 0), (r, r), (0, 0)), mode="edge")
+        blurred = sum(taps[i] * lax.dynamic_slice_in_dim(xp, i, w, axis=2)
+                      for i in range(len(taps)))
+        blurred = jnp.round(blurred)
+        sel = jnp.repeat(mask, 3, axis=-1)[:, None, None, :]  # (B,1,1,3F)
+        return jnp.where(sel, blurred, x)
+
+    return apply
+
+
+def _split_f32(c):
+    """Split an f32 scalar into (high, low) halves with ≤12-bit mantissas
+    each, so products against 8-bit integer-valued pixels are EXACT in
+    f32 — which makes XLA's fma contraction value-preserving and the
+    blend below bit-identical to numpy's mul-round/add-round sequence."""
+    ci = lax.bitcast_convert_type(c, jnp.int32)
+    hi = lax.bitcast_convert_type(ci & ~jnp.int32(0xFFF), jnp.float32)
+    return hi, c - hi
+
+
+def device_mixup_blend(x, lam, one_minus_lam, blocks: int = 1):
+    """FastCollateMixup's uint8 blend, on device, bit-exact.
+
+    ``x`` is the (B, H, W, C) float batch with integer-valued pixels
+    (every upstream device stage rounds onto the uint8 grid); ``blocks``
+    partitions the batch into process-local sub-batches so the flip
+    matches the host collate's per-process ``images[::-1]`` under
+    multi-host sharding.  Returns the rounded blend (still float — the
+    prologue normalizes next, exactly where the host path's uint8 batch
+    would enter).
+    """
+    if blocks > 1:
+        shp = x.shape
+        rev = jnp.flip(x.reshape((blocks, shp[0] // blocks) + shp[1:]),
+                       axis=1).reshape(shp)
+    else:
+        rev = jnp.flip(x, axis=0)
+    lh, ll = _split_f32(lam)
+    oh, ol = _split_f32(one_minus_lam)
+    p1 = x * lh + x * ll                  # == RN(x·lam): exact products
+    p2 = rev * oh + rev * ol              # == RN(rev·(1-lam))
+    return jnp.round(p1 + p2)
 
 
 def make_device_color_jitter(color_jitter: Optional[Sequence[float]],

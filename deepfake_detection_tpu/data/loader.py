@@ -60,13 +60,15 @@ class LoaderStats:
     thread; telemetry reads are torn-proof float loads under the GIL).
     """
 
-    __slots__ = ("batches", "host_wait_s", "stage_block_s")
+    __slots__ = ("batches", "host_wait_s", "stage_block_s", "augment_elided")
 
     def __init__(self):
         self.batches = 0        # batches staged to device
         self.host_wait_s = 0.0  # blocked in next(host_loader) — input starved
         self.stage_block_s = 0.0  # blocked in the slab-recycle
         # block_until_ready — prologue/staging backpressure (device busy)
+        self.augment_elided = 0  # host augment stages elided by
+        # --augment-device (samples x stages moved into the prologue)
 
 
 class HostLoaderStats:
@@ -243,7 +245,7 @@ class DeviceLoader:
                  img_num: int = 4, seed: int = 0,
                  sharding: Optional[Any] = None,
                  color_jitter=None, flicker: float = 0.0,
-                 stem_s2d: bool = False):
+                 stem_s2d: bool = False, device_augment: Optional[Any] = None):
         self.loader = loader
         self.img_num = img_num
         self.stem_s2d = stem_s2d
@@ -251,6 +253,12 @@ class DeviceLoader:
         self.sharding = sharding
         self.seed = seed
         self.stats = LoaderStats()
+        # --augment-device on: a DeviceAugmentSpec (device_augment.py); the
+        # host transform is then the raw-source passthrough and warp/blur/
+        # mixup render here, keyed by the absolute (seed, epoch, index) /
+        # (seed, epoch, batch) numpy streams the host chain would draw from
+        self._augment = device_augment
+        self.augment_device = device_augment is not None
         mean = np.tile(np.asarray(mean, np.float32) * 255.0, img_num)
         std = np.tile(np.asarray(std, np.float32) * 255.0, img_num)
         self._mean = mean.reshape(1, 1, 1, -1)
@@ -272,14 +280,46 @@ class DeviceLoader:
             from ..ops.conv import space_to_depth
         else:
             space_to_depth = None
+        if device_augment is not None:
+            from .device_augment import (device_mixup_blend, make_device_blur,
+                                         make_device_geometric)
+            warp = make_device_geometric(device_augment)
+            blur = make_device_blur(device_augment) \
+                if device_augment.blur_prob > 0.0 else None
+            mix_blocks = device_augment.mixup_blocks
+            mix_on = device_augment.mixup
+        else:
+            warp = blur = None
+            device_mixup_blend = None
+            mix_blocks, mix_on = 1, False
 
-        def prologue(images, key):
+        # ONE jitted prologue — single dispatch per batch.  Documented op
+        # order (augment → normalize → s2d): warp → blur → jitter/flicker →
+        # mixup blend → cast → normalize → RandomErasing → s2d pixel
+        # shuffle.  That is the host chain's order (geometric → blur →
+        # jitter → flicker → collate mixup → prologue), with the s2d stem
+        # shuffle folded in last exactly as the two-stage path applied it
+        # after normalize.
+        def prologue(images, key, geom=None, blur_mask=None,
+                     lam=None, one_minus_lam=None):
             # jitter operates in 0..255 float space BEFORE normalize, like
             # the host PIL chain it replaces (device_augment.py)
             jkey, ekey = jax.random.split(key)
-            x = images.astype(jnp.float32 if jitter is not None else dtype)
-            if jitter is not None:
-                x = jitter(x, jkey).astype(dtype)
+            if warp is not None:
+                x = warp(images, geom)             # f32, integer-valued
+                if blur is not None:
+                    x = blur(x, blur_mask)
+                if jitter is not None:
+                    x = jitter(x, jkey)
+                if mix_on:
+                    x = device_mixup_blend(x, lam, one_minus_lam,
+                                           mix_blocks)
+                x = x.astype(dtype)
+            else:
+                x = images.astype(jnp.float32 if jitter is not None
+                                  else dtype)
+                if jitter is not None:
+                    x = jitter(x, jkey).astype(dtype)
             x = (x.astype(dtype) - mean_j.astype(dtype)) / std_j.astype(dtype)
             if erasing is not None:
                 x = erasing(ekey, x).astype(dtype)
@@ -353,12 +393,37 @@ class DeviceLoader:
             return put_process_local(arr, self.sharding)
         return jax.device_put(arr)
 
-    def _stage(self, item, base_key):
+    def _stage(self, item, base_key, batch_index: int = 0,
+               indices: Optional[Sequence[int]] = None):
         """device_put + dispatch the prologue for one host batch."""
         images, targets = item[0], item[1]
         key = jax.random.fold_in(base_key, self._step)
         self._step += 1
-        x = self._prologue(self._put(images), key)
+        if self._augment is not None:
+            from .device_augment import (derive_geometric_batch,
+                                         derive_mixup_lam)
+            if indices is None or len(indices) != images.shape[0]:
+                raise RuntimeError(
+                    "--augment-device: per-sample indices out of step with "
+                    f"the host batch ({None if indices is None else len(indices)} "
+                    f"vs {images.shape[0]} rows)")
+            geom, blur_mask = derive_geometric_batch(
+                self._augment, indices, self.loader.seed, self.loader.epoch,
+                images.shape[1:3])
+            if self._augment.mixup:
+                cm = self.loader.collate_mixup
+                lam, om = derive_mixup_lam(
+                    self.loader.seed, self.loader.epoch, batch_index,
+                    self._augment.mixup_alpha,
+                    bool(cm is not None and cm.mixup_enabled))
+            else:
+                lam, om = np.float32(1.0), np.float32(0.0)
+            x = self._prologue(self._put(images), key, self._put(geom),
+                               self._put(blur_mask), lam, om)
+            self.stats.augment_elided += \
+                images.shape[0] * self._augment.host_stages_elided
+        else:
+            x = self._prologue(self._put(images), key)
         # targets/valid views may be ring-slab backed: small, copy before
         # the put so slot recycling can never touch them
         y = self._put(np.array(targets))
@@ -368,6 +433,16 @@ class DeviceLoader:
 
     def __iter__(self):
         base_key = jax.random.PRNGKey(self.seed)
+        batches = None
+        if self._augment is not None:
+            # the device side re-derives each sample's augment parameters
+            # from (seed, epoch, index): recompute the host loaders' exact
+            # (epoch, batch) → indices mapping (epoch_batches is a pure
+            # function of the shared sampler state, and both backends
+            # front-end through it)
+            batches, _ = epoch_batches(self.loader.sampler,
+                                       self.loader.batch_size, False)
+        bi = getattr(self.loader, "start_batch", 0)
         it = iter(self.loader)
         # double buffering: stage batch k+1 (host→device transfer +
         # prologue dispatch) BEFORE yielding batch k, so the transfer
@@ -392,7 +467,10 @@ class DeviceLoader:
                 stats.host_wait_s += time.monotonic() - t0
             except StopIteration:
                 break
-            staged = self._stage(item, base_key)
+            staged = self._stage(item, base_key, batch_index=bi,
+                                 indices=None if batches is None
+                                 else batches[bi])
+            bi += 1
             stats.batches += 1
             if pending is not None:
                 prev_x = staged[0]
@@ -534,13 +612,14 @@ def create_deepfake_loader_v3(
         num_shards: int = 1, shard_index: int = 0,
         collate_mixup: Optional[FastCollateMixup] = None,
         dtype: Any = jnp.bfloat16, flicker: float = 0.0,
-        rotate_range: float = 0, blur_radiu: float = 0,
+        rotate_range: float = 0, blur_radius: Optional[float] = None,
         blur_prob: float = 0.0, seed: int = 42, prefetch_depth: int = 2,
         sharding: Optional[Any] = None, valid_mask: Optional[bool] = None,
         eval_crop: str = "random", device_color_jitter: bool = True,
         fused_geom: bool = True, loader_backend: str = "thread",
         ring_depth: int = 4, worker_heartbeat: float = 120.0,
-        stem_s2d: bool = False,
+        stem_s2d: bool = False, augment_device: bool = False,
+        blur_radiu: Optional[float] = None,
         ) -> DeviceLoader:
     """Loader factory (reference loader.py:724-830): builds the v3 transform,
     picks the train/eval sharded sampler, wires collate mixup and the device
@@ -550,7 +629,18 @@ def create_deepfake_loader_v3(
     into the jitted device prologue (device_augment.py); ``fused_geom``
     (default) renders the geometric chain as one native warp — together they
     cut host cost per clip ~3× at the flagship shape.  Disabling both
-    restores the reference-exact host PIL pipeline."""
+    restores the reference-exact host PIL pipeline.
+
+    ``augment_device`` (``--augment-device on``) moves the REMAINING host
+    augment — the geometric warp, per-frame blur, and the mixup blend —
+    into the same jitted prologue, keyed by the identical absolute numpy
+    RNG streams (device_augment.py); the host transform collapses to a
+    raw-source passthrough and host input cost becomes the collate/slab
+    memcpy.  Falls back to the host chain (with a log line) for the
+    host-only stages: AugMix aug-splits and hue jitter.  ``blur_radiu``
+    is the deprecated alias of ``blur_radius``."""
+    from .transforms_factory import _blur_radius_compat
+    blur_radius = _blur_radius_compat(blur_radius, blur_radiu)
     re_num_splits = 0
     if re_split:
         re_num_splits = num_aug_splits or 2
@@ -558,6 +648,20 @@ def create_deepfake_loader_v3(
         else input_size
     if isinstance(img_size, (tuple, list)) and len(img_size) == 2:
         img_size = img_size[0] if img_size[0] == img_size[1] else tuple(img_size)
+
+    aug_device = bool(augment_device and is_training)
+    if aug_device and num_aug_splits > 1:
+        # the AugMix view augmentation is a host PIL op chain applied to
+        # the POST-geometric clip; warping on device would reorder it —
+        # keep the host chain rather than silently change what the JSD
+        # loss measures
+        _logger.info("aug-splits active: device augmentation falls back "
+                     "to the host chain")
+        aug_device = False
+    if aug_device and not fused_geom:
+        raise ValueError("augment_device renders the fused geometric warp "
+                         "on device; it conflicts with the host_geom / "
+                         "fused_geom=False parity escape hatch — pick one")
 
     device_cj = None
     device_flicker = 0.0
@@ -572,6 +676,17 @@ def create_deepfake_loader_v3(
             # device): keep the full PIL chain rather than silently
             # dropping the hue component
             _logger.info("hue jitter requested: color jitter stays on host")
+            if aug_device:
+                _logger.info("hue jitter requested: device augmentation "
+                             "falls back to the host chain")
+                aug_device = False
+        elif aug_device:
+            # the device prologue preserves the host order (jitter BEFORE
+            # the mixup blend, device_augment.py op order), so jitter/
+            # flicker ride the device even under mixup here
+            device_cj = tuple(float(v) for v in cj[:3]) if cj else None
+            device_flicker, flicker = flicker, 0.0
+            color_jitter = None
         elif collate_mixup is not None and is_training:
             # the host chain jitters each source clip BEFORE mixup blends
             # them; a post-blend device jitter would correlate the two
@@ -587,12 +702,48 @@ def create_deepfake_loader_v3(
             device_cj = tuple(float(v) for v in cj[:3]) if cj else None
             device_flicker, flicker = flicker, 0.0
             color_jitter = None
+    if aug_device and (color_jitter is not None or flicker > 0.0):
+        # --host-color-jitter with --augment-device: the passthrough chain
+        # has no host jitter/flicker stage to run them in
+        raise ValueError(
+            "augment_device leaves no host transform stage for host-side "
+            "color jitter/flicker — drop host_color_jitter (hue jitter "
+            "already falls back to the host chain automatically)")
 
+    device_augment = None
     if is_training:
-        transform = transforms_deepfake_train_v3(
-            img_size, color_jitter=color_jitter, flicker=flicker,
-            rotate_range=rotate_range, blur_radiu=blur_radiu,
-            blur_prob=blur_prob, fused_geom=fused_geom)
+        if aug_device:
+            from .device_augment import DeviceAugmentSpec
+            from .transforms_factory import \
+                transforms_deepfake_train_passthrough
+            size2 = (img_size, img_size) if isinstance(img_size, int) \
+                else tuple(img_size)
+            img_num_ = int(input_size[0] / 3) \
+                if isinstance(input_size, (tuple, list)) else 1
+            device_augment = DeviceAugmentSpec(
+                size=size2, rotate_range=int(rotate_range),
+                blur_prob=float(blur_prob),
+                blur_radius=float(blur_radius or 0.0),
+                img_num=max(1, img_num_),
+                mixup=collate_mixup is not None,
+                mixup_alpha=getattr(collate_mixup, "mixup_alpha", 0.0),
+                # the host collate mixes within each PROCESS's local
+                # batch; the device blend flips within matching blocks
+                mixup_blocks=num_shards if distributed else 1)
+            if collate_mixup is not None:
+                collate_mixup.blend = False     # lam + soft targets only
+            if getattr(dataset, "packed_hw", None) is None:
+                _logger.info(
+                    "augment_device without a packed cache: the decode "
+                    "path must yield one uniform source geometry (the "
+                    "warp compiles per source shape)")
+            transform = transforms_deepfake_train_passthrough(
+                img_size, rotate_range=rotate_range, blur_prob=blur_prob)
+        else:
+            transform = transforms_deepfake_train_v3(
+                img_size, color_jitter=color_jitter, flicker=flicker,
+                rotate_range=rotate_range, blur_radius=blur_radius,
+                blur_prob=blur_prob, fused_geom=fused_geom)
     else:
         transform = transforms_deepfake_eval_v3(img_size, crop=eval_crop)
     img_num = int(input_size[0] / 3) if isinstance(input_size, (tuple, list)) \
@@ -606,6 +757,6 @@ def create_deepfake_loader_v3(
              re_count=re_count, re_num_splits=re_num_splits, re_max=re_max,
              img_num=max(1, img_num), sharding=sharding,
              color_jitter=device_cj, flicker=device_flicker,
-             stem_s2d=stem_s2d),
+             stem_s2d=stem_s2d, device_augment=device_augment),
         loader_backend=loader_backend, ring_depth=ring_depth,
         worker_heartbeat=worker_heartbeat)

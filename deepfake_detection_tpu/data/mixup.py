@@ -44,14 +44,22 @@ class FastCollateMixup:
 
     Call with the already-stacked uint8 batch ``(B, H, W, C)`` and int labels;
     returns the mixed uint8 batch and float32 soft targets.
+
+    ``blend=False`` (set by the loader factory under ``--augment-device
+    on``) elides the image blend only: lambda is still drawn from the
+    identical stream and the soft targets still computed here, while the
+    DeviceLoader re-derives the same lambda and blends inside its jitted
+    prologue (``data/device_augment.py::device_mixup_blend``, bit-exact
+    vs the host blend) — host cost drops to the target math.
     """
 
     def __init__(self, mixup_alpha: float = 1.0, label_smoothing: float = 0.1,
-                 num_classes: int = 1000):
+                 num_classes: int = 1000, blend: bool = True):
         self.mixup_alpha = mixup_alpha
         self.label_smoothing = label_smoothing
         self.num_classes = num_classes
         self.mixup_enabled = True
+        self.blend = blend
 
     def __call__(self, images: np.ndarray, targets: np.ndarray,
                  rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
@@ -60,7 +68,7 @@ class FastCollateMixup:
             lam = float(rng.beta(self.mixup_alpha, self.mixup_alpha))
         soft = mixup_target_np(targets, self.num_classes, lam,
                                self.label_smoothing)
-        if lam == 1.0:
+        if lam == 1.0 or not self.blend:
             return images, soft
         mixed = images.astype(np.float32) * lam + \
             images[::-1].astype(np.float32) * (1.0 - lam)

@@ -35,7 +35,8 @@ __all__ = [
     "MultiToNumpy", "MultiConcate", "MultiRandomHorizontalFlip", "MultiBlur",
     "MultiRotate", "MultiRandomResize", "MultiRandomCrop", "MultiCenterCrop",
     "MultiColorJitter", "MultiFlicker", "MultiFusedGeometric",
-    "PackedFrames",
+    "PackedFrames", "DeviceAugmentPassthrough", "fused_geometric_params",
+    "blur_mask_draws",
 ]
 
 _PIL_INTERP = {
@@ -470,6 +471,106 @@ class MultiColorJitter(ColorJitter):
         return [self._apply(_as_pil(img), *params) for img in imgs]
 
 
+def _rot_canvas(w: int, h: int, deg: float) -> Tuple[int, int]:
+    """Canvas size of ``img.rotate(deg, expand=True)``, replicating
+    PIL's computation exactly — including the center-offset constant
+    INSIDE the ceil/floor, which shifts the result by 1 px for odd
+    source extents (the crop-draw bounds must match the sequential
+    chain exactly, not just approximately)."""
+    # PIL's transpose fast paths keep exact sizes at right angles (its
+    # general ceil/floor formula would pad odd extents by 1)
+    deg_n = deg % 360
+    if deg_n in (0, 180):
+        return w, h
+    if deg_n in (90, 270):
+        return h, w
+    a = -math.radians(deg)                     # PIL negates the angle
+    # PIL rounds to 15 decimals so near-axis angles produce exact 0/±1
+    # entries; raw cos/sin residue (~6e-17) would push corner coords
+    # past ceil/floor boundaries
+    c, s = round(math.cos(a), 15), round(math.sin(a), 15)
+    cx, cy = w / 2.0, h / 2.0
+    m2 = cx - (c * cx + s * cy)
+    m5 = cy - (-s * cx + c * cy)
+    xs, ys = [], []
+    for x, y in ((0, 0), (w, 0), (w, h), (0, h)):
+        xs.append(c * x + s * y + m2)
+        ys.append(-s * x + c * y + m5)
+    nw = int(math.ceil(max(xs)) - math.floor(min(xs)))
+    nh = int(math.ceil(max(ys)) - math.floor(min(ys)))
+    return nw, nh
+
+
+def fused_geometric_params(w: int, h: int, size: Tuple[int, int],
+                           rotate_range: int, scale: Tuple[float, float],
+                           p_flip: float, rng: np.random.Generator
+                           ) -> Tuple[float, float, float,
+                                      float, float, float]:
+    """Draw the fused-geometric chain's parameters and compose the
+    output→source INDEX-space affine ``(A, B, C, D, E, F)``.
+
+    Exactly the draw order and conditionals of the sequential
+    MultiRotate(expand) / MultiRandomHorizontalFlip / MultiRandomResize /
+    MultiRandomCrop chain (angle, coin, scale, top, left), so callers
+    that only need the rng stream position — the device-augment host
+    passthrough — consume the identical draws the render path would.
+    Shared by :class:`MultiFusedGeometric` (host render, native or PIL)
+    and the device-side warp (``data/device_augment.py``), which is what
+    pins the two paths to one parameter distribution by construction.
+    """
+    th, tw = size
+    # identical draw order to the sequential chain
+    deg = (int(rng.integers(-rotate_range, rotate_range + 1))
+           if rotate_range else 0)
+    flip = rng.random() < p_flip
+    s = rng.uniform(scale[0], scale[1])
+    w1, h1 = _rot_canvas(w, h, deg) if deg else (w, h)
+    w2, h2 = int(w1 * s), int(h1 * s)          # RandomResize rounding
+    ww, hh = max(w2, tw), max(h2, th)          # pad_if_needed canvas
+    px, py = (ww - w2) // 2, (hh - h2) // 2    # center pad offsets
+    top = int(rng.integers(0, hh - th + 1)) if hh > th else 0
+    left = int(rng.integers(0, ww - tw + 1)) if ww > tw else 0
+
+    # output (x, y) → source (original frame) coords, composed right to
+    # left: crop/pad shift → inverse resize → inverse flip → inverse
+    # rotate.  All half-pixel center corrections fold into the constant
+    # terms.
+    a = math.radians(deg)
+    cos, sin = math.cos(a), math.sin(a)
+
+    # crop+pad: xp = x + left - px (coords in the resized image)
+    # resize:   xr = (xp + .5) * (w1 / w2) - .5
+    sx, sy = w1 / w2, h1 / h2
+    # flip (on the rotated canvas): xf = w1 - 1 - xr
+    # linear parts
+    ax, bx = sx, 0.0
+    cx = (left - px + 0.5) * sx - 0.5
+    dy, ey = 0.0, sy
+    fy = (top - py + 0.5) * sy - 0.5
+    if flip:
+        ax, bx, cx = -ax, -bx, (w1 - 1) - cx
+    # rotate inverse (verified against PIL.rotate numerically): output→
+    # input is xi = cos·dx - sin·dy + w/2, yi = sin·dx + cos·dy + h/2
+    # with dx = xr - w1/2 + .5 etc. (half-pixel center corrections)
+    cos, sin = round(cos, 15), round(sin, 15)  # PIL's axis-angle exactness
+    A = cos * ax - sin * dy
+    B = cos * bx - sin * ey
+    C = (cos * (cx - w1 / 2 + 0.5) - sin * (fy - h1 / 2 + 0.5)
+         + w / 2 - 0.5)
+    D = sin * ax + cos * dy
+    E = sin * bx + cos * ey
+    F = (sin * (cx - w1 / 2 + 0.5) + cos * (fy - h1 / 2 + 0.5)
+         + h / 2 - 0.5)
+    return (A, B, C, D, E, F)
+
+
+def blur_mask_draws(n: int, p: float, rng: np.random.Generator) -> List[bool]:
+    """Per-frame blur coin flips in :class:`MultiBlur`'s draw order (one
+    ``rng.random()`` per frame, frame-major) — the shared draw for the
+    host blur stage and the device-augment blur mask."""
+    return [rng.random() < p for _ in range(n)]
+
+
 class MultiFusedGeometric:
     """rotate → hflip → random-resize → pad-if-needed → random-crop as ONE
     affine resample per frame.
@@ -498,82 +599,16 @@ class MultiFusedGeometric:
         self.p_flip = p_flip
         self.fill = fill
 
-    @staticmethod
-    def _rot_canvas(w: int, h: int, deg: float) -> Tuple[int, int]:
-        """Canvas size of ``img.rotate(deg, expand=True)``, replicating
-        PIL's computation exactly — including the center-offset constant
-        INSIDE the ceil/floor, which shifts the result by 1 px for odd
-        source extents (the crop-draw bounds must match the sequential
-        chain exactly, not just approximately)."""
-        # PIL's transpose fast paths keep exact sizes at right angles (its
-        # general ceil/floor formula would pad odd extents by 1)
-        deg_n = deg % 360
-        if deg_n in (0, 180):
-            return w, h
-        if deg_n in (90, 270):
-            return h, w
-        a = -math.radians(deg)                     # PIL negates the angle
-        # PIL rounds to 15 decimals so near-axis angles produce exact 0/±1
-        # entries; raw cos/sin residue (~6e-17) would push corner coords
-        # past ceil/floor boundaries
-        c, s = round(math.cos(a), 15), round(math.sin(a), 15)
-        cx, cy = w / 2.0, h / 2.0
-        m2 = cx - (c * cx + s * cy)
-        m5 = cy - (-s * cx + c * cy)
-        xs, ys = [], []
-        for x, y in ((0, 0), (w, 0), (w, h), (0, h)):
-            xs.append(c * x + s * y + m2)
-            ys.append(-s * x + c * y + m5)
-        nw = int(math.ceil(max(xs)) - math.floor(min(xs)))
-        nh = int(math.ceil(max(ys)) - math.floor(min(ys)))
-        return nw, nh
+    # kept as a staticmethod alias for external callers; the computation
+    # lives at module level so fused_geometric_params can share it
+    _rot_canvas = staticmethod(_rot_canvas)
 
     def __call__(self, imgs, rng: np.random.Generator):
         th, tw = self.size
         w, h = _wh(imgs[0])
-        # identical draw order to the sequential chain
-        deg = (int(rng.integers(-self.rotate_range, self.rotate_range + 1))
-               if self.rotate_range else 0)
-        flip = rng.random() < self.p_flip
-        s = rng.uniform(self.scale[0], self.scale[1])
-        w1, h1 = self._rot_canvas(w, h, deg) if deg else (w, h)
-        w2, h2 = int(w1 * s), int(h1 * s)          # RandomResize rounding
-        ww, hh = max(w2, tw), max(h2, th)          # pad_if_needed canvas
-        px, py = (ww - w2) // 2, (hh - h2) // 2    # center pad offsets
-        top = int(rng.integers(0, hh - th + 1)) if hh > th else 0
-        left = int(rng.integers(0, ww - tw + 1)) if ww > tw else 0
-
-        # output (x, y) → source (original frame) coords, composed right to
-        # left: crop/pad shift → inverse resize → inverse flip → inverse
-        # rotate.  All half-pixel center corrections fold into the constant
-        # terms.
-        a = math.radians(deg)
-        cos, sin = math.cos(a), math.sin(a)
-
-        # crop+pad: xp = x + left - px (coords in the resized image)
-        # resize:   xr = (xp + .5) * (w1 / w2) - .5
-        sx, sy = w1 / w2, h1 / h2
-        # flip (on the rotated canvas): xf = w1 - 1 - xr
-        # linear parts
-        ax, bx = sx, 0.0
-        cx = (left - px + 0.5) * sx - 0.5
-        dy, ey = 0.0, sy
-        fy = (top - py + 0.5) * sy - 0.5
-        if flip:
-            ax, bx, cx = -ax, -bx, (w1 - 1) - cx
-        # rotate inverse (verified against PIL.rotate numerically): output→
-        # input is xi = cos·dx - sin·dy + w/2, yi = sin·dx + cos·dy + h/2
-        # with dx = xr - w1/2 + .5 etc. (half-pixel center corrections)
-        cos, sin = round(cos, 15), round(sin, 15)  # PIL's axis-angle exactness
-        A = cos * ax - sin * dy
-        B = cos * bx - sin * ey
-        C = (cos * (cx - w1 / 2 + 0.5) - sin * (fy - h1 / 2 + 0.5)
-             + w / 2 - 0.5)
-        D = sin * ax + cos * dy
-        E = sin * bx + cos * ey
-        F = (sin * (cx - w1 / 2 + 0.5) + cos * (fy - h1 / 2 + 0.5)
-             + h / 2 - 0.5)
-        coeffs = (A, B, C, D, E, F)
+        coeffs = fused_geometric_params(
+            w, h, self.size, self.rotate_range, self.scale, self.p_flip, rng)
+        A, B, C, D, E, F = coeffs
         from . import native
         if native.available():
             arrs = [np.asarray(im, np.uint8) if not isinstance(
@@ -609,20 +644,94 @@ def _as_pil(img) -> Image.Image:
 
 class MultiBlur:
     """Independent per-frame Gaussian blur with probability p (reference
-    :243-258 — deliberately *not* shared across frames)."""
+    :243-258 — deliberately *not* shared across frames).
 
-    def __init__(self, p: float, blur_radiu: float = 1.0):
+    ``blur_radiu`` (the reference's misspelling) is accepted as a
+    deprecated alias for ``blur_radius`` so existing configs keep
+    working; it maps to the same attribute.
+    """
+
+    def __init__(self, p: float, blur_radius: Optional[float] = None,
+                 blur_radiu: Optional[float] = None):
         self.p = p
-        self.blur_radiu = blur_radiu
+        if blur_radius is None and blur_radiu is not None:
+            import warnings
+            warnings.warn("MultiBlur(blur_radiu=...) is deprecated; use "
+                          "blur_radius", DeprecationWarning, stacklevel=2)
+            blur_radius = blur_radiu
+        self.blur_radius = 1.0 if blur_radius is None else blur_radius
+
+    @property
+    def blur_radiu(self) -> float:          # deprecated attribute alias
+        return self.blur_radius
 
     def __call__(self, imgs, rng: np.random.Generator):
+        mask = blur_mask_draws(len(imgs), self.p, rng)
         out = [_as_pil(img).filter(
-                   ImageFilter.GaussianBlur(radius=self.blur_radiu))
-               if rng.random() < self.p else img for img in imgs]
+                   ImageFilter.GaussianBlur(radius=self.blur_radius))
+               if fire else img for img, fire in zip(imgs, mask)]
         if isinstance(imgs, PackedFrames) and all(
                 a is b for a, b in zip(out, imgs)):
             return imgs         # keep the copy-free packed fast path alive
         return out
+
+
+class DeviceAugmentPassthrough:
+    """Host half of ``--augment-device on``: ship the RAW source clip.
+
+    Replaces the geometric-warp + blur stages of the train chain with a
+    raw passthrough — the clip leaves the host as one ``(H, W, 3·F)``
+    uint8 buffer (for packed-cache clips the mmap view itself, so the
+    only host work left is the collate/slab memcpy) and the DeviceLoader
+    re-derives the SAME parameters from ``(seed, epoch, index)`` and
+    renders warp/blur/mixup inside its jitted prologue
+    (``data/device_augment.py``).
+
+    Stream-position parity is the load-bearing part: this transform
+    **consumes exactly the rng draws the host chain would** (geometric
+    angle/coin/scale/top/left via :func:`fused_geometric_params`, one
+    blur coin per frame via :func:`blur_mask_draws`), so every later
+    per-sample draw — ``noise_fake`` label flipping, any future
+    transform — sees the identical stream whether augmentation runs on
+    host or device.
+
+    Device augmentation needs a uniform source geometry across the
+    dataset (one static warp shape per compile): the packed cache
+    guarantees it; decode-path frame trees must be pre-sized (a mixed
+    clip raises here, never a silent mis-stack).
+    """
+
+    #: host stages whose per-sample work this passthrough elides (the
+    #: geometric warp and, when enabled, blur; the mixup blend elision is
+    #: counted by the DeviceLoader where the blend actually moves)
+    def __init__(self, size, rotate_range: float = 0,
+                 scale=(2.0 / 3, 3.0 / 2.0), p_flip: float = 0.5,
+                 blur_prob: float = 0.0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.rotate_range = int(rotate_range)
+        self.scale = scale
+        self.p_flip = p_flip
+        self.blur_prob = blur_prob
+        self.elided_stages = 1 + (1 if blur_prob > 0.0 else 0)
+
+    def __call__(self, imgs, rng: np.random.Generator):
+        w, h = _wh(imgs[0])
+        # consume the chain's draws; the DeviceLoader re-derives them
+        fused_geometric_params(w, h, self.size, self.rotate_range,
+                               self.scale, self.p_flip, rng)
+        if self.blur_prob > 0.0:
+            blur_mask_draws(len(imgs), self.blur_prob, rng)
+        if isinstance(imgs, PackedFrames) and imgs.untouched():
+            return imgs.base            # mmap view: collate = one memcpy
+        arrs = [np.asarray(im, np.uint8) if isinstance(im, np.ndarray)
+                else np.asarray(_as_pil(im), np.uint8) for im in imgs]
+        if len({a.shape for a in arrs}) > 1:
+            raise ValueError(
+                "--augment-device needs a uniform source frame geometry "
+                f"(one static warp shape); got {[a.shape for a in arrs]} "
+                "within one clip — pack the dataset (tools/pack_dataset.py) "
+                "or pre-size the frames")
+        return np.concatenate(arrs, axis=-1)
 
 
 class MultiFlicker:

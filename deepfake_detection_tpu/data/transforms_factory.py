@@ -27,7 +27,8 @@ from .auto_augment import (augment_and_mix_transform, auto_augment_transform,
                            rand_augment_transform)
 from .constants import (DEFAULT_CROP_PCT, IMAGENET_DEFAULT_MEAN,
                         IMAGENET_DEFAULT_STD)
-from .transforms import (CenterCrop, ColorJitter, Compose, MultiBlur,
+from .transforms import (CenterCrop, ColorJitter, Compose,
+                         DeviceAugmentPassthrough, MultiBlur,
                          MultiCenterCrop, MultiColorJitter, MultiConcate,
                          MultiFlicker, MultiFusedGeometric,
                          MultiRandomCrop, MultiRandomHorizontalFlip,
@@ -37,15 +38,29 @@ from .transforms import (CenterCrop, ColorJitter, Compose, MultiBlur,
                          Resize, ToNumpy)
 
 __all__ = ["transforms_deepfake_train_v3", "transforms_deepfake_eval_v3",
+           "transforms_deepfake_train_passthrough",
            "transforms_imagenet_train", "transforms_imagenet_eval",
            "create_transform"]
+
+
+def _blur_radius_compat(blur_radius, blur_radiu):
+    """``blur_radiu`` (the reference's misspelling) stays accepted as a
+    deprecated alias — YAML configs and launch scripts written against
+    the old flag keep working, loudly."""
+    if blur_radius is None and blur_radiu is not None:
+        import warnings
+        warnings.warn("blur_radiu= is deprecated; use blur_radius=",
+                      DeprecationWarning, stacklevel=3)
+        return blur_radiu
+    return 0 if blur_radius is None else blur_radius
 
 
 def transforms_deepfake_train_v3(
         img_size: Union[int, Tuple[int, int]] = 600,
         color_jitter: Any = 0.4, flicker: float = 0.0,
-        rotate_range: float = 0, blur_radiu: float = 0,
+        rotate_range: float = 0, blur_radius: Optional[float] = None,
         blur_prob: float = 0.0, fused_geom: bool = True,
+        blur_radiu: Optional[float] = None,
         **unused) -> Compose:
     """The active 4-frame train pipeline (reference :137-183).
 
@@ -56,6 +71,7 @@ def transforms_deepfake_train_v3(
     ``flicker=0`` lets the loader apply those stages on-device instead
     (loader.py DeviceLoader prologue) — host PIL jitter at 600² costs more
     than the whole decode."""
+    blur_radius = _blur_radius_compat(blur_radius, blur_radiu)
     if fused_geom:
         primary: list = [MultiFusedGeometric(
             img_size, rotate_range=rotate_range, scale=(2.0 / 3, 3.0 / 2.0))]
@@ -67,7 +83,7 @@ def transforms_deepfake_train_v3(
             MultiRandomCrop(img_size, pad_if_needed=True),
         ]
     if blur_prob > 0.0:
-        primary.append(MultiBlur(blur_prob, blur_radiu))
+        primary.append(MultiBlur(blur_prob, blur_radius))
     secondary = []
     if color_jitter is not None:
         if isinstance(color_jitter, (list, tuple)):
@@ -79,6 +95,23 @@ def transforms_deepfake_train_v3(
         secondary.append(MultiFlicker(flicker))
     final = [MultiToNumpy(), MultiConcate()]
     return Compose(primary + secondary + final)
+
+
+def transforms_deepfake_train_passthrough(
+        img_size: Union[int, Tuple[int, int]] = 600,
+        rotate_range: float = 0, blur_prob: float = 0.0) -> Compose:
+    """The ``--augment-device on`` host pipeline: ONE passthrough stage.
+
+    The geometric warp, blur, jitter/flicker and the mixup blend all run
+    in the DeviceLoader's jitted prologue; the host only consumes the
+    chain's rng draws (stream-position parity, see
+    :class:`~.transforms.DeviceAugmentPassthrough`) and hands the raw
+    source clip to the collate memcpy.  Same knob meanings as
+    :func:`transforms_deepfake_train_v3` — the scale range is the chain's
+    fixed (2/3, 3/2)."""
+    return Compose([DeviceAugmentPassthrough(
+        img_size, rotate_range=rotate_range, scale=(2.0 / 3, 3.0 / 2.0),
+        blur_prob=blur_prob)])
 
 
 def transforms_deepfake_eval_v3(img_size: Union[int, Tuple[int, int]] = 224,

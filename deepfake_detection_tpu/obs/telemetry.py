@@ -369,9 +369,25 @@ def loader_collector(device_loader, name: str = "train"):
         c = {
             f"input_{name}_batches_total": st.batches,
             f"input_{name}_host_wait_seconds_total": st.host_wait_s,
+            # with --augment-device on this block is ALSO where the
+            # prologue's augment compute surfaces to the host (the only
+            # wait on the prologue output): the per-drain breakdown's
+            # attribution of "where the augment milliseconds live"
             f"input_{name}_stage_block_seconds_total": st.stage_block_s,
+            # samples x host-chain stages (warp/blur/mixup-blend) elided
+            # by device-side augmentation
+            f"input_{name}_host_augment_stages_elided_total":
+                getattr(st, "augment_elided", 0),
         }
-        g: Dict[str, float] = {}
+        g: Dict[str, float] = {
+            # 1 = the train augment renders on device (--augment-device
+            # on), 0 = host chain — the /metrics-scraper pivot; the JSONL
+            # log carries counters only, so tools/obs_report.py keys the
+            # same fact off the elided-stages counter above
+            f"input_{name}_augment_path_device":
+                1.0 if getattr(device_loader, "augment_device", False)
+                else 0.0,
+        }
         host = device_loader.loader
         hstats = getattr(host, "stats", None)
         if hstats is not None:           # thread backend producer stats

@@ -430,9 +430,10 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         re_split=cfg.resplit, re_max=cfg.remax, color_jitter=cfg.color_jitter,
         num_aug_splits=cfg.aug_splits, collate_mixup=collate_mixup,
         flicker=cfg.flicker, rotate_range=cfg.rotate_range,
-        blur_radiu=1, blur_prob=cfg.blur_prob,
+        blur_radius=1, blur_prob=cfg.blur_prob,
         device_color_jitter=not cfg.host_color_jitter,
-        fused_geom=not cfg.host_geom, **loader_kwargs)
+        fused_geom=not cfg.host_geom,
+        augment_device=cfg.augment_device == "on", **loader_kwargs)
     eval_loader = create_deepfake_loader_v3(
         eval_ds, input_size, eval_local_batch, is_training=False,
         eval_crop=cfg.eval_crop,

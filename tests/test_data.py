@@ -72,7 +72,7 @@ class TestMultiTransforms:
     def test_train_pipeline_shape_and_determinism(self):
         tf = transforms_deepfake_train_v3(
             600, color_jitter=0.4, flicker=0.05, rotate_range=5,
-            blur_radiu=1, blur_prob=0.05)
+            blur_radius=1, blur_prob=0.05)
         imgs = _frames(4, size=(700, 500))
         a = tf(imgs, _rng(7))
         b = tf(imgs, _rng(7))

@@ -135,7 +135,7 @@ class TestRoundTrip:
         bytes, same rng draw order."""
         root, pack = tree_and_pack
         chains = [transforms_deepfake_train_v3(
-            CROP, color_jitter=0.4, rotate_range=5, blur_radiu=1,
+            CROP, color_jitter=0.4, rotate_range=5, blur_radius=1,
             blur_prob=0.3, flicker=0.3, fused_geom=False)]
         os.environ["DFD_NO_NATIVE_DECODE"] = "1"
         try:
@@ -188,7 +188,7 @@ class TestLoaderBitIdentity:
     def test_thread_across_epochs_and_workers(self, tree_and_pack, workers):
         root, pack = tree_and_pack
         tf = transforms_deepfake_train_v3(CROP, color_jitter=None,
-                                          rotate_range=5, blur_radiu=1,
+                                          rotate_range=5, blur_radius=1,
                                           blur_prob=0.2)
         ds, pk = self._pair(root, pack, tf)
         mk = lambda d: HostLoader(

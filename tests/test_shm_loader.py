@@ -120,7 +120,7 @@ class TestShmThreadBitIdentity:
         def build():
             ds = DeepFakeClipDataset(root)
             ds.set_transform(transforms_deepfake_train_v3(
-                32, color_jitter=None, rotate_range=5, blur_radiu=1,
+                32, color_jitter=None, rotate_range=5, blur_radius=1,
                 blur_prob=0.2))
             return ds
 

@@ -74,6 +74,33 @@ def test_packed_matrix_smoke(tmp_path):
     assert rows2 and all("skipped" in r for r in rows2)
 
 
+def test_device_augment_matrix_smoke(tmp_path):
+    """--device-augment matrix: host-augment vs passthrough rows on both
+    transports (packed source), provenance-stamped, budget gate honored."""
+    import json
+    root = str(tmp_path / "clips")
+    os.makedirs(root)
+    bench_input.build_dataset(root, n_clips=6, size=40, frames=4)
+    out = str(tmp_path / "rows.jsonl")
+    args = SimpleNamespace(clips=6, size=32, frames=4, batch=2, workers=2,
+                           epochs=1, budget=0.0, json=out, e2e=False)
+    rows = bench_input.run_device_augment(root, args)
+    assert {r["row"] for r in rows} == {
+        "host-augment/thread", "device-augment/thread",
+        "host-augment/shm", "device-augment/shm"}
+    assert all(r["clips_per_s"] > 0 and r["source"] == "packed"
+               for r in rows)
+    # no wall-clock ordering assert: a single 3-batch toy measurement under
+    # CI load can invert; the measured ratios live in INPUT_BENCH.md
+    with open(out) as f:
+        emitted = [json.loads(line) for line in f]
+    assert sum(r.get("kind") == "device_augment" for r in emitted) == 4
+    args2 = SimpleNamespace(clips=6, size=32, frames=4, batch=2, workers=2,
+                            epochs=1, budget=0.001, json="", e2e=False)
+    rows2 = bench_input.run_device_augment(root, args2)
+    assert rows2 and all("skipped" in r for r in rows2)
+
+
 def test_gil_pause_methodology():
     """tools/bench_gil.py: the PyDLL control must read as GIL-held and the
     production CDLL decode as GIL-free — the measured basis for

@@ -14,6 +14,7 @@ Usage::
                                 [--backend thread|shm|all]
                                 [--scaling 1,2,4]
                                 [--packed [--budget 600]]
+                                [--device-augment [--e2e]]
 
 Prints clips/s, frames/s, and achieved GB/s (decoded output bytes staged
 for the device).  ``--backend`` selects the host-loader backend(s): the
@@ -76,8 +77,11 @@ def measure(root: str, args, native: bool, fast: bool = True,
     ``fast=False`` = the reference-exact chain (sequential PIL geometric
     ops + host PIL jitter).  ``backend`` picks the host loader: 'thread'
     (in-process pool) or 'shm' (worker processes + shared-memory ring).
-    ``chain`` picks the transform: 'train' (augment) or 'eval' (crop
-    only — the serving/eval steady state).  ``packed_dir`` swaps the
+    ``chain`` picks the transform: 'train' (augment), 'eval' (crop
+    only — the serving/eval steady state), or 'train-deviceaug' (the
+    ``--augment-device on`` HOST side: rng-draw passthrough + slab
+    memcpy; warp/blur/mixup render on device, so this measures exactly
+    the host cores the flag frees).  ``packed_dir`` swaps the
     JPEG-decode clip source for the packed pre-decoded cache."""
     os.environ.pop("DFD_NO_NATIVE_DECODE", None)
     if not native:
@@ -88,7 +92,8 @@ def measure(root: str, args, native: bool, fast: bool = True,
     from deepfake_detection_tpu.data.packed import PackedDataset
     from deepfake_detection_tpu.data.samplers import ShardedTrainSampler
     from deepfake_detection_tpu.data.transforms_factory import (
-        transforms_deepfake_eval_v3, transforms_deepfake_train_v3)
+        transforms_deepfake_eval_v3, transforms_deepfake_train_passthrough,
+        transforms_deepfake_train_v3)
 
     if packed_dir:
         ds = PackedDataset(packed_dir, roots=[root],
@@ -97,10 +102,13 @@ def measure(root: str, args, native: bool, fast: bool = True,
         ds = DeepFakeClipDataset([root], frames_per_clip=args.frames)
     if chain == "eval":
         ds.set_transform(transforms_deepfake_eval_v3(args.size))
+    elif chain == "train-deviceaug":
+        ds.set_transform(transforms_deepfake_train_passthrough(
+            img_size=args.size, rotate_range=5, blur_prob=0.05))
     else:
         ds.set_transform(transforms_deepfake_train_v3(
             img_size=args.size, color_jitter=None if fast else 0.4,
-            rotate_range=5, blur_radiu=1, blur_prob=0.05,
+            rotate_range=5, blur_radius=1, blur_prob=0.05,
             flicker=0.0 if fast else 0.05, fused_geom=fast))
     sampler = ShardedTrainSampler(len(ds), batch_size=args.batch, seed=0)
     if backend == "shm":
@@ -327,6 +335,109 @@ def run_packed(root: str, args) -> list:
     return rows
 
 
+def run_device_augment(root: str, args) -> list:
+    """host-augment vs device-augment host-side matrix (packed source).
+
+    The ``--augment-device on`` claim is about HOST cores: the train
+    chain's warp/blur/mixup leave the host, which then only memcpys
+    packed mmap views into slabs.  Rows measure the host loader's clips/s
+    with the full packed host-augment chain vs the device-augment
+    passthrough, on both transports; the pre-registered criterion is
+    passthrough ≥ 5× host-augment.  ``--e2e`` adds a full-DeviceLoader
+    row (prologue included) — on this box that renders the warp on CPU
+    XLA, so it is a correctness/ceiling row, not a TPU number.
+    """
+    t0 = time.perf_counter()
+    budget = float(getattr(args, "budget", 0) or 0)
+
+    def budget_left() -> float:
+        return budget - (time.perf_counter() - t0) if budget else float("inf")
+
+    rows = []
+    pack_dir = os.path.join(root, f"_packed_cache_{args.size}")
+    from deepfake_detection_tpu.data.packed import write_pack
+    if budget_left() < 60.0:
+        row = {"kind": "device_augment", "row": "pack",
+               "skipped": f"budget {budget:.0f}s: <60s remain"}
+        rows.append(row)
+        _emit(args, row)
+        return rows
+    t_pack = time.perf_counter()
+    write_pack([root], pack_dir, image_size=args.size,
+               frames_per_clip=args.frames, shard_size=64,
+               workers=args.workers)
+    t_pack = time.perf_counter() - t_pack
+    print(f"| row | clips/s | vs host-augment | [one-time pack: "
+          f"{t_pack:.1f}s]")
+    print("|---|---|---|")
+    matrix = [("host-augment/thread", "train", "thread"),
+              ("device-augment/thread", "train-deviceaug", "thread"),
+              ("host-augment/shm", "train", "shm"),
+              ("device-augment/shm", "train-deviceaug", "shm")]
+    base = {}
+    for name, chain, backend in matrix:
+        row = {"kind": "device_augment", "row": name, "chain": chain,
+               "backend": backend, "source": "packed",
+               "crop_size": args.size, "pack_size": args.size,
+               "frames": args.frames, "batch": args.batch,
+               "workers": args.workers, "host_cpus": os.cpu_count()}
+        if budget_left() < 60.0:
+            row["skipped"] = f"budget {budget:.0f}s: <60s remain"
+            print(f"| {name} | skipped ({row['skipped']}) |")
+            rows.append(row)
+            _emit(args, row)
+            continue
+        cps = measure(root, args, native=True, fast=True, chain=chain,
+                      backend=backend, packed_dir=pack_dir)
+        base.setdefault(backend, {})[chain] = cps
+        ref = base[backend].get("train")
+        ratio = f"{cps / ref:.2f}x" if ref and chain != "train" else "-"
+        row.update(clips_per_s=round(cps, 2),
+                   frames_per_s=round(cps * args.frames, 2),
+                   gbps=round(_gbps(cps, args), 3))
+        rows.append(row)
+        _emit(args, row)
+        print(f"| {name} | {cps:.2f} | {ratio} |")
+    if getattr(args, "e2e", False) and budget_left() >= 60.0:
+        # full DeviceLoader loop: passthrough host chain + the jitted
+        # prologue (warp/blur/normalize) running on THIS box's CPU XLA —
+        # proves the end-to-end path and bounds the CPU-jax prologue
+        # cost; TPU rows when the relay returns
+        import jax.numpy as jnp
+        from deepfake_detection_tpu.data import create_deepfake_loader_v3
+        from deepfake_detection_tpu.data.packed import PackedDataset
+        ds = PackedDataset(pack_dir, roots=[root],
+                           frames_per_clip=args.frames)
+        loader = create_deepfake_loader_v3(
+            ds, (3 * args.frames, args.size, args.size), args.batch,
+            is_training=True, num_workers=args.workers,
+            dtype=jnp.float32, color_jitter=None, rotate_range=5,
+            blur_prob=0.05, augment_device=True, seed=0)
+        try:
+            for _ in loader:          # compile + warm
+                break
+            t1 = time.perf_counter()
+            n = 0
+            for x, *_ in loader:
+                x.block_until_ready()
+                n += x.shape[0]
+            cps = n / (time.perf_counter() - t1)
+        finally:
+            loader.close()
+        row = {"kind": "device_augment", "row": "device-augment/e2e-cpu-xla",
+               "backend": "thread", "source": "packed",
+               "crop_size": args.size, "frames": args.frames,
+               "batch": args.batch, "workers": args.workers,
+               "host_cpus": os.cpu_count(),
+               "clips_per_s": round(cps, 2),
+               "note": "prologue rendered on CPU XLA (no TPU on this box)"}
+        rows.append(row)
+        _emit(args, row)
+        print(f"| device-augment/e2e-cpu-xla | {cps:.2f} | (CPU-XLA "
+              f"prologue; correctness row, not a TPU number) |")
+    return rows
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--clips", type=int, default=64)
@@ -355,6 +466,13 @@ def main() -> None:
     ap.add_argument("--packed", action="store_true",
                     help="run the decode-vs-packed matrix (packs the "
                          "synthetic set once, then fetch/eval/train rows)")
+    ap.add_argument("--device-augment", action="store_true",
+                    help="run the host-augment vs device-augment host-side "
+                         "matrix on the packed source (the --augment-device "
+                         "on cores-per-chip measurement)")
+    ap.add_argument("--e2e", action="store_true",
+                    help="with --device-augment: add a full-DeviceLoader "
+                         "row (prologue on this box's CPU XLA)")
     ap.add_argument("--budget", type=float, default=0.0,
                     help="total seconds for the --packed matrix; a row is "
                          "skipped (and recorded as such) when <60s remain "
@@ -371,6 +489,9 @@ def main() -> None:
               f"...", file=sys.stderr)
         build_dataset(root, args.clips, src, args.frames)
 
+    if args.device_augment:
+        run_device_augment(root, args)
+        return
     if args.packed:
         run_packed(root, args)
         return

@@ -94,6 +94,26 @@ def summarize(path: str) -> None:
             print("\ncounters (latest):")
             for k, v in sorted(interesting.items()):
                 print(f"  {k} = {int(v) if float(v).is_integer() else v}")
+        # where the augment milliseconds live: host chain (fetch seconds)
+        # vs device prologue (stage-block seconds) — the --augment-device
+        # before/after pivot.  The JSONL records carry counters only, so
+        # the pivot keys off the elided-stages counter (> 0 from the
+        # first drain of a device-augment run — stages are counted at
+        # stage time, before any step drains); the
+        # input_train_augment_path_device gauge is the /metrics-scraper
+        # twin of the same fact.
+        elided = last.get("input_train_host_augment_stages_elided_total", 0)
+        if "input_train_batches_total" in last:
+            hw = last.get("input_train_host_wait_seconds_total", 0.0)
+            sb = last.get("input_train_stage_block_seconds_total", 0.0)
+            fetch = last.get("input_train_fetch_seconds_total")
+            aug_path = "device" if elided else "host"
+            line = (f"\ninput augment path: {aug_path} "
+                    f"(host stages elided: {int(elided)}; "
+                    f"host-wait {hw:.1f}s, prologue stage-block {sb:.1f}s")
+            if fetch is not None:
+                line += f", host fetch {fetch:.1f}s"
+            print(line + ")")
     resil = [e for e in events if e.get("event") in
              ("rewind", "preempted", "resume")]
     if resil:
