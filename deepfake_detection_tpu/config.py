@@ -523,6 +523,25 @@ class ServeConfig:
     # --- hot weight reload ---
     reload_dir: str = ""                 # "" disables the watcher
     reload_interval_s: float = 5.0
+    # golden-batch canary score-drift tolerance for hot reloads: new
+    # weights whose canary scores move more than this (max abs diff vs
+    # the serving weights on the same input) are rejected; < 0 disables
+    # the drift gate (finiteness + shape always gate)
+    reload_drift_tol: float = -1.0
+
+    # --- resilience (serving/resilience.py) ---
+    # stuck-batch watchdog: a device batch older than this fails its
+    # requests 503, restarts the engine worker and re-warms every bucket
+    # (readiness drops until done); 0 disables
+    watchdog_timeout_s: float = 30.0
+    # circuit breaker: this many CONSECUTIVE batch failures open it
+    # (immediate 503 + Retry-After at the HTTP edge); 0 disables
+    breaker_threshold: int = 5
+    breaker_open_s: float = 5.0          # open cooldown before the
+    # half-open probe batch
+    # bounded uniform jitter added to shed Retry-After values (a constant
+    # synchronizes every shed client into one thundering-herd resend)
+    retry_jitter_s: float = 2.0
 
     # --- observability ---
     throughput_window_s: float = 30.0
@@ -554,6 +573,13 @@ class ServeConfig:
         if self.wire not in ("float32", "uint8"):
             raise ValueError(f"--wire must be float32|uint8, "
                              f"got {self.wire!r}")
+        if self.watchdog_timeout_s < 0 or self.retry_jitter_s < 0:
+            raise ValueError("--watchdog-timeout-s / --retry-jitter-s "
+                             "must be >= 0")
+        if self.breaker_threshold < 0:
+            raise ValueError("--breaker-threshold must be >= 0 (0 = off)")
+        if self.breaker_open_s <= 0:
+            raise ValueError("--breaker-open-s must be > 0")
 
     @property
     def max_batch_size(self) -> int:
@@ -635,6 +661,11 @@ class StreamConfig(ServeConfig):
     max_streams: int = 64
     stream_ttl_s: float = 120.0          # idle eviction (0 = never)
     event_log_dir: str = ""              # per-stream verdict-event JSONL
+    # session durability: snapshot per-stream tracker + verdict-machine +
+    # window-position state here on shutdown/SIGTERM and restore on the
+    # next start, so a server bounce RESUMES verdict streams instead of
+    # resetting them ("" disables)
+    state_dir: str = ""
 
     # --- bench/test instrumentation ---
     # planted per-window scores ("0.05*8,0.95*12"): windows still ride the

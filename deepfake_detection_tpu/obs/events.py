@@ -34,7 +34,8 @@ from typing import Any, Dict, Iterator, List, Optional
 
 _logger = logging.getLogger(__name__)
 
-__all__ = ["SCHEMA_VERSION", "EventLog", "read_records"]
+__all__ = ["SCHEMA_VERSION", "EventLog", "read_records",
+           "repair_torn_tail"]
 
 #: bump when a record's field meaning changes; readers must check it
 SCHEMA_VERSION = 1
@@ -76,6 +77,11 @@ def _repair_torn_tail(path: str) -> int:
     _logger.warning("telemetry log %s had a torn tail (%d bytes dropped); "
                     "truncated to the last complete record", path, dropped)
     return dropped
+
+
+#: public name for the torn-tail repair (the streaming session-durability
+#: layer reopens per-stream verdict JSONL files with the same discipline)
+repair_torn_tail = _repair_torn_tail
 
 
 class EventLog:

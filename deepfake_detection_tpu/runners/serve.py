@@ -77,10 +77,18 @@ def build_engine(cfg):
     engine = InferenceEngine(
         model, variables, image_size=cfg.image_size, img_num=cfg.img_num,
         buckets=cfg.buckets, metrics=metrics, wire=cfg.wire,
-        multi_frame=not cfg.single_frame_only)
+        multi_frame=not cfg.single_frame_only,
+        watchdog_timeout_s=cfg.watchdog_timeout_s,
+        breaker_threshold=cfg.breaker_threshold,
+        breaker_open_s=cfg.breaker_open_s,
+        reload_drift_tol=cfg.reload_drift_tol,
+        retry_jitter_s=cfg.retry_jitter_s)
+    if engine.chaos.active:
+        _logger.warning("DFD_CHAOS active: %s", sorted(engine.chaos.points))
     batcher = MicroBatcher(max_batch=cfg.max_batch_size,
                            deadline_ms=cfg.batch_deadline_ms,
-                           max_queue=cfg.max_queue, metrics=metrics)
+                           max_queue=cfg.max_queue, metrics=metrics,
+                           retry_jitter_s=cfg.retry_jitter_s)
     if cfg.reload_dir:
         engine.start_reload_watcher(cfg.reload_dir,
                                     interval_s=cfg.reload_interval_s,

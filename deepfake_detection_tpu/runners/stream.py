@@ -88,6 +88,14 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_cpu_multi_thread_eigen=false").strip()
     server = build_stream_server(cfg)
+    if cfg.state_dir:
+        # resume sessions a previous instance snapshotted on its way down
+        # — BEFORE traffic starts, so the first chunk of a resumed stream
+        # continues its verdict machines instead of resetting them
+        restored = server.manager.restore_state(cfg.state_dir)
+        if restored:
+            _logger.info("restored %d stream session(s) from %s",
+                         restored, cfg.state_dir)
     server.engine.start(server.batcher)
     server.dispatcher.start()
     server.manager.start_evictor()
@@ -115,8 +123,16 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             stop.wait(0.5)
     finally:
         server.shutdown()
-        server.manager.shutdown()
+        # quiesce result delivery BEFORE snapshotting: a window score
+        # folding in after its session was serialized would desync the
+        # snapshot from the event log (the snapshot books in-flight
+        # windows dropped — nothing may score behind its back)
         server.dispatcher.stop()
+        if cfg.state_dir:
+            # snapshot BEFORE the manager closes sessions: a SIGTERM
+            # bounce must resume these verdict streams, not reset them
+            server.manager.save_state(cfg.state_dir)
+        server.manager.shutdown()
         server.engine.stop()
         server.batcher.close()
         server.server_close()

@@ -23,9 +23,12 @@ from __future__ import annotations
 
 import itertools
 import queue
+import random
 import threading
 import time
 from typing import Any, List, Optional, Sequence
+
+from .resilience import jittered_retry_after
 
 __all__ = ["Request", "QueueFull", "DeadlineExceeded", "MicroBatcher",
            "pick_bucket"]
@@ -63,9 +66,12 @@ class Request:
     """
 
     _ids = itertools.count()
+    # one lock for all requests: claim() is a few-ns critical section and
+    # a per-instance lock would cost an allocation per HTTP request
+    _claim_guard = threading.Lock()
 
     __slots__ = ("id", "array", "enqueue_t", "deadline_t", "timings",
-                 "_event", "_result", "_error")
+                 "_event", "_result", "_error", "_claimed")
 
     def __init__(self, array: Any, timeout_s: Optional[float] = None):
         self.id = next(self._ids)
@@ -77,6 +83,20 @@ class Request:
         self._event = threading.Event()
         self._result: Any = None
         self._error: Optional[BaseException] = None
+        self._claimed = False
+
+    def claim(self) -> bool:
+        """One-shot resolution ticket: True for exactly one caller, ever.
+
+        The request-books ledger (accepted == scored + shed + deadline +
+        failed) needs every request counted EXACTLY once even when the
+        engine worker and the watchdog race to resolve it — whoever wins
+        the claim does both the counting and the set_result/exception."""
+        with self._claim_guard:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline_t is not None and \
@@ -111,13 +131,16 @@ class MicroBatcher:
     """
 
     def __init__(self, max_batch: int = 64, deadline_ms: float = 5.0,
-                 max_queue: int = 128, metrics: Optional[Any] = None):
+                 max_queue: int = 128, metrics: Optional[Any] = None,
+                 retry_jitter_s: float = 2.0):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.max_batch = int(max_batch)
         self.deadline_s = float(deadline_ms) / 1000.0
         self.max_queue = int(max_queue)
         self.metrics = metrics
+        self.retry_jitter_s = float(retry_jitter_s)
+        self._retry_rng = random.Random(0x5EED)
         self._q: "queue.Queue[Request]" = queue.Queue()
         self._depth = 0
         self._depth_lock = threading.Lock()
@@ -143,6 +166,10 @@ class MicroBatcher:
         ``max_queue`` depth."""
         if self._closed.is_set():
             raise RuntimeError("batcher is closed")
+        if self.metrics is not None:
+            # the books ledger: every submit attempt is accepted, then
+            # resolves exactly once as scored/shed/deadline/failed
+            self.metrics.accepted_total.inc()
         with self._depth_lock:
             if self._depth >= self.max_queue:
                 depth = self._depth
@@ -158,11 +185,24 @@ class MicroBatcher:
                 self.metrics.shed_total.inc()
             # Retry-After estimate: drain time of the current backlog at
             # one deadline-window per max_batch, floored at 1s (the
-            # HTTP-date alternative needs no clock sync this way)
-            retry = max(1.0, depth / self.max_batch * self.deadline_s)
+            # HTTP-date alternative needs no clock sync this way), plus a
+            # bounded uniform jitter — a constant here synchronizes every
+            # shed client into one resend wave that sheds again
+            retry = jittered_retry_after(
+                max(1.0, depth / self.max_batch * self.deadline_s),
+                self.retry_jitter_s, self._retry_rng)
             raise QueueFull(depth, retry)
         req = Request(array, timeout_s)
         self._q.put(req)
+        if self._closed.is_set():
+            # close() raced us: its drain may have run before our put
+            # landed, which would strand an accepted-counted request and
+            # break the books identity — whoever wins the claim resolves
+            # it (the drain, or us, exactly once)
+            if req.claim():
+                if self.metrics is not None:
+                    self.metrics.failed_total.inc()
+                req.set_exception(RuntimeError("batcher is closed"))
         return req
 
     # ------------------------------------------------------------------
@@ -182,11 +222,12 @@ class MicroBatcher:
             self._track_depth(-1)
             if req.expired():
                 req.timings["queue"] = time.monotonic() - req.enqueue_t
-                if self.metrics is not None:
-                    self.metrics.deadline_total.inc()
-                req.set_exception(DeadlineExceeded(
-                    f"request {req.id} expired after "
-                    f"{req.timings['queue'] * 1000:.0f} ms in queue"))
+                if req.claim():
+                    if self.metrics is not None:
+                        self.metrics.deadline_total.inc()
+                    req.set_exception(DeadlineExceeded(
+                        f"request {req.id} expired after "
+                        f"{req.timings['queue'] * 1000:.0f} ms in queue"))
                 continue
             return req
 
@@ -226,4 +267,7 @@ class MicroBatcher:
             except queue.Empty:
                 break
             self._track_depth(-1)
-            req.set_exception(RuntimeError("server shutting down"))
+            if req.claim():
+                if self.metrics is not None:
+                    self.metrics.failed_total.inc()
+                req.set_exception(RuntimeError("server shutting down"))

@@ -35,12 +35,35 @@ Design (mirrors what ``data/loader.py`` does for training input):
 * **Crash recovery** — an exception anywhere in the serve loop fails the
   affected requests (HTTP 500) and restarts the loop; the worker thread
   never dies with requests stranded.
+
+* **Self-healing** (serving/resilience.py) — the failure modes crash
+  recovery can't absorb have their own recovery contracts, each loudly
+  counted in /metrics and each reachable through an env-gated
+  ``DFD_CHAOS`` injection point (``serve_exc`` / ``serve_nan`` /
+  ``serve_hang`` / ``serve_kill`` / ``torn_reload``, stepped by device
+  batch or reload attempt — ``chaos.py``'s fire-once grammar):
+
+  - a batch that returns **NaN/Inf scores** fails every rider with 503
+    (``nonfinite_batches_total``) — a non-finite score is never served;
+  - a batch that **never completes** (or a worker that died outright)
+    trips the stuck-batch watchdog: in-flight requests fail 503,
+    readiness DROPS, a new worker generation starts, and every AOT
+    bucket is re-executed (no recompiles — the executables survive)
+    before ``/readyz`` goes true again;
+  - **consecutive batch failures** open a circuit breaker (immediate
+    503 + Retry-After at the HTTP edge, half-open probe after the
+    cooldown, close on probe success);
+  - a **hot reload** must pass a golden-batch canary (finite,
+    shape-correct, optionally drift-bounded scores) before the swap;
+    torn/garbage/mismatched checkpoints are rejected loudly and the old
+    weights keep serving bit-identically.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import tempfile
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -49,10 +72,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..chaos import chaos_from_env
 from ..params import image_max_height, img_mean, img_num as _default_img_num, \
     img_std
 from .batcher import MicroBatcher, Request, pick_bucket
 from .metrics import ServingMetrics
+from .resilience import (CircuitBreaker, EngineStalled, NonFiniteScores,
+                         ServeWatchdog, torn_copy)
 
 _logger = logging.getLogger(__name__)
 
@@ -66,14 +92,15 @@ _CKPT_SUFFIXES = (".msgpack", ".ckpt", ".flax", ".pkt")
 
 
 class _Staged:
-    __slots__ = ("requests", "out", "bucket", "dispatch_t")
+    __slots__ = ("requests", "out", "bucket", "dispatch_t", "seq")
 
     def __init__(self, requests: List[Request], out: Any, bucket: int,
-                 dispatch_t: float):
+                 dispatch_t: float, seq: int):
         self.requests = requests
         self.out = out
         self.bucket = bucket
         self.dispatch_t = dispatch_t
+        self.seq = seq          # device-batch sequence (the chaos step)
 
 
 class InferenceEngine:
@@ -84,7 +111,13 @@ class InferenceEngine:
                  metrics: Optional[ServingMetrics] = None,
                  wire: str = "float32",
                  multi_frame: bool = True,
-                 warmup: bool = True):
+                 warmup: bool = True,
+                 watchdog_timeout_s: float = 30.0,
+                 breaker_threshold: int = 5,
+                 breaker_open_s: float = 5.0,
+                 reload_drift_tol: float = -1.0,
+                 retry_jitter_s: float = 2.0,
+                 chaos=None):
         self.model = model
         self.image_size = int(image_size)
         self.img_num = int(img_num)
@@ -109,14 +142,42 @@ class InferenceEngine:
             self._host_template)
         self._compiled: Dict[int, Any] = {}
         self._compiled_multi: Dict[int, Any] = {}
+        #: authoritative in-flight ledger — staged sub-batches live here
+        #: from dispatch until completion, so the stuck-batch watchdog
+        #: can read the oldest dispatch time even while the worker is
+        #: blocked inside a completion
         self._pending: List[_Staged] = []
+        self._pending_lock = threading.Lock()
         self._reload_box: List[Tuple[Any, str]] = []   # [(host_tree, path)]
         self._reload_lock = threading.Lock()
         self._last_reload_key: Optional[Tuple[str, float, int]] = None
         self.reload_count = 0
+        self._reload_attempts = 0          # torn_reload chaos step counter
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
         self._watcher: Optional[threading.Thread] = None
+        self._batcher: Optional[MicroBatcher] = None
+        # resilience: chaos injector, worker generations, breaker, watchdog
+        self.chaos = chaos if chaos is not None else chaos_from_env()
+        self._gen = 0                      # bumped by every recovery; a
+        # stale worker checks it before touching shared state
+        self._batch_seq = 0                # device-batch counter (chaos step)
+        self._recover_lock = threading.Lock()
+        self.reload_drift_tol = float(reload_drift_tol)
+        self.breaker = CircuitBreaker(breaker_threshold, breaker_open_s,
+                                      metrics=self.metrics,
+                                      retry_jitter_s=retry_jitter_s)
+        self.watchdog = ServeWatchdog(
+            watchdog_timeout_s, self._oldest_dispatch, self._worker_alive,
+            self._recover)
+        # a recovery re-warm against a TRULY hung device would block the
+        # watchdog thread forever in block_until_ready — run it bounded
+        self._rewarm_timeout_s = max(30.0, 4.0 * float(watchdog_timeout_s))
+        self._rewarm_thread: Optional[threading.Thread] = None
+        self._golden: Optional[np.ndarray] = None     # canary input batch
+        self._golden_ref: Optional[np.ndarray] = None  # current weights'
+        # scores on it (the drift baseline)
+        self._canary_hook = None           # test seam: runs mid-canary
 
         # Wire formats:
         #
@@ -255,7 +316,40 @@ class InferenceEngine:
                 jax.block_until_ready(out)
                 _logger.info("bucket %d (multi-frame) compiled + warmed "
                              "in %.1fs", b, time.monotonic() - t0)
+        # golden canary batch: a fixed seeded input whose scores under the
+        # CURRENT weights baseline both the reload canary and (optionally)
+        # its drift tolerance
+        if self._golden is None:
+            b0 = self.buckets[0]
+            rng = np.random.default_rng(0xCA9A87)
+            if np.dtype(dtype) == np.uint8:
+                self._golden = rng.integers(0, 256, (b0, s, s, chans),
+                                            dtype=np.uint8)
+            else:
+                self._golden = rng.random((b0, s, s, chans),
+                                          dtype=np.float32)
+        self._golden_ref = np.asarray(
+            self._run(self.buckets[0], self._variables, self._golden))
         self.metrics.ready = True
+
+    def _rewarm(self) -> None:
+        """Execute every AOT bucket once against the serving weights (the
+        recovery path's proof that the device answers again).  Runs the
+        EXISTING compiled executables — a recovery never recompiles, which
+        is what lets chaos_serve assert zero post-recovery backend
+        compiles."""
+        s = self.image_size
+        chans, dtype = self._wire_spec
+        for b in self.buckets:
+            jax.block_until_ready(self._run(
+                b, self._variables, jnp.zeros((b, s, s, chans), dtype)))
+        if self.multi_frame:
+            mchans = 3 * self.img_num
+            for b in self.buckets:
+                jax.block_until_ready(self._run(
+                    b, self._variables,
+                    jnp.zeros((b, s, s, mchans), np.uint8), multi=True))
+        self.metrics.rewarms_total.inc()
 
     # ------------------------------------------------------------------
     # scoring
@@ -312,39 +406,94 @@ class InferenceEngine:
         programs, so a coalesced batch that mixes them splits into (at
         most two) staged sub-batches — each still a pre-compiled bucket,
         dispatched back-to-back so both overlap the previous batch's
-        completion."""
+        completion.  Every sub-batch enters the ``_pending`` ledger at
+        dispatch so the watchdog sees its age."""
         groups: Dict[int, List[Request]] = {}
         for r in requests:
             groups.setdefault(self._chans_of(r.array), []).append(r)
         staged: List[_Staged] = []
         try:
             for chans, grp in groups.items():
+                seq = self._batch_seq
+                self._batch_seq += 1
+                if self.chaos.active and self.chaos.fires("serve_exc", seq):
+                    self.metrics.count_chaos("serve_exc")
+                    raise RuntimeError(
+                        f"chaos: injected score-fn exception (batch {seq})")
                 buf, bucket = self._pad_batch([r.array for r in grp],
                                               chans)
                 out = self._run(bucket, self._variables,
                                 jax.device_put(buf),
                                 multi=self._is_multi(chans))
-                self.metrics.inflight += len(grp)
                 now = time.monotonic()
                 for r in grp:
                     r.timings["queue"] = now - r.enqueue_t
-                staged.append(_Staged(grp, out, bucket, now))
+                st = _Staged(grp, out, bucket, now, seq)
+                # gauge bump + ledger entry are ONE atom vs the recovery
+                # path (which zeroes the gauge and clears the ledger under
+                # the same lock) — split, a recovery landing between them
+                # would leave the inflight gauge permanently negative
+                with self._pending_lock:
+                    self.metrics.inflight += len(grp)
+                    self._pending.append(st)
+                staged.append(st)
         except Exception:
             # a later group poisoned the stage: the caller fails EVERY
             # request of the coalesced batch, so unwind the sub-batches
             # already dispatched (their device work is wasted, not leaked)
             for st in staged:
-                self.metrics.inflight -= len(st.requests)
+                self._unpend(st)
             raise
         return staged
 
-    def _complete(self, staged: _Staged) -> None:
+    def _unpend(self, staged: _Staged) -> bool:
+        """Claim a staged batch out of the in-flight ledger; the claim
+        carries its inflight-gauge decrement (one atom, same lock as the
+        recovery path's clear-and-zero).  False = a recovery already
+        claimed it — the caller owns neither the gauge nor the
+        requests."""
+        with self._pending_lock:
+            try:
+                self._pending.remove(staged)
+            except ValueError:
+                return False
+            self.metrics.inflight -= len(staged.requests)
+            return True
+
+    def _complete(self, staged: _Staged, gen: int) -> None:
+        if gen != self._gen:
+            return                 # recovery owns these requests now
+        if self.chaos.active and self.chaos.fires("serve_hang", staged.seq):
+            hang_s = self.chaos.arg("serve_hang", 30.0)
+            self.metrics.count_chaos("serve_hang")
+            _logger.error("chaos: hanging completion of batch %d for "
+                          "%.1fs", staged.seq, hang_s)
+            time.sleep(hang_s)
         scores = np.asarray(staged.out)          # blocks on the device
         now = time.monotonic()
+        if gen != self._gen or not self._unpend(staged):
+            # the watchdog recovered while we were blocked: it already
+            # failed these requests and zeroed the gauges — touch nothing
+            # (the ledger claim is the tiebreaker for the last-instant
+            # race between the gen check and the recovery's clear)
+            return
+        if self.chaos.active and self.chaos.fires("serve_nan", staged.seq):
+            self.metrics.count_chaos("serve_nan")
+            scores = np.full_like(scores, np.nan)
         device_dt = now - staged.dispatch_t
         n = len(staged.requests)
         m = self.metrics
-        m.inflight -= n
+        if not np.isfinite(scores[:n]).all():
+            # a non-finite score is NEVER served: fail every rider with a
+            # 503-mapped error and let the breaker see the batch failure
+            m.nonfinite_batches_total.inc()
+            self.breaker.record_failure()
+            _logger.error("device batch %d produced non-finite scores; "
+                          "failing %d request(s)", staged.seq, n)
+            self._fail(staged.requests, NonFiniteScores(
+                f"device batch {staged.seq} produced non-finite scores "
+                f"(bucket {staged.bucket}); retry against healthy weights"))
+            return
         m.batches_total.inc()
         m.batch_rows_total.inc(n)
         m.padded_rows_total.inc(staged.bucket - n)
@@ -353,12 +502,15 @@ class InferenceEngine:
         for i, r in enumerate(staged.requests):
             r.timings["device"] = device_dt
             m.latency["queue"].observe(r.timings.get("queue", 0.0))
-            r.set_result(scores[i])
+            if r.claim():
+                m.scored_total.inc()
+                r.set_result(scores[i])
+        self.breaker.record_success()
 
-    @staticmethod
-    def _fail(requests: List[Request], err: BaseException) -> None:
+    def _fail(self, requests: List[Request], err: BaseException) -> None:
         for r in requests:
-            if not r._event.is_set():
+            if r.claim():
+                self.metrics.failed_total.inc()
                 r.set_exception(err)
 
     # ------------------------------------------------------------------
@@ -371,17 +523,29 @@ class InferenceEngine:
         except AttributeError:        # pragma: no cover — very old jax
             return True
 
-    def _loop_once(self, batcher: MicroBatcher) -> None:
+    def _loop_once(self, batcher: MicroBatcher, gen: int) -> None:
+        if self.chaos.active and \
+                self.chaos.fires("serve_kill", self._batch_seq):
+            self.metrics.count_chaos("serve_kill")
+            _logger.error("chaos: killing engine worker (gen %d)", gen)
+            # SystemExit ends the worker thread outright (serve_loop's
+            # crash recovery deliberately does not absorb it) — the
+            # watchdog's worker-liveness probe is what must bring
+            # serving back
+            raise SystemExit("chaos: serve_kill")
         self._maybe_apply_reload()
-        if not self._pending:
+        with self._pending_lock:
+            pending = list(self._pending)
+        if not pending:
             # device idle: block for the first request, then coalesce
             # within the deadline window
             requests = batcher.next_batch(timeout=0.05)
             if requests:
                 try:
-                    self._pending = self._stage(requests)
+                    self._stage(requests)
                 except Exception as e:             # noqa: BLE001
                     self._fail(requests, e)        # poisoned batch: 500s
+                    self.breaker.record_failure()
                     raise                          # now, not at timeout
             return
         # Device busy on batch k: its execution time is FREE coalescing
@@ -395,70 +559,178 @@ class InferenceEngine:
         # small-batch equilibrium (tiny batch → short exec → short gather
         # → tiny batch again).
         requests: List[Request] = []
-        out = self._pending[-1].out        # last sub-batch lands last
+        out = pending[-1].out              # last sub-batch lands last
         flush_at = time.monotonic() + batcher.deadline_s
-        while len(requests) < batcher.max_batch:
+        while len(requests) < batcher.max_batch and gen == self._gen:
             if self._out_ready(out) and time.monotonic() >= flush_at:
                 break
             r = batcher.take(timeout=0.001)
             if r is not None:
                 requests.append(r)
-        while len(requests) < batcher.max_batch:
+        while len(requests) < batcher.max_batch and gen == self._gen:
             r = batcher.take(timeout=0.0)
             if r is None:
                 break
             requests.append(r)
+        if gen != self._gen:
+            # a recovery fired while we gathered (a REAL device hang parks
+            # the worker right here, endlessly re-polling is_ready): the
+            # dequeued requests would otherwise be stranded — fail them
+            self._fail(requests, EngineStalled(
+                "engine restarted while this request was being batched"))
+            return
         # dispatch k+1 (async) BEFORE blocking on k: transfer + compute of
         # k+1 overlap k's completion — the DeviceLoader double buffer
-        staged: List[_Staged] = []
         if requests:
             try:
-                staged = self._stage(requests)
+                self._stage(requests)
             except Exception as e:                 # noqa: BLE001
                 self._fail(requests, e)
+                self.breaker.record_failure()
                 raise
-        pending, self._pending = self._pending, []
         err: Optional[Exception] = None
         for st in pending:
             try:
-                self._complete(st)
+                self._complete(st, gen)
             except Exception as e:                 # noqa: BLE001
-                self.metrics.inflight -= len(st.requests)
+                if gen != self._gen:
+                    return             # recovery already owns the ledger
+                self._unpend(st)       # claim carries the gauge decrement
                 self._fail(st.requests, e)
+                self.breaker.record_failure()
                 err = e
-        self._pending = staged
         if err is not None:
             raise err
 
-    def serve_loop(self, batcher: MicroBatcher) -> None:
-        """Run until stop(); never lets an exception strand requests or
-        kill the worker."""
-        while not self._stop.is_set():
+    def serve_loop(self, batcher: MicroBatcher, gen: int = 0) -> None:
+        """Run until stop() or a newer worker generation supersedes this
+        one; never lets an exception strand requests or kill the worker
+        (an injected SystemExit — the worker-kill chaos — does end the
+        thread, and the watchdog's liveness probe recovers from it)."""
+        while not self._stop.is_set() and gen == self._gen:
             try:
-                self._loop_once(batcher)
+                self._loop_once(batcher, gen)
+            except SystemExit:
+                # the worker-kill chaos: die like a crashed thread (the
+                # watchdog must notice and respawn) but without tripping
+                # pytest's thread-exception hook — matching Python's own
+                # silent-SystemExit thread semantics
+                return
             except Exception:                      # noqa: BLE001
                 # _loop_once already failed the requests of whichever batch
                 # crashed; self._pending (if any) is a healthy dispatched
                 # batch the next iteration will complete — don't touch it
+                if gen != self._gen:
+                    return
                 _logger.exception("engine worker crashed; recovering")
                 self.metrics.worker_restarts_total.inc()
                 time.sleep(0.01)     # a persistent fault must not spin-log
 
-    def start(self, batcher: MicroBatcher) -> None:
-        assert self._worker is None, "engine already started"
+    def _spawn_worker(self) -> None:
+        gen = self._gen
         self._worker = threading.Thread(
-            target=self.serve_loop, args=(batcher,),
-            name="serving-engine", daemon=True)
+            target=self.serve_loop, args=(self._batcher, gen),
+            name=f"serving-engine-g{gen}", daemon=True)
         self._worker.start()
+
+    def start(self, batcher: MicroBatcher) -> None:
+        assert self._batcher is None, "engine already started"
+        self._batcher = batcher
+        self._spawn_worker()
+        self.watchdog.start()
 
     def stop(self) -> None:
         self._stop.set()
-        if self._worker is not None:
+        self.watchdog.stop()       # before the join: a recovery must not
+        if self._worker is not None:    # race the shutdown
             self._worker.join(timeout=5.0)
             self._worker = None
-        for st in self._pending:
+        with self._pending_lock:
+            pending, self._pending = self._pending, []
+        for st in pending:
             self._fail(st.requests, RuntimeError("server shutting down"))
-        self._pending = []
+
+    # ------------------------------------------------------------------
+    # watchdog recovery (serving/resilience.py runs the monitor thread)
+    # ------------------------------------------------------------------
+    def _oldest_dispatch(self) -> Optional[float]:
+        with self._pending_lock:
+            if not self._pending:
+                return None
+            return min(st.dispatch_t for st in self._pending)
+
+    def _worker_alive(self) -> bool:
+        return self._worker is None or self._worker.is_alive()
+
+    def _recover(self, reason: str) -> None:
+        """Watchdog-thread recovery: fail everything in flight, retire the
+        current worker generation, prove the device answers by re-warming
+        every AOT bucket (readiness stays FALSE until it does), then start
+        a fresh worker.  Zero recompiles by construction — the bucket
+        executables survive the restart."""
+        with self._recover_lock:
+            if self._stop.is_set():
+                return
+            if self._rewarm_thread is not None and \
+                    self._rewarm_thread.is_alive():
+                # an earlier recovery's re-warm is still wedged on the
+                # device: spawning another would just stack threads —
+                # stay not-ready until the device answers or ops act
+                return
+            _logger.error("engine recovery (%s): failing in-flight "
+                          "requests, restarting worker, re-warming %d "
+                          "bucket(s)", reason, len(self.buckets))
+            self.metrics.ready = False
+            self.metrics.watchdog_recoveries_total.inc()
+            self.breaker.record_failure()
+            self._gen += 1         # neuters the old worker's late writes
+            with self._pending_lock:
+                # clear + zero under the ledger lock: pairs with _stage's
+                # atomic {gauge bump, ledger append} and _unpend's atomic
+                # {claim, gauge decrement}
+                pending, self._pending = self._pending, []
+                self.metrics.inflight = 0
+            for st in pending:
+                self._fail(st.requests, EngineStalled(
+                    f"engine recovery ({reason}) abandoned this batch"))
+            # bounded re-warm on a helper thread: against a genuinely
+            # hung device, block_until_ready never returns — the watchdog
+            # thread must stay free to keep polling (and to let stop()
+            # shut down), so a re-warm that overruns its budget leaves
+            # the engine not-ready and the next watchdog tick re-enters
+            # here (the still-alive guard above keeps it single-flight)
+            done = threading.Event()
+
+            def _rewarm_probe():
+                try:
+                    self._rewarm()
+                    done.set()
+                except Exception:                  # noqa: BLE001
+                    _logger.exception("post-recovery re-warm failed; "
+                                      "engine stays not-ready")
+
+            t = threading.Thread(target=_rewarm_probe, daemon=True,
+                                 name="serving-rewarm")
+            self._rewarm_thread = t
+            t.start()
+            deadline = time.monotonic() + self._rewarm_timeout_s
+            while not done.wait(0.2):
+                if self._stop.is_set():
+                    return
+                if time.monotonic() > deadline:
+                    _logger.error(
+                        "post-recovery re-warm still blocked after %.0fs "
+                        "(device wedged?); engine stays not-ready",
+                        self._rewarm_timeout_s)
+                    return
+                if not t.is_alive() and not done.is_set():
+                    return             # probe raised; already logged
+            self._rewarm_thread = None
+            if self._batcher is not None:
+                self._spawn_worker()
+            self.metrics.ready = True
+            _logger.info("engine recovered (%s): worker gen %d serving, "
+                         "buckets re-warmed", reason, self._gen)
 
     # ------------------------------------------------------------------
     # hot weight reload
@@ -474,32 +746,86 @@ class InferenceEngine:
             if not self._reload_box:
                 return
             host_tree, source = self._reload_box.pop()
+        # Readiness must not lie while the canary runs: the worker thread
+        # is busy proving the candidate weights, not dispatching batches,
+        # so /readyz drops for the canary window (/healthz stays up) and
+        # load balancers can route around the pause.  `gen` is captured
+        # so a watchdog recovery firing mid-canary wins every race: the
+        # stale worker neither commits the swap nor touches the ready
+        # flag the recovery now owns — the reload attempt is requeued
+        # for the fresh worker instead.
+        gen = self._gen
+        was_ready = self.metrics.ready
+        self.metrics.ready = False
         try:
-            shapes = jax.tree.map(
-                lambda a: (tuple(np.shape(a)), np.asarray(a).dtype),
-                host_tree)
-            if shapes != self._var_shapes:
-                raise ValueError("checkpoint tree/shape mismatch vs the "
-                                 "serving model")
-            new_vars = jax.device_put(host_tree)
-            # one throwaway execution proves aval compatibility with the
-            # compiled executables BEFORE the swap (a dtype drift would
-            # otherwise 500 every request after)
+            if self._canary_hook is not None:      # test seam
+                self._canary_hook()
+            try:
+                shapes = jax.tree.map(
+                    lambda a: (tuple(np.shape(a)), np.asarray(a).dtype),
+                    host_tree)
+                if shapes != self._var_shapes:
+                    raise ValueError("checkpoint tree/shape mismatch vs "
+                                     "the serving model")
+                new_vars = jax.device_put(host_tree)
+                canary = self._canary_scores(new_vars)
+            except Exception:                      # noqa: BLE001
+                _logger.exception("hot reload from %s rejected; previous "
+                                  "weights keep serving", source)
+                self.metrics.reload_errors_total.inc()
+                return
+            with self._recover_lock:   # serialize the commit vs recovery
+                if gen != self._gen:
+                    self.submit_reload(host_tree, source)   # retry fresh
+                    return
+                self._variables = new_vars
+                if canary is not None:
+                    self._golden_ref = canary      # new drift baseline
+                self.reload_count += 1
+            self.metrics.reloads_total.inc()
+            _logger.info("hot-reloaded weights from %s (reload #%d)",
+                         source, self.reload_count)
+        finally:
+            with self._recover_lock:
+                if gen == self._gen:
+                    self.metrics.ready = was_ready
+
+    def _canary_scores(self, new_vars) -> Optional[np.ndarray]:
+        """Golden-batch canary: the candidate weights must produce finite,
+        shape-correct scores — and, when ``reload_drift_tol`` >= 0, scores
+        within that tolerance of the serving weights' on the SAME input —
+        before they may serve.  Raises on any violation (the caller
+        rejects and rolls back to the serving set).  Doubles as the aval-
+        compatibility probe: it executes a compiled bucket with the new
+        params, so a dtype drift fails here, not on live traffic."""
+        if self._golden is None:                   # warmup=False engines
             chans, dtype = self._wire_spec
             probe = self._run(
                 self.buckets[0], new_vars,
                 jnp.zeros((self.buckets[0], self.image_size,
                            self.image_size, chans), dtype))
             jax.block_until_ready(probe)
-        except Exception:                          # noqa: BLE001
-            _logger.exception("hot reload from %s rejected", source)
-            self.metrics.reload_errors_total.inc()
-            return
-        self._variables = new_vars
-        self.reload_count += 1
-        self.metrics.reloads_total.inc()
-        _logger.info("hot-reloaded weights from %s (reload #%d)", source,
-                     self.reload_count)
+            return None
+        canary = np.asarray(self._run(self.buckets[0], new_vars,
+                                      self._golden))
+        if self._golden_ref is not None and \
+                canary.shape != self._golden_ref.shape:
+            self.metrics.reload_canary_failures_total.inc()
+            raise ValueError(
+                f"canary: golden-batch scores have shape {canary.shape}, "
+                f"serving weights produce {self._golden_ref.shape}")
+        if not np.isfinite(canary).all():
+            self.metrics.reload_canary_failures_total.inc()
+            raise ValueError("canary: candidate weights produce "
+                             "non-finite scores on the golden batch")
+        if self.reload_drift_tol >= 0 and self._golden_ref is not None:
+            drift = float(np.max(np.abs(canary - self._golden_ref)))
+            if drift > self.reload_drift_tol:
+                self.metrics.reload_canary_failures_total.inc()
+                raise ValueError(
+                    f"canary: golden-batch score drift {drift:.6g} "
+                    f"exceeds --reload-drift-tol {self.reload_drift_tol}")
+        return canary
 
     # ------------------------------------------------------------------
     def _newest_checkpoint(self, ckpt_dir: str
@@ -510,7 +836,9 @@ class InferenceEngine:
             return None
         best = None
         for name in names:
-            if not name.endswith(_CKPT_SUFFIXES):
+            # dotfiles are never candidates (editor temps, the chaos
+            # harness's torn copies)
+            if name.startswith(".") or not name.endswith(_CKPT_SUFFIXES):
                 continue
             path = os.path.join(ckpt_dir, name)
             try:
@@ -529,15 +857,37 @@ class InferenceEngine:
             newest = self._newest_checkpoint(ckpt_dir)
             if newest is None or newest == self._last_reload_key:
                 continue
-            path = newest[0]
+            path = load_path = newest[0]
+            seq = self._reload_attempts
+            self._reload_attempts += 1
+            if self.chaos.active and self.chaos.fires("torn_reload", seq):
+                # route the load through a half-truncated copy so the
+                # REAL torn-msgpack rejection (CheckpointCorrupt naming
+                # the file) is what recovers, not a synthetic stand-in
+                self.metrics.count_chaos("torn_reload")
+                load_path = torn_copy(path, tempfile.gettempdir())
+                _logger.error("chaos: reloading torn checkpoint copy %s",
+                              load_path)
             try:
-                loaded = load_checkpoint(self._host_template, path,
+                loaded = load_checkpoint(self._host_template, load_path,
                                          use_ema=use_ema, strict=False)
             except Exception:                      # noqa: BLE001
-                _logger.exception("reload watcher: cannot load %s", path)
+                _logger.exception("reload watcher: cannot load %s; "
+                                  "previous weights keep serving",
+                                  load_path)
                 self.metrics.reload_errors_total.inc()
-                self._last_reload_key = newest     # don't re-log every tick
+                if load_path == path:
+                    # don't re-log a genuinely corrupt file every tick —
+                    # but a chaos-torn COPY leaves the real file untried,
+                    # so the next tick retries it clean (fire-once)
+                    self._last_reload_key = newest
                 continue
+            finally:
+                if load_path != path:
+                    try:
+                        os.unlink(load_path)
+                    except OSError:
+                        pass
             self._last_reload_key = newest
             self.submit_reload(loaded, source=path)
 

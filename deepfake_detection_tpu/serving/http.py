@@ -19,10 +19,14 @@ Endpoints:
   a clip of identical frames scores bit-identically to the replicate
   path (tests/test_serving.py).  Responds ``{"fake_score": p, "scores":
   [...], "frames": n, "timings_ms": {...}}``; 400 undecodable or a frame
-  count other than 1/``img_num``, 429 + ``Retry-After`` when
-  load-shedding, 503 before warmup, 504 past the request deadline.
-* ``GET /healthz`` — process liveness (200 while the process serves).
-* ``GET /readyz`` — 200 only after every bucket is compiled+warmed.
+  count other than 1/``img_num``, 429 + jittered ``Retry-After`` when
+  load-shedding, 503 before warmup / while the circuit breaker is open /
+  when the batch produced non-finite scores or was abandoned by the
+  watchdog, 504 past the request deadline.
+* ``GET /healthz`` — process liveness (200 while the process serves,
+  INCLUDING during recovery re-warms — only readiness drops).
+* ``GET /readyz`` — 200 only while every bucket is compiled+warmed AND
+  no recovery re-warm or reload canary is in flight.
 * ``GET /metrics`` — Prometheus text format (serving/metrics.py).
 """
 
@@ -44,6 +48,7 @@ from ..params import normalize_concat, normalize_replicate, prepare_canvas
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
 from .engine import InferenceEngine
 from .metrics import ServingMetrics
+from .resilience import BreakerOpen, EngineStalled, NonFiniteScores
 
 _logger = logging.getLogger(__name__)
 
@@ -233,8 +238,21 @@ class _Handler(BaseHTTPRequestHandler):
             return
         srv = self.server
         if not srv.engine.ready:
+            # warming up, or the watchdog is re-warming buckets after a
+            # recovery, or a reload canary is in flight — /healthz stays
+            # 200 throughout, only readiness drops
             self._respond_json(503, {"error": "model warming up"},
                                extra_headers={"Retry-After": 1})
+            return
+        try:
+            # breaker shedding happens BEFORE body decode costs anything
+            # beyond the mandatory keep-alive drain
+            srv.engine.breaker.allow()
+        except BreakerOpen as e:
+            self._respond_json(
+                503, {"error": "circuit breaker open, retry later"},
+                extra_headers={"Retry-After":
+                               max(1, int(round(e.retry_after_s)))})
             return
         ctype_full = self.headers.get("Content-Type") or ""
         frames = self._decode_frames(body, ctype_full) if body else None
@@ -287,6 +305,13 @@ class _Handler(BaseHTTPRequestHandler):
             scores = req.result(timeout=srv.request_timeout_s + 5.0)
         except DeadlineExceeded:
             self._respond_json(504, {"error": "deadline exceeded"})
+            return
+        except (NonFiniteScores, EngineStalled) as e:
+            # the request was fine, the serving set / engine was not:
+            # 503 + Retry-After, never a silent NaN score or a 500 that
+            # blames the client
+            self._respond_json(503, {"error": f"scoring unavailable: {e}"},
+                               extra_headers={"Retry-After": 1})
             return
         except Exception as e:                     # noqa: BLE001
             self._respond_json(500, {"error": f"scoring failed: {e!r}"})

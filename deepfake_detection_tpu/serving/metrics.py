@@ -85,6 +85,14 @@ class ServingMetrics:
             s: LatencyHistogram(_BOUNDS) for s in STAGES}
         self.requests_total: Dict[str, _Counter] = {}   # keyed by status
         self._requests_lock = threading.Lock()
+        # request-books ledger: every submit attempt lands in accepted,
+        # and every accepted request resolves EXACTLY once as scored,
+        # shed, deadline or failed — tools/chaos_serve.py asserts the
+        # identity accepted == scored + shed + deadline + failed from a
+        # /metrics scrape after every fault scenario
+        self.accepted_total = _Counter()
+        self.scored_total = _Counter()
+        self.failed_total = _Counter()
         self.shed_total = _Counter()
         self.deadline_total = _Counter()
         self.batches_total = _Counter()
@@ -93,10 +101,21 @@ class ServingMetrics:
         self.compiles_total = _Counter()
         self.reloads_total = _Counter()
         self.reload_errors_total = _Counter()
+        self.reload_canary_failures_total = _Counter()
         self.worker_restarts_total = _Counter()
+        self.watchdog_recoveries_total = _Counter()
+        self.nonfinite_batches_total = _Counter()
+        self.rewarms_total = _Counter()
+        self.breaker_opens_total = _Counter()
+        self.breaker_probes_total = _Counter()
+        self.breaker_rejected_total = _Counter()
+        self.chaos_injections_total: Dict[str, _Counter] = {}
+        self._chaos_lock = threading.Lock()
         self.queue_depth = 0            # gauge, written by the batcher
         self.inflight = 0               # gauge, written by the engine
-        self.ready = False              # gauge, flipped after warmup
+        self.ready = False              # gauge, flipped after warmup and
+        # DROPPED during watchdog recovery / bucket re-warm / reload canary
+        self.breaker_state = 0          # gauge (0 closed, 1 open, 2 half)
         self._window_s = float(throughput_window_s)
         self._completions: Deque[Tuple[float, int]] = collections.deque()
         self._completions_lock = threading.Lock()
@@ -108,6 +127,15 @@ class ServingMetrics:
             c = self.requests_total.get(key)
             if c is None:
                 c = self.requests_total[key] = _Counter()
+        c.inc()
+
+    def count_chaos(self, point: str) -> None:
+        """One injected fault fired (keyed by injection-point name) —
+        chaos runs must be as loudly accounted as the faults they mimic."""
+        with self._chaos_lock:
+            c = self.chaos_injections_total.get(point)
+            if c is None:
+                c = self.chaos_injections_total[point] = _Counter()
         c.inc()
 
     def count_completion(self, n: int, now: float | None = None) -> None:
@@ -146,6 +174,14 @@ class ServingMetrics:
                            self.requests_total.items())
         for status, value in items:
             doc.sample("requests_total", f'{{status="{status}"}}', value)
+        counter("accepted_total", "Requests offered to the micro-batcher "
+                "(books: accepted == scored + shed + deadline + failed)",
+                self.accepted_total.value)
+        counter("scored_total", "Requests resolved with a score",
+                self.scored_total.value)
+        counter("failed_total", "Requests resolved with an error (engine "
+                "fault, non-finite batch, stall, shutdown)",
+                self.failed_total.value)
         counter("shed_total", "Requests rejected 429 (queue full)",
                 self.shed_total.value)
         counter("deadline_total", "Requests failed 504 (deadline exceeded)",
@@ -166,12 +202,41 @@ class ServingMetrics:
                 self.reloads_total.value)
         counter("reload_errors_total", "Rejected/failed hot reloads",
                 self.reload_errors_total.value)
+        counter("reload_canary_failures_total", "Hot reloads rejected by "
+                "the golden-batch canary (non-finite / drifted scores)",
+                self.reload_canary_failures_total.value)
         counter("worker_restarts_total", "Engine worker crash recoveries",
                 self.worker_restarts_total.value)
+        counter("watchdog_recoveries_total", "Watchdog-driven engine "
+                "restarts (stuck batch or dead worker)",
+                self.watchdog_recoveries_total.value)
+        counter("nonfinite_batches_total", "Device batches discarded for "
+                "NaN/Inf scores (every row failed 503, never served)",
+                self.nonfinite_batches_total.value)
+        counter("rewarms_total", "Full AOT bucket re-warm passes after a "
+                "recovery (executes existing executables; no recompiles)",
+                self.rewarms_total.value)
+        counter("breaker_opens_total", "Circuit-breaker closed/half-open "
+                "-> open transitions", self.breaker_opens_total.value)
+        counter("breaker_probes_total", "Half-open probe requests admitted",
+                self.breaker_probes_total.value)
+        counter("breaker_rejected_total", "Requests shed 503 by the open "
+                "breaker", self.breaker_rejected_total.value)
+        doc.header("chaos_injections_total",
+                   "Injected faults fired (DFD_CHAOS), by point", "counter")
+        with self._chaos_lock:
+            chaos_items = sorted((k, c.value) for k, c in
+                                 self.chaos_injections_total.items())
+        for point, value in chaos_items:
+            doc.sample("chaos_injections_total", f'{{point="{point}"}}',
+                       value)
         gauge("queue_depth", "Requests waiting in the micro-batch queue",
               self.queue_depth)
         gauge("inflight", "Requests staged on device", self.inflight)
-        gauge("ready", "1 once all buckets are warmed", int(self.ready))
+        gauge("ready", "1 once all buckets are warmed (drops during "
+              "recovery re-warm and the reload canary)", int(self.ready))
+        gauge("breaker_state", "Circuit breaker state (0 closed, 1 open, "
+              "2 half-open)", self.breaker_state)
         gauge("throughput_rps",
               f"Scored requests/sec, trailing {self._window_s:.0f}s window",
               round(self.throughput(), 3))

@@ -69,6 +69,10 @@ __all__ = ["StreamSession", "StreamManager", "StreamServer",
 _MAX_BODY = 64 * 1024 * 1024     # one chunk of frames, not one image
 _ID_RE = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
 _STATUS_SCHEMA = "dfd.streaming.status.v1"
+#: session-durability snapshot schema — bump on any layout change so a
+#: restore can reject snapshots it does not understand instead of
+#: resuming from misread state
+_STATE_SCHEMA = "dfd.streaming.session_state.v1"
 
 
 # ---------------------------------------------------------------------------
@@ -173,15 +177,29 @@ class FfmpegDemuxer:
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL)
         self._frames: "queue.Queue[bytes]" = queue.Queue()
+        self._closing = False        # close() in progress: an exit is
+        # deliberate, not a mid-stream death
         self._reader = threading.Thread(target=self._read_loop,
                                         name="ffmpeg-demux", daemon=True)
         self._reader.start()
+
+    @property
+    def dead(self) -> bool:
+        """ffmpeg exited on its own (killed, codec crash, corrupt input)
+        — as opposed to a deliberate :meth:`close`.  The reader thread
+        sees EOF and exits cleanly, so a death can never hang it; THIS
+        flag is how the ingest path surfaces the failure as a counted
+        per-stream error instead of silently dropping frames."""
+        return not self._closing and self._proc.poll() is not None
 
     def _read_loop(self) -> None:
         buf = b""
         out = self._proc.stdout
         while True:
-            chunk = out.read(65536)
+            # read1: return whatever the pipe has (>= 1 byte) instead of
+            # blocking for a full 64 KiB — frames surface as ffmpeg emits
+            # them, and a death is seen at the next EOF, not 64 KiB later
+            chunk = out.read1(65536)
             if not chunk:
                 break
             buf += chunk
@@ -200,8 +218,16 @@ class FfmpegDemuxer:
                 buf = buf[end + 2:]
 
     def feed(self, data: bytes) -> None:
-        self._proc.stdin.write(data)
-        self._proc.stdin.flush()
+        # a pre-write poll catches a dead process even when the kernel
+        # pipe buffer would have swallowed the bytes without an EPIPE
+        if self._proc.poll() is not None:
+            raise OSError(f"ffmpeg exited with code "
+                          f"{self._proc.returncode} mid-stream")
+        try:
+            self._proc.stdin.write(data)
+            self._proc.stdin.flush()
+        except ValueError as e:       # stdin already closed
+            raise OSError(str(e)) from None
 
     def poll_frames(self, wait_s: float = 0.2) -> List[bytes]:
         """Drain decoded frames; waits up to ``wait_s`` for the first."""
@@ -217,14 +243,21 @@ class FfmpegDemuxer:
 
     def close(self) -> List[bytes]:
         """Flush: close stdin so ffmpeg drains its pipeline, then return
-        any trailing frames."""
+        any trailing frames.  Safe to call on an already-dead process —
+        the reader thread exits at stdout EOF (a death can't wedge it),
+        and a terminate that won't die escalates to kill."""
+        self._closing = True
         try:
             self._proc.stdin.close()
         except OSError:
             pass
         self._reader.join(timeout=5.0)
         self._proc.terminate()
-        self._proc.wait(timeout=5.0)
+        try:
+            self._proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:          # pragma: no cover
+            self._proc.kill()
+            self._proc.wait(timeout=5.0)
         frames: List[bytes] = []
         while True:
             try:
@@ -286,6 +319,7 @@ class StreamSession:
         self.frame_idx = 0
         self.frames_ingested = 0
         self.decode_errors = 0
+        self.demux_failures = 0
         self.windows_emitted = 0
         self.windows_scored = 0
         self.windows_dropped = 0
@@ -470,6 +504,7 @@ class StreamSession:
                 "counters": {
                     "frames_ingested": self.frames_ingested,
                     "decode_errors": self.decode_errors,
+                    "demux_failures": self.demux_failures,
                     "windows_emitted": self.windows_emitted,
                     "windows_scored": self.windows_scored,
                     "windows_dropped": self.windows_dropped,
@@ -495,6 +530,93 @@ class StreamSession:
                 self._event_log = None
             self._event_log_path = None
         return st
+
+    # ------------------------------------------------------------------
+    # durability: a server bounce must RESUME this stream's verdicts, not
+    # reset them (tracker + verdict machines + window-position state all
+    # round-trip; the verdict event log stays ONE coherent stream)
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            # windows still in flight at snapshot time can never report
+            # back into the restored session — account them dropped NOW so
+            # the per-stream books (emitted == scored + dropped + shed +
+            # failed) still balance after the bounce
+            pending = self.windows_emitted - self.windows_scored - \
+                self.windows_dropped - self.windows_shed - \
+                self.windows_failed
+            if pending > 0:
+                self.windows_dropped += pending
+                self.metrics.windows_dropped_total.inc(pending)
+            return {
+                "schema": _STATE_SCHEMA,
+                "stream_id": self.id,
+                "created": self.created_t,
+                "frame_idx": self.frame_idx,
+                "counters": {
+                    "frames_ingested": self.frames_ingested,
+                    "decode_errors": self.decode_errors,
+                    "demux_failures": self.demux_failures,
+                    "windows_emitted": self.windows_emitted,
+                    "windows_scored": self.windows_scored,
+                    "windows_dropped": self.windows_dropped,
+                    "windows_shed": self.windows_shed,
+                    "windows_failed": self.windows_failed,
+                },
+                "stream_verdict": self.stream_verdict.state_dict(),
+                "track_verdicts": {
+                    str(tid): vm.state_dict()
+                    for tid, vm in sorted(self.track_verdicts.items())},
+                "dead_tracks": list(self.dead_tracks),
+                "events": self.events[-self._event_limit:],
+                "tracker": self.tracker.state_dict(),
+                "windower": self.windower.state_dict(),
+            }
+
+    def load_state(self, d: Dict[str, Any]) -> None:
+        if d.get("schema") != _STATE_SCHEMA:
+            raise ValueError(
+                f"stream {self.id}: snapshot schema {d.get('schema')!r} "
+                f"!= {_STATE_SCHEMA!r}; refusing to resume from it")
+        if d.get("stream_id") != self.id:
+            raise ValueError(f"snapshot is for stream "
+                             f"{d.get('stream_id')!r}, not {self.id!r}")
+        with self._lock:
+            self.created_t = float(d["created"])
+            self.frame_idx = int(d["frame_idx"])
+            c = d["counters"]
+            self.frames_ingested = int(c["frames_ingested"])
+            self.decode_errors = int(c["decode_errors"])
+            self.demux_failures = int(c.get("demux_failures", 0))
+            self.windows_emitted = int(c["windows_emitted"])
+            self.windows_scored = int(c["windows_scored"])
+            self.windows_dropped = int(c["windows_dropped"])
+            self.windows_shed = int(c["windows_shed"])
+            self.windows_failed = int(c["windows_failed"])
+            self.stream_verdict.load_state_dict(d["stream_verdict"])
+            self.track_verdicts = {}
+            for tid_s, vmd in d["track_verdicts"].items():
+                tid = int(tid_s)
+                vm = VerdictMachine(
+                    self.thresholds, ema_alpha=self.cfg.verdict_ema_alpha,
+                    min_windows=self.cfg.verdict_min_windows,
+                    context={"stream_id": self.id, "scope": "track",
+                             "track_id": tid})
+                vm.load_state_dict(vmd)
+                self.track_verdicts[tid] = vm
+            self.dead_tracks.clear()
+            self.dead_tracks.extend(d.get("dead_tracks", []))
+            self.events = list(d.get("events", []))
+            self.tracker.load_state_dict(d["tracker"])
+            self.windower.load_state_dict(d["windower"])
+            # the event log is APPENDED to across the bounce; a SIGTERM
+            # can tear its last line, so reopen with the PR 6 repair
+            # discipline — one coherent schema-versioned stream
+            if self._event_log_path and \
+                    os.path.exists(self._event_log_path):
+                from ..obs.events import repair_torn_tail
+                repair_torn_tail(self._event_log_path)
+            self.last_activity = time.monotonic()
 
 
 # ---------------------------------------------------------------------------
@@ -577,6 +699,82 @@ class StreamManager:
         with self._lock:
             self.metrics.active_tracks = sum(
                 len(s.tracker.tracks) for s in self._sessions.values())
+
+    # ------------------------------------------------------------------
+    # session durability: shutdown snapshot + startup restore
+    # ------------------------------------------------------------------
+    def save_state(self, state_dir: str) -> int:
+        """Snapshot every live session into ``state_dir`` (one JSON per
+        stream, write → fsync → atomic rename — the checkpoint-writer
+        discipline); returns how many were saved.  Called on shutdown/
+        SIGTERM so a server bounce can resume verdict streams."""
+        if not state_dir:
+            return 0
+        os.makedirs(state_dir, exist_ok=True)
+        with self._lock:
+            sessions = list(self._sessions.values())
+        saved = 0
+        for s in sessions:
+            path = os.path.join(state_dir, f"{s.id}.state.json")
+            try:
+                data = json.dumps(s.state_dict(), sort_keys=True)
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+                saved += 1
+            except (OSError, ValueError, TypeError):
+                self.metrics.state_errors_total.inc()
+                _logger.exception("stream %s: state snapshot failed "
+                                  "(stream will RESET on restart)", s.id)
+        if saved:
+            _logger.info("saved %d stream session snapshot(s) to %s",
+                         saved, state_dir)
+        return saved
+
+    def restore_state(self, state_dir: str) -> int:
+        """Resume sessions from ``state_dir`` snapshots; returns how many.
+
+        Each snapshot is CONSUMED (unlinked) on successful restore so a
+        later crash-without-snapshot cannot resurrect stale state; a
+        corrupt/unreadable snapshot is renamed ``.bad`` (kept for
+        forensics, never retried) and counted, loudly."""
+        if not state_dir or not os.path.isdir(state_dir):
+            return 0
+        restored = 0
+        for name in sorted(os.listdir(state_dir)):
+            if not name.endswith(".state.json"):
+                continue
+            path = os.path.join(state_dir, name)
+            try:
+                with open(path) as f:
+                    d = json.load(f)
+                s = self.create(d.get("stream_id"))
+                try:
+                    s.load_state(d)
+                except Exception:
+                    # half-restored sessions must not serve: drop it
+                    self.close(s.id)
+                    raise
+            except Exception:                      # noqa: BLE001
+                self.metrics.state_errors_total.inc()
+                _logger.exception("cannot restore stream snapshot %s; "
+                                  "renaming .bad", path)
+                try:
+                    os.replace(path, path + ".bad")
+                except OSError:
+                    pass
+                continue
+            os.unlink(path)
+            restored += 1
+            self.metrics.streams_restored_total.inc()
+            _logger.info("resumed stream %s (verdict %r, %d windows "
+                         "scored)", s.id, s.current_verdict(),
+                         s.windows_scored)
+        self.refresh_track_gauge()
+        return restored
 
     # ------------------------------------------------------------------
     def start_evictor(self) -> None:
@@ -837,13 +1035,22 @@ class _StreamHandler(BaseHTTPRequestHandler):
         try:
             demuxer.feed(body)
             encoded = demuxer.poll_frames()
+            if demuxer.dead:
+                # the process died AFTER accepting the bytes (kill, codec
+                # crash mid-chunk): the reader saw EOF and exited, so
+                # this surfaces here, counted — never as a silent stall
+                raise OSError(f"ffmpeg exited with code "
+                              f"{demuxer._proc.returncode} mid-stream")
         except OSError as e:
-            # ffmpeg died (corrupt container, codec error): reset so the
-            # NEXT chunk gets a fresh demuxer instead of a wedged pipe,
-            # and tell the client instead of dropping the connection
+            # ffmpeg died (corrupt container, codec error, killed): count
+            # it per-stream + process-wide, reset so the NEXT chunk gets
+            # a fresh demuxer instead of a wedged pipe, and tell the
+            # client instead of dropping the connection
             with session._lock:
                 if session.demuxer is demuxer:
                     session.demuxer = None
+                session.demux_failures += 1
+            self.server.metrics.demux_failures_total.inc()
             try:
                 demuxer.close()
             except Exception:                      # noqa: BLE001

@@ -54,6 +54,11 @@ class StreamingMetrics:
         self.windows_dropped_total = _Counter()    # drop-oldest backpressure
         self.windows_shed_total = _Counter()       # batcher QueueFull
         self.windows_failed_total = _Counter()     # deadline / engine error
+        self.demux_failures_total = _Counter()     # ffmpeg died mid-stream
+        self.streams_restored_total = _Counter()   # sessions resumed from
+        # a state-dir snapshot after a server bounce
+        self.state_errors_total = _Counter()       # snapshot save/restore
+        # failures (corrupt/stale state files, unwritable dir)
         self.verdict_transitions_total: Dict[str, _Counter] = {}
         self._verdict_lock = threading.Lock()
         self.active_streams = 0                    # gauge (manager-owned)
@@ -98,6 +103,14 @@ class StreamingMetrics:
                 "(queue full)", self.windows_shed_total.value)
         counter("windows_failed_total", "Windows failed (deadline or "
                 "engine error)", self.windows_failed_total.value)
+        counter("demux_failures_total", "ffmpeg demuxer deaths surfaced "
+                "as per-stream errors (422 + demuxer reset)",
+                self.demux_failures_total.value)
+        counter("streams_restored_total", "Stream sessions resumed from "
+                "a state-dir snapshot", self.streams_restored_total.value)
+        counter("state_errors_total", "Session snapshot save/restore "
+                "failures (corrupt or unwritable state files)",
+                self.state_errors_total.value)
         doc.header("verdict_transitions_total",
                    "Verdict state transitions by destination state",
                    "counter")

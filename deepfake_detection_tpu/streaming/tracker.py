@@ -203,6 +203,24 @@ class Track:
                 "last_frame": self.last_frame,
                 "coasting": self.coasting}
 
+    # -- durability: FULL precision (snapshot() rounds for display) ----
+    def state_dict(self) -> Dict[str, Any]:
+        return {"id": self.id, "box": list(self.box), "score": self.score,
+                "hits": self.hits, "misses": self.misses,
+                "born_frame": self.born_frame,
+                "last_frame": self.last_frame,
+                "windows_scored": self.windows_scored}
+
+    @classmethod
+    def from_state_dict(cls, d: Dict[str, Any]) -> "Track":
+        t = cls(int(d["id"]), tuple(float(c) for c in d["box"]),
+                float(d["score"]), int(d["born_frame"]))
+        t.hits = int(d["hits"])
+        t.misses = int(d["misses"])
+        t.last_frame = int(d["last_frame"])
+        t.windows_scored = int(d["windows_scored"])
+        return t
+
 
 class TrackerUpdate:
     """Result of one tracker step.  ``born`` lists EVERY new track
@@ -328,3 +346,20 @@ class GreedyIouTracker:
 
     def snapshot(self) -> List[Dict[str, Any]]:
         return [t.snapshot() for t in self.active()]
+
+    # ------------------------------------------------------------------
+    # durability (streaming session snapshots)
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {"next_id": self.next_id, "born_total": self.born_total,
+                "died_total": self.died_total,
+                "tracks": [t.state_dict() for t in self.active()]}
+
+    def load_state_dict(self, d: Dict[str, Any]) -> None:
+        self.next_id = int(d["next_id"])
+        self.born_total = int(d["born_total"])
+        self.died_total = int(d["died_total"])
+        self.tracks = {}
+        for td in d["tracks"]:
+            t = Track.from_state_dict(td)
+            self.tracks[t.id] = t

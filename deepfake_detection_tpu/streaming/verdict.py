@@ -161,3 +161,27 @@ class VerdictMachine:
             "transitions": self.transitions,
             "last_score": self.last_score,
         }
+
+    # ------------------------------------------------------------------
+    # durability (streaming session snapshots): unlike snapshot(), the
+    # state dict is FULL precision — a restored machine must continue the
+    # score sequence bit-identically to one that never stopped
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "state": self.state,
+            "ema": self.ema,               # unrounded: EMA continuity
+            "windows": self.windows,
+            "transitions": self.transitions,
+            "last_score": self.last_score,
+        }
+
+    def load_state_dict(self, d: Dict[str, Any]) -> None:
+        if d["state"] not in SEVERITY:
+            raise ValueError(f"unknown verdict state {d['state']!r}")
+        self.state = d["state"]
+        self.ema = None if d["ema"] is None else float(d["ema"])
+        self.windows = int(d["windows"])
+        self.transitions = int(d["transitions"])
+        self.last_score = None if d.get("last_score") is None else \
+            float(d["last_score"])

@@ -33,6 +33,7 @@ bounded by one request deadline.
 
 from __future__ import annotations
 
+import base64
 import collections
 import logging
 import queue
@@ -124,6 +125,56 @@ class TrackWindower:
 
     def buffered_tracks(self) -> List[int]:
         return sorted(self._buffers)
+
+    # ------------------------------------------------------------------
+    # durability (streaming session snapshots): window-POSITION state —
+    # pushes/emitted/last-emit counters AND the buffered crops (base64
+    # raw bytes + shape), so a restored track continues mid-window and
+    # emits its next window at exactly the push an unkilled server would
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        tracks = {}
+        for tid, buf in self._buffers.items():
+            tracks[str(tid)] = {
+                "pushes": self._pushes[tid],
+                "emitted": self._emitted[tid],
+                "last_emit_push": self._last_emit_push.get(tid),
+                "frames": [
+                    {"frame_idx": fi,
+                     "shape": list(np.shape(canvas)),
+                     "dtype": str(np.asarray(canvas).dtype),
+                     "data_b64": base64.b64encode(
+                         np.ascontiguousarray(canvas).tobytes()).decode()}
+                    for fi, canvas in buf],
+            }
+        return {"img_num": self.img_num, "stride": self.stride,
+                "hop": self.hop, "tracks": tracks}
+
+    def load_state_dict(self, d: Dict[str, Any]) -> None:
+        if (int(d["img_num"]), int(d["stride"]), int(d["hop"])) != \
+                (self.img_num, self.stride, self.hop):
+            raise ValueError(
+                f"windower geometry changed across restart: snapshot has "
+                f"img_num={d['img_num']} stride={d['stride']} "
+                f"hop={d['hop']}, server runs img_num={self.img_num} "
+                f"stride={self.stride} hop={self.hop}")
+        self._buffers.clear()
+        self._pushes.clear()
+        self._emitted.clear()
+        self._last_emit_push.clear()
+        for tid_s, td in d["tracks"].items():
+            tid = int(tid_s)
+            buf = collections.deque(maxlen=self.span)
+            for fr in td["frames"]:
+                canvas = np.frombuffer(
+                    base64.b64decode(fr["data_b64"]),
+                    dtype=np.dtype(fr["dtype"])).reshape(fr["shape"])
+                buf.append((int(fr["frame_idx"]), canvas))
+            self._buffers[tid] = buf
+            self._pushes[tid] = int(td["pushes"])
+            self._emitted[tid] = int(td["emitted"])
+            if td.get("last_emit_push") is not None:
+                self._last_emit_push[tid] = int(td["last_emit_push"])
 
 
 def build_payload(frames: List[np.ndarray], wire: str) -> np.ndarray:

@@ -1,0 +1,42 @@
+"""Live-server chaos e2e (ISSUE 10): tools/chaos_serve.py scenarios
+against real ``runners/serve.py`` / ``runners/stream.py`` subprocesses.
+
+Slow tier (see tests/README.md): each scenario spawns at least one fresh
+interpreter that builds the model and warms buckets (~9 s each on the
+reference box even cache-warm), and the stream-resume scenario spawns
+THREE.  The fast tier keeps every recovery mechanism covered in-process
+(tests/test_serving_resilience.py, tests/test_streaming.py); this file
+proves the same contracts over real HTTP + SIGTERM + /metrics scrapes:
+books balance exactly, zero post-recovery backend recompiles, recovery
+under the SLO, and verdict streams that RESUME across a server bounce
+bit-identically to an unkilled replay.
+
+Small conv model at a 32² canvas so every subprocess hits the persistent
+compilation cache (the chaos-tier idiom).
+"""
+
+import pytest
+
+import tools.chaos_serve as chaos_serve
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos_serve,
+              pytest.mark.serving]
+
+_BASE = ["--model", "mobilenetv3_small_100", "--image-size", "32",
+         "--slo-s", "15"]
+
+
+def test_serve_faults_recover_books_balance_zero_recompiles():
+    """exc / nan / hang / kill: each injected fault fires under live
+    load, the engine self-heals within the SLO, the request books
+    balance exactly, and no backend recompile happens across recovery."""
+    assert chaos_serve.main(["--scenario", "exc,nan,hang,kill"] +
+                            _BASE) == 0
+
+
+def test_torn_reload_rejected_then_clean_reload_lands():
+    assert chaos_serve.main(["--scenario", "torn_reload"] + _BASE) == 0
+
+
+def test_stream_server_bounce_resumes_verdicts_bit_identically():
+    assert chaos_serve.main(["--scenario", "stream_resume"] + _BASE) == 0

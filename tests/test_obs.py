@@ -26,8 +26,11 @@ _REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 # ---------------------------------------------------------------------------
 
 def _old_serving_render(self) -> str:
-    """The pre-refactor serving/metrics.py renderer, verbatim — the golden
-    the shared utils/prometheus.py renderer must reproduce byte-for-byte."""
+    """The pre-refactor serving/metrics.py renderer — the golden the
+    shared utils/prometheus.py renderer must reproduce byte-for-byte.
+    Catalog additions since the refactor (the ISSUE 10 resilience
+    counters/gauges) are mirrored here in the same hand-rolled style, so
+    the byte-layout lock keeps covering the whole exposition."""
     from deepfake_detection_tpu.serving.metrics import (STAGES,
                                                         backend_compile_count)
     _PREFIX = "dfd_serving"
@@ -51,6 +54,14 @@ def _old_serving_render(self) -> str:
     for status, value in items:
         lines.append(
             f'{_PREFIX}_requests_total{{status="{status}"}} {value}')
+    counter("accepted_total", "Requests offered to the micro-batcher "
+            "(books: accepted == scored + shed + deadline + failed)",
+            self.accepted_total.value)
+    counter("scored_total", "Requests resolved with a score",
+            self.scored_total.value)
+    counter("failed_total", "Requests resolved with an error (engine "
+            "fault, non-finite batch, stall, shutdown)",
+            self.failed_total.value)
     counter("shed_total", "Requests rejected 429 (queue full)",
             self.shed_total.value)
     counter("deadline_total", "Requests failed 504 (deadline exceeded)",
@@ -71,12 +82,42 @@ def _old_serving_render(self) -> str:
             self.reloads_total.value)
     counter("reload_errors_total", "Rejected/failed hot reloads",
             self.reload_errors_total.value)
+    counter("reload_canary_failures_total", "Hot reloads rejected by "
+            "the golden-batch canary (non-finite / drifted scores)",
+            self.reload_canary_failures_total.value)
     counter("worker_restarts_total", "Engine worker crash recoveries",
             self.worker_restarts_total.value)
+    counter("watchdog_recoveries_total", "Watchdog-driven engine "
+            "restarts (stuck batch or dead worker)",
+            self.watchdog_recoveries_total.value)
+    counter("nonfinite_batches_total", "Device batches discarded for "
+            "NaN/Inf scores (every row failed 503, never served)",
+            self.nonfinite_batches_total.value)
+    counter("rewarms_total", "Full AOT bucket re-warm passes after a "
+            "recovery (executes existing executables; no recompiles)",
+            self.rewarms_total.value)
+    counter("breaker_opens_total", "Circuit-breaker closed/half-open "
+            "-> open transitions", self.breaker_opens_total.value)
+    counter("breaker_probes_total", "Half-open probe requests admitted",
+            self.breaker_probes_total.value)
+    counter("breaker_rejected_total", "Requests shed 503 by the open "
+            "breaker", self.breaker_rejected_total.value)
+    lines.append(f"# HELP {_PREFIX}_chaos_injections_total Injected "
+                 "faults fired (DFD_CHAOS), by point")
+    lines.append(f"# TYPE {_PREFIX}_chaos_injections_total counter")
+    with self._chaos_lock:
+        chaos_items = sorted((k, c.value) for k, c in
+                             self.chaos_injections_total.items())
+    for point, value in chaos_items:
+        lines.append(f'{_PREFIX}_chaos_injections_total'
+                     f'{{point="{point}"}} {value}')
     gauge("queue_depth", "Requests waiting in the micro-batch queue",
           self.queue_depth)
     gauge("inflight", "Requests staged on device", self.inflight)
-    gauge("ready", "1 once all buckets are warmed", int(self.ready))
+    gauge("ready", "1 once all buckets are warmed (drops during "
+          "recovery re-warm and the reload canary)", int(self.ready))
+    gauge("breaker_state", "Circuit breaker state (0 closed, 1 open, "
+          "2 half-open)", self.breaker_state)
     gauge("throughput_rps",
           f"Scored requests/sec, trailing {self._window_s:.0f}s window",
           round(self.throughput(), 3))

@@ -1,0 +1,586 @@
+"""Serving resilience units (ISSUE 10): circuit breaker, stuck-batch
+watchdog, non-finite batch handling, reload canary + rollback, jittered
+Retry-After, request books.
+
+Fast tier (``serving`` marker): every chaos fault here is injected
+in-process through the engine's ``chaos`` argument (no env vars, no
+subprocesses) against the small conv model at a 32² canvas, so the
+bucket compiles hit the persistent compilation cache.  The live-server
+versions of these scenarios (real HTTP load, SIGTERM, /metrics
+scrapes) are the slow-tier ``tools/chaos_serve.py`` e2e
+(tests/test_chaos_serve_e2e.py).
+"""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepfake_detection_tpu.chaos import ChaosInjector
+from deepfake_detection_tpu.models import create_model, init_model
+from deepfake_detection_tpu.models.helpers import save_model_checkpoint
+from deepfake_detection_tpu.params import normalize_replicate, prepare_canvas
+from deepfake_detection_tpu.serving.batcher import MicroBatcher, QueueFull
+from deepfake_detection_tpu.serving.engine import InferenceEngine
+from deepfake_detection_tpu.serving.http import (make_server,
+                                                 serve_forever_in_thread)
+from deepfake_detection_tpu.serving.metrics import (ServingMetrics,
+                                                    backend_compile_count)
+from deepfake_detection_tpu.serving.resilience import (BreakerOpen,
+                                                       CircuitBreaker,
+                                                       EngineStalled,
+                                                       NonFiniteScores,
+                                                       jittered_retry_after)
+
+pytestmark = pytest.mark.serving
+
+_MODEL = "mobilenetv3_small_100"
+_SIZE = 32
+
+
+def _perturbed_variables(model, size, chans, seed=0):
+    variables = init_model(model, jax.random.PRNGKey(0),
+                           (1, size, size, chans))
+    rng = np.random.default_rng(seed)
+    return jax.tree.map(
+        lambda a: a + jnp.asarray(
+            0.02 * rng.standard_normal(np.shape(a)).astype(np.float32)
+        ).astype(a.dtype),
+        variables)
+
+
+def _payload(seed=0):
+    rng = np.random.default_rng(seed)
+    return normalize_replicate(prepare_canvas(
+        rng.integers(0, 255, (48, 40, 3), dtype=np.uint8), _SIZE), 1)
+
+
+@pytest.fixture(scope="module")
+def mv():
+    model = create_model(_MODEL, num_classes=2, in_chans=3)
+    return model, _perturbed_variables(model, _SIZE, 3)
+
+
+def _engine(mv, *, chaos="", buckets=(1,), watchdog_timeout_s=0.0, **kw):
+    model, variables = mv
+    metrics = ServingMetrics()
+    return InferenceEngine(
+        model, variables, image_size=_SIZE, img_num=1, buckets=buckets,
+        metrics=metrics, chaos=ChaosInjector(chaos),
+        watchdog_timeout_s=watchdog_timeout_s, **kw)
+
+
+def _books(m: ServingMetrics):
+    return (m.accepted_total.value,
+            m.scored_total.value + m.shed_total.value +
+            m.deadline_total.value + m.failed_total.value)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine (injected clock, no jax)
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_opens_on_consecutive_failures_only():
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=3, open_s=5.0, clock=clk)
+    # sporadic failures interleaved with successes never open it
+    for _ in range(10):
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        b.allow()
+    assert b.state == "closed"
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "open"
+    with pytest.raises(BreakerOpen) as ei:
+        b.allow()
+    # remaining cooldown plus the bounded anti-herd jitter
+    assert 0 < ei.value.retry_after_s <= 5.0 + b.retry_jitter_s
+
+
+def test_breaker_half_open_single_probe_then_close_or_reopen():
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=1, open_s=5.0, clock=clk)
+    b.record_failure()
+    assert b.state == "open"
+    clk.t += 5.1
+    b.allow()                      # the probe is admitted
+    assert b.state == "half_open"
+    with pytest.raises(BreakerOpen):
+        b.allow()                  # ...but only ONE probe
+    b.record_success()             # probe succeeded
+    assert b.state == "closed"
+    b.allow()
+    # reopen path: probe failure restarts the full cooldown
+    b.record_failure()
+    clk.t += 5.1
+    b.allow()
+    b.record_failure()             # probe failed
+    assert b.state == "open"
+    with pytest.raises(BreakerOpen):
+        b.allow()
+
+
+def test_breaker_unreported_probe_cannot_wedge_it_shut():
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=1, open_s=2.0, clock=clk)
+    b.record_failure()
+    clk.t += 2.1
+    b.allow()                      # probe admitted, outcome never reported
+    clk.t += 2.1                   # a cooldown's silence later...
+    b.allow()                      # ...the next arrival re-probes
+
+
+def test_breaker_threshold_zero_disables():
+    b = CircuitBreaker(failure_threshold=0, open_s=1.0)
+    for _ in range(100):
+        b.record_failure()
+    assert b.state == "closed"
+    b.allow()
+
+
+# ---------------------------------------------------------------------------
+# jittered Retry-After (thundering-herd satellite)
+# ---------------------------------------------------------------------------
+
+def test_jittered_retry_after_bounded_spread():
+    import random
+    rng = random.Random(3)
+    vals = [jittered_retry_after(1.0, 2.0, rng) for _ in range(200)]
+    assert all(1.0 <= v < 3.0 for v in vals)
+    assert len({round(v, 3) for v in vals}) > 100    # spread, not constant
+
+
+def test_queue_full_retry_after_is_jittered():
+    m = ServingMetrics()
+    b = MicroBatcher(max_batch=4, deadline_ms=1.0, max_queue=1,
+                     metrics=m, retry_jitter_s=2.0)
+    b.submit(np.zeros((4, 4, 3), np.uint8))
+    retries = []
+    for _ in range(24):
+        with pytest.raises(QueueFull) as ei:
+            b.submit(np.zeros((4, 4, 3), np.uint8))
+        retries.append(ei.value.retry_after_s)
+    assert all(1.0 <= r < 3.0 for r in retries)      # base 1 + [0, 2)
+    assert len({round(r, 3) for r in retries}) >= 2  # jittered, not fixed
+    # books: the shed submits are accepted + shed, the queued one pending
+    assert m.accepted_total.value == 25
+    assert m.shed_total.value == 24
+
+
+# ---------------------------------------------------------------------------
+# non-finite batch: 503 + counter, never a silent score
+# ---------------------------------------------------------------------------
+
+def test_nonfinite_batch_fails_requests_and_next_batch_serves(mv):
+    eng = _engine(mv, chaos="serve_nan@0")
+    b = MicroBatcher(max_batch=1, deadline_ms=1.0, max_queue=8,
+                     metrics=eng.metrics)
+    eng.start(b)
+    try:
+        with pytest.raises(NonFiniteScores):
+            b.submit(_payload(), timeout_s=10).result(timeout=10)
+        assert eng.metrics.nonfinite_batches_total.value == 1
+        # the engine self-heals: the next batch serves normally
+        scores = b.submit(_payload(1), timeout_s=10).result(timeout=10)
+        assert scores.shape == (2,) and np.isfinite(scores).all()
+        acc, resolved = _books(eng.metrics)
+        assert acc == resolved == 2
+    finally:
+        eng.stop()
+        b.close()
+
+
+def test_injected_score_fn_exception_recovers(mv):
+    eng = _engine(mv, chaos="serve_exc@0")
+    b = MicroBatcher(max_batch=1, deadline_ms=1.0, max_queue=8,
+                     metrics=eng.metrics)
+    eng.start(b)
+    try:
+        with pytest.raises(RuntimeError, match="chaos"):
+            b.submit(_payload(), timeout_s=10).result(timeout=10)
+        # the request fails BEFORE the exception finishes unwinding into
+        # the serve loop's crash counter: poll for it
+        deadline = time.monotonic() + 5
+        while eng.metrics.worker_restarts_total.value == 0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.metrics.worker_restarts_total.value == 1
+        scores = b.submit(_payload(1), timeout_s=10).result(timeout=10)
+        assert scores.shape == (2,)
+        assert _books(eng.metrics)[0] == _books(eng.metrics)[1] == 2
+    finally:
+        eng.stop()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# stuck-batch watchdog: fail in-flight, restart worker, re-warm, readyz
+# ---------------------------------------------------------------------------
+
+def test_hang_watchdog_fails_inflight_rewarm_drops_readiness(mv):
+    eng = _engine(mv, chaos="serve_hang@0:8", watchdog_timeout_s=0.5)
+    eng.watchdog.poll_s = 0.02
+    ready_during_rewarm = []
+    orig_rewarm = eng._rewarm
+
+    def spying_rewarm():
+        ready_during_rewarm.append(eng.metrics.ready)
+        orig_rewarm()
+
+    eng._rewarm = spying_rewarm
+    b = MicroBatcher(max_batch=1, deadline_ms=1.0, max_queue=8,
+                     metrics=eng.metrics)
+    backend0 = backend_compile_count()
+    eng.start(b)
+    try:
+        with pytest.raises(EngineStalled):
+            b.submit(_payload(), timeout_s=30).result(timeout=20)
+        assert eng.metrics.watchdog_recoveries_total.value == 1
+        # the requests fail BEFORE the (bounded, helper-thread) re-warm
+        # runs: wait for recovery to finish, then check the flag history
+        deadline = time.monotonic() + 10
+        while not eng.metrics.ready and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert eng.metrics.ready            # serving again...
+        assert ready_during_rewarm == [False]   # ...and readiness was
+        assert eng.metrics.rewarms_total.value == 1   # DOWN mid-re-warm
+        # the restarted worker serves, on the SAME executables
+        scores = b.submit(_payload(1), timeout_s=10).result(timeout=10)
+        assert scores.shape == (2,)
+        assert backend_compile_count() == backend0   # zero recompiles
+        assert _books(eng.metrics)[0] == _books(eng.metrics)[1] == 2
+    finally:
+        eng.stop()
+        b.close()
+
+
+def test_worker_kill_respawned_by_watchdog(mv):
+    eng = _engine(mv, chaos="serve_kill@0", watchdog_timeout_s=5.0)
+    eng.watchdog.poll_s = 0.02
+    b = MicroBatcher(max_batch=1, deadline_ms=1.0, max_queue=8,
+                     metrics=eng.metrics)
+    eng.start(b)
+    try:
+        deadline = time.monotonic() + 10
+        while eng.metrics.watchdog_recoveries_total.value == 0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert eng.metrics.watchdog_recoveries_total.value == 1
+        scores = b.submit(_payload(), timeout_s=10).result(timeout=10)
+        assert scores.shape == (2,)
+    finally:
+        eng.stop()
+        b.close()
+
+
+def test_breaker_opens_after_consecutive_batch_failures(mv):
+    eng = _engine(mv, chaos="serve_exc@0x2", breaker_threshold=2,
+                  breaker_open_s=0.3)
+    b = MicroBatcher(max_batch=1, deadline_ms=1.0, max_queue=8,
+                     metrics=eng.metrics)
+    eng.start(b)
+    try:
+        for seed in (0, 1):        # two consecutive injected batch faults
+            with pytest.raises(RuntimeError):
+                b.submit(_payload(seed), timeout_s=10).result(timeout=10)
+        assert eng.breaker.state == "open"
+        assert eng.metrics.breaker_opens_total.value == 1
+        with pytest.raises(BreakerOpen):
+            eng.breaker.allow()
+        assert eng.metrics.breaker_rejected_total.value == 1
+        time.sleep(0.35)           # cooldown -> half-open probe
+        eng.breaker.allow()
+        assert eng.metrics.breaker_probes_total.value == 1
+        scores = b.submit(_payload(2), timeout_s=10).result(timeout=10)
+        assert scores.shape == (2,)
+        assert eng.breaker.state == "closed"     # probe batch closed it
+    finally:
+        eng.stop()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# hot-reload canary gate + rollback (satellite: torn / mismatched / NaN
+# checkpoints each leave the old weights serving bit-identically)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def reload_stack(mv):
+    """Engine + HTTP server with NO worker thread: the canary tests
+    drive ``_maybe_apply_reload`` synchronously (deterministic
+    assertions, no race with a serve loop); /healthz, /readyz and
+    /metrics still serve."""
+    model, variables = mv
+    metrics = ServingMetrics()
+    engine = InferenceEngine(model, variables, image_size=_SIZE, img_num=1,
+                             buckets=(1,), metrics=metrics,
+                             watchdog_timeout_s=0.0)
+    batcher = MicroBatcher(max_batch=1, deadline_ms=1.0, max_queue=16,
+                           metrics=metrics)
+    server = make_server("127.0.0.1", 0, engine, batcher, metrics,
+                         request_timeout_s=10.0)
+    serve_forever_in_thread(server)
+    yield type("S", (), dict(model=model, variables=variables,
+                             engine=engine, batcher=batcher,
+                             metrics=metrics, server=server,
+                             port=server.server_address[1]))
+    server.shutdown()
+    engine.stop()
+    batcher.close()
+    server.server_close()
+
+
+@pytest.fixture(scope="module")
+def live_stack(mv):
+    """Engine + RUNNING worker + HTTP server, for tests that score over
+    the wire (breaker shedding, request books)."""
+    model, variables = mv
+    metrics = ServingMetrics()
+    engine = InferenceEngine(model, variables, image_size=_SIZE, img_num=1,
+                             buckets=(1,), metrics=metrics,
+                             watchdog_timeout_s=0.0)
+    batcher = MicroBatcher(max_batch=1, deadline_ms=1.0, max_queue=16,
+                           metrics=metrics)
+    engine.start(batcher)
+    server = make_server("127.0.0.1", 0, engine, batcher, metrics,
+                         request_timeout_s=10.0)
+    serve_forever_in_thread(server)
+    yield type("S", (), dict(model=model, variables=variables,
+                             engine=engine, batcher=batcher,
+                             metrics=metrics, server=server,
+                             port=server.server_address[1]))
+    server.shutdown()
+    engine.stop()
+    batcher.close()
+    server.server_close()
+
+
+def _host_tree(variables):
+    return jax.tree.map(np.asarray, variables)
+
+
+def test_canary_rejects_nan_params_bit_identical_rollback(reload_stack):
+    s = reload_stack
+    payload = _payload(5)
+    before = s.engine.score_batch([payload])
+    errors0 = s.metrics.reload_errors_total.value
+    canary0 = s.metrics.reload_canary_failures_total.value
+    nan_tree = jax.tree.map(
+        lambda a: np.full_like(np.asarray(a), np.nan)
+        if np.issubdtype(np.asarray(a).dtype, np.floating)
+        else np.asarray(a), s.variables)
+    s.engine.submit_reload(nan_tree, source="<nan-test>")
+    s.engine._maybe_apply_reload()
+    assert s.metrics.reload_errors_total.value == errors0 + 1
+    assert s.metrics.reload_canary_failures_total.value == canary0 + 1
+    assert s.engine.reload_count == 0
+    np.testing.assert_array_equal(s.engine.score_batch([payload]), before)
+
+
+def test_canary_rejects_shape_mismatch_bit_identical_rollback(reload_stack):
+    s = reload_stack
+    payload = _payload(6)
+    before = s.engine.score_batch([payload])
+    errors0 = s.metrics.reload_errors_total.value
+    s.engine.submit_reload(
+        {"params": {"nope": np.zeros((3, 3), np.float32)}},
+        source="<shape-test>")
+    s.engine._maybe_apply_reload()
+    assert s.metrics.reload_errors_total.value == errors0 + 1
+    np.testing.assert_array_equal(s.engine.score_batch([payload]), before)
+
+
+def test_watcher_rejects_torn_msgpack_bit_identical_rollback(
+        reload_stack, tmp_path):
+    s = reload_stack
+    payload = _payload(7)
+    before = s.engine.score_batch([payload])
+    errors0 = s.metrics.reload_errors_total.value
+    good = _host_tree(_perturbed_variables(s.model, _SIZE, 3, seed=9))
+    watch_dir = tmp_path / "watch"
+    watch_dir.mkdir()
+    # the watcher only reacts to files appearing AFTER it starts, so
+    # tear the checkpoint in a staging dir and move it in atomically
+    staging = tmp_path / "next.msgpack"
+    save_model_checkpoint(str(staging), good)
+    data = staging.read_bytes()
+    staging.write_bytes(data[:len(data) // 2])       # tear it in half
+    s.engine.start_reload_watcher(str(watch_dir), interval_s=0.05)
+    import os
+    os.replace(staging, watch_dir / "next.msgpack")
+    try:
+        deadline = time.monotonic() + 10
+        while s.metrics.reload_errors_total.value == errors0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert s.metrics.reload_errors_total.value == errors0 + 1
+        assert s.engine.reload_count == 0
+        np.testing.assert_array_equal(s.engine.score_batch([payload]),
+                                      before)
+    finally:
+        s.engine._stop.set()       # stop only the watcher thread
+        s.engine._watcher.join(timeout=5)
+        s.engine._watcher = None
+        s.engine._stop.clear()
+
+
+def test_canary_drift_tolerance_gates_and_admits(reload_stack):
+    s = reload_stack
+    payload = _payload(8)
+    before = s.engine.score_batch([payload])
+    nudged = _host_tree(_perturbed_variables(s.model, _SIZE, 3, seed=4))
+    canary0 = s.metrics.reload_canary_failures_total.value
+    try:
+        s.engine.reload_drift_tol = 0.0      # zero tolerance: any change
+        s.engine.submit_reload(nudged, source="<drift-test>")
+        s.engine._maybe_apply_reload()
+        assert s.metrics.reload_canary_failures_total.value == canary0 + 1
+        assert s.engine.reload_count == 0
+        np.testing.assert_array_equal(s.engine.score_batch([payload]),
+                                      before)
+        s.engine.reload_drift_tol = 1.0      # softmax drift is <= 1
+        s.engine.submit_reload(nudged, source="<drift-test-2>")
+        s.engine._maybe_apply_reload()
+        assert s.engine.reload_count == 1
+        after = s.engine.score_batch([payload])
+        assert not np.array_equal(after, before)
+    finally:
+        s.engine.reload_drift_tol = -1.0
+        # restore the original serving weights for later tests
+        s.engine.submit_reload(_host_tree(s.variables), source="<restore>")
+        s.engine._maybe_apply_reload()
+
+
+def test_readyz_drops_during_canary_healthz_stays(reload_stack):
+    """The satellite fix pinned: while the reload canary runs, /readyz
+    must say 503 (readiness would otherwise lie about the paused worker)
+    and /healthz must stay 200."""
+    s = reload_stack
+    seen = {}
+
+    def hook():
+        seen["ready_flag"] = s.engine.ready
+        for path in ("/healthz", "/readyz"):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{s.port}{path}", timeout=5) as r:
+                    seen[path] = r.status
+            except urllib.error.HTTPError as e:
+                seen[path] = e.code
+
+    s.engine._canary_hook = hook
+    try:
+        s.engine.submit_reload(_host_tree(s.variables), source="<ready>")
+        s.engine._maybe_apply_reload()
+    finally:
+        s.engine._canary_hook = None
+    assert seen == {"ready_flag": False, "/healthz": 200, "/readyz": 503}
+    assert s.engine.ready                    # restored after the canary
+
+
+# ---------------------------------------------------------------------------
+# HTTP mapping: non-finite -> 503 + Retry-After, breaker -> 503
+# ---------------------------------------------------------------------------
+
+def _post_image(port, body, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/score", data=body,
+        headers={"Content-Type": "image/jpeg"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers)
+
+
+def _jpeg(seed=0):
+    import io
+
+    from PIL import Image
+    rng = np.random.default_rng(seed)
+    buf = io.BytesIO()
+    Image.fromarray(rng.integers(0, 255, (40, 40, 3), dtype=np.uint8)
+                    ).save(buf, "JPEG", quality=90)
+    return buf.getvalue()
+
+
+def test_http_nonfinite_maps_503_with_retry_after(mv):
+    eng = _engine(mv, chaos="serve_nan@0")
+    b = MicroBatcher(max_batch=1, deadline_ms=1.0, max_queue=8,
+                     metrics=eng.metrics)
+    eng.start(b)
+    server = make_server("127.0.0.1", 0, eng, b, eng.metrics,
+                         request_timeout_s=10.0)
+    serve_forever_in_thread(server)
+    port = server.server_address[1]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_image(port, _jpeg())
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        status, _ = _post_image(port, _jpeg(1))   # self-healed
+        assert status == 200
+    finally:
+        server.shutdown()
+        eng.stop()
+        b.close()
+        server.server_close()
+
+
+def test_http_breaker_open_sheds_503(live_stack):
+    s = live_stack
+    # force the breaker open without faulting the shared engine
+    for _ in range(s.engine.breaker.failure_threshold):
+        s.engine.breaker.record_failure()
+    try:
+        assert s.engine.breaker.state == "open"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_image(s.port, _jpeg(2))
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert s.metrics.breaker_rejected_total.value >= 1
+    finally:
+        s.engine.breaker.record_success()        # close it again
+    assert s.engine.breaker.state == "closed"
+    assert _post_image(s.port, _jpeg(3))[0] == 200
+
+
+# ---------------------------------------------------------------------------
+# request books under mixed outcomes
+# ---------------------------------------------------------------------------
+
+def test_books_balance_under_mixed_load(live_stack):
+    """accepted == scored + shed + deadline + failed, exactly, across a
+    mix of successes, a poisoned request, queue-expired deadlines and
+    shutdown — the invariant tools/chaos_serve.py asserts from /metrics
+    after every live fault scenario."""
+    s = live_stack
+    m = s.metrics
+    # successes
+    reqs = [s.batcher.submit(_payload(i), timeout_s=10) for i in range(3)]
+    for r in reqs:
+        assert r.result(timeout=10).shape == (2,)
+    # a poisoned request (bad shape) fails
+    bad = s.batcher.submit(np.zeros((7, 9, 3), np.float32), timeout_s=10)
+    with pytest.raises(Exception):
+        bad.result(timeout=10)
+    # one more success so the worker is provably healthy again
+    assert s.batcher.submit(_payload(9),
+                            timeout_s=10).result(timeout=10).shape == (2,)
+    deadline = time.monotonic() + 10
+    while _books(m)[0] != _books(m)[1] and time.monotonic() < deadline:
+        time.sleep(0.02)
+    acc, resolved = _books(m)
+    assert acc == resolved

@@ -8,7 +8,9 @@ of iterations per seed.
 """
 
 import io
+import json
 import sys
+import time
 import types
 
 import numpy as np
@@ -595,3 +597,262 @@ def test_split_multipart_empty_header_block_and_binary_payload():
             b"--b\r\nContent-Type: image/jpeg\r\n\r\n" + payload +
             b"\r\n--b--\r\n")
     assert split_multipart(body, "b") == [payload, payload]
+
+
+# ---------------------------------------------------------------------------
+# session durability (ISSUE 10): state round trips must CONTINUE streams
+# bit-identically, never reset them
+# ---------------------------------------------------------------------------
+
+def test_verdict_machine_state_roundtrip_bit_identical():
+    """Restore + continue == never stopped, bit-for-bit: same states,
+    same EMA floats, same events, for any split point."""
+    rng = np.random.default_rng(5)
+    scores = rng.random(40)
+    for split in (1, 7, 23):
+        ref = VerdictMachine(VerdictThresholds(), ema_alpha=0.3)
+        ref_events = [ref.update(s, wall_time=0.0) for s in scores]
+        vm = VerdictMachine(VerdictThresholds(), ema_alpha=0.3)
+        head = [vm.update(s, wall_time=0.0) for s in scores[:split]]
+        resumed = VerdictMachine(VerdictThresholds(), ema_alpha=0.3)
+        resumed.load_state_dict(vm.state_dict())
+        tail = [resumed.update(s, wall_time=0.0) for s in scores[split:]]
+        assert resumed.state == ref.state
+        assert resumed.ema == ref.ema                # bit-identical float
+        assert resumed.windows == ref.windows
+        assert resumed.transitions == ref.transitions
+        assert head + tail == ref_events
+    with pytest.raises(ValueError):
+        VerdictMachine().load_state_dict({"state": "weird", "ema": 0.1,
+                                          "windows": 1, "transitions": 0})
+
+
+def test_tracker_state_roundtrip_continues_identically():
+    def boxes(i):
+        return [((10.0 + i, 10.0, 30.0 + i, 30.0), 0.9),
+                ((60.0, 60.0 + i, 80.0, 80.0 + i), 0.8)]
+
+    ref = GreedyIouTracker(ema_alpha=0.6, max_coast=2)
+    for i in range(12):
+        ref.update(i, boxes(i))
+    t = GreedyIouTracker(ema_alpha=0.6, max_coast=2)
+    for i in range(5):
+        t.update(i, boxes(i))
+    restored = GreedyIouTracker(ema_alpha=0.6, max_coast=2)
+    restored.load_state_dict(t.state_dict())
+    for i in range(5, 12):
+        restored.update(i, boxes(i))
+    assert restored.next_id == ref.next_id
+    assert restored.born_total == ref.born_total
+    assert sorted(restored.tracks) == sorted(ref.tracks)
+    for tid in ref.tracks:
+        assert restored.tracks[tid].box == ref.tracks[tid].box  # bit-equal
+        assert restored.tracks[tid].hits == ref.tracks[tid].hits
+
+
+def test_windower_state_roundtrip_resumes_mid_window():
+    ref = TrackWindower(img_num=3, stride=1, hop=2)
+    w = TrackWindower(img_num=3, stride=1, hop=2)
+    ref_wins, cut_wins = [], []
+    frames = _frames(10)
+    for i, f in enumerate(frames):
+        rw = ref.push(0, i, f)
+        if rw is not None:
+            ref_wins.append(rw)
+    for i, f in enumerate(frames[:4]):                # cut mid-hop
+        cw = w.push(0, i, f)
+        if cw is not None:
+            cut_wins.append(cw)
+    restored = TrackWindower(img_num=3, stride=1, hop=2)
+    restored.load_state_dict(w.state_dict())
+    for i, f in enumerate(frames[4:], start=4):
+        cw = restored.push(0, i, f)
+        if cw is not None:
+            cut_wins.append(cw)
+    assert len(cut_wins) == len(ref_wins)
+    for a, b in zip(cut_wins, ref_wins):
+        assert a.window_idx == b.window_idx
+        assert a.frame_idxs == b.frame_idxs
+        for fa, fb in zip(a.frames, b.frames):
+            np.testing.assert_array_equal(fa, fb)    # buffered crops too
+    # geometry drift across a restart is a loud error, not silent skew
+    other = TrackWindower(img_num=2, stride=1, hop=2)
+    with pytest.raises(ValueError, match="geometry"):
+        other.load_state_dict(w.state_dict())
+
+
+def _session(cfg_kw=None, jobs=None, sid="s1", metrics=None,
+             event_log_path=None):
+    from deepfake_detection_tpu.config import StreamConfig
+    from deepfake_detection_tpu.streaming.ingest import StreamSession
+    cfg = StreamConfig(image_size=16, img_num=2, buckets=(1,),
+                       max_queue=1, stream_ttl_s=0.0,
+                       verdict_vector="0.1*2,0.95*8", **(cfg_kw or {}))
+    disp = types.SimpleNamespace(push=(jobs.append if jobs is not None
+                                       else (lambda j: None)))
+    return StreamSession(sid, cfg, disp, metrics or StreamingMetrics(),
+                         16, "float32", event_log_path=event_log_path)
+
+
+def _feed(session, jobs, n_frames, tag=0):
+    """Push frames; score every emitted window in arrival order (the
+    planted verdict vector makes scores deterministic)."""
+    frames = [np.full((16, 16, 3), (tag + i) % 255, np.uint8)
+              for i in range(n_frames)]
+    for f in frames:
+        session.ingest_arrays([f])
+        while jobs:
+            session.on_window_result(jobs.pop(0),
+                                     np.asarray([0.5, 0.5]), None)
+
+
+def test_session_state_roundtrip_resumes_verdicts_bit_identically():
+    """The tentpole durability contract at session granularity: snapshot
+    after N frames + restore + the remaining frames == one uninterrupted
+    session, for status, verdict machines and event sequence."""
+    ref_jobs, jobs = [], []
+    ref = _session(jobs=ref_jobs)
+    _feed(ref, ref_jobs, 20)
+
+    s1 = _session(jobs=jobs)
+    _feed(s1, jobs, 8)
+    snap = s1.state_dict()
+    snap2 = json.loads(json.dumps(snap))       # through-JSON round trip
+
+    s2 = _session(jobs=jobs, sid="s1")
+    s2.load_state(snap2)
+    assert s2.windows_scored == s1.windows_scored    # no reset
+    _feed(s2, jobs, 12, tag=8)
+
+    def comparable(st):
+        return {k: v for k, v in st.items()
+                if k not in ("created", "events")} | {
+                    "events": [{k: v for k, v in ev.items()
+                                if k != "wall_time"}
+                               for ev in st["events"]]}
+
+    assert comparable(s2.status()) == comparable(ref.status())
+    assert s2.stream_verdict.ema == ref.stream_verdict.ema   # bit-equal
+    # wrong-schema and wrong-id snapshots are loud errors
+    with pytest.raises(ValueError, match="schema"):
+        _session(sid="s1").load_state({**snap2, "schema": "nope"})
+    with pytest.raises(ValueError, match="stream"):
+        _session(sid="other").load_state(snap2)
+
+
+def test_session_snapshot_counts_inflight_windows_dropped():
+    """Windows in flight at snapshot time can never report back into the
+    restored session — the snapshot books them dropped so per-stream
+    accounting still balances across the bounce."""
+    jobs = []
+    s = _session(jobs=jobs)
+    frames = [np.zeros((16, 16, 3), np.uint8)] * 4
+    for f in frames:
+        s.ingest_arrays([f])
+    assert len(jobs) == 2                     # 2 windows still "in flight"
+    snap = s.state_dict()
+    c = snap["counters"]
+    assert c["windows_emitted"] == 2
+    assert c["windows_dropped"] == 2          # booked at snapshot
+    assert c["windows_emitted"] == c["windows_scored"] + \
+        c["windows_dropped"] + c["windows_shed"] + c["windows_failed"]
+
+
+def test_manager_save_restore_consumes_snapshots_and_flags_bad(tmp_path):
+    from deepfake_detection_tpu.config import StreamConfig
+    from deepfake_detection_tpu.streaming.ingest import StreamManager
+    cfg = StreamConfig(image_size=16, img_num=2, buckets=(1,),
+                       max_queue=1, stream_ttl_s=0.0)
+    metrics = StreamingMetrics()
+    disp = types.SimpleNamespace(push=lambda j: None,
+                                 drop_stream=lambda sid: 0)
+    mgr = StreamManager(cfg, disp, metrics, 16, "float32")
+    a = mgr.create("alpha")
+    mgr.create("beta")
+    a.ingest_arrays([np.zeros((16, 16, 3), np.uint8)] * 2)
+    state_dir = tmp_path / "state"
+    assert mgr.save_state(str(state_dir)) == 2
+    files = sorted(p.name for p in state_dir.iterdir())
+    assert files == ["alpha.state.json", "beta.state.json"]
+    # a corrupt snapshot is renamed .bad + counted; good ones restore
+    (state_dir / "beta.state.json").write_text("{torn")
+    mgr2 = StreamManager(cfg, disp, metrics, 16, "float32")
+    assert mgr2.restore_state(str(state_dir)) == 1
+    assert mgr2.get("alpha") is not None
+    assert mgr2.get("alpha").frames_ingested == 2
+    assert mgr2.get("beta") is None
+    assert metrics.streams_restored_total.value == 1
+    assert metrics.state_errors_total.value == 1
+    left = sorted(p.name for p in state_dir.iterdir())
+    assert left == ["beta.state.json.bad"]    # consumed + quarantined
+
+
+def test_event_log_one_coherent_stream_across_resume_with_torn_tail(
+        tmp_path):
+    """The PR 6 telemetry idiom applied to per-stream verdict JSONL: a
+    SIGTERM-torn tail is truncated on resume and appends continue the
+    SAME schema-versioned stream (every line parses, transition paths
+    stay connected per machine)."""
+    log = tmp_path / "s1.events.jsonl"
+    jobs = []
+    s1 = _session(jobs=jobs, event_log_path=str(log))
+    _feed(s1, jobs, 8)                       # escalations hit the log
+    snap = s1.state_dict()
+    with open(log, "a") as f:
+        f.write('{"schema": "dfd.streaming.verdict.v1", "event": "verd')
+    s2 = _session(jobs=jobs, event_log_path=str(log))
+    s2.load_state(snap)                      # repairs the torn tail
+    _feed(s2, jobs, 12, tag=8)
+    events = [json.loads(line) for line in open(log)]
+    assert len(events) >= 2
+    by_machine = {}
+    for ev in events:
+        assert ev["schema"] == "dfd.streaming.verdict.v1"
+        by_machine.setdefault((ev.get("scope"), ev.get("track_id")),
+                              []).append(ev)
+    for evs in by_machine.values():
+        assert all(a["to"] == b["from"] for a, b in zip(evs, evs[1:]))
+
+
+# ---------------------------------------------------------------------------
+# ffmpeg demuxer failure path (ISSUE 10 satellite): death mid-stream is a
+# counted error, never a hang
+# ---------------------------------------------------------------------------
+
+def _stub_ffmpeg(tmp_path):
+    """A fake ffmpeg: forwards stdin to stdout unbuffered (so SOI/EOI
+    framing works through it) and ignores the real binary's flags."""
+    stub = tmp_path / "fake-ffmpeg"
+    stub.write_text(
+        f"#!{sys.executable}\n"
+        "import sys\n"
+        "while True:\n"
+        "    b = sys.stdin.buffer.read1(65536)\n"
+        "    if not b:\n"
+        "        break\n"
+        "    sys.stdout.buffer.write(b)\n"
+        "    sys.stdout.buffer.flush()\n")
+    stub.chmod(0o755)
+    return str(stub)
+
+
+def test_demuxer_kill_mid_stream_surfaces_error_not_hang(tmp_path):
+    from deepfake_detection_tpu.streaming.ingest import FfmpegDemuxer
+    d = FfmpegDemuxer(binary=_stub_ffmpeg(tmp_path))
+    try:
+        d.feed(_jpeg(1) + _jpeg(2))
+        frames = []
+        deadline = time.monotonic() + 10
+        while len(frames) < 2 and time.monotonic() < deadline:
+            frames.extend(d.poll_frames())
+        assert len(frames) == 2              # passthrough frames surface
+        assert not d.dead
+        d._proc.kill()                       # ffmpeg dies mid-stream
+        d._proc.wait(timeout=10)
+        assert d.dead
+        with pytest.raises(OSError, match="mid-stream"):
+            d.feed(_jpeg(3))                 # surfaces, never wedges
+    finally:
+        # close-flush must stay safe on an already-dead process
+        assert d.close() == []
+    assert not d.dead                        # deliberate close, not death

@@ -467,3 +467,64 @@ def test_bench_stream_smoke(tmp_path):
     text = out.read_text()
     assert "PASS" in text                      # verdict probe
     assert "delta across every load/probe phase = **0**" in text
+
+
+# ---------------------------------------------------------------------------
+# container demux death over HTTP (ISSUE 10 satellite): counted per-stream
+# error + reset, never a hang
+# ---------------------------------------------------------------------------
+
+def test_container_demux_death_counted_and_reset_over_http(
+        stack, tmp_path, monkeypatch):
+    """ffmpeg dying mid-stream surfaces as a 422 with the demuxer reset
+    and ``dfd_streaming_demux_failures_total`` + the per-stream counter
+    moving; the session stays usable and closes cleanly."""
+    import io
+
+    from PIL import Image
+    from test_streaming import _stub_ffmpeg
+
+    from deepfake_detection_tpu.streaming import ingest as ingest_mod
+
+    stub = _stub_ffmpeg(tmp_path)
+
+    class StubDemuxer(ingest_mod.FfmpegDemuxer):
+        @staticmethod
+        def available(binary="ffmpeg"):
+            return True
+
+        def __init__(self, binary="ffmpeg"):
+            super().__init__(binary=str(stub))
+
+    monkeypatch.setattr(ingest_mod, "FfmpegDemuxer", StubDemuxer)
+    port = stack.port
+    sid = _open_stream(port, "demux-kill")
+    rng = np.random.default_rng(3)
+    buf = io.BytesIO()
+    Image.fromarray(rng.integers(0, 255, (_SIZE, _SIZE, 3),
+                                 dtype=np.uint8)).save(buf, "JPEG",
+                                                       quality=90)
+    jpeg = buf.getvalue()
+    headers = {"Content-Type": "video/mp4"}
+    status, ack = _req(port, "POST", f"/streams/{sid}/frames",
+                       jpeg * 2, headers)
+    assert status == 200                      # passthrough stub: frames
+    assert ack["frames_accepted"] == 2        # surface like real ffmpeg
+    session = stack.manager.get(sid)
+    failures0 = stack.metrics.demux_failures_total.value
+    session.demuxer._proc.kill()              # ffmpeg dies mid-stream
+    session.demuxer._proc.wait(timeout=10)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(port, "POST", f"/streams/{sid}/frames", jpeg, headers)
+    assert ei.value.code == 422               # surfaced, not hung
+    assert stack.metrics.demux_failures_total.value == failures0 + 1
+    _, st = _req(port, "GET", f"/streams/{sid}")
+    assert st["counters"]["demux_failures"] == 1
+    assert session.demuxer is None            # reset for the next chunk
+    # the stream stays usable: the next container chunk gets a fresh
+    # demuxer, and close-flush is safe
+    status, ack = _req(port, "POST", f"/streams/{sid}/frames", jpeg,
+                       headers)
+    assert status == 200 and ack["frames_accepted"] == 1
+    status, final = _req(port, "DELETE", f"/streams/{sid}")
+    assert status == 200 and final["counters"]["demux_failures"] == 1

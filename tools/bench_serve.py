@@ -147,12 +147,14 @@ class _Client(threading.Thread):
     2-core box's CPU to the load generator instead of the server."""
 
     def __init__(self, netloc: str, jpegs: List[bytes], stop: threading.Event,
-                 measure_from: float, seed: int):
+                 measure_from: float, seed: int,
+                 retry_cap_s: float = 2.0):
         super().__init__(daemon=True)
         host, port = netloc.split(":")
         self.addr = (host, int(port))
         self.stop_ev = stop
         self.measure_from = measure_from
+        self.retry_cap_s = retry_cap_s
         self.latencies: List[float] = []
         self.statuses: Dict[int, int] = {}
         # pre-serialize one request per source image
@@ -165,30 +167,38 @@ class _Client(threading.Thread):
         self.offset = int(np.random.default_rng(seed).integers(
             0, len(self.requests)))
 
-    def _recv_response(self, sock_file) -> int:
+    def _recv_response(self, sock_file) -> Tuple[int, float]:
         """Minimal HTTP/1.1 response read: status + headers +
-        Content-Length body."""
+        Content-Length body; returns (status, retry_after_s or 0)."""
         status_line = sock_file.readline()
         if not status_line:
             raise OSError("connection closed")
         status = int(status_line.split(b" ", 2)[1])
         length = 0
+        retry_after = 0.0
         while True:
             line = sock_file.readline()
             if line in (b"\r\n", b"\n", b""):
                 break
             if line.lower().startswith(b"content-length:"):
                 length = int(line.split(b":", 1)[1])
+            elif line.lower().startswith(b"retry-after:"):
+                try:
+                    retry_after = float(line.split(b":", 1)[1])
+                except ValueError:
+                    pass
         if length:
             sock_file.read(length)
-        return status
+        return status, retry_after
 
     def run(self) -> None:
         sock = None
         f = None
         i = self.offset
+        consec_shed = 0
         while not self.stop_ev.is_set():
             t0 = time.monotonic()
+            retry_after = 0.0
             try:
                 if sock is None:
                     sock = socket.create_connection(self.addr, timeout=30)
@@ -197,7 +207,7 @@ class _Client(threading.Thread):
                     f = sock.makefile("rb")
                 sock.sendall(self.requests[i % len(self.requests)])
                 i += 1
-                status = self._recv_response(f)
+                status, retry_after = self._recv_response(f)
             except OSError:
                 if sock is not None:
                     sock.close()
@@ -208,18 +218,29 @@ class _Client(threading.Thread):
                 if status == 200:
                     self.latencies.append(dt)
                 self.statuses[status] = self.statuses.get(status, 0) + 1
-            if status == 429:
-                time.sleep(0.05)
+            if status in (429, 503):
+                # honor the server's (jittered) Retry-After with capped
+                # exponential backoff: repeated sheds double the wait up
+                # to the cap instead of hammering a saturated queue
+                consec_shed += 1
+                base = retry_after if retry_after > 0 else 0.05
+                wait = min(self.retry_cap_s,
+                           base * (2 ** min(consec_shed - 1, 4)))
+                self.stop_ev.wait(wait)
+            else:
+                consec_shed = 0
         if sock is not None:
             sock.close()
 
 
 def run_load(netloc: str, jpegs: List[bytes], concurrency: int,
-             duration: float, warmup: float) -> Dict[str, float]:
+             duration: float, warmup: float,
+             retry_cap_s: float = 2.0) -> Dict[str, float]:
     stop = threading.Event()
     t_start = time.monotonic()
     measure_from = t_start + warmup
-    clients = [_Client(netloc, jpegs, stop, measure_from, seed=c)
+    clients = [_Client(netloc, jpegs, stop, measure_from, seed=c,
+                       retry_cap_s=retry_cap_s)
                for c in range(concurrency)]
     for c in clients:
         c.start()
@@ -413,6 +434,10 @@ def main(argv=None) -> int:
     ap.add_argument("--warmup", type=float, default=2.0)
     ap.add_argument("--src-size", type=int, default=256,
                     help="synthetic source image side before server resize")
+    ap.add_argument("--retry-cap", type=float, default=2.0,
+                    help="client backoff cap (s): sheds honor the "
+                         "server's Retry-After with capped exponential "
+                         "backoff up to this")
     ap.add_argument("--single-thread-xla", action="store_true",
                     help="serve with XLA capped to one CPU thread (pays "
                          "off for small models: decode gets the cores)")
@@ -453,7 +478,8 @@ def main(argv=None) -> int:
         for c in [int(x) for x in args.concurrency.split(",") if x]:
             _log(f"closed loop: concurrency {c}, {args.duration:.0f}s "
                  f"(+{args.warmup:.0f}s warmup)")
-            r = run_load(netloc, jpegs, c, args.duration, args.warmup)
+            r = run_load(netloc, jpegs, c, args.duration, args.warmup,
+                         retry_cap_s=args.retry_cap)
             _log(f"  -> {r['rps']:.1f} req/s, p50 {r['p50']:.1f} ms, "
                  f"p95 {r['p95']:.1f} ms, statuses {r['statuses']}")
             rows.append((c, r))

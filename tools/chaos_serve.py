@@ -1,0 +1,621 @@
+#!/usr/bin/env python
+"""Closed-loop chaos harness for the serve/stream stack (ISSUE 10).
+
+Where ``tools/chaos.py`` proves the TRAINING recovery contract
+(inject fault → assert exit code → auto-resume → bit-identical state),
+this harness proves the SERVING one: it spawns a live
+``runners/serve.py`` / ``runners/stream.py`` with a ``DFD_CHAOS`` fault
+armed, drives it with real HTTP load, watches the fault fire in
+/metrics, and asserts the recovery invariants:
+
+* **books balance** — ``accepted == scored + shed + deadline + failed``
+  from a post-drain /metrics scrape, exactly: no request is ever lost
+  or double-counted through a fault;
+* **zero post-recovery recompiles** — ``backend_compiles_total`` (jax's
+  own monitoring hook) does not move across fault + recovery: re-warms
+  execute existing bucket executables;
+* **recovery SLO** — from the first fault-induced failure to the next
+  successful score is bounded (``--slo-s``);
+* **no verdict-stream resets** — a SIGTERM'd stream server restarted
+  with the same ``--state-dir`` resumes per-stream verdict machines and
+  finishes BIT-IDENTICALLY (status + events) to an unkilled replay.
+
+Scenarios (``--scenario``, comma list or ``all``):
+
+* ``exc``          — score-fn exception mid-traffic (``serve_exc``);
+* ``nan``          — non-finite device scores (``serve_nan``): riders
+  get 503, ``nonfinite_batches_total`` moves, next batch serves;
+* ``hang``         — artificial device hang (``serve_hang``): the
+  stuck-batch watchdog fails in-flight requests, restarts the worker
+  and re-warms buckets (readiness dips, then serving resumes);
+* ``kill``         — engine worker killed outright (``serve_kill``):
+  the watchdog's liveness probe respawns it;
+* ``torn_reload``  — the reload watcher is fed a half-truncated
+  checkpoint copy (``torn_reload``): rejected loudly, scores
+  bit-identical before/after, the clean file reloads on the next tick;
+* ``stream_resume``— stream server SIGTERM + restart with
+  ``--state-dir``: verdict streams RESUME (compared against an
+  unkilled replay of the same frames).
+
+Example (the CI slow tier runs exactly this, small model)::
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python tools/chaos_serve.py --scenario all \
+        --model mobilenetv3_small_100 --image-size 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tools.bench_serve import free_port, make_jpegs, scrape_metrics, \
+    wait_ready  # noqa: E402
+
+SCENARIOS = ("exc", "nan", "hang", "kill", "torn_reload", "stream_resume")
+
+
+def _log(msg: str) -> None:
+    print(f"[chaos_serve] {msg}", file=sys.stderr, flush=True)
+
+
+def _child_env(chaos: str = "") -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if chaos:
+        env["DFD_CHAOS"] = chaos
+    else:
+        env.pop("DFD_CHAOS", None)
+    return env
+
+
+def _terminate(proc: subprocess.Popen, timeout: float = 15.0) -> int:
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=timeout)
+    return proc.returncode
+
+
+# ---------------------------------------------------------------------------
+# serve-side scenarios
+# ---------------------------------------------------------------------------
+
+def _spawn_serve(args, port: int, chaos: str,
+                 extra: Optional[List[str]] = None) -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "deepfake_detection_tpu.runners.serve",
+           "--model", args.model, "--image-size", str(args.image_size),
+           "--img-num", "1", "--port", str(port), "--buckets", "1,4",
+           "--batch-deadline-ms", "5", "--max-queue", "64",
+           "--watchdog-timeout-s", str(args.watchdog_timeout_s),
+           "--breaker-threshold", str(args.breaker_threshold)]
+    cmd += list(extra or [])
+    _log("spawn: DFD_CHAOS=%r %s" % (chaos, " ".join(cmd)))
+    return subprocess.Popen(cmd, cwd=_REPO, env=_child_env(chaos),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+class _Poster(threading.Thread):
+    """Modest closed-loop poster: keeps batches flowing so stepped chaos
+    points fire, records (t, status) samples for the SLO computation."""
+
+    def __init__(self, netloc: str, jpegs: List[bytes],
+                 stop: threading.Event):
+        super().__init__(daemon=True)
+        host, port = netloc.split(":")
+        self.host, self.port = host, int(port)
+        self.jpegs = jpegs
+        self.stop_ev = stop
+        self.samples: List[Tuple[float, int]] = []
+
+    def run(self) -> None:
+        conn = None
+        i = 0
+        while not self.stop_ev.is_set():
+            try:
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        self.host, self.port, timeout=30)
+                body = self.jpegs[i % len(self.jpegs)]
+                i += 1
+                conn.request("POST", "/score", body,
+                             {"Content-Type": "image/jpeg"})
+                resp = conn.getresponse()
+                resp.read()
+                status = resp.status
+            except OSError:
+                if conn is not None:
+                    conn.close()
+                conn = None
+                status = -1
+            self.samples.append((time.monotonic(), status))
+            if status in (429, 503):
+                self.stop_ev.wait(0.05)   # fast probe cadence: the SLO
+                # measurement wants a tight upper bound on recovery
+        if conn is not None:
+            conn.close()
+
+
+def _drive_until_recovered(netloc: str, jpegs: List[bytes],
+                           fault_seen, slo_s: float,
+                           concurrency: int = 3,
+                           timeout_s: float = 120.0) -> Dict[str, float]:
+    """Post load until ``fault_seen()`` is true AND a later 200 lands;
+    returns fault/recovery timing + status counts."""
+    stop = threading.Event()
+    posters = [_Poster(netloc, jpegs, stop) for _ in range(concurrency)]
+    for p in posters:
+        p.start()
+    t0 = time.monotonic()
+    fault_t = None
+    recovered_t = None
+    try:
+        while time.monotonic() - t0 < timeout_s:
+            if fault_t is None:
+                if fault_seen():
+                    fault_t = time.monotonic()
+                    _log(f"fault observed after {fault_t - t0:.1f}s")
+            else:
+                ok = [t for p in posters for (t, s) in list(p.samples)
+                      if s == 200 and t > fault_t]
+                if ok:
+                    recovered_t = min(ok)
+                    break
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        for p in posters:
+            p.join(timeout=10)
+    if fault_t is None:
+        raise AssertionError("fault never observed under load")
+    if recovered_t is None:
+        raise AssertionError("no successful score after the fault "
+                             f"within {timeout_s}s")
+    statuses: Dict[int, int] = {}
+    for p in posters:
+        for _, s in p.samples:
+            statuses[s] = statuses.get(s, 0) + 1
+    recovery_s = recovered_t - fault_t
+    _log(f"recovered {recovery_s:.2f}s after the fault "
+         f"(statuses {statuses})")
+    if recovery_s > slo_s:
+        raise AssertionError(
+            f"recovery took {recovery_s:.2f}s > SLO {slo_s}s")
+    return {"recovery_s": recovery_s, "statuses": statuses}
+
+
+def _assert_books_balance(netloc: str, settle_s: float = 2.0) -> dict:
+    """Post-drain scrape: accepted == scored + shed + deadline + failed,
+    exactly."""
+    deadline = time.monotonic() + 30.0
+    while True:
+        m = scrape_metrics(netloc)
+        acc = m.get("dfd_serving_accepted_total", 0)
+        resolved = (m.get("dfd_serving_scored_total", 0) +
+                    m.get("dfd_serving_shed_total", 0) +
+                    m.get("dfd_serving_deadline_total", 0) +
+                    m.get("dfd_serving_failed_total", 0))
+        if acc == resolved or time.monotonic() > deadline:
+            break
+        time.sleep(settle_s)   # something still in flight: let it drain
+    if acc != resolved:
+        raise AssertionError(
+            f"books do not balance: accepted {acc:.0f} != scored "
+            f"{m.get('dfd_serving_scored_total', 0):.0f} + shed "
+            f"{m.get('dfd_serving_shed_total', 0):.0f} + deadline "
+            f"{m.get('dfd_serving_deadline_total', 0):.0f} + failed "
+            f"{m.get('dfd_serving_failed_total', 0):.0f}")
+    _log(f"books balance: accepted {acc:.0f} == resolved {resolved:.0f}")
+    return m
+
+
+def _fault_metric_seen(netloc: str, metric: str, baseline: float = 0.0):
+    def probe() -> bool:
+        try:
+            return scrape_metrics(netloc).get(metric, 0) > baseline
+        except OSError:
+            return False
+    return probe
+
+
+#: scenario -> (chaos spec, /metrics counter that proves the fault fired;
+#: None = the injected exception shows as failed requests)
+_SERVE_FAULTS = {
+    "exc": ("serve_exc@3", None),
+    "nan": ("serve_nan@3",
+            "dfd_serving_nonfinite_batches_total"),
+    "hang": ("serve_hang@3:20",
+             "dfd_serving_watchdog_recoveries_total"),
+    "kill": ("serve_kill@3",
+             "dfd_serving_watchdog_recoveries_total"),
+}
+
+
+def run_serve_fault(args, name: str) -> dict:
+    chaos, metric = _SERVE_FAULTS[name]
+    jpegs = make_jpegs(8, args.src_size)
+    port = free_port()
+    proc = _spawn_serve(args, port, chaos)
+    netloc = f"127.0.0.1:{port}"
+    try:
+        wait_ready(netloc, timeout=args.ready_timeout_s)
+        m0 = scrape_metrics(netloc)
+        backend0 = m0.get("dfd_serving_backend_compiles_total", 0)
+        if metric is None:
+            probe = _fault_metric_seen(netloc, "dfd_serving_failed_total")
+        else:
+            probe = _fault_metric_seen(netloc, metric,
+                                       m0.get(metric, 0))
+        r = _drive_until_recovered(netloc, jpegs, probe, args.slo_s)
+        m1 = _assert_books_balance(netloc)
+        backend1 = m1.get("dfd_serving_backend_compiles_total", 0)
+        if backend1 != backend0:
+            raise AssertionError(
+                f"{backend1 - backend0:+.0f} backend recompiles across "
+                f"fault + recovery (must be zero)")
+        _log(f"{name}: zero post-recovery recompiles "
+             f"({backend1:.0f} total)")
+        return {"scenario": name, "recovery_s": r["recovery_s"],
+                "statuses": r["statuses"],
+                "metrics": {k: v for k, v in m1.items()
+                            if k.startswith("dfd_serving_")}}
+    finally:
+        _terminate(proc)
+
+
+# ---------------------------------------------------------------------------
+# torn reload
+# ---------------------------------------------------------------------------
+
+def run_torn_reload(args) -> dict:
+    """Arm ``torn_reload@0``: the FIRST reload attempt reads a torn copy
+    (rejected loudly, serving scores bit-identical), the next tick loads
+    the clean file and the reload lands."""
+    import numpy as np
+
+    jpegs = make_jpegs(2, args.src_size)
+    port = free_port()
+    reload_dir = tempfile.mkdtemp(prefix="chaos-reload-")
+    proc = _spawn_serve(args, port, "torn_reload@0",
+                        extra=["--reload-dir", reload_dir,
+                               "--reload-interval-s", "0.3"])
+    netloc = f"127.0.0.1:{port}"
+    try:
+        wait_ready(netloc, timeout=args.ready_timeout_s)
+
+        def score(body: bytes) -> list:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            conn.request("POST", "/score", body,
+                         {"Content-Type": "image/jpeg"})
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            conn.close()
+            assert resp.status == 200, out
+            return out["scores"]
+
+        s_before = score(jpegs[0])
+        # build a compatible checkpoint: same model, nudged params (the
+        # server with no --model-path serves the PRNGKey(0) init)
+        import jax
+        import jax.numpy as jnp
+        from deepfake_detection_tpu.models import create_model, init_model
+        from deepfake_detection_tpu.models.helpers import \
+            save_model_checkpoint
+        model = create_model(args.model, num_classes=2, in_chans=3)
+        variables = init_model(
+            model, jax.random.PRNGKey(0),
+            (1, args.image_size, args.image_size, 3))
+        rng = np.random.default_rng(7)
+        nudged = jax.tree.map(
+            lambda a: np.asarray(a) + 0.05 * rng.standard_normal(
+                np.shape(a)).astype(np.asarray(a).dtype)
+            if np.issubdtype(np.asarray(a).dtype, np.floating)
+            else np.asarray(a), variables)
+        save_model_checkpoint(os.path.join(reload_dir, "new.msgpack"),
+                              nudged)
+        # phase 1: the torn copy is rejected; scores stay bit-identical
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            m = scrape_metrics(netloc)
+            if m.get("dfd_serving_reload_errors_total", 0) >= 1:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("torn reload was never rejected")
+        s_torn = score(jpegs[0])
+        if s_torn != s_before:
+            raise AssertionError(
+                f"scores drifted across a REJECTED reload: {s_before} "
+                f"-> {s_torn}")
+        _log("torn reload rejected; scores bit-identical")
+        # phase 2: next tick reloads the clean file
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            m = scrape_metrics(netloc)
+            if m.get("dfd_serving_reloads_total", 0) >= 1:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("clean reload never landed after the "
+                                 "torn rejection")
+        s_after = score(jpegs[0])
+        if s_after == s_before:
+            raise AssertionError("reload landed but scores unchanged "
+                                 "(nudged weights must move them)")
+        _log("clean reload landed on the next tick; scores moved")
+        m1 = _assert_books_balance(netloc)
+        return {"scenario": "torn_reload",
+                "reload_errors": m1.get(
+                    "dfd_serving_reload_errors_total", 0),
+                "reloads": m1.get("dfd_serving_reloads_total", 0)}
+    finally:
+        _terminate(proc)
+
+
+# ---------------------------------------------------------------------------
+# stream resume
+# ---------------------------------------------------------------------------
+
+def _stream_cmd(args, port: int, state_dir: str, event_dir: str) -> list:
+    return [sys.executable, "-m",
+            "deepfake_detection_tpu.runners.stream",
+            "--model", args.model, "--image-size", str(args.image_size),
+            "--img-num", "2", "--port", str(port), "--buckets", "1,4",
+            "--max-inflight-windows", "16", "--stream-ttl-s", "0",
+            "--verdict-vector", "0.1*3,0.95*17",
+            "--state-dir", state_dir, "--event-log-dir", event_dir]
+
+
+class _StreamClient:
+    def __init__(self, port: int):
+        self.port = port
+
+    def _req(self, method: str, path: str, body: bytes = b"",
+             headers: Optional[dict] = None) -> Tuple[int, dict]:
+        conn = http.client.HTTPConnection("127.0.0.1", self.port,
+                                          timeout=30)
+        conn.request(method, path, body, headers or {})
+        resp = conn.getresponse()
+        out = json.loads(resp.read() or b"{}")
+        conn.close()
+        return resp.status, out
+
+    def open(self, sid: str) -> None:
+        status, out = self._req("POST", "/streams",
+                                json.dumps({"stream_id": sid}).encode(),
+                                {"Content-Type": "application/json"})
+        assert status == 201, (status, out)
+
+    def push_raw(self, sid: str, frames) -> dict:
+        import numpy as np
+        body = np.concatenate([f.reshape(-1) for f in frames]).tobytes()
+        h, w = frames[0].shape[:2]
+        status, out = self._req(
+            "POST", f"/streams/{sid}/frames", body,
+            {"Content-Type": "application/x-dfd-raw",
+             "X-Frame-Width": str(w), "X-Frame-Height": str(h)})
+        assert status == 200, (status, out)
+        return out
+
+    def status(self, sid: str) -> dict:
+        status, out = self._req("GET", f"/streams/{sid}")
+        assert status == 200, (status, out)
+        return out
+
+    def wait_scored(self, sid: str, n: int, timeout: float = 60.0) -> dict:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.status(sid)
+            if st["counters"]["windows_scored"] >= n:
+                return st
+            time.sleep(0.1)
+        raise AssertionError(
+            f"stream {sid}: only "
+            f"{self.status(sid)['counters']['windows_scored']}/{n} "
+            f"windows scored within {timeout}s")
+
+
+def _strip_wall_time(events: list) -> list:
+    return [{k: v for k, v in ev.items() if k != "wall_time"}
+            for ev in events]
+
+
+def _comparable(st: dict) -> dict:
+    """The resume-vs-replay comparison view of a stream status: verdict
+    machines, counters and event sequence; wall-clock fields dropped."""
+    return {
+        "verdict": st["verdict"],
+        "stream": st["stream"],
+        "tracks": st["tracks"],
+        "counters": st["counters"],
+        "events": _strip_wall_time(st["events"]),
+    }
+
+
+def run_stream_resume(args) -> dict:
+    """SIGTERM a stream server mid-stream, restart it on the same
+    --state-dir, finish the stream, and require the final status to be
+    BIT-IDENTICAL to an unkilled replay of the same frames."""
+    import numpy as np
+    rng = np.random.default_rng(11)
+    s = args.image_size
+    # frames sized to the canvas: full_frame localizer + no resize =
+    # deterministic pipeline; scores are planted via --verdict-vector
+    frames = [rng.integers(0, 255, (s, s, 3), dtype=np.uint8)
+              for _ in range(20)]
+    # img_num=2, stride 1, default hop -> one window per 2 frames
+    phase1, phase2 = frames[:8], frames[8:]
+    n1, n_total = len(phase1) // 2, len(frames) // 2
+
+    def drive(client: _StreamClient, sid: str, chunk) -> dict:
+        client.push_raw(sid, chunk)
+        return client.status(sid)
+
+    state_dir = tempfile.mkdtemp(prefix="chaos-stream-state-")
+    event_dir = tempfile.mkdtemp(prefix="chaos-stream-events-")
+    port = free_port()
+    netloc = f"127.0.0.1:{port}"
+    # --- killed + resumed run ---------------------------------------
+    proc = subprocess.Popen(_stream_cmd(args, port, state_dir, event_dir),
+                            cwd=_REPO, env=_child_env(),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        wait_ready(netloc, timeout=args.ready_timeout_s)
+        client = _StreamClient(port)
+        client.open("resume-me")
+        client.push_raw("resume-me", phase1)
+        client.wait_scored("resume-me", n1)   # quiesce: nothing in flight
+        _log(f"phase 1: {n1} windows scored; SIGTERM")
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        _log(f"server exited {rc}")
+    except BaseException:
+        _terminate(proc)
+        raise
+    # --- restart on the same state dir ------------------------------
+    port2 = free_port()
+    netloc2 = f"127.0.0.1:{port2}"
+    proc2 = subprocess.Popen(
+        _stream_cmd(args, port2, state_dir, event_dir),
+        cwd=_REPO, env=_child_env(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    try:
+        wait_ready(netloc2, timeout=args.ready_timeout_s)
+        m = scrape_metrics(netloc2)
+        if m.get("dfd_streaming_streams_restored_total", 0) != 1:
+            raise AssertionError("restarted server did not restore the "
+                                 "stream snapshot")
+        client2 = _StreamClient(port2)
+        st_resumed = client2.status("resume-me")
+        if st_resumed["counters"]["windows_scored"] != n1:
+            raise AssertionError(
+                f"verdict stream RESET across the bounce: "
+                f"{st_resumed['counters']['windows_scored']} != {n1}")
+        client2.push_raw("resume-me", phase2)
+        final_resumed = client2.wait_scored("resume-me", n_total)
+        proc2.send_signal(signal.SIGTERM)
+        proc2.wait(timeout=30)
+    except BaseException:
+        _terminate(proc2)
+        raise
+    # --- unkilled replay --------------------------------------------
+    port3 = free_port()
+    replay_state = tempfile.mkdtemp(prefix="chaos-stream-replay-")
+    replay_events = tempfile.mkdtemp(prefix="chaos-stream-replay-ev-")
+    proc3 = subprocess.Popen(
+        _stream_cmd(args, port3, replay_state, replay_events),
+        cwd=_REPO, env=_child_env(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    try:
+        wait_ready(f"127.0.0.1:{port3}", timeout=args.ready_timeout_s)
+        client3 = _StreamClient(port3)
+        client3.open("resume-me")
+        client3.push_raw("resume-me", phase1)
+        client3.wait_scored("resume-me", n1)
+        client3.push_raw("resume-me", phase2)
+        final_replay = client3.wait_scored("resume-me", n_total)
+    finally:
+        _terminate(proc3)
+    got, want = _comparable(final_resumed), _comparable(final_replay)
+    if got != want:
+        raise AssertionError(
+            "resumed stream diverged from the unkilled replay:\n"
+            f"resumed: {json.dumps(got, sort_keys=True)}\n"
+            f"replay:  {json.dumps(want, sort_keys=True)}")
+    _log(f"stream resume bit-identical to unkilled replay "
+         f"(verdict {got['verdict']!r}, "
+         f"{got['counters']['windows_scored']} windows)")
+    # the per-stream event log must be ONE coherent stream: every line
+    # parses, and the transition path is connected across the bounce
+    log_path = os.path.join(event_dir, "resume-me.events.jsonl")
+    with open(log_path) as f:
+        events = [json.loads(line) for line in f]
+    # stream-scope and per-track machines interleave in the log: the
+    # connected-path invariant holds per machine
+    by_machine: Dict[tuple, list] = {}
+    for ev in events:
+        by_machine.setdefault(
+            (ev.get("scope"), ev.get("track_id")), []).append(ev)
+    for key, evs in by_machine.items():
+        if not all(a["to"] == b["from"] for a, b in zip(evs, evs[1:])):
+            raise AssertionError(f"event log transition path for "
+                                 f"{key} is broken across the bounce: "
+                                 f"{evs}")
+    _log(f"event log coherent across the bounce ({len(events)} "
+         f"transition(s))")
+    return {"scenario": "stream_resume",
+            "windows_scored": got["counters"]["windows_scored"],
+            "verdict": got["verdict"], "events": len(events)}
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--scenario", default="all",
+                    help=f"comma list of {SCENARIOS} or 'all'")
+    ap.add_argument("--model", default="mobilenetv3_small_100",
+                    help="registered model (default sized for CPU boxes)")
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--src-size", type=int, default=64)
+    ap.add_argument("--slo-s", type=float, default=15.0,
+                    help="max seconds from fault to next 200")
+    ap.add_argument("--watchdog-timeout-s", type=float, default=2.0)
+    ap.add_argument("--breaker-threshold", type=int, default=5)
+    ap.add_argument("--ready-timeout-s", type=float, default=900.0)
+    ap.add_argument("--out", default="", help="write a JSON report here")
+    args = ap.parse_args(argv)
+
+    names = list(SCENARIOS) if args.scenario == "all" else \
+        [s.strip() for s in args.scenario.split(",") if s.strip()]
+    for n in names:
+        if n not in SCENARIOS:
+            ap.error(f"unknown scenario {n!r} (known: {SCENARIOS})")
+
+    results, failures = [], []
+    for n in names:
+        _log(f"=== scenario {n} ===")
+        try:
+            if n == "torn_reload":
+                results.append(run_torn_reload(args))
+            elif n == "stream_resume":
+                results.append(run_stream_resume(args))
+            else:
+                results.append(run_serve_fault(args, n))
+            _log(f"=== {n} PASS ===")
+        except (AssertionError, TimeoutError, OSError) as e:
+            _log(f"=== {n} FAIL: {e} ===")
+            failures.append((n, str(e)))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"results": results,
+                       "failures": failures}, f, indent=2)
+        _log(f"wrote {args.out}")
+    if failures:
+        _log(f"{len(failures)}/{len(names)} scenario(s) FAILED")
+        return 1
+    _log(f"all {len(names)} scenario(s) passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
