@@ -34,9 +34,26 @@ import os
 import re
 from typing import Dict, Optional, Set, Tuple
 
-__all__ = ["ChaosInjector", "chaos_from_env", "CHAOS_ENV_VAR"]
+__all__ = ["ChaosInjector", "chaos_from_env", "CHAOS_ENV_VAR",
+           "KNOWN_POINTS"]
 
 CHAOS_ENV_VAR = "DFD_CHAOS"
+
+#: The one registry of injection-point names.  Every ``fires("name", ...)``
+#: probe site and every ``name@step`` spec literal in the harnesses must
+#: use a name from this set — a typo'd point is a *dead injection path*
+#: (the scenario silently tests nothing), which is exactly what dfdlint
+#: rule DFD006 exists to catch.  Add the point here in the same change
+#: that adds its probe site.
+KNOWN_POINTS = frozenset({
+    # trainer loop (train/trainer.py; stepped by optimizer update)
+    "sigterm", "nanbatch", "truncate_ckpt",
+    # host loaders (data/loader.py, stepped by batch index; shm workers
+    # by completed tasks)
+    "stall_loader", "kill_shm_worker",
+    # serving request path (serving/engine.py, stepped by device-batch seq)
+    "serve_exc", "serve_hang", "serve_nan", "serve_kill", "torn_reload",
+})
 
 _SPEC_RE = re.compile(
     r"^(?P<name>[a-z][a-z0-9_]*)@(?P<step>\d+)"

@@ -134,7 +134,12 @@ class TfPreprocessTransform:
 
     def __call__(self, img: Any,
                  rng: Optional[np.random.Generator] = None) -> np.ndarray:
-        rng = rng if rng is not None else np.random.default_rng()
+        # the Compose chain always threads the per-sample (seed, epoch,
+        # index) Generator; the no-rng fallback is for ad-hoc eval use and
+        # must be deterministic, not wall-clock-entropy (dfdlint DFD003 —
+        # an OS-seeded draw here would silently break resume parity if a
+        # caller ever forgot to pass rng on the training path)
+        rng = rng if rng is not None else np.random.default_rng(0)
         arr = np.asarray(img, dtype=np.uint8)
         if arr.ndim == 2:
             arr = np.stack([arr] * 3, -1)

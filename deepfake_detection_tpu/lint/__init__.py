@@ -1,0 +1,45 @@
+"""dfdlint — in-repo static analysis enforcing the stack's hard-won invariants.
+
+Three of the worst bugs in this repo's history were invisible to tests
+until they crashed a whole pytest process or silently corrupted state:
+donated-buffer use-after-free on zero-copy resume views (CHANGES.md PR
+2/PR 3), closure-captured weights constant-folding into jit programs
+(PR 2), and a split lock around a gauge bump/decrement that left the
+in-flight gauge permanently negative (PR 10).  Every one is a statically
+detectable *pattern*; this package encodes them as rules that run on
+every change (``tools/dfdlint.py``, ``scripts/lint.sh``, and the
+``tests/test_lint.py`` gate).
+
+Deliberately jax-free and stdlib-only (``ast`` + ``symtable``): the
+linter must be importable and fast in any subprocess — the same
+discipline its own DFD001 rule enforces on the data/obs/tools modules.
+
+Layout:
+
+* :mod:`core`     — file indexing, suppressions, baseline, the runner
+* :mod:`manifest` — the declarative project manifest the rules consume
+* :mod:`rules`    — DFD001..DFD009 implementations
+
+Per-line suppression::
+
+    something_flagged()   # dfdlint: disable=DFD003  -- why it is safe
+
+or on a standalone comment line directly above the flagged line.
+Pre-existing debt is frozen in ``tools/dfdlint_baseline.json``; new
+violations fail.  Both suppressions and baseline entries are themselves
+checked: an entry that no longer matches any violation is reported as
+rot (``--strict`` / the gate test fail on it), so neither can silently
+outlive the code it excused.
+"""
+
+from .core import (BaselineEntry, FileCtx, LintConfig, LintResult,
+                   ProjectIndex, Violation, load_baseline, run_lint,
+                   save_baseline)
+from .manifest import default_config
+from .rules import ALL_RULES, rule_catalog
+
+__all__ = [
+    "ALL_RULES", "BaselineEntry", "FileCtx", "LintConfig", "LintResult",
+    "ProjectIndex", "Violation", "default_config", "load_baseline",
+    "rule_catalog", "run_lint", "save_baseline",
+]

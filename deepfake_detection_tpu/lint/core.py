@@ -1,0 +1,352 @@
+"""dfdlint core: file indexing, suppressions, baseline, and the runner.
+
+Everything here is rule-agnostic.  A lint run is::
+
+    index  = ProjectIndex.build(paths, repo_root)
+    result = run_lint(index, config)
+
+``run_lint`` executes every rule, drops violations carrying a per-line
+``# dfdlint: disable=RULE`` suppression, subtracts the frozen baseline,
+and reports *rot* in both directions: suppression comments that suppress
+nothing and baseline entries that match nothing.  Rot is an error under
+``--strict`` (and in the tests/test_lint.py gate) so neither mechanism
+can silently outlive the code it excused.
+
+Baseline identity is ``(rule, path, stripped line text)`` rather than a
+line *number*: edits elsewhere in a file must not invalidate frozen
+entries, while editing the offending line itself (the moment the debt is
+actually touched) surfaces the violation again.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import symtable
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["Violation", "FileCtx", "ProjectIndex", "LintConfig",
+           "BaselineEntry", "LintResult", "load_baseline", "save_baseline",
+           "run_lint"]
+
+_SUPPRESS_RE = re.compile(r"#\s*dfdlint:\s*disable=([A-Z0-9,\s]+)")
+
+
+# ---------------------------------------------------------------------------
+# data model
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One rule finding, anchored to a repo-relative path and 1-based line."""
+    rule: str
+    path: str
+    line: int
+    message: str
+    hint: str = ""
+
+    def format(self, fix_hints: bool = False) -> str:
+        s = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        if fix_hints and self.hint:
+            s += f"\n    fix: {self.hint}"
+        return s
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    """Frozen pre-existing debt: matches up to ``count`` violations of
+    ``rule`` in ``path`` whose stripped source line equals ``line_text``."""
+    rule: str
+    path: str
+    line_text: str
+    count: int = 1
+    justification: str = ""
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.line_text)
+
+
+@dataclasses.dataclass
+class LintResult:
+    violations: List[Violation]            # new (post-suppress, post-baseline)
+    suppressed: List[Violation]            # dropped by inline comments
+    baselined: List[Violation]             # dropped by baseline entries
+    unused_suppressions: List[Tuple[str, int, str]]   # (path, line, rule)
+    unused_baseline: List[BaselineEntry]   # entries that matched nothing
+
+    @property
+    def clean(self) -> bool:
+        return not self.violations
+
+    @property
+    def strict_clean(self) -> bool:
+        return (not self.violations and not self.unused_suppressions
+                and not self.unused_baseline)
+
+
+# ---------------------------------------------------------------------------
+# file context + project index
+# ---------------------------------------------------------------------------
+
+class FileCtx:
+    """One parsed source file: AST, lines, module name, suppressions."""
+
+    def __init__(self, abspath: str, relpath: str, source: str):
+        self.abspath = abspath
+        self.relpath = relpath            # posix, repo-root-relative
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.module = _module_name(relpath)
+        #: line (1-based) -> set of rule ids disabled on that line.
+        #: Scanned from real COMMENT tokens so a docstring *describing*
+        #: the suppression syntax can't accidentally enact it.
+        self.suppressions: Dict[int, set] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",")
+                             if r.strip()}
+                    self.suppressions.setdefault(
+                        tok.start[0], set()).update(rules)
+        except tokenize.TokenError:
+            pass                          # unparseable tail: no comments
+        self._symtable = None
+
+    # symtable is built lazily — only rules that need scope analysis
+    # (DFD004) pay for it, and only on files they inspect
+    def symbols(self):
+        if self._symtable is None:
+            self._symtable = symtable.symtable(
+                self.source, self.relpath, "exec")
+        return self._symtable
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def suppressed_rules_at(self, line: int) -> set:
+        """Rules disabled at ``line``: an inline comment on the line itself,
+        or a standalone ``# dfdlint: disable=...`` comment directly above."""
+        rules = set(self.suppressions.get(line, ()))
+        above = line - 1
+        if above in self.suppressions and \
+                self.line_text(above).startswith("#"):
+            rules |= self.suppressions[above]
+        return rules
+
+
+def _module_name(relpath: str) -> str:
+    parts = relpath.replace(os.sep, "/").split("/")
+    if parts[-1] == "__init__.py":
+        parts = parts[:-1]
+    elif parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    return ".".join(parts)
+
+
+class ProjectIndex:
+    """All files of one lint run + a module-name → file lookup."""
+
+    def __init__(self, files: List[FileCtx], repo_root: str):
+        self.files = files
+        self.repo_root = repo_root
+        self.by_module: Dict[str, FileCtx] = {f.module: f for f in files}
+        self.by_relpath: Dict[str, FileCtx] = {f.relpath: f for f in files}
+
+    @classmethod
+    def build(cls, paths: Sequence[str], repo_root: str,
+              skip_dirs: Iterable[str] = ("__pycache__", ".git",
+                                          ".claude")) -> "ProjectIndex":
+        repo_root = os.path.abspath(repo_root)
+        seen: Dict[str, None] = {}
+        skip = set(skip_dirs)
+        for p in paths:
+            p = p if os.path.isabs(p) else os.path.join(repo_root, p)
+            if os.path.isfile(p) and p.endswith(".py"):
+                seen.setdefault(os.path.abspath(p))
+                continue
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in skip and
+                                     not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        seen.setdefault(os.path.join(dirpath, fn))
+        files = []
+        for abspath in seen:
+            rel = os.path.relpath(abspath, repo_root).replace(os.sep, "/")
+            with open(abspath, encoding="utf-8") as f:
+                source = f.read()
+            try:
+                files.append(FileCtx(abspath, rel, source))
+            except SyntaxError as e:
+                # a file the interpreter cannot parse is its own violation;
+                # surface it instead of crashing the run
+                bad = FileCtx.__new__(FileCtx)
+                bad.abspath, bad.relpath, bad.source = abspath, rel, source
+                bad.lines = source.splitlines()
+                bad.tree = ast.Module(body=[], type_ignores=[])
+                bad.module = _module_name(rel)
+                bad.suppressions = {}
+                bad._symtable = None
+                bad.parse_error = e
+                files.append(bad)
+        return cls(files, repo_root)
+
+
+# ---------------------------------------------------------------------------
+# config (populated from manifest.py; fixtures override)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LintConfig:
+    """Declarative manifest the rules consume.  Defaults live in
+    :mod:`deepfake_detection_tpu.lint.manifest`; fixture tests construct
+    their own pointing at a tmp tree."""
+    # DFD001
+    jax_free_modules: Tuple[str, ...] = ()
+    banned_import_roots: Tuple[str, ...] = (
+        "jax", "jaxlib", "flax", "optax", "chex", "orbax")
+    # DFD002
+    donating_factories: Dict[str, Tuple[int, ...]] = \
+        dataclasses.field(default_factory=dict)
+    thread_escape_callees: Tuple[str, ...] = (
+        "Thread", "submit", "apply_async", "start_soon")
+    # DFD003
+    rng_dirs: Tuple[str, ...] = ()
+    # DFD004
+    array_suspect_names: Tuple[str, ...] = (
+        "params", "variables", "weights", "batch_stats", "opt_state",
+        "ema", "mean", "std")
+    # DFD005
+    metric_registries: Dict[str, str] = \
+        dataclasses.field(default_factory=dict)       # relpath -> prefix
+    metric_dynamic_prefixes: Tuple[str, ...] = ()
+    lock_guarded: Tuple[Tuple[str, str, str], ...] = ()
+    # DFD006
+    chaos_module: str = ""                            # relpath of registry
+    chaos_registry_name: str = "KNOWN_POINTS"
+    # DFD009
+    ctypes_exempt: Tuple[str, ...] = ()
+    native_symbol_prefix: str = "dfd_"
+
+
+# ---------------------------------------------------------------------------
+# baseline I/O
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("version") != 1:
+        raise ValueError(f"{path}: unsupported baseline version "
+                         f"{doc.get('version')!r}")
+    return [BaselineEntry(**e) for e in doc.get("entries", [])]
+
+
+def save_baseline(path: str, entries: Sequence[BaselineEntry]) -> None:
+    doc = {
+        "version": 1,
+        "comment": "dfdlint frozen debt: each entry matches up to `count` "
+                   "violations of `rule` in `path` on lines whose stripped "
+                   "text equals `line_text`.  Entries need a written "
+                   "justification; unmatched entries fail --strict (rot).",
+        "entries": [dataclasses.asdict(e) for e in sorted(
+            entries, key=lambda e: (e.path, e.rule, e.line_text))],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# the runner
+# ---------------------------------------------------------------------------
+
+def run_lint(index: ProjectIndex, config: LintConfig,
+             baseline: Sequence[BaselineEntry] = (),
+             rules: Optional[Sequence] = None,
+             honor_suppressions: bool = True) -> LintResult:
+    from .rules import ALL_RULES
+    active = list(rules) if rules is not None else list(ALL_RULES)
+
+    raw: List[Violation] = []
+    for f in index.files:
+        err = getattr(f, "parse_error", None)
+        if err is not None:
+            raw.append(Violation("DFD000", f.relpath,
+                                 err.lineno or 1,
+                                 f"file does not parse: {err.msg}",
+                                 "fix the syntax error"))
+    for rule in active:
+        raw.extend(rule.check(index, config))
+    raw.sort(key=lambda v: (v.path, v.line, v.rule))
+
+    # --- inline suppressions -------------------------------------------
+    kept: List[Violation] = []
+    suppressed: List[Violation] = []
+    used_suppressions: set = set()        # (path, line-of-comment, rule)
+    if honor_suppressions:
+        for v in raw:
+            ctx = index.by_relpath.get(v.path)
+            hit = False
+            if ctx is not None:
+                for cl in (v.line, v.line - 1):
+                    if v.rule in ctx.suppressions.get(cl, set()) and \
+                            v.rule in ctx.suppressed_rules_at(v.line):
+                        used_suppressions.add((v.path, cl, v.rule))
+                        hit = True
+                        break
+            (suppressed if hit else kept).append(v)
+    else:
+        kept = list(raw)
+
+    # rot is only judged for the rules that actually ran: a filtered
+    # `--rules DFD003` run must not call a DFD004 suppression/baseline
+    # entry unused just because its rule never executed
+    active_ids = {r.id for r in active}
+    unused_suppressions: List[Tuple[str, int, str]] = []
+    if honor_suppressions:
+        for f in index.files:
+            for line, rule_ids in sorted(f.suppressions.items()):
+                for rid in sorted(rule_ids & active_ids):
+                    if (f.relpath, line, rid) not in used_suppressions:
+                        unused_suppressions.append((f.relpath, line, rid))
+
+    # --- baseline ------------------------------------------------------
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline:
+        budget[e.key()] = budget.get(e.key(), 0) + e.count
+    matched: Dict[Tuple[str, str, str], int] = {}
+    new: List[Violation] = []
+    baselined: List[Violation] = []
+    for v in kept:
+        ctx = index.by_relpath.get(v.path)
+        text = ctx.line_text(v.line) if ctx is not None else ""
+        key = (v.rule, v.path, text)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            matched[key] = matched.get(key, 0) + 1
+            baselined.append(v)
+        else:
+            new.append(v)
+    unused = [e for e in baseline
+              if e.rule in active_ids and matched.get(e.key(), 0) == 0]
+
+    return LintResult(violations=new, suppressed=suppressed,
+                      baselined=baselined,
+                      unused_suppressions=unused_suppressions,
+                      unused_baseline=unused)

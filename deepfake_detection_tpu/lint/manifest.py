@@ -1,0 +1,106 @@
+"""The project manifest dfdlint runs against — the single declarative
+statement of which modules/attributes carry which invariants.
+
+Every entry here is a *promise the rest of the repo makes*:
+
+* ``JAX_FREE_MODULES`` — modules whose import must never reach jax/flax
+  transitively (PR 1's spawned-worker import discipline; spawned shm
+  decode workers, data-prep hosts and reporting subprocesses import
+  these with no accelerator stack).  DFD001 proves it on the static
+  import graph; one subprocess canary in tests/test_lint.py proves the
+  graph against reality.
+* ``DONATING_FACTORIES`` — factory functions whose *returned* callable
+  donates argument buffers (``donate_argnums``): reading a value after
+  passing it to one is the PR 2/PR 3 use-after-free class.
+* ``RNG_DIRS`` — subtrees where every random draw must derive from the
+  absolute ``(seed, epoch, index)`` streams or an injected Generator
+  (bit-identical resume depends on it).
+* ``METRIC_REGISTRIES`` — the modules allowed to register ``dfd_*``
+  Prometheus names, one prefix each; every literal reference elsewhere
+  must resolve to a registered name (a typo'd metric is a silently dead
+  dashboard).  ``METRIC_DYNAMIC_PREFIXES`` marks families registered
+  from runtime dicts (obs collectors) that static analysis cannot
+  enumerate.
+* ``LOCK_GUARDED`` — (file, attribute, lock) triples where a mutation
+  outside ``with <lock>`` re-opens the PR 10 split-lock gauge bug.
+* ``CHAOS_MODULE`` — where the ``KNOWN_POINTS`` injection-point registry
+  lives; a ``fires("typo", ...)`` probe or a ``name@step`` spec literal
+  naming an unknown point is a dead injection path.
+* ``CTYPES_EXEMPT`` — the one module allowed to bind ``dfd_*`` native
+  symbols without its own ABI-version probe (it owns the probe).
+"""
+
+from __future__ import annotations
+
+from .core import LintConfig
+
+# Modules that must stay importable with zero jax in sys.modules.
+# Note the graph includes ancestor packages: declaring a submodule
+# jax-free also pins every ``__init__.py`` above it.
+JAX_FREE_MODULES = (
+    "deepfake_detection_tpu",               # top-level __init__ (registry+config)
+    "deepfake_detection_tpu.chaos",
+    "deepfake_detection_tpu.data",          # lazy __init__ (PEP 562)
+    "deepfake_detection_tpu.data.packed",
+    "deepfake_detection_tpu.data.native",
+    "deepfake_detection_tpu.data.shm_ring",
+    "deepfake_detection_tpu.obs",           # lazy __init__ (PEP 562)
+    "deepfake_detection_tpu.obs.events",
+    "deepfake_detection_tpu.streaming.tracker",
+    "deepfake_detection_tpu.streaming.verdict",
+    "deepfake_detection_tpu.lint",          # the linter itself
+    "tools.pack_dataset",
+    "tools.obs_report",
+    "tools.make_lists",
+    "tools.dfdlint",
+)
+
+DONATING_FACTORIES = {
+    # train/steps.py: returned step donates the TrainState (argument 0)
+    "make_train_step": (0,),
+}
+
+RNG_DIRS = (
+    "deepfake_detection_tpu/data",
+    "deepfake_detection_tpu/streaming",
+    "deepfake_detection_tpu/serving",
+)
+
+METRIC_REGISTRIES = {
+    "deepfake_detection_tpu/serving/metrics.py": "dfd_serving",
+    "deepfake_detection_tpu/streaming/metrics.py": "dfd_streaming",
+    "deepfake_detection_tpu/obs/telemetry.py": "dfd_train",
+}
+
+# obs collectors register gauge/counter names from runtime dicts (loader
+# stats, resilience counters) — those families cannot be enumerated
+# statically, so literal references under these prefixes are not checked
+METRIC_DYNAMIC_PREFIXES = (
+    "dfd_train_",
+)
+
+LOCK_GUARDED = (
+    # the PR 10 incident: inflight gauge bump/decrement must be one atom
+    # with the _pending ledger mutation, under the ledger's own lock
+    ("deepfake_detection_tpu/serving/engine.py", "inflight",
+     "_pending_lock"),
+)
+
+CHAOS_MODULE = "deepfake_detection_tpu/chaos.py"
+
+CTYPES_EXEMPT = (
+    "deepfake_detection_tpu/data/native.py",    # owns the ABI probe
+)
+
+
+def default_config() -> LintConfig:
+    return LintConfig(
+        jax_free_modules=JAX_FREE_MODULES,
+        donating_factories=dict(DONATING_FACTORIES),
+        rng_dirs=RNG_DIRS,
+        metric_registries=dict(METRIC_REGISTRIES),
+        metric_dynamic_prefixes=METRIC_DYNAMIC_PREFIXES,
+        lock_guarded=LOCK_GUARDED,
+        chaos_module=CHAOS_MODULE,
+        ctypes_exempt=CTYPES_EXEMPT,
+    )

@@ -1,0 +1,1128 @@
+"""dfdlint rules DFD001–DFD009.
+
+Each rule encodes one bug class this repo has actually shipped (and
+fixed) — the rule table in README.md maps every id to the CHANGES.md
+incident it came from.  Rules are deliberately *pattern* checkers, not
+type systems: they over-approximate, and the suppression/baseline
+machinery in core.py absorbs the (few, justified) false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import FileCtx, LintConfig, ProjectIndex, Violation
+
+__all__ = ["ALL_RULES", "rule_catalog"]
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` chain as a string; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _walk_with_parents(tree: ast.AST) -> Iterator[Tuple[ast.AST,
+                                                        List[ast.AST]]]:
+    stack: List[Tuple[ast.AST, List[ast.AST]]] = [(tree, [])]
+    while stack:
+        node, parents = stack.pop()
+        yield node, parents
+        child_parents = parents + [node]
+        for child in ast.iter_child_nodes(node):
+            stack.append((child, child_parents))
+
+
+def _functions(tree: ast.AST) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _own_statements(fn: ast.AST) -> Iterator[ast.stmt]:
+    """Statements of ``fn``'s body, recursing into compound statements but
+    NOT into nested function/class bodies (those are separate scopes)."""
+    def rec(body):
+        for stmt in body:
+            yield stmt
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                yield from rec(getattr(stmt, field, []) or [])
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from rec(handler.body)
+    yield from rec(fn.body)
+
+
+def _scope_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Every node in ``fn``'s own scope exactly once, excluding nested
+    function/class/lambda bodies (separate scopes).  Use this instead of
+    ``ast.walk`` over :func:`_own_statements` — that pair visits nodes
+    inside compound statements twice (once via the compound, once via the
+    child statement)."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _module_scope_statements(tree: ast.Module) -> Iterator[ast.stmt]:
+    """Statements that execute at import time (module + class bodies,
+    through module-level if/try/with), excluding ``if TYPE_CHECKING:``
+    guards and function bodies."""
+    def is_type_checking(test: ast.AST) -> bool:
+        d = _dotted(test)
+        return d is not None and d.split(".")[-1] == "TYPE_CHECKING"
+
+    def rec(body):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.If) and is_type_checking(stmt.test):
+                yield from rec(stmt.orelse)
+                continue
+            yield stmt
+            if isinstance(stmt, ast.ClassDef):
+                yield from rec(stmt.body)
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                yield from rec(getattr(stmt, field, []) or [])
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from rec(handler.body)
+    yield from rec(tree.body)
+
+
+class Rule:
+    id = "DFD000"
+    name = "base"
+    bug_class = ""
+    hint = ""
+
+    def check(self, index: ProjectIndex,
+              config: LintConfig) -> List[Violation]:
+        raise NotImplementedError
+
+    def v(self, ctx_or_path, line: int, message: str) -> Violation:
+        path = ctx_or_path.relpath if isinstance(ctx_or_path, FileCtx) \
+            else ctx_or_path
+        return Violation(self.id, path, line, message, self.hint)
+
+
+# ---------------------------------------------------------------------------
+# DFD001 — jax purity: declared modules never reach jax transitively
+# ---------------------------------------------------------------------------
+
+class JaxPurity(Rule):
+    id = "DFD001"
+    name = "jax-purity"
+    bug_class = ("a module declared jax-free (spawned decode workers, "
+                 "data-prep hosts, reporting subprocesses) grows a "
+                 "transitive jax import: seconds of startup + hundreds "
+                 "of MB RSS per worker")
+    hint = ("move the jax-touching import into the function that needs it "
+            "(PEP 562 lazy idiom, see data/__init__.py), or drop the "
+            "module from lint/manifest.py JAX_FREE_MODULES")
+
+    def check(self, index: ProjectIndex,
+              config: LintConfig) -> List[Violation]:
+        banned = set(config.banned_import_roots)
+        # module -> [(target_module, lineno)]
+        edges: Dict[str, List[Tuple[str, int]]] = {}
+        direct: Dict[str, Tuple[str, int]] = {}   # module -> (banned, line)
+        for f in index.files:
+            tgts = self._imports(f, index)
+            edges[f.module] = tgts
+            for tgt, line in tgts:
+                root = tgt.split(".")[0]
+                if root in banned and f.module not in direct:
+                    direct[f.module] = (tgt, line)
+
+        out: List[Violation] = []
+        for declared in config.jax_free_modules:
+            ctx = index.by_module.get(declared)
+            if ctx is None:
+                # manifest rot: a declared module that no longer exists
+                # would silently stop being checked
+                out.append(Violation(
+                    self.id, "<manifest>", 1,
+                    f"declared jax-free module {declared!r} not found in "
+                    "the linted tree", self.hint))
+                continue
+            # importing pkg.mod executes every ancestor __init__ first
+            roots = [declared]
+            parts = declared.split(".")
+            for i in range(1, len(parts)):
+                anc = ".".join(parts[:i])
+                if anc in index.by_module:
+                    roots.append(anc)
+            chain = self._find_banned_path(roots, edges, direct, index)
+            if chain is not None:
+                path_mods, (banned_tgt, line) = chain
+                via = " -> ".join(path_mods)
+                first = index.by_module[path_mods[0]]
+                # anchor at the first import edge inside the declared
+                # module's chain when it exists, else at the module head
+                anchor_line = 1
+                if len(path_mods) > 1:
+                    for tgt, ln in edges.get(path_mods[0], []):
+                        if tgt == path_mods[1] or \
+                                tgt.startswith(path_mods[1] + "."):
+                            anchor_line = ln
+                            break
+                else:
+                    anchor_line = line
+                out.append(self.v(
+                    first, anchor_line,
+                    f"module {declared!r} is declared jax-free but reaches "
+                    f"{banned_tgt!r} via {via} "
+                    f"({path_mods[-1]}:{line} imports it)"))
+        return out
+
+    # -- import extraction + graph walk ---------------------------------
+    def _imports(self, f: FileCtx, index: ProjectIndex
+                 ) -> List[Tuple[str, int]]:
+        """Module-scope import targets of ``f`` as dotted names (internal
+        names resolved against the index; external left as-is)."""
+        out: List[Tuple[str, int]] = []
+        for stmt in _module_scope_statements(f.tree):
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    out.append((alias.name, stmt.lineno))
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.level == 0:
+                    base = stmt.module or ""
+                else:
+                    parts = (f.module.split(".") if
+                             f.relpath.endswith("__init__.py")
+                             else f.module.split(".")[:-1])
+                    if stmt.level > 1:
+                        parts = parts[:-(stmt.level - 1)]
+                    base = ".".join(parts)
+                    if stmt.module:
+                        base = f"{base}.{stmt.module}" if base \
+                            else stmt.module
+                if base:
+                    out.append((base, stmt.lineno))
+                # ``from pkg import sub`` also executes pkg/sub.py when
+                # sub is a module
+                for alias in stmt.names:
+                    cand = f"{base}.{alias.name}" if base else alias.name
+                    if cand in index.by_module:
+                        out.append((cand, stmt.lineno))
+        return out
+
+    def _find_banned_path(self, roots: List[str],
+                          edges: Dict[str, List[Tuple[str, int]]],
+                          direct: Dict[str, Tuple[str, int]],
+                          index: ProjectIndex
+                          ) -> Optional[Tuple[List[str], Tuple[str, int]]]:
+        """BFS over internal edges from ``roots``; returns the module
+        chain to the first module with a direct banned import."""
+        seen: Set[str] = set()
+        queue: List[List[str]] = [[r] for r in roots]
+        while queue:
+            path = queue.pop(0)
+            mod = path[-1]
+            if mod in seen:
+                continue
+            seen.add(mod)
+            if mod in direct:
+                return path, direct[mod]
+            for tgt, _line in edges.get(mod, []):
+                # resolve to longest internal prefix (``import a.b.c``
+                # executes a, a.b and a.b.c — cover each internal level)
+                parts = tgt.split(".")
+                for i in range(1, len(parts) + 1):
+                    pref = ".".join(parts[:i])
+                    if pref in index.by_module and pref not in seen:
+                        queue.append(path + [pref])
+        return None
+
+
+# ---------------------------------------------------------------------------
+# DFD002 — donation aliasing: reads after donation, views escaping async
+# ---------------------------------------------------------------------------
+
+_VIEW_FUNCS = {"np.frombuffer", "numpy.frombuffer", "np.asarray",
+               "numpy.asarray", "jax.device_get"}
+
+
+class DonationAliasing(Rule):
+    id = "DFD002"
+    name = "donation-aliasing"
+    bug_class = ("donated-buffer use-after-free: zero-copy host views of "
+                 "jax buffers read after the buffer was donated (PR 2 "
+                 "tp-resume SIGSEGV), or handed to a thread/async save "
+                 "that serializes while the train step overwrites them "
+                 "(PR 3 torn snapshots)")
+    hint = ("copy before the escape/donation (`x = np.asarray(x).copy()` "
+            "or `_to_host(copy=True)`), or re-bind the name from the "
+            "donating call's return value")
+
+    def check(self, index: ProjectIndex,
+              config: LintConfig) -> List[Violation]:
+        out: List[Violation] = []
+        for f in index.files:
+            module_donators = self._donating_names(
+                _module_scope_statements(f.tree), config)
+            for fn in _functions(f.tree):
+                out.extend(self._check_fn(f, fn, dict(module_donators),
+                                          config))
+        return out
+
+    # -- which local names hold donating callables -----------------------
+    def _donating_names(self, stmts, config: LintConfig
+                        ) -> Dict[str, Tuple[int, ...]]:
+        found: Dict[str, Tuple[int, ...]] = {}
+        for stmt in stmts:
+            if not isinstance(stmt, ast.Assign) or \
+                    not isinstance(stmt.value, ast.Call):
+                continue
+            call = stmt.value
+            pos = self._donated_positions(call, config)
+            if pos is None:
+                continue
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    found[tgt.id] = pos
+        return found
+
+    def _donated_positions(self, call: ast.Call, config: LintConfig
+                           ) -> Optional[Tuple]:
+        """Donated argument designators: ints (positional index) and/or
+        strs (``donate_argnames`` keyword name); None = not donating."""
+        d = _dotted(call.func)
+        if d in ("jax.jit", "jit", "pjit", "jax.pjit"):
+            for kw in call.keywords:
+                if kw.arg in ("donate_argnums", "donate_argnames"):
+                    val = kw.value
+                    if isinstance(val, (ast.Tuple, ast.List)) and \
+                            not val.elts:
+                        return None           # explicit empty: no donation
+                    if isinstance(val, ast.Constant) and \
+                            isinstance(val.value, (int, str)):
+                        return (val.value,)
+                    if isinstance(val, (ast.Tuple, ast.List)) and all(
+                            isinstance(e, ast.Constant) and
+                            isinstance(e.value, (int, str))
+                            for e in val.elts):
+                        return tuple(e.value for e in val.elts)
+                    return (0,)               # conditional/computed: assume
+            return None
+        if d in config.donating_factories:
+            return tuple(config.donating_factories[d])
+        return None
+
+    # -- per-function linear analysis ------------------------------------
+    def _check_fn(self, f: FileCtx, fn, donators: Dict[str, Tuple[int, ...]],
+                  config: LintConfig) -> List[Violation]:
+        out: List[Violation] = []
+        stmts = list(_own_statements(fn))
+        donators.update(self._donating_names(stmts, config))
+
+        # compound statements appear in `stmts` alongside their children;
+        # per-scan seen-sets keep every Call processed exactly once while
+        # preserving statement order for the views tracking below
+
+        # (a) use-after-donate
+        donations: List[Tuple[int, str, str]] = []  # (line, var, callee)
+        seen_don: Set[int] = set()
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Name) and
+                        node.func.id in donators) or id(node) in seen_don:
+                    continue
+                seen_don.add(id(node))
+                for pos in donators[node.func.id]:
+                    if isinstance(pos, str):
+                        # donate_argnames: match the call's keyword args
+                        # (positional resolution would need the callee's
+                        # signature, which this scope may not contain)
+                        for kw in node.keywords:
+                            if kw.arg == pos and \
+                                    isinstance(kw.value, ast.Name):
+                                donations.append((node.lineno,
+                                                  kw.value.id,
+                                                  node.func.id))
+                    elif pos < len(node.args) and \
+                            isinstance(node.args[pos], ast.Name):
+                        donations.append((node.lineno,
+                                          node.args[pos].id,
+                                          node.func.id))
+        for don_line, var, callee in donations:
+            event = self._first_event_after(stmts, var, don_line)
+            if event is not None and event[1] == "load":
+                out.append(self.v(
+                    f, event[0],
+                    f"`{var}` read after being donated to `{callee}` "
+                    f"(line {don_line}): the buffer no longer exists"))
+
+        # (b) zero-copy views escaping to threads/async
+        views: Dict[str, int] = {}
+        escapees = set(config.thread_escape_callees)
+        seen_esc: Set[int] = set()
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call):
+                d = _dotted(stmt.value.func)
+                for tgt in stmt.targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    if d in _VIEW_FUNCS:
+                        views[tgt.id] = stmt.lineno
+                    elif d is not None and d.endswith(".copy"):
+                        views.pop(tgt.id, None)
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call) or id(node) in seen_esc:
+                    continue
+                seen_esc.add(id(node))
+                callee = _dotted(node.func)
+                leaf = callee.split(".")[-1] if callee else None
+                if leaf not in escapees:
+                    continue
+                for arg in self._flat_args(node):
+                    d = _dotted(arg.func) if isinstance(arg, ast.Call) \
+                        else None
+                    if d in _VIEW_FUNCS:
+                        out.append(self.v(
+                            f, node.lineno,
+                            f"zero-copy host view ({d}) escapes to "
+                            f"`{leaf}` without a copy"))
+                    elif isinstance(arg, ast.Name) and arg.id in views:
+                        out.append(self.v(
+                            f, node.lineno,
+                            f"zero-copy host view `{arg.id}` (line "
+                            f"{views[arg.id]}) escapes to `{leaf}` "
+                            "without a copy"))
+        return out
+
+    def _flat_args(self, call: ast.Call) -> Iterator[ast.AST]:
+        pend = list(call.args) + [kw.value for kw in call.keywords]
+        while pend:
+            a = pend.pop()
+            if isinstance(a, (ast.Tuple, ast.List)):
+                pend.extend(a.elts)
+            elif isinstance(a, ast.Starred):
+                pend.append(a.value)
+            else:
+                yield a
+
+    def _first_event_after(self, stmts, var: str, line: int
+                           ) -> Optional[Tuple[int, str]]:
+        events: List[Tuple[int, int, str]] = []
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name) and node.id == var:
+                    kind = "load" if isinstance(node.ctx, ast.Load) \
+                        else "store"
+                    events.append((node.lineno, node.col_offset, kind))
+        # stores on the donation line (the `x, m = step(x, ...)` rebind)
+        # count as stores; loads on it were arguments to the call itself
+        after = sorted(e for e in events if e[0] > line or
+                       (e[0] == line and e[2] == "store"))
+        if not after:
+            return None
+        ln, _col, kind = after[0]
+        return ln, kind
+
+
+# ---------------------------------------------------------------------------
+# DFD003 — RNG discipline in data/, streaming/, serving/
+# ---------------------------------------------------------------------------
+
+_NAKED_NP = {"rand", "randn", "randint", "random", "random_sample",
+             "uniform", "normal", "standard_normal", "choice", "shuffle",
+             "permutation", "beta", "seed"}
+_NAKED_STDLIB = {"random", "randint", "uniform", "choice", "shuffle",
+                 "seed", "randrange", "gauss", "betavariate", "sample"}
+_TIME_FUNCS = {"time.time", "time.time_ns", "time.perf_counter",
+               "time.monotonic"}
+
+
+class RngDiscipline(Rule):
+    id = "DFD003"
+    name = "rng-discipline"
+    bug_class = ("a naked global/time-seeded RNG draw in the input or "
+                 "request path breaks the absolute (seed, epoch, index) "
+                 "streams that bit-identical kill/resume, packed-cache "
+                 "parity and the device-augment prologue all key off")
+    hint = ("derive the generator from np.random.SeedSequence([seed, "
+            "epoch, index]) / fold_in, or accept an injected "
+            "np.random.Generator / random.Random(seed)")
+
+    def check(self, index: ProjectIndex,
+              config: LintConfig) -> List[Violation]:
+        out: List[Violation] = []
+        for f in index.files:
+            if not any(f.relpath.startswith(d.rstrip("/") + "/")
+                       for d in config.rng_dirs):
+                continue
+            imports_stdlib_random = any(
+                isinstance(s, ast.Import) and
+                any(a.name == "random" for a in s.names)
+                for s in ast.walk(f.tree) if isinstance(s, ast.Import))
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                if d is None:
+                    continue
+                if d in ("np.random.default_rng",
+                         "numpy.random.default_rng",
+                         "np.random.RandomState",
+                         "numpy.random.RandomState",
+                         "random.Random"):
+                    if not node.args and not node.keywords:
+                        out.append(self.v(
+                            f, node.lineno,
+                            f"unseeded `{d}()` — draws are not derivable "
+                            "from (seed, epoch, index)"))
+                    elif self._time_seeded(node):
+                        out.append(self.v(
+                            f, node.lineno,
+                            f"time-seeded `{d}(...)` — run-dependent "
+                            "stream breaks bit-identical resume"))
+                    continue
+                parts = d.split(".")
+                if len(parts) == 3 and parts[0] in ("np", "numpy") and \
+                        parts[1] == "random" and parts[2] in _NAKED_NP:
+                    out.append(self.v(
+                        f, node.lineno,
+                        f"naked global-RNG draw `{d}(...)`"))
+                elif len(parts) == 2 and parts[0] == "random" and \
+                        imports_stdlib_random and parts[1] in _NAKED_STDLIB:
+                    out.append(self.v(
+                        f, node.lineno,
+                        f"naked stdlib global-RNG draw `{d}(...)`"))
+        return out
+
+    def _time_seeded(self, call: ast.Call) -> bool:
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for node in ast.walk(arg):
+                if isinstance(node, ast.Call) and \
+                        _dotted(node.func) in _TIME_FUNCS:
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# DFD004 — recompile hygiene: jit in loops, array closures
+# ---------------------------------------------------------------------------
+
+_JIT_FUNCS = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_PALLAS_FUNCS = {"pl.pallas_call", "pallas_call", "jax.experimental."
+                 "pallas.pallas_call"}
+_ARRAY_CTORS = re.compile(
+    r"^(jnp|np|numpy|jax\.numpy)\.(array|asarray|zeros|ones|full|empty|"
+    r"arange|linspace|frombuffer|zeros_like|ones_like)$"
+    r"|^jax\.(device_put|device_get)$|^jax\.random\.\w+$")
+
+
+class RecompileHygiene(Rule):
+    id = "DFD004"
+    name = "recompile-hygiene"
+    bug_class = ("jit/pallas_call built inside a loop body compiles (or "
+                 "cache-probes) every iteration; a jit closure capturing "
+                 "array values constant-folds them into the program "
+                 "(~1ulp drift vs the argument form, compile-memory "
+                 "bloat, and a recompile per new constant — PR 2's "
+                 "closure-constant weights)")
+    hint = ("hoist the jit/pallas_call construction out of the loop; "
+            "pass captured arrays (weights, mean/std) as arguments of "
+            "the jitted function")
+
+    def check(self, index: ProjectIndex,
+              config: LintConfig) -> List[Violation]:
+        out: List[Violation] = []
+        for f in index.files:
+            out.extend(self._jit_in_loop(f))
+            out.extend(self._array_closures(f, config))
+        return out
+
+    # -- (a) jit/pallas_call constructed in a loop body ------------------
+    def _jit_in_loop(self, f: FileCtx) -> List[Violation]:
+        out = []
+        for node, parents in _walk_with_parents(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d not in _JIT_FUNCS and d not in _PALLAS_FUNCS:
+                continue
+            # nearest enclosing loop, unless a function boundary sits
+            # between it and the call (then the loop doesn't re-run it)
+            for p in reversed(parents):
+                if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    break
+                if isinstance(p, (ast.For, ast.While, ast.AsyncFor)):
+                    out.append(self.v(
+                        f, node.lineno,
+                        f"`{d}(...)` constructed inside a loop body"))
+                    break
+        return out
+
+    # -- (b) jit-wrapped defs closing over array-typed values ------------
+    def _array_closures(self, f: FileCtx,
+                        config: LintConfig) -> List[Violation]:
+        out = []
+        # map def name+lineno -> def node for jit-wrap resolution
+        defs: Dict[str, List[ast.AST]] = {}
+        for fn in _functions(f.tree):
+            defs.setdefault(fn.name, []).append(fn)
+
+        jitted: List[ast.AST] = []
+        for fn in _functions(f.tree):
+            for dec in fn.decorator_list:
+                d = _dotted(dec if not isinstance(dec, ast.Call)
+                            else dec.func)
+                if d in _JIT_FUNCS:
+                    jitted.append(fn)
+                elif isinstance(dec, ast.Call) and d is not None and \
+                        d.split(".")[-1] == "partial" and dec.args and \
+                        _dotted(dec.args[0]) in _JIT_FUNCS:
+                    jitted.append(fn)
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call) and \
+                    _dotted(node.func) in _JIT_FUNCS and node.args and \
+                    isinstance(node.args[0], ast.Name):
+                for cand in defs.get(node.args[0].id, []):
+                    jitted.append(cand)
+
+        for fn in jitted:
+            for name, why in self._suspect_frees(f, fn, config):
+                out.append(self.v(
+                    f, fn.lineno,
+                    f"jit-wrapped `{fn.name}` closes over array-typed "
+                    f"`{name}` ({why}); it will constant-fold into the "
+                    "compiled program"))
+        return out
+
+    def _suspect_frees(self, f: FileCtx, fn,
+                       config: LintConfig) -> List[Tuple[str, str]]:
+        table = self._find_table(f.symbols(), fn.name, fn.lineno)
+        if table is None:
+            return []
+        frees = [s.get_name() for s in table.get_symbols()
+                 if s.is_free()]
+        if not frees:
+            return []
+        suspects: List[Tuple[str, str]] = []
+        enclosing = self._enclosing_fn(f.tree, fn)
+        suspect_names = set(config.array_suspect_names)
+        for name in frees:
+            if enclosing is None:
+                continue
+            # bound as a parameter of the enclosing function?
+            args = enclosing.args
+            param_names = [a.arg for a in
+                           args.posonlyargs + args.args + args.kwonlyargs]
+            if name in param_names and name in suspect_names:
+                suspects.append(
+                    (name, f"parameter of `{enclosing.name}` with an "
+                           "array-suspect name"))
+                continue
+            # bound by an assignment from an array constructor?
+            for stmt in _own_statements(enclosing):
+                if isinstance(stmt, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == name
+                        for t in stmt.targets):
+                    val = stmt.value
+                    d = _dotted(val.func) if isinstance(val, ast.Call) \
+                        else None
+                    if d is not None and _ARRAY_CTORS.match(d):
+                        suspects.append(
+                            (name, f"assigned from `{d}(...)` at line "
+                                   f"{stmt.lineno}"))
+                        break
+        return suspects
+
+    def _find_table(self, table, name: str, lineno: int):
+        for child in table.get_children():
+            if child.get_name() == name and child.get_lineno() == lineno:
+                return child
+            found = self._find_table(child, name, lineno)
+            if found is not None:
+                return found
+        return None
+
+    def _enclosing_fn(self, tree: ast.AST, target) -> Optional[ast.AST]:
+        for node, parents in _walk_with_parents(tree):
+            if node is target:
+                for p in reversed(parents):
+                    if isinstance(p, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        return p
+                return None
+        return None
+
+
+# ---------------------------------------------------------------------------
+# DFD005 — metric hygiene: registration uniqueness, reference resolution,
+#           lock-guarded mutation
+# ---------------------------------------------------------------------------
+
+_METRIC_REF_RE = re.compile(r"^dfd_[a-z0-9_]*[a-z0-9]$")
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+_REG_METHODS = {"counter", "gauge", "header", "histogram"}
+
+
+class MetricHygiene(Rule):
+    id = "DFD005"
+    name = "metric-hygiene"
+    bug_class = ("a metric name registered twice shadows itself on the "
+                 "scrape; a referenced-but-unregistered name (typo) is a "
+                 "silently dead dashboard/probe; a gauge mutated outside "
+                 "its owning lock re-opens the PR 10 permanently-negative "
+                 "inflight gauge")
+    hint = ("register dfd_* names exactly once in their registry module; "
+            "fuse gauge mutation with its ledger under the declared lock "
+            "(see lint/manifest.py LOCK_GUARDED)")
+
+    def check(self, index: ProjectIndex,
+              config: LintConfig) -> List[Violation]:
+        out: List[Violation] = []
+        registered: Dict[str, Tuple[str, int]] = {}
+        reg_literal_sites: Set[Tuple[str, int, str]] = set()
+        dynamic_prefixes = set(config.metric_dynamic_prefixes)
+
+        for relpath, prefix in sorted(config.metric_registries.items()):
+            ctx = index.by_relpath.get(relpath)
+            if ctx is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                # registration calls appear both as `doc.counter(...)` and
+                # through local aliases (`counter, gauge = doc.counter,
+                # doc.gauge; counter(...)`) — accept either form inside a
+                # declared registry module
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Attribute):
+                    meth = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    meth = node.func.id
+                else:
+                    continue
+                if meth not in _REG_METHODS:
+                    continue
+                if not node.args:
+                    continue
+                name = _str_const(node.args[0])
+                if name is None:
+                    # registration from a runtime value: the whole prefix
+                    # family becomes uncheckable statically
+                    dynamic_prefixes.add(prefix + "_")
+                    continue
+                full = f"{prefix}_{name}"
+                names = [full]
+                if meth == "histogram":
+                    names += [full + s for s in _HIST_SUFFIXES]
+                reg_literal_sites.add((ctx.relpath, node.lineno, full))
+                for n in names:
+                    prev = registered.get(n)
+                    if prev is not None and \
+                            prev != (ctx.relpath, node.lineno):
+                        out.append(self.v(
+                            ctx, node.lineno,
+                            f"metric `{n}` registered more than once "
+                            f"(first at {prev[0]}:{prev[1]})"))
+                    registered.setdefault(n, (ctx.relpath, node.lineno))
+
+        # --- literal references must resolve ---------------------------
+        prefixes = sorted(config.metric_registries.values(),
+                          key=len, reverse=True)
+        for f in index.files:
+            for node in ast.walk(f.tree):
+                s = _str_const(node)
+                if s is None or not _METRIC_REF_RE.match(s):
+                    continue
+                pfx = next((p for p in prefixes
+                            if s.startswith(p + "_")), None)
+                if pfx is None:
+                    continue
+                if any(s.startswith(dp) for dp in dynamic_prefixes):
+                    continue
+                if (f.relpath, node.lineno, s) in reg_literal_sites:
+                    continue
+                base = s
+                for suf in _HIST_SUFFIXES:
+                    if s.endswith(suf) and s[:-len(suf)] in registered:
+                        base = s[:-len(suf)]
+                        break
+                if base not in registered:
+                    out.append(self.v(
+                        f, node.lineno,
+                        f"references unregistered metric `{s}` (typo'd "
+                        "names scrape as silent zeros)"))
+
+        # --- lock-guarded mutation --------------------------------------
+        for relpath, attr, lock_attr in config.lock_guarded:
+            ctx = index.by_relpath.get(relpath)
+            if ctx is None:
+                continue
+            for node, parents in _walk_with_parents(ctx.tree):
+                if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if not any(isinstance(t, ast.Attribute) and t.attr == attr
+                           for t in targets):
+                    continue
+                guarded = any(
+                    isinstance(p, ast.With) and any(
+                        isinstance(item.context_expr, ast.Attribute) and
+                        item.context_expr.attr == lock_attr
+                        for item in p.items)
+                    for p in parents)
+                if not guarded:
+                    out.append(self.v(
+                        ctx, node.lineno,
+                        f"`{attr}` mutated outside `with {lock_attr}` — "
+                        "the gauge and its ledger must move as one atom"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# DFD006 — chaos points come from the declared registry
+# ---------------------------------------------------------------------------
+
+_CHAOS_SPEC_RE = re.compile(r"^([a-z][a-z0-9_]*)@\d+")
+
+
+class ChaosRegistry(Rule):
+    id = "DFD006"
+    name = "chaos-registry"
+    bug_class = ("a typo'd DFD_CHAOS point name — at a fires() probe or "
+                 "in a harness spec literal — is a dead injection path: "
+                 "the chaos scenario silently tests nothing")
+    hint = ("add the point to KNOWN_POINTS in chaos.py (the one "
+            "registry) or fix the name to match it")
+
+    def check(self, index: ProjectIndex,
+              config: LintConfig) -> List[Violation]:
+        out: List[Violation] = []
+        registry = self._load_registry(index, config)
+        for f in index.files:
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "fires" and node.args:
+                    name = _str_const(node.args[0])
+                    if name is None:
+                        continue
+                    if registry is None:
+                        out.append(self.v(
+                            f, node.lineno,
+                            f"chaos probe `fires({name!r})` but no "
+                            f"{config.chaos_registry_name} registry is "
+                            f"declared in {config.chaos_module}"))
+                    elif name not in registry:
+                        out.append(self.v(
+                            f, node.lineno,
+                            f"chaos point {name!r} not in "
+                            f"{config.chaos_registry_name} — dead "
+                            "injection path"))
+                s = _str_const(node)
+                if s is not None and registry is not None:
+                    for part in s.split(","):
+                        m = _CHAOS_SPEC_RE.match(part.strip())
+                        if m and m.group(1) not in registry:
+                            out.append(self.v(
+                                f, node.lineno,
+                                f"chaos spec names unknown point "
+                                f"{m.group(1)!r} — dead injection path"))
+        return out
+
+    def _load_registry(self, index: ProjectIndex,
+                       config: LintConfig) -> Optional[Set[str]]:
+        ctx = index.by_relpath.get(config.chaos_module)
+        if ctx is None:
+            return None
+        for stmt in _module_scope_statements(ctx.tree):
+            if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and
+                    t.id == config.chaos_registry_name
+                    for t in stmt.targets):
+                names: Set[str] = set()
+                for node in ast.walk(stmt.value):
+                    s = _str_const(node)
+                    if s is not None:
+                        names.add(s)
+                return names
+        return None
+
+
+# ---------------------------------------------------------------------------
+# DFD007 — JSONL event-writer discipline
+# ---------------------------------------------------------------------------
+
+class EventSchema(Rule):
+    id = "DFD007"
+    name = "event-schema"
+    bug_class = ("a JSONL event stream without a schema stamp cannot be "
+                 "versioned by readers; a write without the single-line+"
+                 "flush idiom tears mid-kill into unparseable multi-record "
+                 "fragments the torn-tail repair cannot fix")
+    hint = ("stamp a 'schema' (or 'v') key into the record, serialize to "
+            "ONE line, terminate with '\\n', and flush() after every "
+            "write on long-lived handles (obs/events.py is the template)")
+
+    def check(self, index: ProjectIndex,
+              config: LintConfig) -> List[Violation]:
+        out: List[Violation] = []
+        for f in index.files:
+            for fn in _functions(f.tree):
+                out.extend(self._check_fn(f, fn))
+        return out
+
+    def _check_fn(self, f: FileCtx, fn) -> List[Violation]:
+        out: List[Violation] = []
+        stmts = list(_own_statements(fn))
+
+        #: names assigned `x = json.dumps(...) + "\n"` → jsonl line
+        jsonl_names: Dict[str, ast.Call] = {}
+        #: names assigned from a dict literal → schema-checkable payloads
+        dict_literals: Dict[str, ast.Dict] = {}
+        has_flush = False
+        with_managed: Set[str] = set()      # file handles from `with open`
+        append_mode = False
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Call) and \
+                            _dotted(ce.func) in ("open", "io.open") and \
+                            item.optional_vars is not None and \
+                            isinstance(item.optional_vars, ast.Name):
+                        with_managed.add(item.optional_vars.id)
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                tgt = stmt.targets[0].id
+                dumps = self._jsonl_dumps(stmt.value)
+                if dumps is not None:
+                    jsonl_names[tgt] = dumps
+                if isinstance(stmt.value, ast.Dict):
+                    dict_literals[tgt] = stmt.value
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    d = _dotted(node.func)
+                    if d is not None and (d.endswith(".flush") or
+                                          d == "os.fsync"):
+                        has_flush = True
+                    if isinstance(node, ast.Call) and \
+                            _dotted(node.func) in ("open", "io.open"):
+                        mode = node.args[1] if len(node.args) > 1 else None
+                        for kw in node.keywords:
+                            if kw.arg == "mode":
+                                mode = kw.value
+                        ms = _str_const(mode) if mode is not None else None
+                        if ms is not None and "a" in ms:
+                            append_mode = True
+
+        seen_writes: Set[int] = set()
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr == "write" and
+                        len(node.args) == 1) or id(node) in seen_writes:
+                    continue
+                seen_writes.add(id(node))
+                arg = node.args[0]
+                writer = node.func.value
+                writer_name = writer.id if isinstance(writer, ast.Name) \
+                    else None
+                dumps = self._jsonl_dumps(arg)
+                plain = self._plain_dumps(arg)
+                if dumps is None and isinstance(arg, ast.Name) and \
+                        arg.id in jsonl_names:
+                    dumps = jsonl_names[arg.id]
+                if dumps is None and plain is None:
+                    continue
+                if dumps is None and plain is not None:
+                    # json.dumps written with NO newline: a bug only for
+                    # append-mode streams (whole-file snapshots are fine)
+                    if append_mode:
+                        out.append(self.v(
+                            f, node.lineno,
+                            "append-mode json.dumps write is not "
+                            "newline-terminated — records will fuse"))
+                    continue
+                # it IS a jsonl write: flush discipline on long-lived
+                # handles (with-managed handles flush at close)
+                long_lived = writer_name not in with_managed
+                if long_lived and not has_flush:
+                    out.append(self.v(
+                        f, node.lineno,
+                        "JSONL write on a long-lived handle without a "
+                        "flush() in the same function — a kill strands "
+                        "buffered records"))
+                payload = dumps.args[0] if dumps.args else None
+                if isinstance(payload, ast.Name) and \
+                        payload.id in dict_literals:
+                    payload = dict_literals[payload.id]
+                if isinstance(payload, ast.Dict):
+                    keys = {_str_const(k) for k in payload.keys
+                            if k is not None}
+                    if not keys & {"schema", "v"}:
+                        out.append(self.v(
+                            f, node.lineno,
+                            "JSONL record lacks a 'schema'/'v' stamp — "
+                            "readers cannot version it"))
+        return out
+
+    def _jsonl_dumps(self, node: ast.AST) -> Optional[ast.Call]:
+        """The json.dumps call of a `json.dumps(...) + "\\n"` expression."""
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            if _str_const(node.right) == "\n":
+                return self._plain_dumps(node.left) or \
+                    self._jsonl_dumps(node.left)
+            if _str_const(node.left) == "\n":
+                return self._plain_dumps(node.right) or \
+                    self._jsonl_dumps(node.right)
+        return None
+
+    def _plain_dumps(self, node: ast.AST) -> Optional[ast.Call]:
+        if isinstance(node, ast.Call) and \
+                _dotted(node.func) in ("json.dumps", "dumps"):
+            return node
+        # json.dumps(...).encode() — byte-mode writers
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "encode":
+            return self._plain_dumps(node.func.value)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# DFD008 — subprocess discipline in tools/
+# ---------------------------------------------------------------------------
+
+_RUN_FUNCS = {"subprocess.run", "subprocess.call", "subprocess.check_call",
+              "subprocess.check_output"}
+
+
+class SubprocessDiscipline(Rule):
+    id = "DFD008"
+    name = "subprocess-discipline"
+    bug_class = ("a subprocess.run without timeout (or a Popen whose "
+                 "owner never terminate/kills) hangs the calling tool "
+                 "forever when the child wedges — the bench/chaos "
+                 "harnesses must always converge")
+    hint = ("pass timeout= to subprocess.run, or own the Popen with a "
+            "terminate()->kill() escalation (tools/chaos_serve.py "
+            "_terminate is the template)")
+
+    def check(self, index: ProjectIndex,
+              config: LintConfig) -> List[Violation]:
+        out: List[Violation] = []
+        for f in index.files:
+            kills = any(
+                isinstance(n, ast.Attribute) and
+                n.attr in ("kill", "terminate", "send_signal")
+                for n in ast.walk(f.tree))
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                if d in _RUN_FUNCS:
+                    has_timeout = any(kw.arg == "timeout" or kw.arg is None
+                                      for kw in node.keywords)
+                    if not has_timeout:
+                        out.append(self.v(
+                            f, node.lineno,
+                            f"`{d}(...)` without timeout= — a wedged "
+                            "child hangs the tool forever"))
+                elif d is not None and d.split(".")[-1] == "Popen" and \
+                        (d.startswith("subprocess") or d == "Popen"):
+                    if not kills:
+                        out.append(self.v(
+                            f, node.lineno,
+                            "Popen in a module with no terminate()/kill() "
+                            "escalation anywhere — orphaned children on "
+                            "every failure path"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# DFD009 — direct ctypes native bindings must probe the ABI version
+# ---------------------------------------------------------------------------
+
+class CtypesAbi(Rule):
+    id = "DFD009"
+    name = "ctypes-abi"
+    bug_class = ("a hand-written ctypes binding of a dfd_* native symbol "
+                 "outside data/native.py goes stale when the ABI bumps — "
+                 "every argument silently shifts (the PR 6 ABI-3 "
+                 "bench_gil incident) instead of failing loudly")
+    hint = ("call lib.dfd_abi_version() and assert it against "
+            "data/native.py's _ABI_VERSION before binding symbols (or "
+            "go through data/native.py's wrappers)")
+
+    def check(self, index: ProjectIndex,
+              config: LintConfig) -> List[Violation]:
+        out: List[Violation] = []
+        exempt = set(config.ctypes_exempt)
+        pfx = config.native_symbol_prefix
+        for f in index.files:
+            if f.relpath in exempt:
+                continue
+            loads = [n for n in ast.walk(f.tree)
+                     if isinstance(n, ast.Call) and
+                     _dotted(n.func) in ("ctypes.PyDLL", "ctypes.CDLL",
+                                         "PyDLL", "CDLL",
+                                         "ctypes.cdll.LoadLibrary")]
+            if not loads:
+                continue
+            binds = [n for n in ast.walk(f.tree)
+                     if isinstance(n, ast.Attribute) and
+                     n.attr.startswith(pfx) and
+                     n.attr != pfx + "abi_version"]
+            probed = any(isinstance(n, ast.Attribute) and
+                         n.attr == pfx + "abi_version"
+                         for n in ast.walk(f.tree))
+            if binds and not probed:
+                out.append(self.v(
+                    f, loads[0].lineno,
+                    f"direct ctypes load binds `{pfx}*` symbols without "
+                    f"a `{pfx}abi_version()` probe — a stale binding "
+                    "shifts every argument"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+
+ALL_RULES: Tuple[Rule, ...] = (
+    JaxPurity(), DonationAliasing(), RngDiscipline(), RecompileHygiene(),
+    MetricHygiene(), ChaosRegistry(), EventSchema(),
+    SubprocessDiscipline(), CtypesAbi(),
+)
+
+
+def rule_catalog() -> List[Dict[str, str]]:
+    """id/name/bug-class/hint table for ``--list-rules`` and the README."""
+    return [{"id": r.id, "name": r.name, "bug_class": r.bug_class,
+             "hint": r.hint} for r in ALL_RULES]

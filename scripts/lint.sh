@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# dfdlint gate — the static-analysis half of verification (the dynamic
+# half is the tier-1 pytest run; see ROADMAP.md "Tier-1 verify").
+#
+#   scripts/lint.sh              # strict gate: new violations OR rot fail
+#   scripts/lint.sh --fix-hints  # same, with per-finding fix hints
+#
+# Runs jax-free (stdlib ast/symtable only), so PYTHONPATH is emptied to
+# skip the axon sitecustomize: the whole pass is ~3 s on this box.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env PYTHONPATH= python tools/dfdlint.py \
+    deepfake_detection_tpu tools --strict "$@"

@@ -1,0 +1,775 @@
+"""dfdlint tests: per-rule good/bad fixtures, suppression + baseline
+semantics, import-graph cycles, and the whole-package gate.
+
+The gate test is the contract ISSUE 11 asks for: running dfdlint over
+``deepfake_detection_tpu`` + ``tools`` with the checked-in baseline must
+produce ZERO non-baselined violations AND zero rot — every baseline
+entry must still match a live violation and every inline suppression
+must still suppress one.  Deleting any single suppression or baseline
+entry therefore fails this test: the suppressed/baselined violation
+resurfaces as `new` (or the entry itself reports as unused rot).
+
+One subprocess canary validates the DFD001 static import graph against
+reality (it replaced the per-module subprocess import tests that used to
+live in test_packed_data.py / test_obs.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, REPO)
+
+from deepfake_detection_tpu.lint import (  # noqa: E402
+    BaselineEntry, LintConfig, ProjectIndex, default_config, load_baseline,
+    run_lint, save_baseline)
+from deepfake_detection_tpu.lint import rules as R  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# fixture helpers
+# ---------------------------------------------------------------------------
+
+def make_index(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return ProjectIndex.build([str(tmp_path)], str(tmp_path))
+
+
+def lint_one(tmp_path, files, rule, config=None, **kw):
+    index = make_index(tmp_path, files)
+    return run_lint(index, config or LintConfig(), rules=[rule], **kw)
+
+
+def rule_ids(result):
+    return sorted({v.rule for v in result.violations})
+
+
+# ---------------------------------------------------------------------------
+# DFD001 jax purity
+# ---------------------------------------------------------------------------
+
+class TestJaxPurity:
+    RULE = R.JaxPurity()
+
+    def test_direct_import_fires(self, tmp_path):
+        res = lint_one(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/a.py": "import os\nimport jax\n",
+        }, self.RULE, LintConfig(jax_free_modules=("pkg.a",)))
+        assert [v.rule for v in res.violations] == ["DFD001"]
+        assert "pkg.a" in res.violations[0].message
+
+    def test_transitive_and_ancestor_reach(self, tmp_path):
+        # a -> b -> flax, and separately an ancestor __init__ that
+        # imports jax poisons every submodule declared jax-free
+        res = lint_one(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/a.py": "from . import b\n",
+            "pkg/b.py": "import flax\n",
+            "pkg2/__init__.py": "import jax\n",
+            "pkg2/c.py": "import os\n",
+        }, self.RULE, LintConfig(jax_free_modules=("pkg.a", "pkg2.c")))
+        msgs = " | ".join(v.message for v in res.violations)
+        assert len(res.violations) == 2
+        assert "pkg.a -> pkg.b" in msgs and "flax" in msgs
+        assert "pkg2" in msgs
+
+    def test_lazy_and_type_checking_imports_pass(self, tmp_path):
+        res = lint_one(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/a.py": """\
+                from typing import TYPE_CHECKING
+                if TYPE_CHECKING:
+                    import jax
+                def f():
+                    import jax.numpy as jnp      # lazy: fine
+                    return jnp
+                def __getattr__(name):
+                    import importlib
+                    return importlib.import_module('.b', __name__)
+            """,
+        }, self.RULE, LintConfig(jax_free_modules=("pkg.a",)))
+        assert res.violations == []
+
+    def test_import_cycle_terminates_cleanly(self, tmp_path):
+        files = {
+            "pkg/__init__.py": "",
+            "pkg/a.py": "from . import b\n",
+            "pkg/b.py": "from . import a\n",
+        }
+        res = lint_one(tmp_path, files, self.RULE,
+                       LintConfig(jax_free_modules=("pkg.a",)))
+        assert res.violations == []          # cycle, but no jax: clean
+        files["pkg/b.py"] = "from . import a\nimport jax\n"
+        res = lint_one(tmp_path, files, self.RULE,
+                       LintConfig(jax_free_modules=("pkg.a",)))
+        assert [v.rule for v in res.violations] == ["DFD001"]
+
+    def test_manifest_rot_when_module_missing(self, tmp_path):
+        res = lint_one(tmp_path, {"pkg/__init__.py": ""}, self.RULE,
+                       LintConfig(jax_free_modules=("pkg.gone",)))
+        assert len(res.violations) == 1
+        assert "not found" in res.violations[0].message
+
+
+# ---------------------------------------------------------------------------
+# DFD002 donation aliasing
+# ---------------------------------------------------------------------------
+
+class TestDonationAliasing:
+    RULE = R.DonationAliasing()
+
+    def test_read_after_donate_fires(self, tmp_path):
+        res = lint_one(tmp_path, {"m.py": """\
+            import jax
+            def f(state, batch):
+                step = jax.jit(run, donate_argnums=(0,))
+                new_state, m = step(state, batch)
+                return state.params
+        """}, self.RULE)
+        assert [v.rule for v in res.violations] == ["DFD002"]
+        assert "`state` read after being donated" in res.violations[0].message
+
+    def test_rebind_same_statement_passes(self, tmp_path):
+        res = lint_one(tmp_path, {"m.py": """\
+            import jax
+            def f(state, batch):
+                step = jax.jit(run, donate_argnums=(0,))
+                state, m = step(state, batch)
+                return state.params
+        """}, self.RULE)
+        assert res.violations == []
+
+    def test_donate_argnames_no_crash_and_keyword_match(self, tmp_path):
+        """String donate_argnames must not TypeError the run; a keyword-
+        passed donated arg is traced, and positional args (whose name
+        mapping needs the callee signature) are skipped, not crashed."""
+        res = lint_one(tmp_path, {"m.py": """\
+            import jax
+            def f(state, batch):
+                step = jax.jit(run, donate_argnames=("state",))
+                out = step(batch, state=state)
+                return state.params
+            def g(state, batch):
+                step = jax.jit(run, donate_argnames=("state",))
+                out = step(state, batch)
+                return state.params
+        """}, self.RULE)
+        assert [(v.rule, v.line) for v in res.violations] == [("DFD002", 5)]
+
+    def test_factory_donation_from_manifest(self, tmp_path):
+        cfg = LintConfig(donating_factories={"make_train_step": (0,)})
+        res = lint_one(tmp_path, {"m.py": """\
+            def f(model, state, x):
+                step = make_train_step(model)
+                out = step(state, x)
+                print(state)
+        """}, self.RULE, cfg)
+        assert [v.rule for v in res.violations] == ["DFD002"]
+
+    def test_view_escape_to_thread_fires_and_copy_passes(self, tmp_path):
+        res = lint_one(tmp_path, {"bad.py": """\
+            import threading, numpy as np
+            def save(buf, pool):
+                view = np.frombuffer(buf, np.uint8)
+                threading.Thread(target=write, args=(view,)).start()
+                pool.submit(write, np.asarray(buf))
+        """}, self.RULE)
+        assert [v.rule for v in res.violations] == ["DFD002", "DFD002"]
+        res = lint_one(tmp_path / "good", {"good.py": """\
+            import threading, numpy as np
+            def save(buf):
+                view = np.frombuffer(buf, np.uint8)
+                view = view.copy()
+                threading.Thread(target=write, args=(view,)).start()
+        """}, self.RULE)
+        assert res.violations == []
+
+
+# ---------------------------------------------------------------------------
+# DFD003 RNG discipline
+# ---------------------------------------------------------------------------
+
+class TestRngDiscipline:
+    RULE = R.RngDiscipline()
+    CFG = LintConfig(rng_dirs=("pkg",))
+
+    def test_naked_and_unseeded_fire(self, tmp_path):
+        res = lint_one(tmp_path, {"pkg/m.py": """\
+            import random, time
+            import numpy as np
+            def f():
+                a = np.random.uniform(0, 1)
+                rng = np.random.default_rng()
+                b = random.random()
+                c = np.random.default_rng(int(time.time()))
+                return a, b, c, rng
+        """}, self.RULE, self.CFG)
+        assert [v.rule for v in res.violations] == ["DFD003"] * 4
+        msgs = " | ".join(v.message for v in res.violations)
+        assert "naked global-RNG" in msgs and "unseeded" in msgs \
+            and "time-seeded" in msgs
+
+    def test_derived_and_injected_pass(self, tmp_path):
+        res = lint_one(tmp_path, {"pkg/m.py": """\
+            import random
+            import numpy as np
+            def f(seed, epoch, index, rng):
+                g = np.random.default_rng(
+                    np.random.SeedSequence([seed, epoch, index]))
+                r = random.Random(0x5EED)
+                return g.uniform(), rng.normal(), r.random()
+        """}, self.RULE, self.CFG)
+        assert res.violations == []
+
+    def test_outside_declared_dirs_ignored(self, tmp_path):
+        res = lint_one(tmp_path, {"other/m.py": """\
+            import numpy as np
+            def f():
+                return np.random.uniform()
+        """}, self.RULE, self.CFG)
+        assert res.violations == []
+
+
+# ---------------------------------------------------------------------------
+# DFD004 recompile hygiene
+# ---------------------------------------------------------------------------
+
+class TestRecompileHygiene:
+    RULE = R.RecompileHygiene()
+
+    def test_jit_in_loop_fires(self, tmp_path):
+        res = lint_one(tmp_path, {"m.py": """\
+            import jax
+            def warm(buckets, score):
+                for b in buckets:
+                    f = jax.jit(score)
+                return f
+        """}, self.RULE)
+        assert [v.rule for v in res.violations] == ["DFD004"]
+
+    def test_hoisted_jit_passes(self, tmp_path):
+        res = lint_one(tmp_path, {"m.py": """\
+            import jax
+            def warm(buckets, score):
+                f = jax.jit(score)
+                for b in buckets:
+                    f(b)
+                return f
+        """}, self.RULE)
+        assert res.violations == []
+
+    def test_array_closure_fires(self, tmp_path):
+        res = lint_one(tmp_path, {"m.py": """\
+            import jax
+            import jax.numpy as jnp
+            def make(x, params):
+                w = jnp.asarray(x)
+                @jax.jit
+                def f(a):
+                    return a + w + params["k"]
+                return f
+        """}, self.RULE)
+        assert [v.rule for v in res.violations] == ["DFD004", "DFD004"]
+        msgs = " | ".join(v.message for v in res.violations)
+        assert "`w`" in msgs and "`params`" in msgs
+
+    def test_arrays_as_arguments_pass(self, tmp_path):
+        res = lint_one(tmp_path, {"m.py": """\
+            import jax
+            import jax.numpy as jnp
+            def make(model, use_ema):
+                @jax.jit
+                def f(params, a):
+                    if use_ema:                     # scalar capture: fine
+                        return model.apply(params, a)
+                    return a
+                return f
+        """}, self.RULE)
+        assert res.violations == []
+
+
+# ---------------------------------------------------------------------------
+# DFD005 metric hygiene
+# ---------------------------------------------------------------------------
+
+class TestMetricHygiene:
+    RULE = R.MetricHygiene()
+
+    def cfg(self):
+        return LintConfig(
+            metric_registries={"metrics.py": "dfd_serving"},
+            lock_guarded=(("engine.py", "inflight", "_pending_lock"),))
+
+    METRICS = """\
+        def render(doc):
+            doc.counter("scored_total", "h", 1)
+            doc.gauge("inflight", "h", 0)
+            doc.histogram("latency_seconds", "h", None)
+    """
+
+    def test_duplicate_registration_fires(self, tmp_path):
+        res = lint_one(tmp_path, {"metrics.py": """\
+            def render(doc):
+                doc.counter("scored_total", "h", 1)
+                doc.gauge("scored_total", "h", 2)
+        """}, self.RULE, self.cfg())
+        assert [v.rule for v in res.violations] == ["DFD005"]
+        assert "more than once" in res.violations[0].message
+
+    def test_unregistered_reference_fires_registered_passes(self, tmp_path):
+        res = lint_one(tmp_path, {
+            "metrics.py": self.METRICS,
+            "probe.py": """\
+                OK = ("dfd_serving_scored_total",
+                      "dfd_serving_latency_seconds_bucket",
+                      "dfd_other_not_a_registry")
+                BAD = "dfd_serving_scoerd_total"
+            """,
+        }, self.RULE, self.cfg())
+        assert [v.rule for v in res.violations] == ["DFD005"]
+        assert "dfd_serving_scoerd_total" in res.violations[0].message
+
+    def test_dynamic_prefix_exempt(self, tmp_path):
+        cfg = self.cfg()
+        cfg.metric_dynamic_prefixes = ("dfd_serving_input_",)
+        res = lint_one(tmp_path, {
+            "metrics.py": self.METRICS,
+            "probe.py": "X = 'dfd_serving_input_anything_total'\n",
+        }, self.RULE, cfg)
+        assert res.violations == []
+
+    def test_unguarded_gauge_mutation_fires(self, tmp_path):
+        res = lint_one(tmp_path, {"engine.py": """\
+            class E:
+                def bump(self, n):
+                    self.metrics.inflight += n
+                def ok(self, n):
+                    with self._pending_lock:
+                        self.metrics.inflight -= n
+        """}, self.RULE, self.cfg())
+        assert [(v.rule, v.line) for v in res.violations] == [("DFD005", 3)]
+
+
+# ---------------------------------------------------------------------------
+# DFD006 chaos registry
+# ---------------------------------------------------------------------------
+
+class TestChaosRegistry:
+    RULE = R.ChaosRegistry()
+    CFG = LintConfig(chaos_module="chaos.py")
+
+    def test_unknown_point_and_spec_fire(self, tmp_path):
+        res = lint_one(tmp_path, {
+            "chaos.py": "KNOWN_POINTS = frozenset({'boom', 'stall'})\n",
+            "use.py": """\
+                def f(inj, step):
+                    if inj.fires("bom", step):
+                        pass
+                SPEC = "stall@3,explode@5x2"
+            """,
+        }, self.RULE, self.CFG)
+        assert [v.rule for v in res.violations] == ["DFD006", "DFD006"]
+        msgs = " | ".join(v.message for v in res.violations)
+        assert "'bom'" in msgs and "'explode'" in msgs
+
+    def test_known_points_pass(self, tmp_path):
+        res = lint_one(tmp_path, {
+            "chaos.py": "KNOWN_POINTS = frozenset({'boom', 'stall'})\n",
+            "use.py": """\
+                def f(inj, step):
+                    return inj.fires("boom", step)
+                SPEC = "stall@3x2:1.5"
+            """,
+        }, self.RULE, self.CFG)
+        assert res.violations == []
+
+    def test_missing_registry_fires(self, tmp_path):
+        res = lint_one(tmp_path, {
+            "use.py": "def f(inj):\n    return inj.fires('boom', 1)\n",
+        }, self.RULE, self.CFG)
+        assert [v.rule for v in res.violations] == ["DFD006"]
+        assert "no KNOWN_POINTS registry" in res.violations[0].message
+
+
+# ---------------------------------------------------------------------------
+# DFD007 event-schema discipline
+# ---------------------------------------------------------------------------
+
+class TestEventSchema:
+    RULE = R.EventSchema()
+
+    def test_missing_flush_and_schema_fire(self, tmp_path):
+        res = lint_one(tmp_path, {"w.py": """\
+            import json
+            class Log:
+                def emit(self, rec):
+                    line = json.dumps(rec) + "\\n"
+                    self._f.write(line)
+            def other(f):
+                rec = {"a": 1}
+                f.write(json.dumps(rec) + "\\n")
+                f.flush()
+        """}, self.RULE)
+        assert [v.rule for v in res.violations] == ["DFD007", "DFD007"]
+        msgs = " | ".join(v.message for v in res.violations)
+        assert "without a flush()" in msgs and "schema" in msgs
+
+    def test_append_without_newline_fires(self, tmp_path):
+        res = lint_one(tmp_path, {"w.py": """\
+            import json
+            def emit(path, rec):
+                with open(path, "a") as f:
+                    f.write(json.dumps(rec))
+        """}, self.RULE)
+        assert [v.rule for v in res.violations] == ["DFD007"]
+        assert "not newline-terminated" in res.violations[0].message
+
+    def test_events_py_idiom_passes(self, tmp_path):
+        res = lint_one(tmp_path, {"w.py": """\
+            import json
+            class Log:
+                def emit(self, extra):
+                    rec = {"v": 1, "x": extra}
+                    line = json.dumps(rec) + "\\n"
+                    self._f.write(line)
+                    self._f.flush()
+            def snapshot(path, state):
+                with open(path, "w") as f:        # whole-file, not JSONL
+                    f.write(json.dumps(state))
+            def bench_rows(path, rows):
+                with open(path, "a") as f:        # with-managed: close
+                    for r in rows:                # flushes
+                        f.write(json.dumps(r) + "\\n")
+        """}, self.RULE)
+        assert res.violations == []
+
+
+# ---------------------------------------------------------------------------
+# DFD008 subprocess discipline
+# ---------------------------------------------------------------------------
+
+class TestSubprocessDiscipline:
+    RULE = R.SubprocessDiscipline()
+
+    def test_run_without_timeout_and_unowned_popen_fire(self, tmp_path):
+        res = lint_one(tmp_path, {"t.py": """\
+            import subprocess
+            def f(cmd):
+                subprocess.run(cmd)
+                return subprocess.Popen(cmd)
+        """}, self.RULE)
+        assert [v.rule for v in res.violations] == ["DFD008", "DFD008"]
+
+    def test_timeout_and_kill_escalation_pass(self, tmp_path):
+        res = lint_one(tmp_path, {"t.py": """\
+            import subprocess
+            def f(cmd):
+                subprocess.run(cmd, timeout=60)
+                p = subprocess.Popen(cmd)
+                try:
+                    p.wait(timeout=10)
+                finally:
+                    p.terminate()
+                    p.kill()
+        """}, self.RULE)
+        assert res.violations == []
+
+
+# ---------------------------------------------------------------------------
+# DFD009 ctypes ABI
+# ---------------------------------------------------------------------------
+
+class TestCtypesAbi:
+    RULE = R.CtypesAbi()
+
+    def test_unprobed_binding_fires(self, tmp_path):
+        res = lint_one(tmp_path, {"b.py": """\
+            import ctypes
+            lib = ctypes.PyDLL("libdfd_native.so")
+            lib.dfd_warp_affine.argtypes = []
+        """}, self.RULE)
+        assert [v.rule for v in res.violations] == ["DFD009"]
+
+    def test_probed_binding_and_exempt_module_pass(self, tmp_path):
+        cfg = LintConfig(ctypes_exempt=("native.py",))
+        res = lint_one(tmp_path, {
+            "b.py": """\
+                import ctypes
+                lib = ctypes.PyDLL("libdfd_native.so")
+                assert lib.dfd_abi_version() == 3
+                lib.dfd_warp_affine.argtypes = []
+            """,
+            "native.py": """\
+                import ctypes
+                lib = ctypes.CDLL("libdfd_native.so")
+                lib.dfd_decode.argtypes = []
+            """,
+        }, self.RULE, cfg)
+        assert res.violations == []
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline semantics
+# ---------------------------------------------------------------------------
+
+class TestSuppressionSemantics:
+    RULE = R.RngDiscipline()
+    CFG = LintConfig(rng_dirs=("pkg",))
+
+    SRC = """\
+        import numpy as np
+        def f():
+            a = np.random.uniform()  # dfdlint: disable=DFD003
+            # dfdlint: disable=DFD003
+            b = np.random.uniform()
+            c = np.random.uniform()
+            return a, b, c
+    """
+
+    def test_inline_and_comment_above_suppress(self, tmp_path):
+        res = lint_one(tmp_path, {"pkg/m.py": self.SRC}, self.RULE,
+                       self.CFG)
+        assert len(res.violations) == 1 and res.violations[0].line == 6
+        assert len(res.suppressed) == 2
+        assert res.unused_suppressions == []
+
+    def test_ignoring_suppressions_resurfaces_all(self, tmp_path):
+        res = lint_one(tmp_path, {"pkg/m.py": self.SRC}, self.RULE,
+                       self.CFG, honor_suppressions=False)
+        assert len(res.violations) == 3
+
+    def test_unused_suppression_is_rot(self, tmp_path):
+        res = lint_one(tmp_path, {"pkg/m.py": """\
+            import numpy as np
+            def f(rng):
+                return rng.uniform()  # dfdlint: disable=DFD003
+        """}, self.RULE, self.CFG)
+        assert res.violations == []
+        assert res.unused_suppressions == [("pkg/m.py", 3, "DFD003")]
+        assert not res.strict_clean
+
+    def test_docstring_mention_is_not_a_suppression(self, tmp_path):
+        res = lint_one(tmp_path, {"pkg/m.py": '''\
+            """Docs: write  # dfdlint: disable=DFD003  on the line."""
+            import numpy as np
+            def f():
+                return np.random.uniform()
+        '''}, self.RULE, self.CFG)
+        assert len(res.violations) == 1
+        assert res.unused_suppressions == []
+
+
+class TestBaselineSemantics:
+    RULE = R.RngDiscipline()
+    CFG = LintConfig(rng_dirs=("pkg",))
+    FILES = {"pkg/m.py": """\
+        import numpy as np
+        def f():
+            a = np.random.uniform()
+            b = np.random.uniform()
+            return a, b
+    """}
+
+    def entry(self, count=2):
+        return BaselineEntry(rule="DFD003", path="pkg/m.py",
+                             line_text="a = np.random.uniform()",
+                             count=count, justification="test")
+
+    def test_baseline_absorbs_up_to_count(self, tmp_path):
+        index = make_index(tmp_path, self.FILES)
+        res = run_lint(index, self.CFG, baseline=[self.entry(1)],
+                       rules=[self.RULE])
+        # one absorbed, the b-line still new
+        assert len(res.baselined) == 1 and len(res.violations) == 1
+        assert res.unused_baseline == []
+
+    def test_unused_entry_is_rot(self, tmp_path):
+        index = make_index(tmp_path, self.FILES)
+        stale = BaselineEntry(rule="DFD003", path="pkg/m.py",
+                              line_text="gone = np.random.rand()",
+                              count=1, justification="stale")
+        res = run_lint(index, self.CFG, baseline=[stale],
+                       rules=[self.RULE])
+        assert stale in res.unused_baseline
+        assert not res.strict_clean
+
+    def test_roundtrip_io(self, tmp_path):
+        p = str(tmp_path / "b.json")
+        save_baseline(p, [self.entry()])
+        loaded = load_baseline(p)
+        assert loaded == [self.entry()]
+        with open(p) as f:
+            assert json.load(f)["version"] == 1
+
+    def test_rule_filter_does_not_rot_other_rules(self, tmp_path):
+        """A filtered run (--rules DFD00X) must not report suppressions or
+        baseline entries of rules that never executed as rot — otherwise
+        `--rules DFD003 --strict` would false-fail on every DFD004 entry."""
+        index = make_index(tmp_path, {"pkg/m.py": """\
+            import numpy as np
+            import subprocess
+            def f(cmd):
+                subprocess.run(cmd)  # dfdlint: disable=DFD008
+                return np.random.uniform()
+        """})
+        other = BaselineEntry(rule="DFD008", path="pkg/other.py",
+                              line_text="subprocess.run(x)", count=1,
+                              justification="other rule's debt")
+        res = run_lint(index, self.CFG, baseline=[other],
+                       rules=[R.RngDiscipline()])
+        assert [v.rule for v in res.violations] == ["DFD003"]
+        # neither the DFD008 suppression nor the DFD008 entry is rot here
+        assert res.unused_suppressions == []
+        assert res.unused_baseline == []
+        # ...but a full run does judge them
+        res = run_lint(index, self.CFG, baseline=[other])
+        assert other in res.unused_baseline
+
+    def test_unparseable_file_reports_dfd000(self, tmp_path):
+        index = make_index(tmp_path, {"pkg/bad.py": "def f(:\n"})
+        res = run_lint(index, self.CFG, rules=[self.RULE])
+        assert [v.rule for v in res.violations] == ["DFD000"]
+
+
+# ---------------------------------------------------------------------------
+# the gate: whole package + tools, checked-in baseline, zero rot
+# ---------------------------------------------------------------------------
+
+class TestGate:
+    def _run(self):
+        index = ProjectIndex.build(["deepfake_detection_tpu", "tools"],
+                                   REPO)
+        baseline = load_baseline(
+            os.path.join(REPO, "tools", "dfdlint_baseline.json"))
+        return index, baseline, run_lint(index, default_config(),
+                                         baseline=baseline)
+
+    def test_tree_is_clean_and_rot_free(self):
+        index, baseline, res = self._run()
+        assert res.violations == [], "\n".join(
+            v.format(fix_hints=True) for v in res.violations)
+        # rot-freedom is what makes baseline/suppression deletion fail
+        # this test: every baseline entry absorbs >=1 live violation
+        # (delete it -> that violation becomes `new`), and every inline
+        # suppression suppresses >=1 (delete it -> same)
+        assert res.unused_baseline == []
+        assert res.unused_suppressions == []
+        assert len(baseline) > 0 and len(res.baselined) > 0
+        assert len(res.suppressed) > 0
+
+    def test_every_rule_is_alive_on_fixtures(self, tmp_path):
+        """No dead rules: each rule produces a violation on a minimal bad
+        fixture (the per-rule classes above prove direction and detail;
+        this is the aggregate liveness pin)."""
+        bad = {
+            "pkg/__init__.py": "",
+            "pkg/a.py": "import jax\n",
+            "pkg/rng.py": "import numpy as np\nX = np.random.uniform()\n",
+            "m.py": ("import jax\n"
+                     "def f(s, b):\n"
+                     "    g = jax.jit(r, donate_argnums=(0,))\n"
+                     "    o, _ = g(s, b)\n"
+                     "    return s\n"
+                     "def w(bs, sc):\n"
+                     "    for b in bs:\n"
+                     "        jax.jit(sc)\n"),
+            "metrics.py": 'def r(doc):\n    doc.counter("a_total", "h", 1)'
+                          '\n    doc.counter("a_total", "h", 1)\n',
+            "use.py": "def f(i):\n    return i.fires('nope', 1)\n",
+            "chaosreg.py": "KNOWN_POINTS = frozenset({'yes'})\n",
+            "w.py": ("import json\n"
+                     "def e(path, rec):\n"
+                     "    with open(path, 'a') as f:\n"
+                     "        f.write(json.dumps(rec))\n"),
+            "sp.py": "import subprocess\nsubprocess.run(['x'])\n",
+            "ct.py": ("import ctypes\nl = ctypes.CDLL('x.so')\n"
+                      "l.dfd_y.argtypes = []\n"),
+        }
+        cfg = LintConfig(jax_free_modules=("pkg.a",),
+                         rng_dirs=("pkg",),
+                         metric_registries={"metrics.py": "dfd_serving"},
+                         chaos_module="chaosreg.py")
+        index = make_index(tmp_path, bad)
+        res = run_lint(index, cfg)
+        fired = {v.rule for v in res.violations}
+        expected = {f"DFD00{i}" for i in range(1, 10)}
+        assert expected <= fired, f"dead rules: {expected - fired}"
+
+    def test_filtered_baseline_update_preserves_other_rules(self, tmp_path):
+        """`--rules DFD003 --baseline-update` must refresh only DFD003's
+        debt — wiping the hand-justified DFD004 entries would be data
+        loss through the documented runbook command."""
+        import importlib.util
+        import shutil
+        spec = importlib.util.spec_from_file_location(
+            "dfdlint_cli", os.path.join(REPO, "tools", "dfdlint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        bl = str(tmp_path / "b.json")
+        shutil.copy(os.path.join(REPO, "tools", "dfdlint_baseline.json"),
+                    bl)
+        before = {e.key() for e in load_baseline(bl)
+                  if e.rule != "DFD003"}
+        assert before, "fixture assumes non-DFD003 entries exist"
+        rc = mod.main(["deepfake_detection_tpu", "tools",
+                       "--rules", "DFD003", "--baseline-update",
+                       "--baseline", bl])
+        assert rc == 0
+        after = {e.key() for e in load_baseline(bl) if e.rule != "DFD003"}
+        assert after == before
+
+    def test_cli_gate_run(self):
+        """The CLI itself: strict gate exits 0 on the tree, fast, jax-free
+        (this is the command scripts/lint.sh and the verify recipe run)."""
+        code = (
+            "import sys, runpy\n"
+            "sys.argv = ['dfdlint', 'deepfake_detection_tpu', 'tools',"
+            " '--strict']\n"
+            "try:\n"
+            "    runpy.run_path('tools/dfdlint.py', run_name='__main__')\n"
+            "except SystemExit as e:\n"
+            "    assert e.code == 0, f'dfdlint gate failed: {e.code}'\n"
+            "bad = [m for m in sys.modules if m == 'jax' or"
+            " m.startswith('jax.')]\n"
+            "assert not bad, f'linter dragged jax in: {bad[:3]}'\n"
+        )
+        r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                           capture_output=True, text=True, timeout=120,
+                           env={**os.environ, "PYTHONPATH": ""})
+        assert r.returncode == 0, r.stderr[-1500:]
+
+
+# ---------------------------------------------------------------------------
+# the one subprocess canary: static graph vs reality
+# ---------------------------------------------------------------------------
+
+def test_jax_free_manifest_canary():
+    """DFD001 proves jax-freedom on the *static* import graph; this single
+    subprocess imports every declared module for real and asserts jax never
+    enters sys.modules — validating the graph against reality.  (Replaces
+    the per-module subprocess tests that predated dfdlint: one child, not
+    N.)"""
+    from deepfake_detection_tpu.lint.manifest import JAX_FREE_MODULES
+    imports = "\n".join(f"import {m}" for m in JAX_FREE_MODULES)
+    code = (
+        "import sys; sys.path.insert(0, '.')\n"
+        f"{imports}\n"
+        "bad = sorted(m for m in sys.modules if m == 'jax' or "
+        "m.startswith('jax.'))\n"
+        "assert not bad, f'jax leaked: {bad[:5]}'\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=180,
+                       env={**os.environ, "PYTHONPATH": ""})
+    assert r.returncode == 0, (r.stderr[-1500:] or r.stdout[-500:])

@@ -361,28 +361,15 @@ class TestPackerResume:
 
 
 # ---------------------------------------------------------------------------
-# Satellites: jax-free imports, make_lists cross-check
+# Satellites: make_lists cross-check
 # ---------------------------------------------------------------------------
+# (The per-module "jax never enters sys.modules" subprocess test that
+# lived here moved into dfdlint: rule DFD001 proves jax-freedom on the
+# static import graph for EVERY module in lint/manifest.py
+# JAX_FREE_MODULES, and the single subprocess canary in
+# tests/test_lint.py validates that graph against reality.)
 
 REPO = os.path.join(os.path.dirname(__file__), os.pardir)
-
-
-def test_packed_modules_import_jax_free():
-    """data/packed.py and tools/pack_dataset.py must not pull jax into
-    sys.modules (PR 1's spawned-worker import-cost discipline): shm decode
-    workers and data-prep hosts unpickle/import these with no accelerator
-    stack."""
-    code = (
-        "import sys; sys.path.insert(0, '.');\n"
-        "import deepfake_detection_tpu.data.packed\n"
-        "import tools.pack_dataset\n"
-        "bad = sorted(m for m in sys.modules if m == 'jax' or "
-        "m.startswith('jax.'))\n"
-        "assert not bad, f'jax leaked: {bad[:5]}'\n"
-    )
-    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
-                       capture_output=True, text=True, timeout=120)
-    assert r.returncode == 0, r.stderr[-800:]
 
 
 def test_make_lists_validate_packed(tree_and_pack):

@@ -124,6 +124,17 @@ def main() -> None:
     # dfd_warp_affine has the simplest ABI; replicate the argtypes binding
     # (ABI v3: src pixel stride sits between the source dims and the dst).
     pylib = ctypes.PyDLL(native._LIB)
+    # hand-written argtypes go stale silently when the native ABI bumps —
+    # every argument shifts (the ABI-3 incident this tool already lived
+    # through once).  Probe the version so a stale binding fails LOUDLY
+    # before any mis-shifted call (dfdlint DFD009 enforces this pattern).
+    pylib.dfd_abi_version.restype = ctypes.c_int
+    abi = pylib.dfd_abi_version()
+    if abi != native._ABI_VERSION:
+        raise RuntimeError(
+            f"bench_gil's hand-written dfd_warp_affine binding targets ABI "
+            f"{native._ABI_VERSION} but libdfd_native.so reports ABI {abi}; "
+            "update the argtypes below to the new signature")
     u8p = ctypes.POINTER(ctypes.c_uint8)
     pylib.dfd_warp_affine.argtypes = [
         u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,

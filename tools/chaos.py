@@ -62,6 +62,9 @@ def run_scenario(fault: str, cmd: list, expect: int,
     env = dict(os.environ, DFD_CHAOS=fault)
     print(f"[chaos] launch 0: DFD_CHAOS={fault!r}: {' '.join(cmd)}",
           flush=True)
+    # unbounded on purpose: the child is a full training run whose own
+    # StallWatchdog (exit 85) is the hang bound — a fixed timeout here
+    # would flake every long scenario   # dfdlint: disable=DFD008
     rc = subprocess.run(cmd, env=env).returncode
     print(f"[chaos] launch 0 exited {rc} (expected {expect})", flush=True)
     if rc != expect:
@@ -82,7 +85,8 @@ def run_scenario(fault: str, cmd: list, expect: int,
     for attempt in range(1, max_restarts + 1):
         print(f"[chaos] relaunch {attempt}/{max_restarts}: "
               f"{' '.join(resume_cmd)}", flush=True)
-        rc = subprocess.run(resume_cmd, env=env).returncode
+        # same contract as launch 0: the child's watchdog is the bound
+        rc = subprocess.run(resume_cmd, env=env).returncode  # dfdlint: disable=DFD008
         print(f"[chaos] relaunch {attempt} exited {rc}", flush=True)
         if rc == 0:
             print("[chaos] PASS: recovered to completion")

@@ -143,15 +143,18 @@ def _commit(tag: str) -> None:
     paths = ["BENCH_TPU_ROWS.json", "battery_logs", "ATTN_BENCH.jsonl",
              "BENCH_BATTERY.json", "DRESS_REHEARSAL.json", "traces"]
     try:
+        # bounded: a wedged git (stale lock, hung hook) must not stall the
+        # battery loop (dfdlint DFD008)
         subprocess.run(["git", "add", "-A", "--"] +
                        [p for p in paths if os.path.exists(os.path.join(REPO, p))],
-                       cwd=REPO, check=True, capture_output=True)
-        r = subprocess.run(["git", "diff", "--cached", "--quiet"], cwd=REPO)
+                       cwd=REPO, check=True, capture_output=True, timeout=120)
+        r = subprocess.run(["git", "diff", "--cached", "--quiet"], cwd=REPO,
+                           timeout=120)
         if r.returncode == 0:
             _log("commit: nothing staged")
             return
         subprocess.run(["git", "commit", "-m", f"chip battery: {tag}"],
-                       cwd=REPO, check=True, capture_output=True)
+                       cwd=REPO, check=True, capture_output=True, timeout=120)
         _log(f"commit: done ({tag})")
     except Exception as e:  # noqa: BLE001
         _log(f"commit failed (continuing): {e}")

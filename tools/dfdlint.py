@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""dfdlint CLI — run the repo's static-analysis rules (DFD001–DFD009).
+
+Runbook::
+
+    python tools/dfdlint.py deepfake_detection_tpu tools   # the gate run
+    python tools/dfdlint.py --list-rules                   # rule catalog
+    python tools/dfdlint.py <paths> --fix-hints            # verbose hints
+    python tools/dfdlint.py <paths> --baseline-update      # refreeze debt
+
+Exit codes: 0 clean, 1 new violations (or rot under ``--strict``),
+2 usage error.  New violations are anything not matched by a per-line
+``# dfdlint: disable=RULE`` suppression or by ``tools/dfdlint_baseline.
+json``; ``--strict`` (the tests/test_lint.py gate) additionally fails on
+*rot* — suppressions that suppress nothing and baseline entries that
+match nothing — so frozen debt can never silently outlive its code.
+
+``--baseline-update`` rewrites the baseline from the current tree,
+preserving the justification text of entries that still match; new
+entries get a ``TODO: justify`` marker you are expected to edit.
+
+jax-free by construction (the linter is stdlib ast/symtable only) —
+safe and fast (<10 s) in any hook or CI step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from deepfake_detection_tpu.lint import (  # noqa: E402
+    BaselineEntry, ProjectIndex, default_config, load_baseline,
+    rule_catalog, run_lint, save_baseline)
+
+DEFAULT_BASELINE = os.path.join(_REPO, "tools", "dfdlint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dfdlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    default=["deepfake_detection_tpu", "tools"],
+                    help="files/dirs to lint (default: the package + tools)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report ALL violations)")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="rewrite the baseline from the current tree, "
+                    "keeping justifications of entries that still match")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on unused suppressions/baseline "
+                    "entries (rot)")
+    ap.add_argument("--fix-hints", action="store_true",
+                    help="print the per-rule fix hint under each finding")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in rule_catalog():
+            print(f"{r['id']} ({r['name']})")
+            print(f"    bug class: {r['bug_class']}")
+            print(f"    fix: {r['hint']}")
+        return 0
+
+    paths = args.paths or ["deepfake_detection_tpu", "tools"]
+    t0 = time.monotonic()
+    index = ProjectIndex.build(paths, _REPO)
+    config = default_config()
+    baseline = [] if (args.no_baseline or args.baseline_update) \
+        else load_baseline(args.baseline)
+
+    rules = None
+    if args.rules:
+        from deepfake_detection_tpu.lint import ALL_RULES
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        rules = [r for r in ALL_RULES if r.id in wanted]
+        if not rules:
+            print(f"no such rule(s): {args.rules}", file=sys.stderr)
+            return 2
+
+    result = run_lint(index, config, baseline=baseline, rules=rules)
+
+    if args.baseline_update:
+        old = {e.key(): e for e in (load_baseline(args.baseline)
+                                    if os.path.exists(args.baseline)
+                                    else [])}
+        grouped = {}
+        for v in result.violations + result.baselined:
+            ctx = index.by_relpath.get(v.path)
+            text = ctx.line_text(v.line) if ctx is not None else ""
+            key = (v.rule, v.path, text)
+            grouped[key] = grouped.get(key, 0) + 1
+        entries = []
+        for (rule, path, text), count in sorted(grouped.items()):
+            prev = old.get((rule, path, text))
+            entries.append(BaselineEntry(
+                rule=rule, path=path, line_text=text, count=count,
+                justification=prev.justification if prev is not None
+                else "TODO: justify"))
+        if rules is not None:
+            # a filtered run only refreshes its own rules' debt — entries
+            # for rules that did not execute carry over untouched
+            active_ids = {r.id for r in rules}
+            entries.extend(e for e in old.values()
+                           if e.rule not in active_ids)
+        save_baseline(args.baseline, entries)
+        print(f"baseline rewritten: {len(entries)} entr"
+              f"{'y' if len(entries) == 1 else 'ies'} -> {args.baseline}")
+        return 0
+
+    for v in result.violations:
+        print(v.format(fix_hints=args.fix_hints))
+    rot = 0
+    if args.strict:
+        for path, line, rid in result.unused_suppressions:
+            print(f"{path}:{line}: ROT unused suppression for {rid}")
+            rot += 1
+        for e in result.unused_baseline:
+            print(f"{e.path}: ROT baseline entry for {e.rule} "
+                  f"({e.line_text!r}) matches nothing")
+            rot += 1
+
+    dt = time.monotonic() - t0
+    n = len(result.violations)
+    print(f"dfdlint: {len(index.files)} files, {n} new violation"
+          f"{'' if n == 1 else 's'}, {len(result.baselined)} baselined, "
+          f"{len(result.suppressed)} suppressed"
+          + (f", {rot} rot" if args.strict else "")
+          + f" ({dt:.2f}s)", file=sys.stderr)
+    return 1 if (result.violations or rot) else 0
+
+
+if __name__ == "__main__":
+    # `dfdlint ... | head` must not stack-trace on the closed pipe
+    try:
+        import signal
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    except (ImportError, AttributeError, ValueError):
+        pass
+    sys.exit(main())
