@@ -340,9 +340,13 @@ class TrainConfig:
     experiment: str = ""
 
     # --- parallelism (TPU-native; no reference analog) ---
-    mesh_shape: Optional[Tuple[int, ...]] = None   # default: (n_devices,)
+    # default mesh: the unified 2-D ('batch': n_devices, 'model': 1) GSPMD
+    # mesh (parallel/mesh.py make_train_mesh); explicit --mesh-shape/
+    # --mesh-axes select a legacy layout verbatim
+    mesh_shape: Optional[Tuple[int, ...]] = None
     mesh_axes: Tuple[str, ...] = ("data",)
-    fsdp: bool = False                   # shard params over 'data' axis
+    fsdp: bool = False          # shard params (+moments/EMA) over the
+    # batch axis per the sharding-rule table (train_state_shardings)
     grad_accum: int = 1  # microbatches accumulated per optimizer step
     tp_size: int = 1     # model-axis extent for transformer tensor
     # parallelism: builds a (data, model) 2-D mesh and applies the

@@ -240,6 +240,8 @@ class LintConfig:
     # DFD009
     ctypes_exempt: Tuple[str, ...] = ()
     native_symbol_prefix: str = "dfd_"
+    # DFD010
+    shard_map_allowlist: Tuple[str, ...] = ()
 
 
 # ---------------------------------------------------------------------------

@@ -28,6 +28,9 @@ Every entry here is a *promise the rest of the repo makes*:
   naming an unknown point is a dead injection path.
 * ``CTYPES_EXEMPT`` — the one module allowed to bind ``dfd_*`` native
   symbols without its own ABI-version probe (it owns the probe).
+* ``SHARD_MAP_ALLOWLIST`` — legacy manual-SPMD modules still allowed to
+  call ``shard_map``/``pmap`` directly; everything else must express
+  parallelism as NamedSharding under plain jit (DFD010, ISSUE 12).
 """
 
 from __future__ import annotations
@@ -92,6 +95,20 @@ CTYPES_EXEMPT = (
     "deepfake_detection_tpu/data/native.py",    # owns the ABI probe
 )
 
+# Modules still allowed to call shard_map/pmap directly ("legacy manual
+# SPMD").  The ISSUE 12 migration unified training on NamedSharding under
+# plain jit; these two genuinely need manual per-device programs —
+# collective-permute rings (ring attention) and pipeline ppermute hops —
+# and each rides here only until its own migration.  DFD010 rot-checks
+# the list: an entry whose file stops calling shard_map fails the gate.
+SHARD_MAP_ALLOWLIST = (
+    "deepfake_detection_tpu/parallel/ring_attention.py",
+    "deepfake_detection_tpu/parallel/pp.py",
+    # the version shim: imports + signature-probes shard_map so every
+    # legacy caller shares ONE compat surface — it never builds programs
+    "deepfake_detection_tpu/parallel/_compat.py",
+)
+
 
 def default_config() -> LintConfig:
     return LintConfig(
@@ -103,4 +120,5 @@ def default_config() -> LintConfig:
         lock_guarded=LOCK_GUARDED,
         chaos_module=CHAOS_MODULE,
         ctypes_exempt=CTYPES_EXEMPT,
+        shard_map_allowlist=SHARD_MAP_ALLOWLIST,
     )

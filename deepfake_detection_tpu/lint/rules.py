@@ -1114,11 +1114,84 @@ class CtypesAbi(Rule):
 
 
 # ---------------------------------------------------------------------------
+# DFD010 — sharding hygiene: no bare pmap/shard_map outside the allowlist
+# ---------------------------------------------------------------------------
+
+_MANUAL_SPMD = {"shard_map", "pmap"}
+
+
+class ShardingHygiene(Rule):
+    id = "DFD010"
+    name = "sharding-hygiene"
+    bug_class = ("a bare pmap/shard_map re-forks the per-topology dispatch "
+                 "the ISSUE 12 GSPMD migration removed: the program stops "
+                 "scaling by mesh shape under plain jit, and every "
+                 "subsystem layered on the train step (resilience, "
+                 "telemetry, device-augment prologue) needs a second "
+                 "proof for the manual-SPMD fork")
+    hint = ("express the computation as plain jax.jit with NamedSharding/"
+            "with_sharding_constraint over the unified mesh "
+            "(parallel/mesh.py make_train_mesh + "
+            "parallel/sharding.py train_state_shardings); genuinely "
+            "manual-SPMD modules (collective-permute rings, pipeline "
+            "stages) ride lint/manifest.py SHARD_MAP_ALLOWLIST until "
+            "their own migration")
+
+    def check(self, index: ProjectIndex,
+              config: LintConfig) -> List[Violation]:
+        out: List[Violation] = []
+        allow = set(config.shard_map_allowlist)
+        used_allow: Set[str] = set()
+
+        # REFERENCE-level matching, not just calls: `@jax.pmap`
+        # decorators, `functools.partial(jax.pmap, ...)` arguments and
+        # stored handles are all the same manual-SPMD re-entry.  Any
+        # Name/Attribute whose leaf IS pmap/shard_map counts (imports
+        # produce ast.alias nodes, not Names, so `from jax import
+        # shard_map` by itself does not fire — using it does).
+        for f in index.files:
+            seen = set()
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Attribute):
+                    leaf = node.attr
+                elif isinstance(node, ast.Name):
+                    leaf = node.id
+                else:
+                    continue
+                if leaf not in _MANUAL_SPMD:
+                    continue
+                if f.relpath in allow:
+                    used_allow.add(f.relpath)
+                    continue
+                if (node.lineno, leaf) in seen:   # call = Name + Call
+                    continue
+                seen.add((node.lineno, leaf))
+                out.append(self.v(
+                    f, node.lineno,
+                    f"bare `{leaf}` reference outside the legacy "
+                    "allowlist — new code goes through the unified GSPMD "
+                    "path (NamedSharding under plain jit)"))
+        # allowlist rot, same contract as baseline entries: an entry whose
+        # file no longer calls pmap/shard_map (the debt was paid) must be
+        # deleted from the manifest or the gate fails.  Judged only for
+        # files actually IN this run's index — a subset run
+        # (`dfdlint deepfake_detection_tpu/data`) must not call entries
+        # it never looked at rotten.
+        indexed = allow & set(index.by_relpath)
+        for entry in sorted(indexed - used_allow):
+            out.append(self.v(
+                entry, 1,
+                "lint/manifest.py SHARD_MAP_ALLOWLIST entry matches no "
+                "pmap/shard_map call in this file (rot) — remove it"))
+        return out
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES: Tuple[Rule, ...] = (
     JaxPurity(), DonationAliasing(), RngDiscipline(), RecompileHygiene(),
     MetricHygiene(), ChaosRegistry(), EventSchema(),
-    SubprocessDiscipline(), CtypesAbi(),
+    SubprocessDiscipline(), CtypesAbi(), ShardingHygiene(),
 )
 
 

@@ -164,6 +164,27 @@ def _fused_dw_bn_act(block: nn.Module, x, training: bool, *, chs: int,
     z = fused_depthwise(x, kernel, None, None, stride=stride,
                         padding=pad_type, act="none")
     zf = z.astype(jnp.promote_types(z.dtype, jnp.float32))
+    from ..ops.norm import (_active_local_stats, grouped_local_stats,
+                            grouped_running_update)
+    scope = _active_local_stats()
+    if axis_name is None and scope is not None and scope.groups > 1:
+        # unified GSPMD local-BN (ISSUE 12): per-group statistics via the
+        # SAME ops/norm.py core as _LocalStatsBatchNorm — each mesh slot
+        # normalizes with its own shard's stats, running stats take the
+        # group mean (== the shard_map era's per-device update + pmean)
+        zg, mu_g, var_g = grouped_local_stats(zf, scope.groups,
+                                              scope.sharding)
+        if not block.is_initializing():
+            m = 1.0 - momentum      # flax convention (BatchNorm2d:70)
+            ra_mean.value = grouped_running_update(ra_mean.value, mu_g, m)
+            ra_var.value = grouped_running_update(ra_var.value, var_g, m)
+        mul = jax.lax.rsqrt(var_g + eps)[:, None, None, None] \
+            * scale.astype(jnp.float32)
+        y = ((zg - mu_g[:, None, None, None]) * mul
+             + bias.astype(jnp.float32))
+        if scope.sharding is not None:
+            y = jax.lax.with_sharding_constraint(y, scope.sharding)
+        return act_fn(y.reshape(zf.shape).astype(out_dtype))
     mu = jnp.mean(zf, axis=(0, 1, 2))
     mu2 = jnp.mean(zf * zf, axis=(0, 1, 2))
     if axis_name is not None:

@@ -12,6 +12,17 @@ TPU-native replacements for the reference's BN stack:
 * ``SplitBatchNorm2d`` — AdvProp auxiliary BN (layers/split_batchnorm.py:18-38):
   first 1/N of the batch through the main BN, remaining chunks through aux BNs.
 * ``GroupNorm`` re-export for norm-free/group-norm model variants.
+* ``local_stats_scope`` — the GSPMD expression of the shard_map-era
+  "local BN" (ISSUE 12): inside the scope, TRAINING batch statistics are
+  computed per contiguous batch *group* (one group per data-parallel mesh
+  slot, pinned there by a ``with_sharding_constraint``), so under plain
+  ``jax.jit`` each device normalizes with its own shard's statistics — no
+  per-layer cross-device collectives in the forward — and the running
+  stats are updated with the group-mean, exactly what the old shard_map
+  body's per-device update + ``lax.pmean`` produced.  The scope is
+  TRACE-time state (entered by the train step's body while jit traces),
+  so eval and init never see it and no model-construction plumbing is
+  needed across the 25 model families.
 
 Reference BN defaults: torch (momentum .1, eps 1e-5); TF-ported weights need
 ``BN_MOMENTUM_TF_DEFAULT=0.01`` / ``BN_EPS_TF_DEFAULT=1e-3``
@@ -20,9 +31,11 @@ Reference BN defaults: torch (momentum .1, eps 1e-5); TF-ported weights need
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 BN_MOMENTUM_TF_DEFAULT = 0.01
@@ -46,6 +59,123 @@ def resolve_bn_args(kwargs: dict) -> dict:
     return bn_args
 
 
+_local_stats = threading.local()
+
+
+class local_stats_scope:
+    """Trace-time scope: BN training statistics per contiguous batch group.
+
+    ``groups`` is the data-parallel extent of the mesh; ``sharding`` (a
+    ``NamedSharding`` whose spec shards axis 0 over the batch axis) pins
+    group ``g`` of the ``(groups, B/groups, ...)`` reshape onto mesh slot
+    ``g`` so XLA computes every group's statistics locally.  Entered by
+    ``make_train_step`` around the forward — i.e. while ``jax.jit`` traces
+    — and therefore invisible to eval/init traces.  Reentrant per thread
+    (a stack), matching nested tracing.
+    """
+
+    def __init__(self, groups: int, sharding: Any = None):
+        self.groups = int(groups)
+        self.sharding = sharding
+
+    def __enter__(self):
+        stack = getattr(_local_stats, "stack", None)
+        if stack is None:
+            stack = _local_stats.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _local_stats.stack.pop()
+        return False
+
+
+def _active_local_stats() -> Optional["local_stats_scope"]:
+    stack = getattr(_local_stats, "stack", None)
+    return stack[-1] if stack else None
+
+
+def grouped_local_stats(x, groups: int, sharding: Any, dtype: Any = None):
+    """The ONE implementation of the local-BN grouping semantics.
+
+    Returns ``(xg, mean, var)``: ``xg`` is the ``(groups, B/groups, ...)``
+    reshape pinned to ``sharding`` (one group per batch-axis mesh slot),
+    ``mean``/``var`` are per-group statistics of shape ``(groups, C)``
+    computed by flax's own ``_compute_stats`` (f32 promotion,
+    ``max(0, E[x²]−E[x]²)`` clamp) — so every caller (the generic
+    ``_LocalStatsBatchNorm`` and the fused-depthwise epilogue) shares the
+    exact formula and the exact divisibility contract.
+    """
+    from flax.linen import normalization as _fnorm
+    g = int(groups)
+    b = x.shape[0]
+    if b % g:
+        raise ValueError(
+            f"local-BN grouping: batch {b} not divisible by the "
+            f"data-parallel extent {g} — pad the global batch to a "
+            f"multiple of the mesh's batch axis")
+    xg = x.reshape((g, b // g) + x.shape[1:])
+    if sharding is not None:
+        xg = jax.lax.with_sharding_constraint(xg, sharding)
+    red = tuple(range(1, xg.ndim - 1))       # per-group stats → (g, C)
+    mean, var = _fnorm._compute_stats(xg, red, dtype)
+    return xg, mean, var
+
+
+def grouped_running_update(ra_value, stat_g, momentum: float):
+    """Running-stat update from per-group statistics (FLAX-convention
+    ``momentum``): the group-mean update equals the shard_map era's
+    per-device update followed by the step's one ``lax.pmean``."""
+    return momentum * ra_value + (1.0 - momentum) * stat_g.mean(axis=0)
+
+
+class _LocalStatsBatchNorm(nn.Module):
+    """``flax.linen.BatchNorm``-compatible BN with per-group statistics.
+
+    Declares the SAME variables (params ``scale``/``bias``, batch_stats
+    ``mean``/``var``, float32, feature-shaped) and uses flax's own
+    ``_compute_stats`` / ``_normalize`` kernels on a ``(groups, B/groups,
+    ...)`` reshape — so the math per group is bit-for-bit the formula
+    ``nn.BatchNorm`` applied per shard under the old shard_map body, and
+    checkpoints are interchangeable between the paths.  ``momentum`` is
+    FLAX convention here (running = m*running + (1-m)*batch).
+    """
+    groups: int = 1
+    momentum: float = 0.9
+    epsilon: float = BN_EPS_PT_DEFAULT
+    use_scale: bool = True
+    use_bias: bool = True
+    dtype: Any = None
+    scale_init: Any = nn.initializers.ones
+    sharding: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        from flax.linen import normalization as _fnorm
+        feature_shape = (x.shape[-1],)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda s: jnp.zeros(s, jnp.float32),
+                                feature_shape)
+        ra_var = self.variable("batch_stats", "var",
+                               lambda s: jnp.ones(s, jnp.float32),
+                               feature_shape)
+        xg, mean, var = grouped_local_stats(x, self.groups, self.sharding,
+                                            self.dtype)
+        if not self.is_initializing():
+            ra_mean.value = grouped_running_update(ra_mean.value, mean,
+                                                   self.momentum)
+            ra_var.value = grouped_running_update(ra_var.value, var,
+                                                  self.momentum)
+        red = tuple(range(1, xg.ndim - 1))
+        y = _fnorm._normalize(
+            self, xg, mean, var, red, (xg.ndim - 1,), self.dtype,
+            jnp.float32, self.epsilon, self.use_bias, self.use_scale,
+            nn.initializers.zeros, self.scale_init)
+        if self.sharding is not None:
+            y = jax.lax.with_sharding_constraint(y, self.sharding)
+        return y.reshape(x.shape)
+
+
 class BatchNorm2d(nn.Module):
     """NHWC batch norm with torch-style momentum and optional cross-replica sync.
 
@@ -63,6 +193,22 @@ class BatchNorm2d(nn.Module):
 
     @nn.compact
     def __call__(self, x, training: bool = False):
+        scope = _active_local_stats()
+        if training and self.axis_name is None and scope is not None \
+                and scope.groups > 1:
+            # unified GSPMD local-BN path (ISSUE 12): same variable tree
+            # under the same "bn" name, statistics per batch group
+            return _LocalStatsBatchNorm(
+                groups=scope.groups,
+                sharding=scope.sharding,
+                momentum=1.0 - self.momentum,
+                epsilon=self.eps,
+                use_scale=self.use_scale,
+                use_bias=self.use_bias,
+                dtype=self.dtype,
+                scale_init=(self.scale_init if self.scale_init is not None
+                            else nn.initializers.ones),
+                name="bn")(x)
         kwargs = {}
         if self.scale_init is not None:
             kwargs["scale_init"] = self.scale_init

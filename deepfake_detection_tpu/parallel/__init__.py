@@ -5,8 +5,9 @@ TPU-native replacement for the reference's NCCL/apex-DDP layer (SURVEY.md
 """
 
 from .collectives import distribute_bn, pmean, psum, tree_pmean
-from .mesh import (initialize_distributed, local_batch_size, make_mesh,
-                   process_count, process_index)
+from .mesh import (BATCH_AXIS, MODEL_AXIS, data_axis_name,
+                   initialize_distributed, local_batch_size, make_mesh,
+                   make_train_mesh, process_count, process_index)
 from .ring_attention import (full_attention, ring_attention,
                              ring_flash_attention, ring_self_attention,
                              ulysses_attention)
@@ -14,5 +15,7 @@ from .ep import condconv_ep_sharding, condconv_ep_specs
 from .pp import gpipe_apply, gpipe_transformer_tower, pipeline_sharding, \
     stack_block_params
 from .tp import transformer_tp_sharding, transformer_tp_specs
-from .sharding import (batch_sharding, fsdp_param_specs, param_sharding,
-                       put_process_local, replicated_sharding, shard_batch)
+from .sharding import (batch_sharding, fsdp_param_specs, own_and_place,
+                       param_sharding, place_train_state, put_process_local,
+                       replicated_sharding, shard_batch,
+                       train_state_shardings)

@@ -15,6 +15,12 @@ file://<shared_nfs_file>)`` with rank arithmetic from a JSON server map,
   only strategy the reference has, SURVEY.md §2.7); any shape/axis tuple
   works for dp×fsdp×tp×sp meshes.  Axis order maps the *innermost* axis to
   the fastest ICI links, so put model/tensor axes last.
+* :func:`make_train_mesh` is the unified GSPMD training mesh (ISSUE 12):
+  ONE logical 2-D ``('batch', 'model')`` mesh under which the train step is
+  a plain ``jax.jit`` with ``NamedSharding`` annotations — the same program
+  compiles for 1 chip and a v5e-256 pod without code changes (SNIPPETS.md
+  [1]–[3]).  ``data_axis_name`` resolves which axis the global batch shards
+  over so loaders/steps work on both the unified and legacy axis layouts.
 """
 
 from __future__ import annotations
@@ -30,8 +36,15 @@ from jax.sharding import Mesh
 
 _logger = logging.getLogger(__name__)
 
-__all__ = ["initialize_distributed", "make_mesh", "local_batch_size",
-           "process_count", "process_index"]
+__all__ = ["initialize_distributed", "make_mesh", "make_train_mesh",
+           "data_axis_name", "local_batch_size",
+           "process_count", "process_index", "BATCH_AXIS", "MODEL_AXIS"]
+
+#: canonical axis names of the unified 2-D training mesh.  ``BATCH_AXIS``
+#: carries pure data parallelism (and FSDP parameter sharding); MODEL_AXIS
+#: carries tensor/expert parallelism.  Innermost (= fastest ICI) axis last.
+BATCH_AXIS = "batch"
+MODEL_AXIS = "model"
 
 
 def initialize_distributed(cluster=None, hostname: Optional[str] = None,
@@ -105,6 +118,35 @@ def make_mesh(mesh_shape: Optional[Sequence[int]] = None,
         f"mesh shape {shape} != device count {n}"
     assert len(shape) == len(axis_names), (shape, axis_names)
     return Mesh(np.asarray(devices).reshape(shape), tuple(axis_names))
+
+
+def make_train_mesh(batch: int = -1, model: int = 1,
+                    devices: Optional[Sequence] = None) -> Mesh:
+    """The ONE 2-D ``('batch', 'model')`` mesh unified training runs over.
+
+    ``batch=-1`` infers the data-parallel extent from the device count so
+    the same call works from 1 chip to a full pod; ``model`` is the
+    tensor-parallel extent (1 = pure DP).  Every sharding rule in
+    :func:`~deepfake_detection_tpu.parallel.sharding.train_state_shardings`
+    names these axes, and the train step is a plain ``jax.jit`` over them —
+    no per-topology code.
+    """
+    return make_mesh((batch, model), (BATCH_AXIS, MODEL_AXIS),
+                     devices=devices)
+
+
+def data_axis_name(mesh: Mesh) -> str:
+    """The mesh axis the global batch shards over.
+
+    ``'batch'`` on the unified mesh, ``'data'`` on legacy 1-D / explicit
+    ``--mesh-axes`` layouts, else the first (outermost) axis — so loader
+    sharding and the train step agree on any mesh a user can construct.
+    """
+    names = tuple(mesh.axis_names)
+    for cand in (BATCH_AXIS, "data"):
+        if cand in names:
+            return cand
+    return names[0]
 
 
 def process_count() -> int:

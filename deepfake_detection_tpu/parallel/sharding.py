@@ -1,11 +1,16 @@
-"""Sharding specs and host→global array assembly.
+"""Sharding specs, the TrainState sharding-rule table, and host→global
+array assembly.
 
 The reference's distribution story is DDP: replicate the model, shard the
 batch, allreduce gradients (apex ``delay_allreduce``, train.py:402).  Under
-pjit the same program is expressed declaratively: annotate the batch as
-sharded over ``'data'`` and parameters as replicated (or FSDP-sharded), and
-XLA inserts the collectives over ICI/DCN.  This module holds the annotation
-helpers so runners never spell out PartitionSpecs by hand.
+GSPMD the same program is expressed declaratively: annotate the batch as
+sharded over the batch axis and parameters as replicated (or FSDP/TP-
+sharded), and XLA inserts the collectives over ICI/DCN.  This module holds
+the annotation helpers so runners never spell out PartitionSpecs by hand —
+:func:`train_state_shardings` is the ONE rule table that decides the
+``NamedSharding`` of every TrainState leaf (params / BN stats / optimizer
+moments / EMA / step counter), and :func:`place_train_state` lays a freshly
+built or restored state onto the mesh accordingly.
 """
 
 from __future__ import annotations
@@ -16,13 +21,19 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .mesh import data_axis_name
+
 __all__ = ["batch_sharding", "replicated_sharding", "fsdp_param_specs",
-           "shard_batch", "param_sharding"]
+           "shard_batch", "param_sharding", "train_state_shardings",
+           "place_train_state", "own_and_place"]
 
 
-def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
-    """Leading (batch) dim sharded over the data axis, rest replicated."""
-    return NamedSharding(mesh, P(axis))
+def batch_sharding(mesh: Mesh, axis: Optional[str] = None) -> NamedSharding:
+    """Leading (batch) dim sharded over the data axis, rest replicated.
+
+    ``axis=None`` resolves the mesh's own data axis (``'batch'`` on the
+    unified mesh, ``'data'`` on legacy layouts)."""
+    return NamedSharding(mesh, P(axis or data_axis_name(mesh)))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
@@ -66,6 +77,105 @@ def param_sharding(params: Any, mesh: Mesh, fsdp: bool = False,
                         is_leaf=lambda x: isinstance(x, P))
 
 
+def train_state_shardings(state: Any, mesh: Mesh, fsdp: bool = False,
+                          axis: Optional[str] = None) -> Any:
+    """The sharding-rule table: a NamedSharding per TrainState leaf.
+
+    Rules (ISSUE 12 — one table instead of per-path conventions):
+
+    * **params** — a leaf that already carries a ``NamedSharding`` with a
+      non-trivial spec keeps it (tensor/expert-parallel placement applied
+      at model build wins); otherwise FSDP-sharded over the batch axis
+      when ``fsdp`` else replicated.
+    * **opt_state / EMA** — any subtree whose structure mirrors the params
+      tree (Adam/RMSProp moments, the EMA params stream) inherits the
+      params shardings leaf-for-leaf; everything else (step counts,
+      injected hyperparams, EMA batch_stats) is replicated.
+    * **batch_stats / step** — replicated: BN running stats are pmean-
+      merged inside the step and must stay one logical copy.
+
+    Returns a pytree congruent with ``state`` (usable as jit
+    in/out_shardings and as the :func:`place_train_state` target).
+    """
+    axis = axis or data_axis_name(mesh)
+    rep = replicated_sharding(mesh)
+    params = state.params
+    base = param_sharding(params, mesh, fsdp=fsdp, axis=axis)
+
+    def keep_existing(p, b):
+        sh = getattr(p, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.spec != P():
+            return NamedSharding(mesh, sh.spec)   # re-anchor to THIS mesh
+        return b
+
+    params_sh = jax.tree.map(keep_existing, params, base)
+    params_def = jax.tree.structure(params)
+
+    def is_params_like(node):
+        if node is None:
+            return False
+        try:
+            return jax.tree.structure(node) == params_def
+        except Exception:  # noqa: BLE001 — non-pytree nodes are not params
+            return False
+
+    def follow_params(tree):
+        # substitute the params sharding tree wholesale under any
+        # params-shaped subtree; every other leaf is replicated
+        return jax.tree.map(
+            lambda n: params_sh if is_params_like(n) else rep,
+            tree, is_leaf=is_params_like)
+
+    return state.replace(
+        step=rep,
+        params=params_sh,
+        batch_stats=jax.tree.map(lambda _: rep, state.batch_stats),
+        opt_state=follow_params(state.opt_state),
+        ema=follow_params(state.ema) if state.ema is not None else None)
+
+
+def place_train_state(state: Any, shardings: Any) -> Any:
+    """Lay a TrainState onto the mesh per the sharding table.
+
+    Every leaf routes through :func:`own_and_place`: single-process this
+    is a per-leaf ``device_put`` (with numpy leaves copied into JAX-owned
+    buffers first — never a host alias a donating step could free);
+    multi-process each host holds a full replica of host-local leaves and
+    global arrays are assembled shard-by-shard via
+    ``make_array_from_callback`` (a plain cross-host ``device_put`` of
+    non-addressable shards is not a thing); leaves already carrying their
+    target sharding (tp-placed params) pass through untouched.
+    """
+    return jax.tree.map(own_and_place, state, shardings)
+
+
+def own_and_place(leaf: Any, sh: Optional[NamedSharding]) -> Any:
+    """One leaf onto its target sharding, as a JAX-OWNED buffer.
+
+    The single implementation of the ownership discipline both state
+    placement and checkpoint restore rely on: a host numpy leaf must
+    never enter the donating train step as a zero-copy alias of host
+    memory (the CPU backend aliases suitably-aligned buffers; donation
+    then frees memory numpy owns — the PR 2 native-SIGSEGV class), and a
+    cross-host layout cannot be ``device_put`` from a host array at all
+    (non-addressable shards) — it is assembled per-shard from this
+    host's full copy, with ``jnp.array`` inside the callback keeping
+    every shard an owned copy.  ``sh=None`` leaves placement alone but
+    still takes ownership of numpy leaves.
+    """
+    import jax.numpy as jnp
+
+    if sh is not None and jax.process_count() > 1:
+        if isinstance(leaf, jax.Array) and leaf.sharding == sh:
+            return leaf
+        a = np.asarray(leaf)
+        return jax.make_array_from_callback(
+            a.shape, sh, lambda idx: jnp.array(a[idx]))
+    if isinstance(leaf, np.ndarray):
+        leaf = jnp.array(leaf)            # device-owned copy
+    return jax.device_put(leaf, sh) if sh is not None else leaf
+
+
 def put_process_local(x: Any, sharding: NamedSharding) -> Any:
     """One per-process host array → global sharded jax.Array.
 
@@ -80,7 +190,7 @@ def put_process_local(x: Any, sharding: NamedSharding) -> Any:
     return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
 
-def shard_batch(batch: Any, mesh: Mesh, axis: str = "data") -> Any:
+def shard_batch(batch: Any, mesh: Mesh, axis: Optional[str] = None) -> Any:
     """Assemble per-process host arrays into a global batch-sharded array
     (replaces the per-process DataLoader shard of DDP)."""
     sharding = batch_sharding(mesh, axis)

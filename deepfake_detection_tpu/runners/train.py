@@ -46,16 +46,18 @@ from ..losses import create_loss_fn, cross_entropy
 from ..models import (create_deepfake_model, create_deepfake_model_v3,
                       create_deepfake_model_v4, create_model, init_model)
 from ..optim import create_optimizer
-from ..parallel import (batch_sharding, initialize_distributed, make_mesh,
-                        transformer_tp_sharding)
+from ..parallel import (batch_sharding, data_axis_name,
+                        initialize_distributed, make_mesh, make_train_mesh,
+                        place_train_state, replicated_sharding,
+                        train_state_shardings, transformer_tp_sharding)
 from ..scheduler import create_scheduler
 from ..train import (EXIT_PREEMPTED, CheckpointCorrupt, CheckpointSaver,
                      Preempted, Resilience, RewindRequested,
                      ShardedCheckpointSaver, create_train_state,
                      find_resume_candidates, make_eval_step,
-                     make_train_step, replicate_for_save,
-                     restore_train_state, set_learning_rate,
-                     train_one_epoch, validate, wait_pending_saves)
+                     make_train_step, replicate_for_save, restore_resharded,
+                     set_learning_rate, train_one_epoch, validate,
+                     wait_pending_saves)
 from ..utils import get_outdir, setup_default_logging, update_summary
 
 _logger = logging.getLogger("train")
@@ -172,16 +174,39 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
             raise ValueError(
                 "--tp-size conflicts with an explicit --mesh-shape/--fsdp; "
                 "configure one parallelism layout at a time")
-        # dp×tp 2-D mesh; parameter shardings applied after init below
-        mesh = make_mesh((-1, cfg.tp_size), ("data", "model"))
-    else:
+        # dp×tp on the unified mesh; parameter shardings applied after
+        # init below (transformer_tp_sharding names the 'model' axis)
+        mesh = make_train_mesh(batch=-1, model=cfg.tp_size)
+    elif cfg.mesh_shape is not None or tuple(cfg.mesh_axes) != ("data",):
+        # explicit legacy layout: honored verbatim (tests / sp meshes)
         mesh = make_mesh(cfg.mesh_shape, cfg.mesh_axes)
-    n_dev = int(np.prod(list(mesh.shape.values())))
+    else:
+        # the default: ONE 2-D ('batch', 'model') mesh — the same program
+        # compiles for 1 chip and a pod (ISSUE 12)
+        mesh = make_train_mesh()
+    n_dev = int(mesh.size)
+    batch_axis = data_axis_name(mesh)
     # the data-parallel degree: batch and linear-LR scaling follow it, not
     # the raw device count (a tp group is ONE model replica)
-    dp_size = int(mesh.shape.get("data", n_dev))
+    dp_size = int(mesh.shape.get(batch_axis, n_dev))
     _logger.info("Training with %d devices, mesh %s, process %d/%d",
                  n_dev, dict(mesh.shape), rank, jax.process_count())
+    if cfg.fused_depthwise == "pallas" and n_dev > 1 and \
+            jax.default_backend() == "tpu":
+        # chip-gated residue of the GSPMD migration (ROADMAP chip-debt):
+        # the compiled Mosaic pallas_call has no SPMD partitioning rule,
+        # so embedding it in the unified jit over a >1-chip mesh would at
+        # best replicate the batch around every dw stage and at worst
+        # fail to lower — the old shard_map wrapper that guaranteed
+        # per-device execution is gone.  Interpret mode (off-TPU CI)
+        # partitions fine; on real multi-chip, fail loudly until the
+        # kernel grows its own partitioning (shard_map island or
+        # custom_partitioning).
+        raise NotImplementedError(
+            "--fused-depthwise pallas on a multi-chip mesh is not yet "
+            "verified under the unified GSPMD step; run with "
+            "--fused-depthwise off (or a single chip) until the kernel's "
+            "multi-chip migration lands")
     if cfg.split_bn and dp_size > 1:
         # the loader's split-major batch layout ([all clean, all aug])
         # does not survive contiguous per-device sharding — device d
@@ -195,8 +220,10 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     # ONE seed for every host: params are logically replicated, so init must
     # be identical everywhere (the reference's per-rank seed, train.py:299,
     # was safe only because DDP broadcast rank-0's weights; SPMD has no such
-    # broadcast).  Per-device randomness comes from fold_in(axis_index)
-    # inside the step.
+    # broadcast).  The unified step draws dropout noise over the GLOBAL
+    # batch from one mesh-replicated key (the key is pinned replicated
+    # before the loop below) — do NOT re-add a per-device fold; it would
+    # break the replicated-key in_shardings contract.
     rng = jax.random.PRNGKey(cfg.seed)
     data_config = resolve_data_config(cfg.to_dict(), verbose=rank == 0)
     input_size = data_config["input_size"]
@@ -269,6 +296,16 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
     lr = cfg.resolved_lr(world_size=dp_size * cfg.grad_accum)
     tx = create_optimizer(cfg, learning_rate=lr)
     state = create_train_state(variables, tx, with_ema=cfg.model_ema)
+    # the sharding-rule table (parallel/sharding.py): every TrainState leaf
+    # gets its NamedSharding — params replicated/FSDP/TP per rule, opt
+    # moments and EMA following their params, BN stats and step replicated
+    # — and the state is laid onto the mesh accordingly.  Everything
+    # downstream (the jitted step's in/out_shardings, checkpoint restore
+    # re-layout, the guard's rewind template) reads layout from this one
+    # table.
+    state_shardings = train_state_shardings(state, mesh, fsdp=cfg.fsdp,
+                                            axis=batch_axis)
+    state = place_train_state(state, state_shardings)
 
     lr_scheduler, num_epochs = create_scheduler(cfg, base_lr=lr)
     start_epoch = cfg.start_epoch or 0
@@ -313,38 +350,6 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
                 checkpoint_dir=output_dir, bak_dir=os.path.join(
                     output_dir, "_bak"), decreasing=decreasing)
 
-    def _restore_msgpack(path: str, template, load_opt: bool):
-        """msgpack restore into ``template``'s structure AND device layout
-        (shared by --resume, --auto-resume and the guard's rewind path).
-
-        Capture the fresh state's shardings (opt moments / EMA inherited
-        them from the TP'd params via eager zeros_like) so the restored
-        host arrays go back to the same layout, not just the params.
-
-        msgpack restore yields HOST numpy leaves; the compiled train step
-        DONATES its state, and jax's CPU backend zero-copies suitably-
-        aligned host buffers into jax arrays — donating such an alias
-        frees memory numpy still owns, a use-after-free that surfaced as
-        a native SIGSEGV/SIGABRT on the first resumed steps of a tp run.
-        Copy every restored host leaf into a device-OWNED array
-        (re-applying the template's sharding where it had one — restore
-        must also re-lay-out for tp).
-        """
-        from jax.sharding import NamedSharding
-        shard_tree = jax.tree.map(
-            lambda x: x.sharding if isinstance(x, jax.Array)
-            and isinstance(x.sharding, NamedSharding) else None,
-            template)
-        restored, meta_r = restore_train_state(
-            path, template, load_opt=load_opt)
-
-        def _own(leaf, sh):
-            if isinstance(leaf, np.ndarray):
-                leaf = jnp.array(leaf)        # device-owned copy
-            return jax.device_put(leaf, sh) if sh is not None else leaf
-
-        return jax.tree.map(_own, restored, shard_tree), meta_r
-
     def _restore_any(path: str, template, load_opt: Optional[bool] = None):
         if load_opt is None:
             load_opt = not cfg.no_resume_opt
@@ -353,9 +358,22 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
             # directly into the template's shardings — re-layout
             # (incl. a different tp_size) happens inside the read
             from ..train import restore_sharded_checkpoint
-            return restore_sharded_checkpoint(
+            st, meta_r = restore_sharded_checkpoint(
                 path, template, load_opt=load_opt)
-        return _restore_msgpack(path, template, load_opt)
+            # re-own every restored leaf before it reaches the donating
+            # step: with the sharding table pinning ALL template leaves,
+            # the restore no longer demotes anything to host numpy, and
+            # orbax/tensorstore-backed buffers donated by the step
+            # corrupt the heap (observed: glibc abort on --ckpt-sharded
+            # resume).  jnp.copy preserves each leaf's sharding.
+            st = jax.tree.map(
+                lambda x: jnp.copy(x)
+                if isinstance(x, (jax.Array, np.ndarray)) else x, st)
+            return st, meta_r
+        # msgpack: host arrays re-laid onto the template's sharding-table
+        # annotations (train/checkpoint.py) — a (1,1)-mesh checkpoint
+        # restores onto this run's mesh and vice versa
+        return restore_resharded(path, template, load_opt=load_opt)
 
     def _restore_with_fallback(template, load_opt: Optional[bool] = None):
         """Walk the resume ladder (recovery snapshots newest-first, then
@@ -440,10 +458,8 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         **loader_kwargs)                          # eval bs ×2 (train.py:492)
 
     train_loss_fn = create_loss_fn(cfg)
-    # TP'd params can't ride the shard_map local-BN path (its in_specs
-    # declare params replicated); the jit path lets GSPMD honor the
-    # per-leaf shardings.  Transformers have no BN, so semantics are
-    # unchanged.
+    # tp runs use global-BN semantics: the transformer families carry no
+    # BN, so local-stat grouping would only add layout churn for nothing
     bn_mode = "global" if (cfg.sync_bn or cfg.tp_size > 1) else "local"
     if cfg.dist_bn:
         _logger.info("--dist-bn %s accepted for flag parity; BN stats are "
@@ -451,10 +467,12 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
                      "supersedes the reference's per-epoch distribute_bn",
                      cfg.dist_bn)
     train_step = make_train_step(
-        model, tx, train_loss_fn, mesh=mesh, bn_mode=bn_mode,
+        model, tx, train_loss_fn, mesh=mesh, axis=batch_axis,
+        bn_mode=bn_mode,
         ema_decay=cfg.model_ema_decay if cfg.model_ema else 0.0,
         clip_grad=cfg.clip_grad, grad_accum=cfg.grad_accum,
-        nonfinite_guard=cfg.guard_nonfinite == "skip")
+        nonfinite_guard=cfg.guard_nonfinite == "skip",
+        state_shardings=state_shardings)
     eval_step = make_eval_step(model, cross_entropy)
     eval_step_ema = make_eval_step(model, cross_entropy, use_ema=True) \
         if cfg.model_ema else None
@@ -483,6 +501,15 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("train_start")
 
+    # the jitted step declares its rng argument replicated over the mesh
+    # (in_shardings); fold_in of a mesh-replicated key yields another
+    # mesh-replicated key, so one placement here covers every step of the
+    # run (a committed single-device key would be an in_shardings
+    # mismatch).  own_and_place owns the bytes and covers multi-host,
+    # where every process holds the same host key.
+    from ..parallel import own_and_place
+    rng = own_and_place(np.asarray(rng), replicated_sharding(mesh))
+
     meta = {"arch": cfg.model, "version": 2}
     best_metric, best_epoch = None, None
     eval_metrics: Dict[str, float] = {}
@@ -504,9 +531,12 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
             event_log=event_log, flops_per_sample=fwd_flops,
             # throughput is measured on the GLOBAL batch (the loader
             # assembles the global sharded array), so the MFU denominator
-            # must be the whole mesh's peak, not one chip's
+            # is the whole MESH's peak — n_dev == mesh.size, which a
+            # sub-mesh run may set below the visible device count
             peak_flops=peak_flops() * n_dev,
-            meta=dict(model=cfg.model, global_batch=global_batch))
+            meta=dict(model=cfg.model, global_batch=global_batch,
+                      mesh_shape=[int(s) for s in mesh.shape.values()],
+                      axis_names=list(mesh.axis_names)))
         telemetry.register_collector(loader_collector(train_loader))
         telemetry.register_collector(native_warp_collector())
         telemetry.register_collector(resilience_collector(resilience))
@@ -520,7 +550,9 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
             telemetry.profiler = profiler
         telemetry.event("run_start", model=cfg.model, epochs=num_epochs,
                         start_epoch=start_epoch, global_batch=global_batch,
-                        world_size=n_dev)
+                        world_size=n_dev,
+                        mesh_shape=[int(s) for s in mesh.shape.values()],
+                        axis_names=list(mesh.axis_names))
         if resumed_from:
             telemetry.event("resume", path=resumed_from,
                             epoch=start_epoch, batch=resume_batch)

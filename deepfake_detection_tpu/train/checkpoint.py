@@ -35,7 +35,8 @@ _logger = logging.getLogger(__name__)
 
 __all__ = ["CheckpointSaver", "ShardedCheckpointSaver", "CheckpointCorrupt",
            "save_checkpoint_file", "load_checkpoint_file",
-           "replicate_for_save", "restore_train_state", "wait_pending_saves",
+           "replicate_for_save", "restore_train_state",
+           "restore_resharded", "wait_pending_saves",
            "save_sharded_checkpoint", "restore_sharded_checkpoint",
            "load_sharded_for_eval", "find_resume_candidates"]
 
@@ -516,6 +517,42 @@ def restore_train_state(path: str, target_state: Any,
         sd = _fresh_opt_sd(sd, target_state)
     state = serialization.from_state_dict(target_state, sd)
     return state, meta
+
+
+def restore_resharded(path: str, target_state: Any,
+                      load_opt: bool = True) -> Tuple[Any, Dict[str, Any]]:
+    """msgpack restore into ``target_state``'s structure AND device layout.
+
+    This is the mesh-portable restore (ISSUE 12): the checkpoint file
+    carries plain host arrays, the TEMPLATE carries the sharding-rule
+    table's ``NamedSharding`` annotations — so a checkpoint written on a
+    (1, 1) mesh restores onto an (8, 1) layout (and vice versa) by
+    re-laying every leaf onto the template's sharding at load time.
+    Shared by ``--resume``, ``--auto-resume`` and the guard's rewind path.
+
+    msgpack restore yields HOST numpy leaves; the compiled train step
+    DONATES its state, and jax's CPU backend zero-copies suitably-aligned
+    host buffers into jax arrays — donating such an alias frees memory
+    numpy still owns, a use-after-free that surfaced as a native
+    SIGSEGV/SIGABRT on the first resumed steps of a tp run.  Every
+    restored host leaf is therefore copied into a device-OWNED array
+    (re-applying the template's sharding where it had one).
+    """
+    from jax.sharding import NamedSharding
+
+    from ..parallel.sharding import own_and_place
+
+    shard_tree = jax.tree.map(
+        lambda x: x.sharding if isinstance(x, jax.Array)
+        and isinstance(x.sharding, NamedSharding) else None,
+        target_state)
+    restored, meta = restore_train_state(path, target_state,
+                                         load_opt=load_opt)
+    # own_and_place carries the whole ownership discipline: restored host
+    # numpy leaves become JAX-OWNED copies (never zero-copy aliases the
+    # donating step could free — the PR 2 SIGSEGV class) laid onto the
+    # template's sharding, cross-host via per-shard assembly
+    return jax.tree.map(own_and_place, restored, shard_tree), meta
 
 
 class CheckpointSaver:

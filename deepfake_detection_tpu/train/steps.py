@@ -1,4 +1,4 @@
-"""Jitted train / eval steps.
+"""Jitted train / eval steps — ONE GSPMD program from 1 chip to a pod.
 
 The reference's per-batch hot loop (``/root/reference/dfd/runners/train.py:
 594-700``: forward → loss → accuracy → metric allreduce → backward with DDP
@@ -8,28 +8,38 @@ host sync (the runner only blocks on the scalars it logs) and no separate
 allreduce launches — gradient reduction is part of the compiled program
 riding ICI.
 
+Since ISSUE 12 the step is a plain ``jax.jit`` with ``NamedSharding``
+annotations over the unified ``('batch', 'model')`` mesh
+(parallel/mesh.py:make_train_mesh) — the shard_map-era dispatch is gone.
+``in_shardings``/``out_shardings`` come from the sharding-rule table
+(parallel/sharding.py:train_state_shardings) when the caller provides it;
+``donate_argnums=(0,)`` keeps the state update in-place on device.  The
+same program lowers for an abstract v5e-256 topology exactly as it does
+for one chip (tools/bench_multichip.py, tests/test_mesh_aot.py).
+
 Two BN strategies (SURVEY.md §7 hard part #2):
 
-* ``bn_mode='global'`` — plain ``jit`` over the data-sharded batch.  BN
-  statistics are computed over the *global* batch (XLA inserts the per-layer
-  reductions): semantically apex SyncBN (train.py:388-400), always on.
-* ``bn_mode='local'`` (default, matches the reference default) — the step is
-  a ``shard_map`` over the data axis: BN normalizes with the *local* shard's
-  statistics (no per-layer collectives in the forward — faster), gradients
-  and metrics are ``lax.pmean``-ed once, and the BN running stats are
-  pmean-ed once per step, keeping the state replicated.  The per-step stat
-  pmean is the reference's ``--dist-bn reduce`` (utils.py:263-274) applied
-  continuously instead of per-epoch — required because pjit state is
-  logically one copy.
+* ``bn_mode='global'`` — BN statistics are computed over the *global*
+  batch (XLA inserts the per-layer reductions): semantically apex SyncBN
+  (train.py:388-400), always on.
+* ``bn_mode='local'`` (default, matches the reference default) — BN
+  normalizes each contiguous batch group (one per data-parallel mesh
+  slot) with that group's *own* statistics.  This used to be a bespoke
+  ``shard_map`` body; it is now a ``with_sharding_constraint`` over the
+  batch axis inside the BN layer itself (ops/norm.py:local_stats_scope),
+  so there are still no per-layer collectives in the forward — XLA keeps
+  every group's statistics local to its mesh slot — and the running stats
+  are updated with the group mean (what the old per-device update + one
+  ``lax.pmean`` produced).
 
 Both modes produce bit-identical optimizer updates given the same gradients;
-they differ only in BN normalization statistics (per-shard vs global).
+they differ only in BN normalization statistics (per-group vs global).
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Dict, Optional, Tuple
+import contextlib
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +49,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..losses import cross_entropy
 from ..utils.ema import update_ema
-from ..utils.metrics import accuracy, masked_mean
+from ..utils.metrics import accuracy
 from .state import TrainState
 
 __all__ = ["make_train_step", "make_eval_step"]
@@ -55,17 +65,27 @@ def _clip_grads(grads, clip_grad: Optional[float]):
 
 def make_train_step(model, tx: optax.GradientTransformation,
                     loss_fn: Callable = cross_entropy,
-                    mesh: Optional[Mesh] = None, axis: str = "data",
+                    mesh: Optional[Mesh] = None, axis: Optional[str] = None,
                     bn_mode: str = "local", ema_decay: float = 0.0,
                     clip_grad: Optional[float] = None,
                     grad_accum: int = 1,
                     donate: bool = True,
-                    nonfinite_guard: bool = False) -> Callable:
+                    nonfinite_guard: bool = False,
+                    state_shardings: Optional[Any] = None) -> Callable:
     """Build ``train_step(state, x, y, rng) -> (state, metrics)``.
 
     ``x`` is the (globally) batch-sharded NHWC input, ``y`` int labels or
     soft targets.  ``metrics`` = {'loss', 'prec1'} global-batch scalars
     (replaces the per-step ``reduce_tensor`` calls, train.py:625-627).
+
+    ``mesh`` + ``axis`` (default: the mesh's own data axis) select the
+    unified GSPMD path: the batch is constrained to ``P(axis)``, local-BN
+    statistics group over the mesh's batch extent, and — when
+    ``state_shardings`` (the parallel/sharding.py rule table) is given —
+    the jit carries explicit ``in_shardings``/``out_shardings`` so the
+    compiled executable's I/O layout is pinned, CI-assertable and
+    donation-aliased.  Callers passing ``state_shardings`` must place the
+    state accordingly first (``place_train_state``).
 
     ``grad_accum > 1`` splits the batch into that many microbatches inside
     the compiled step (a ``lax.scan``): gradients are averaged across
@@ -99,14 +119,14 @@ def make_train_step(model, tx: optax.GradientTransformation,
         prec1 = accuracy(logits, y)
         return loss, grads, new_stats, prec1
 
-    def forward_backward(params, batch_stats, x, y, rng, vary_axis=None):
+    def forward_backward(params, batch_stats, x, y, rng):
         if grad_accum == 1:
             return forward_backward_one(params, batch_stats, x, y, rng)
         b = x.shape[0]
         assert b % grad_accum == 0, (b, grad_accum)
         # strided split (row j of microbatch i = global row j*A + i): under
         # a data-sharded batch each device keeps 1/A of ITS OWN rows per
-        # microbatch, so the jit/TP path needs no per-iteration reshuffle
+        # microbatch, so no per-iteration cross-device reshuffle is needed
         # (a contiguous split would put microbatch 0 on the first dp/A
         # devices only); gradient averaging is partition-invariant
         xm = jnp.moveaxis(
@@ -124,16 +144,8 @@ def make_train_step(model, tx: optax.GradientTransformation,
 
         g0 = jax.tree.map(jnp.zeros_like, params)
         z = jnp.zeros((), jnp.float32)
-        carry0 = (batch_stats, g0, z, z)
-        if vary_axis is not None:
-            # inside shard_map the microbatch outputs are device-varying;
-            # the scan carry type must match from step 0 (a no-op on
-            # pre-0.6 jax, which has no varying-manual-axes type system)
-            from ..parallel._compat import pcast_varying
-            carry0 = jax.tree.map(
-                lambda v: pcast_varying(v, vary_axis), carry0)
         (new_stats, gsum, lsum, psum_), _ = jax.lax.scan(
-            micro, carry0, (xm, ym, jnp.arange(grad_accum)))
+            micro, (batch_stats, g0, z, z), (xm, ym, jnp.arange(grad_accum)))
         inv = 1.0 / grad_accum
         grads = jax.tree.map(lambda g: g * inv, gsum)
         return lsum * inv, grads, new_stats, psum_ * inv
@@ -165,44 +177,48 @@ def make_train_step(model, tx: optax.GradientTransformation,
             metrics["gnorm"] = gnorm
         return new_state, metrics
 
-    if bn_mode == "global" or mesh is None:
+    if mesh is None:
         def step(state: TrainState, x, y, rng):
             loss, grads, new_stats, prec1 = forward_backward(
                 state.params, state.batch_stats, x, y, rng)
             return apply_updates(state, grads, new_stats, loss, prec1)
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 
-    # ---- local-BN shard_map over the data axis -------------------------
-    from ..parallel import _compat
-    from ..parallel._compat import shard_map
+    # ---- unified GSPMD path: plain jit over the mesh -------------------
+    from ..parallel.mesh import data_axis_name
+    axis = axis or data_axis_name(mesh)
+    dp = int(mesh.shape[axis])
+    batch_sh = NamedSharding(mesh, P(axis))
+    if bn_mode == "local" and dp > 1:
+        from ..ops.norm import local_stats_scope
 
-    def local_step(state: TrainState, x, y, rng):
-        rng = jax.random.fold_in(rng, lax.axis_index(axis))
-        loss, grads, new_stats, prec1 = forward_backward(
-            state.params, state.batch_stats, x, y, rng, vary_axis=axis)
-        # one fused cross-replica mean for grads + stats + metrics
-        loss, grads, new_stats, prec1 = lax.pmean(
-            (loss, grads, new_stats, prec1), axis)
+        def bn_scope():
+            return local_stats_scope(dp, batch_sh)
+    else:
+        bn_scope = contextlib.nullcontext
+
+    def step(state: TrainState, x, y, rng):
+        # pin the batch to the batch axis: with inferred in_shardings this
+        # is what keeps GSPMD from gathering the batch onto one device; the
+        # BN grouping constraint inside the scope does the rest of the
+        # local-stats layout
+        x = lax.with_sharding_constraint(x, batch_sh)
+        y = lax.with_sharding_constraint(y, batch_sh)
+        with bn_scope():        # entered at TRACE time (ops/norm.py)
+            loss, grads, new_stats, prec1 = forward_backward(
+                state.params, state.batch_stats, x, y, rng)
         return apply_updates(state, grads, new_stats, loss, prec1)
 
-    # The fused depthwise path embeds pallas_call in the step: the legacy
-    # check_rep machinery has no replication rule for that primitive AT ALL,
-    # and off-TPU the Pallas *interpreter* mixes its non-varying block
-    # counters with varying refs, which even the modern vma checker rejects
-    # (same reason ring_flash disables it, parallel/ring_attention.py).  On
-    # compiled Mosaic under a check_vma jax the vma-typed out_shapes keep
-    # the check satisfied, so it stays on there.
-    check = True
-    if getattr(model, "fused_depthwise", "off") == "pallas":
-        legacy = "check_rep" in _compat.shard_map_check_kwargs(True)
-        check = not legacy and jax.default_backend() == "tpu"
-    data_spec = P(axis)
-    sharded = shard_map(
-        local_step, mesh=mesh,
-        in_specs=(P(), data_spec, data_spec, P()),
-        out_specs=(P(), P()),
-        **_compat.shard_map_check_kwargs(check))
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    jit_kwargs: Dict[str, Any] = {}
+    if state_shardings is not None:
+        rep = NamedSharding(mesh, P())
+        jit_kwargs["in_shardings"] = (state_shardings, batch_sh, batch_sh,
+                                      rep)
+        # metrics is a dict of global scalars — a single replicated
+        # sharding is a valid prefix pytree for it
+        jit_kwargs["out_shardings"] = (state_shardings, rep)
+    return jax.jit(step, donate_argnums=(0,) if donate else (),
+                   **jit_kwargs)
 
 
 def make_eval_step(model, loss_fn: Callable = cross_entropy,

@@ -172,7 +172,12 @@ def train_one_epoch(epoch: int, train_step: Callable, state: TrainState,
             # device-side skip and, in a burst, the rewind path
             _logger.warning("chaos: poisoning batch at update %d",
                             num_updates)
-            x = jnp.full_like(x, np.nan)
+            # keep the poisoned batch on the ORIGINAL sharding: the jitted
+            # step pins its in_shardings, and an eager full_like lands
+            # wherever XLA likes
+            x = jax.device_put(jnp.full_like(x, np.nan),
+                               getattr(x, "sharding", None)) \
+                if hasattr(x, "sharding") else jnp.full_like(x, np.nan)
 
         step_rng = jax.random.fold_in(rng, num_updates)
         if first_step and step_exec is None:

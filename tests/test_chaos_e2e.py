@@ -154,6 +154,38 @@ def test_nanbatch_burst_skips_then_rewinds(tmp_path, uninterrupted):
         "skip+rewind diverged from the uninterrupted run")
 
 
+@pytest.mark.slow   # tier-1 budget: two subprocess CLI runs (~50s); the
+# thread-transport variant above keeps the resume path in the fast tier
+def test_sigterm_resume_bit_identical_on_shm_transport(tmp_path,
+                                                       uninterrupted):
+    """ISSUE 12 satellite: SIGTERM-kill → --auto-resume bit-continuity
+    holds under the unified mesh step on the SHM loader transport too.
+
+    The oracle is the shared THREAD-transport reference run: shm batches
+    are bit-identical to thread batches by construction (PR 1, pinned in
+    test_shm_loader), so a bit-identical resume on shm must also land
+    exactly on the thread run's final params — this doubles as a
+    cross-transport check of that invariant under the mesh step."""
+    out = tmp_path / "out"
+    args = _BASE + ["--experiment", "run", "--output", str(out),
+                    "--auto-resume", "--loader-backend", "shm"]
+    r = _launch(args, chaos="sigterm@11")
+    assert r.returncode == EXIT_PREEMPTED, \
+        f"rc={r.returncode}\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+    run_dir = out / "run"
+    assert (run_dir / "recovery-1-2.ckpt").exists(), \
+        os.listdir(str(run_dir))
+
+    r2 = _launch(args)                        # fault cleared: relaunch
+    assert r2.returncode == 0, \
+        f"rc={r2.returncode}\n{r2.stdout[-2000:]}\n{r2.stderr[-2000:]}"
+    assert "Auto-resumed" in r2.stderr or "Auto-resumed" in r2.stdout
+    _assert_states_identical(
+        _state_of(uninterrupted), _state_of(run_dir / "checkpoint-1.ckpt"),
+        "shm-transport preempt+auto-resume diverged from the "
+        "uninterrupted thread-transport run")
+
+
 @pytest.mark.slow   # tier-1 budget: subprocess CLI run (~25s);
 # the sigterm + nanbatch tests keep the core recovery paths fast
 def test_loader_stall_trips_watchdog(tmp_path):

@@ -542,19 +542,21 @@ def test_loader_prologue_s2d(tmp_path):
                                   np.asarray(s2d_op(jnp.asarray(plain))))
 
 
-def test_fused_step_under_local_bn_shard_map():
-    """The runner's DEFAULT multi-device path wraps the train step in a
-    local-BN shard_map — where pallas_call historically tripped the
-    replication checker (legacy check_rep has no rule for the primitive;
-    the interpreter trips even check_vma).  Route one fused step through
-    that exact wrapper and hold it to the stock step's numbers."""
-    from deepfake_detection_tpu.parallel import batch_sharding, make_mesh
+def test_fused_step_under_local_bn_mesh():
+    """The runner's DEFAULT multi-device path is the unified GSPMD jit
+    with local-BN stat grouping (ISSUE 12; it was a shard_map wrapper
+    before — where pallas_call historically tripped the replication
+    checker).  Route one fused step through that exact path on the
+    8-device unified mesh and hold it to the stock step's numbers —
+    pinning that interpret-mode pallas_call partitions under GSPMD."""
+    from deepfake_detection_tpu.parallel import batch_sharding, \
+        make_train_mesh
     from deepfake_detection_tpu.train import (create_train_state,
                                               make_train_step)
     from deepfake_detection_tpu.losses import cross_entropy
     import optax
 
-    mesh = make_mesh()
+    mesh = make_train_mesh()
     x = jax.device_put(
         np.random.default_rng(3).uniform(-2, 2, (8, 32, 32, 3))
         .astype(np.float32), batch_sharding(mesh))

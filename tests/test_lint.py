@@ -520,6 +520,103 @@ class TestCtypesAbi:
 
 
 # ---------------------------------------------------------------------------
+# DFD010 sharding hygiene
+# ---------------------------------------------------------------------------
+
+class TestShardingHygiene:
+    RULE = R.ShardingHygiene()
+
+    def test_bare_shard_map_and_pmap_fire(self, tmp_path):
+        res = lint_one(tmp_path, {"t.py": """\
+            import jax
+            from jax.experimental.shard_map import shard_map
+            def f(body, mesh, specs):
+                g = shard_map(body, mesh=mesh, in_specs=specs,
+                              out_specs=specs)
+                h = jax.pmap(body)
+                return g, h
+        """}, self.RULE)
+        assert [v.rule for v in res.violations] == ["DFD010", "DFD010"]
+
+    def test_bare_decorator_form_fires(self, tmp_path):
+        """@jax.pmap with no arguments is an Attribute in decorator_list,
+        not a Call — the rule must still see it."""
+        res = lint_one(tmp_path, {"t.py": """\
+            import jax
+            @jax.pmap
+            def step(x):
+                return x + 1
+        """}, self.RULE)
+        assert [v.rule for v in res.violations] == ["DFD010"]
+
+    def test_partial_argument_form_fires(self, tmp_path):
+        """functools.partial(jax.pmap, ...) passes pmap as a Call ARGUMENT
+        — reference-level matching must catch it (and a direct call must
+        yield exactly one violation, not Name+Call double-counted)."""
+        res = lint_one(tmp_path, {"t.py": """\
+            import functools
+            import jax
+            def f(fn):
+                return functools.partial(jax.pmap, axis_name="batch")(fn)
+        """}, self.RULE)
+        assert [v.rule for v in res.violations] == ["DFD010"]
+
+    def test_allowlisted_file_and_jit_path_pass(self, tmp_path):
+        cfg = LintConfig(shard_map_allowlist=("ring.py",))
+        res = lint_one(tmp_path, {
+            "ring.py": """\
+                from jax.experimental.shard_map import shard_map
+                def ring(body, mesh, specs):
+                    return shard_map(body, mesh=mesh, in_specs=specs,
+                                     out_specs=specs)
+            """,
+            "unified.py": """\
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                def step(fn, mesh, shardings):
+                    return jax.jit(fn, in_shardings=shardings,
+                                   out_shardings=shardings,
+                                   donate_argnums=(0,))
+            """,
+        }, self.RULE, cfg)
+        assert res.violations == []
+
+    def test_allowlist_rot_fires(self, tmp_path):
+        """An allowlist entry whose file no longer shard_maps is rot: the
+        frozen debt was paid, so the manifest line must go."""
+        cfg = LintConfig(shard_map_allowlist=("clean.py",))
+        res = lint_one(tmp_path, {"clean.py": """\
+            import jax
+            def f(fn):
+                return jax.jit(fn)
+        """}, self.RULE, cfg)
+        assert [v.rule for v in res.violations] == ["DFD010"]
+        assert "rot" in res.violations[0].message
+
+    def test_allowlist_rot_skips_unindexed_files(self, tmp_path):
+        """A subset run (`dfdlint some/dir`) must not call entries rotten
+        for files it never looked at."""
+        cfg = LintConfig(shard_map_allowlist=("elsewhere/ring.py",))
+        res = lint_one(tmp_path, {"clean.py": """\
+            import jax
+            def f(fn):
+                return jax.jit(fn)
+        """}, self.RULE, cfg)
+        assert res.violations == []
+
+    def test_unrelated_names_do_not_fire(self, tmp_path):
+        """shard_map_check_kwargs / pmean etc. share substrings with the
+        banned callees but are not manual-SPMD dispatch."""
+        res = lint_one(tmp_path, {"t.py": """\
+            from compat import shard_map_check_kwargs
+            def f(x, pmean):
+                kw = shard_map_check_kwargs(True)
+                return pmean(x), kw
+        """}, self.RULE)
+        assert res.violations == []
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline semantics
 # ---------------------------------------------------------------------------
 
@@ -695,6 +792,9 @@ class TestGate:
             "sp.py": "import subprocess\nsubprocess.run(['x'])\n",
             "ct.py": ("import ctypes\nl = ctypes.CDLL('x.so')\n"
                       "l.dfd_y.argtypes = []\n"),
+            "sm.py": ("from jax.experimental.shard_map import shard_map\n"
+                      "def f(b, m):\n"
+                      "    return shard_map(b, mesh=m)\n"),
         }
         cfg = LintConfig(jax_free_modules=("pkg.a",),
                          rng_dirs=("pkg",),
@@ -703,7 +803,7 @@ class TestGate:
         index = make_index(tmp_path, bad)
         res = run_lint(index, cfg)
         fired = {v.rule for v in res.violations}
-        expected = {f"DFD00{i}" for i in range(1, 10)}
+        expected = {f"DFD00{i}" for i in range(1, 10)} | {"DFD010"}
         assert expected <= fired, f"dead rules: {expected - fired}"
 
     def test_filtered_baseline_update_preserves_other_rules(self, tmp_path):

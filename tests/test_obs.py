@@ -641,7 +641,8 @@ class TestObsReport:
     def test_summarizes_run_dir(self, tmp_path):
         from deepfake_detection_tpu.obs import EventLog
         with EventLog(str(tmp_path / "telemetry.jsonl")) as log:
-            log.event("run_start", model="m")
+            log.event("run_start", model="m", mesh_shape=[8, 1],
+                      axis_names=["batch", "model"])
             for u in range(1, 4):
                 log.metrics(epoch=0, batch=u - 1, update=u,
                             imgs_per_s=100.0 + u, step_ms=10.0,
@@ -661,6 +662,8 @@ class TestObsReport:
         assert "| 0 |" in out.stdout          # the epoch row
         assert "rewind" in out.stdout         # resilience event surfaced
         assert "recovery_snapshots_total = 1" in out.stdout
+        # the mesh line (ISSUE 12 satellite): topology from run_start
+        assert "mesh: batch=8 × model=1 (8 devices)" in out.stdout
         tail = subprocess.run(
             [sys.executable, os.path.join(_REPO, "tools", "obs_report.py"),
              str(tmp_path), "--tail", "2"],
@@ -793,6 +796,10 @@ class TestJsonlAcrossAutoResume:
         assert events.count("run_start") == 2      # launch + relaunch
         assert "preempted" in events
         assert "resume" in events
+        # run_start records the mesh topology (ISSUE 12 satellite)
+        start = next(r for r in recs if r.get("event") == "run_start")
+        assert start["mesh_shape"] == [1, 1]       # 1 virtual device
+        assert start["axis_names"] == ["batch", "model"]
         assert events[-1] == "run_end"
         # the resume event points at the recovery snapshot's position
         resume = next(r for r in recs if r.get("event") == "resume")

@@ -32,6 +32,109 @@ class TestMesh:
             make_mesh((3, 2), ("data", "model"))
 
 
+class TestTrainMesh:
+    def test_unified_axes_and_inference(self, devices):
+        from deepfake_detection_tpu.parallel import (data_axis_name,
+                                                     make_train_mesh)
+        mesh = make_train_mesh()
+        assert mesh.axis_names == ("batch", "model")
+        assert mesh.shape["batch"] == 8 and mesh.shape["model"] == 1
+        assert data_axis_name(mesh) == "batch"
+        mesh2 = make_train_mesh(batch=-1, model=2)
+        assert mesh2.shape["batch"] == 4 and mesh2.shape["model"] == 2
+
+    def test_data_axis_name_legacy_and_fallback(self, devices):
+        from deepfake_detection_tpu.parallel import data_axis_name
+        assert data_axis_name(make_mesh()) == "data"
+        assert data_axis_name(make_mesh((8,), ("replica",))) == "replica"
+
+    def test_batch_sharding_resolves_mesh_axis(self, devices):
+        from deepfake_detection_tpu.parallel import make_train_mesh
+        sh = batch_sharding(make_train_mesh())
+        assert sh.spec == P("batch")
+        assert batch_sharding(make_mesh()).spec == P("data")
+
+
+class TestTrainStateShardingTable:
+    """The ISSUE 12 sharding-rule table: every TrainState leaf gets its
+    NamedSharding, opt moments and EMA follow their params."""
+
+    def _state(self, with_ema=False):
+        from types import SimpleNamespace
+        from deepfake_detection_tpu.models import create_model, init_model
+        from deepfake_detection_tpu.optim import create_optimizer
+        from deepfake_detection_tpu.train import create_train_state
+        m = create_model("mnasnet_small", num_classes=2, in_chans=3)
+        v = init_model(m, jax.random.PRNGKey(0), (2, 32, 32, 3),
+                       training=True)
+        tx = create_optimizer(SimpleNamespace(
+            opt="rmsproptf", opt_eps=1e-3, momentum=0.9, weight_decay=0.0,
+            lr=1e-3, decay_rate=0.9), inject=True)
+        return create_train_state(v, tx, with_ema=with_ema)
+
+    def test_default_rules_congruent_and_replicated(self, devices):
+        from deepfake_detection_tpu.parallel import (make_train_mesh,
+                                                     train_state_shardings)
+        state = self._state(with_ema=True)
+        mesh = make_train_mesh()
+        sh = train_state_shardings(state, mesh)
+        # congruent tree: one NamedSharding per leaf
+        flat_s, tree_s = jax.tree.flatten(sh)
+        flat_x, tree_x = jax.tree.flatten(state)
+        assert tree_s == tree_x
+        assert all(isinstance(s, NamedSharding) for s in flat_s)
+        # pure DP: everything replicated
+        assert all(s.spec == P() for s in flat_s)
+
+    def test_fsdp_rule_propagates_to_moments_and_ema(self, devices):
+        from deepfake_detection_tpu.parallel import (make_train_mesh,
+                                                     train_state_shardings)
+        state = self._state(with_ema=True)
+        mesh = make_train_mesh()
+        sh = train_state_shardings(state, mesh, fsdp=True)
+        sharded_params = [s for s in jax.tree.leaves(sh.params)
+                         if s.spec != P()]
+        assert sharded_params, "no param leaf was FSDP-sharded"
+        # the opt-state moments mirror the params tree → same specs
+        p_specs = [s.spec for s in jax.tree.leaves(sh.params)]
+        opt_named = [s.spec for s in jax.tree.leaves(sh.opt_state)
+                     if s.spec != P()]
+        assert opt_named, "no moment leaf followed its param's sharding"
+        assert set(map(str, opt_named)) <= set(map(str, p_specs))
+        ema_specs = [s.spec for s in jax.tree.leaves(sh.ema["params"])]
+        assert ema_specs == p_specs
+        # BN stats + step stay replicated regardless
+        assert all(s.spec == P()
+                   for s in jax.tree.leaves(sh.batch_stats))
+        assert sh.step.spec == P()
+
+    def test_existing_tp_placement_wins(self, devices):
+        from deepfake_detection_tpu.parallel import (make_train_mesh,
+                                                     train_state_shardings)
+        from deepfake_detection_tpu.train.state import TrainState
+        mesh = make_train_mesh(batch=-1, model=2)
+        tp_sh = NamedSharding(mesh, P(None, "model"))
+        params = {"w": jax.device_put(jnp.zeros((4, 8)), tp_sh),
+                  "b": jnp.zeros((8,))}
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           batch_stats={}, opt_state=(), ema=None)
+        sh = train_state_shardings(state, mesh)
+        assert sh.params["w"].spec == P(None, "model")
+        assert sh.params["b"].spec == P()
+
+    def test_place_train_state_lays_out(self, devices):
+        from deepfake_detection_tpu.parallel import (make_train_mesh,
+                                                     place_train_state,
+                                                     train_state_shardings)
+        state = self._state()
+        mesh = make_train_mesh()
+        sh = train_state_shardings(state, mesh, fsdp=True)
+        placed = place_train_state(state, sh)
+        for leaf, want in zip(jax.tree.leaves(placed),
+                              jax.tree.leaves(sh)):
+            assert leaf.sharding == want, (leaf.sharding, want)
+
+
 class TestSharding:
     def test_batch_sharding_distributes_rows(self, devices):
         mesh = make_mesh()

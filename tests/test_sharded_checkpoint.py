@@ -263,3 +263,86 @@ class TestShardedCheckpoint:
         os.remove(os.path.join(path, "dfd_meta.json"))
         with pytest.raises(FileNotFoundError, match="interrupted"):
             restore_sharded_checkpoint(path, state)
+
+
+class TestMsgpackMeshContinuity:
+    """ISSUE 12 satellite: the msgpack checkpoint format is mesh-portable.
+
+    ``restore_resharded`` re-lays host arrays onto the TEMPLATE's
+    sharding-table annotations, so a checkpoint written on a (1,1) mesh
+    restores onto an (8,1) layout — including FSDP resharding — and vice
+    versa, with values bit-identical either way.  The PR 3 resume ladder
+    routes through this exact function (runners/train.py::_restore_any).
+    """
+
+    def _unified_state(self, devices, n_batch, fsdp=False):
+        from types import SimpleNamespace
+        from deepfake_detection_tpu.models import create_model, init_model
+        from deepfake_detection_tpu.optim import create_optimizer
+        from deepfake_detection_tpu.parallel import (make_train_mesh,
+                                                     place_train_state,
+                                                     train_state_shardings)
+        model = create_model("mnasnet_small", num_classes=2, in_chans=3)
+        variables = init_model(model, jax.random.PRNGKey(0),
+                               (2, 32, 32, 3), training=True)
+        tx = create_optimizer(SimpleNamespace(
+            opt="sgd", opt_eps=1e-8, momentum=0.9, weight_decay=0.0,
+            lr=0.01), inject=True)
+        state = create_train_state(variables, tx, donate=False)
+        mesh = make_train_mesh(batch=n_batch, model=1,
+                               devices=devices[:n_batch])
+        sh = train_state_shardings(state, mesh, fsdp=fsdp)
+        return place_train_state(state, sh), sh
+
+    def test_one_chip_checkpoint_restores_onto_eight_way_mesh(
+            self, tmp_path, devices):
+        from jax.sharding import PartitionSpec as P
+        from deepfake_detection_tpu.train import (restore_resharded,
+                                                  save_checkpoint_file)
+        small, _ = self._unified_state(devices, 1)
+        path = str(tmp_path / "one_chip.ckpt")
+        save_checkpoint_file(path, small, {"epoch": 4})
+        template, sh = self._unified_state(devices, 8, fsdp=True)
+        restored, meta = restore_resharded(path, template)
+        assert meta["epoch"] == 4
+        resharded = 0
+        for got, want, orig in zip(jax.tree.leaves(restored),
+                                   jax.tree.leaves(sh),
+                                   jax.tree.leaves(small)):
+            assert got.sharding == want
+            if want.spec != P():
+                resharded += 1
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(orig))
+        assert resharded > 0, "template had no FSDP-sharded leaf"
+
+    def test_eight_way_checkpoint_restores_onto_one_chip(
+            self, tmp_path, devices):
+        from deepfake_detection_tpu.train import (restore_resharded,
+                                                  save_checkpoint_file)
+        big, _ = self._unified_state(devices, 8, fsdp=True)
+        path = str(tmp_path / "pod.ckpt")
+        save_checkpoint_file(path, big, {"epoch": 7})
+        template, sh = self._unified_state(devices, 1)
+        restored, meta = restore_resharded(path, template)
+        assert meta["epoch"] == 7
+        for got, want, orig in zip(jax.tree.leaves(restored),
+                                   jax.tree.leaves(sh),
+                                   jax.tree.leaves(big)):
+            assert got.sharding == want
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(orig))
+
+    def test_restored_leaves_own_their_bytes(self, tmp_path, devices):
+        """The DFD002 donation-aliasing discipline survives the move into
+        train/checkpoint.py: no restored leaf may be a zero-copy view of
+        host memory (donating such an alias is the PR 2 SIGSEGV class)."""
+        from deepfake_detection_tpu.train import (restore_resharded,
+                                                  save_checkpoint_file)
+        state, _ = self._unified_state(devices, 8)
+        path = str(tmp_path / "own.ckpt")
+        save_checkpoint_file(path, state, {})
+        template, _ = self._unified_state(devices, 8)
+        restored, _ = restore_resharded(path, template)
+        for leaf in jax.tree.leaves(restored):
+            assert isinstance(leaf, jax.Array), type(leaf)

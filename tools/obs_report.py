@@ -74,7 +74,21 @@ def summarize(path: str) -> None:
     if not metrics and not events:
         raise SystemExit(f"{path}: no records")
     print(f"# {path}: {len(metrics)} metrics records, "
-          f"{len(events)} events\n")
+          f"{len(events)} events")
+    # the mesh line (ISSUE 12): which topology the run compiled for — the
+    # MFU denominator is mesh.size chips, so throughput numbers are only
+    # comparable per mesh shape
+    start = next((e for e in events if e.get("event") == "run_start"), None)
+    if start is not None and start.get("mesh_shape"):
+        shape = start["mesh_shape"]
+        axes = start.get("axis_names") or []
+        n = 1
+        for s in shape:
+            n *= int(s)
+        print("mesh: "
+              + " × ".join(f"{a}={s}" for a, s in zip(axes, shape))
+              + f" ({n} device{'s' if n != 1 else ''})")
+    print()
     if metrics:
         print("| epoch | imgs/s | ms/step | data-wait | device | host | "
               "mfu | loss |")
