@@ -1,0 +1,35 @@
+"""Corpus-scale offline backfill: leased work shards, exact books.
+
+Streaming (PR 7) optimizes latency and serving (PR 2/10) optimizes
+request fan-in; this package is the third workload shape — pure
+throughput over an *archived* corpus.  A sharded work **manifest**
+(clips grouped into fixed-size shards, built by ``tools/make_lists.py
+--manifest`` from the v3 lists or a packed cache) is mapped over by N
+independent worker processes that **lease** shards through atomic
+filesystem operations in a shared run directory, score each shard
+through a deadline-free double-buffered pipeline
+(``runners/backfill.py``), and append schema-versioned
+``dfd.backfill.verdict.v1`` JSONL per shard with a per-shard done
+marker — so a SIGTERM (or a dead host) at any point resumes at shard
+granularity with exact books: ``manifest clips == scored + failed``,
+no clip scored twice, none missing.
+
+Import discipline: this package (manifest/lease/writer/source) is
+jax-free — the chaos harness, ``tools/make_lists.py`` and reporting
+subprocesses import it with no accelerator stack (dfdlint DFD001 pins
+it).  Only ``runners/backfill.py`` touches jax.
+"""
+
+from .lease import LeaseDir
+from .manifest import (BackfillManifestStale, MANIFEST_SCHEMA,
+                       build_manifest_from_lists, build_manifest_from_pack,
+                       load_manifest, manifest_entries, verify_manifest_source)
+from .writer import (VERDICT_SCHEMA, ShardVerdictWriter, collect_books,
+                     read_verdicts)
+
+__all__ = [
+    "BackfillManifestStale", "LeaseDir", "MANIFEST_SCHEMA",
+    "ShardVerdictWriter", "VERDICT_SCHEMA", "build_manifest_from_lists",
+    "build_manifest_from_pack", "collect_books", "load_manifest",
+    "manifest_entries", "read_verdicts", "verify_manifest_source",
+]

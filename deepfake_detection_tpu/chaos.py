@@ -53,6 +53,9 @@ KNOWN_POINTS = frozenset({
     "stall_loader", "kill_shm_worker",
     # serving request path (serving/engine.py, stepped by device-batch seq)
     "serve_exc", "serve_hang", "serve_nan", "serve_kill", "torn_reload",
+    # offline backfill (runners/backfill.py; kill/torn stepped by device-
+    # batch seq, lease_race by lease-acquisition attempt)
+    "backfill_kill", "backfill_lease_race", "backfill_torn_shard",
 })
 
 _SPEC_RE = re.compile(

@@ -621,6 +621,115 @@ class ServeConfig:
 
 
 # ---------------------------------------------------------------------------
+# Backfill config (runners/backfill.py)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BackfillConfig:
+    """Knob surface of the corpus-scale offline backfill runner.
+
+    Same conventions as :class:`TrainConfig`/:class:`ServeConfig`: every
+    field is a ``--dashed-flag``, a YAML ``-c`` file resets defaults, CLI
+    overrides.  There is deliberately no deadline, queue or wire knob —
+    backfill always runs the uint8 wire at ONE fixed batch bucket (the
+    saturation shape), and concurrency comes from launching more worker
+    processes against the same ``--out`` run dir.
+    """
+    # --- work ---
+    manifest: str = ""                   # tools/make_lists.py --manifest
+    out: str = ""                        # shared run dir (leases/, done/,
+    # verdicts/, telemetry JSONL)
+    data_packed: str = ""                # packed cache (zero-decode path)
+    data: str = ""                       # v3 list roots, ':'-separated
+    # (decode path; exactly one of data_packed/data)
+
+    # --- model (mirrors runners/serve.py) ---
+    model: str = "efficientnet_deepfake_v4"
+    model_path: str = ""
+    use_ema: bool = False
+    num_classes: int = 2
+    # raw-tree decode geometry: frames per clip and the canonical square
+    # resample (0 keeps native resolution, which must then be uniform);
+    # a packed source carries both in its index and ignores these
+    frames: int = 4
+    image_size: int = 0
+
+    # --- pipeline ---
+    batch_size: int = 16                 # THE bucket: one AOT compile,
+    # partial shard tails pad up to it
+    workers: int = 0                     # decode/memcpy threads
+    # (0 = cpu count)
+    stem_s2d: bool = False               # fold the s2d pixel shuffle into
+    # the compiled prologue (EfficientNet family; PERF.md §6)
+
+    # --- leasing ---
+    lease_ttl_s: float = 600.0           # a lease not heartbeaten for
+    # this long belonged to a dead host and may be re-leased; must
+    # exceed the worst single-batch wall time
+    worker_name: str = ""                # lease owner + telemetry file
+    # suffix (default: <hostname>-<pid>)
+    max_shards: int = 0                  # stop this worker after N
+    # shards (0 = run to corpus completion; smoke/test hook)
+
+    # ------------------------------------------------------------------
+    def __post_init__(self):
+        # required-field checks live in validate_required(): the two-stage
+        # parse (and YAML overlays) construct an all-defaults instance
+        # before the CLI values land
+        if int(self.batch_size) < 1:
+            raise ValueError(f"--batch-size must be >= 1, got "
+                             f"{self.batch_size}")
+        if int(self.frames) < 1:
+            raise ValueError(f"--frames must be >= 1, got {self.frames}")
+        if float(self.lease_ttl_s) <= 0:
+            raise ValueError(f"--lease-ttl-s must be > 0, got "
+                             f"{self.lease_ttl_s}")
+        if int(self.image_size) < 0 or int(self.max_shards) < 0 or \
+                int(self.workers) < 0:
+            raise ValueError("--image-size / --max-shards / --workers "
+                             "must be >= 0")
+
+    def validate_required(self) -> "BackfillConfig":
+        """The launch-surface checks (run by ``from_args`` and the
+        runner): what work, where, from which source."""
+        if not self.manifest:
+            raise ValueError("--manifest is required (build one with "
+                             "tools/make_lists.py --manifest)")
+        if not self.out:
+            raise ValueError("--out is required (the shared run dir)")
+        if bool(self.data_packed) == bool(self.data):
+            raise ValueError("exactly one of --data-packed / --data "
+                             "must be given (the clip source)")
+        return self
+
+    # ------------------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "BackfillConfig":
+        known = {f_.name for f_ in fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "BackfillConfig":
+        with open(path) as f:
+            d = yaml.safe_load(f) if _HAS_YAML else json.load(f)
+        return cls.from_dict(d or {})
+
+    @classmethod
+    def argument_parser(cls) -> argparse.ArgumentParser:
+        return _dataclass_parser(
+            cls, "corpus-scale offline backfill scoring runner")
+
+    @classmethod
+    def from_args(cls, argv: Optional[Sequence[str]] = None
+                  ) -> "BackfillConfig":
+        return _two_stage_parse(
+            cls, argv, cls.argument_parser()).validate_required()
+
+
+# ---------------------------------------------------------------------------
 # Streaming config (runners/stream.py)
 # ---------------------------------------------------------------------------
 

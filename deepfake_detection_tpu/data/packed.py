@@ -55,8 +55,9 @@ from .transforms import PackedFrames, pil_interp
 
 __all__ = ["PackedDataset", "PackedShardCorrupt", "PackedCacheStale",
            "PACK_INDEX", "PACK_PARTIAL", "canonical_clip_array",
-           "load_index", "pack_fingerprint", "read_source_lists",
-           "verify_pack", "write_pack"]
+           "clip_records", "load_index", "open_shard_array",
+           "pack_fingerprint", "read_source_lists", "verify_pack",
+           "write_pack"]
 
 PACK_INDEX = "index.json"
 PACK_PARTIAL = "index.partial.json"
@@ -215,6 +216,43 @@ def verify_pack(pack_dir: str, checksums: bool = True) -> List[str]:
     return _shard_size_problems(pack_dir, index, checksums=checksums)
 
 
+def clip_records(index: Dict[str, Any]
+                 ) -> Dict[Tuple[str, int, str], Tuple[int, int]]:
+    """``(kind, root_index, name) → (shard_index, slot)`` for every
+    packed sample, in index order — the sample lookup every pack reader
+    shares (:class:`PackedDataset` and the backfill ``PackSource``)."""
+    records: Dict[Tuple[str, int, str], Tuple[int, int]] = {}
+    pos = 0
+    for si, sh in enumerate(index["shards"]):
+        for slot in range(int(sh["num_samples"])):
+            kind, ri, name = index["clips"][pos][:3]
+            records[(kind, int(ri), name)] = (si, slot)
+            pos += 1
+    return records
+
+
+def open_shard_array(pack_dir: str, index: Dict[str, Any],
+                     si: int) -> np.ndarray:
+    """mmap one shard as a ``(n, H, W, 3·frames)`` uint8 view, with the
+    size re-audit at mmap time: a shard truncated AFTER construction-
+    time checks must still fail as a named :class:`PackedShardCorrupt`,
+    never a bare mmap error mid-corpus."""
+    sh = index["shards"][si]
+    n_s = int(sh["num_samples"])
+    want = n_s * _sample_stride(index)
+    path = os.path.join(pack_dir, sh["file"])
+    with open(path, "rb") as f:
+        got = os.fstat(f.fileno()).st_size
+        if got != want:
+            raise PackedShardCorrupt(
+                f"{path}: {got} bytes at mmap time, "
+                f"expected {want} ({n_s} samples)")
+        mm = mmap.mmap(f.fileno(), want, access=mmap.ACCESS_READ)
+    h, w = (int(v) for v in index["sample_hw"])
+    return np.frombuffer(mm, np.uint8, count=want).reshape(
+        (n_s, h, w, 3 * int(index["frames_per_clip"])))
+
+
 # ---------------------------------------------------------------------------
 # Reader
 # ---------------------------------------------------------------------------
@@ -283,13 +321,7 @@ class PackedDataset(DeepFakeClipDataset):
         self._sample_shape = (hw[0], hw[1], 3 * k)
         self._stride = _sample_stride(self.index)
         # sample lookup: (kind, root_index, name) → (shard, slot)
-        self._records: Dict[Tuple[str, int, str], Tuple[int, int]] = {}
-        pos = 0
-        for si, sh in enumerate(self.index["shards"]):
-            for slot in range(int(sh["num_samples"])):
-                kind, ri, name = self.index["clips"][pos][:3]
-                self._records[(kind, int(ri), name)] = (si, slot)
-                pos += 1
+        self._records = clip_records(self.index)
         # shard audit up front: a truncated pack must fail at
         # construction, not yield garbage pixels mid-epoch (checksums
         # cost one sequential read of the pack — opt-in via verify)
@@ -334,20 +366,7 @@ class PackedDataset(DeepFakeClipDataset):
             with self._open_lock:
                 arr = self._mmaps.get(si)
                 if arr is None:
-                    sh = self.index["shards"][si]
-                    path = os.path.join(self.pack_dir, sh["file"])
-                    n_s = int(sh["num_samples"])
-                    want = n_s * self._stride
-                    with open(path, "rb") as f:
-                        got = os.fstat(f.fileno()).st_size
-                        if got != want:
-                            raise PackedShardCorrupt(
-                                f"{path}: {got} bytes at mmap time, "
-                                f"expected {want} ({n_s} samples)")
-                        mm = mmap.mmap(f.fileno(), want,
-                                       access=mmap.ACCESS_READ)
-                    arr = np.frombuffer(mm, np.uint8, count=want).reshape(
-                        (n_s,) + self._sample_shape)
+                    arr = open_shard_array(self.pack_dir, self.index, si)
                     self._mmaps[si] = arr
         return arr
 

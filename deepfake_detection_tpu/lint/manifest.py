@@ -52,6 +52,14 @@ JAX_FREE_MODULES = (
     "deepfake_detection_tpu.streaming.tracker",
     "deepfake_detection_tpu.streaming.verdict",
     "deepfake_detection_tpu.lint",          # the linter itself
+    # backfill worker-side modules: the chaos harness, make_lists
+    # manifest emission and book audits run with no accelerator stack
+    # (only runners/backfill.py touches jax)
+    "deepfake_detection_tpu.backfill",
+    "deepfake_detection_tpu.backfill.manifest",
+    "deepfake_detection_tpu.backfill.lease",
+    "deepfake_detection_tpu.backfill.writer",
+    "deepfake_detection_tpu.backfill.source",
     "tools.pack_dataset",
     "tools.obs_report",
     "tools.make_lists",

@@ -32,6 +32,15 @@ Examples::
     # tear the newest checkpoint in half (manual corruption for testing
     # the CheckpointCorrupt fallback ladder)
     python tools/chaos.py truncate path/to/recovery-0-5.ckpt
+
+    # backfill: kill a worker mid-corpus (exit 75), relaunch to
+    # completion, then prove exact books AND that the concatenated
+    # verdict JSONL is identical (order-normalized) to an unkilled
+    # reference run's
+    python tools/chaos.py backfill --fault backfill_kill@2 -- \
+        python -m deepfake_detection_tpu.runners.backfill \
+        --manifest m.json --data-packed pack/ --out run/ \
+        --model vit_tiny_patch16_224 --batch-size 4 --lease-ttl-s 2
 """
 
 from __future__ import annotations
@@ -99,8 +108,134 @@ def run_scenario(fault: str, cmd: list, expect: int,
     return 1
 
 
+def _cmd_flag(cmd: list, flag: str) -> str:
+    """Value of ``--flag x`` / ``--flag=x`` inside a command line."""
+    for i, a in enumerate(cmd):
+        if a == flag and i + 1 < len(cmd):
+            return cmd[i + 1]
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return ""
+
+
+def _normalized_verdicts(run_dir: str, manifest: dict) -> list:
+    """Every verdict record of a run, order-normalized — the identity
+    the backfill acceptance criterion compares across kill scenarios."""
+    import json as _json
+
+    from deepfake_detection_tpu.backfill import read_verdicts
+    from deepfake_detection_tpu.backfill.writer import verdict_path
+    recs = []
+    for s in manifest["shards"]:
+        recs += read_verdicts(verdict_path(run_dir, s["id"]))
+    return sorted(_json.dumps(r, sort_keys=True) for r in recs)
+
+
+def run_backfill_scenario(fault: str, cmd: list, expect: int,
+                          max_restarts: int, timeout: float) -> int:
+    """Injected-death backfill drive: kill → relaunch → exact books +
+    bit-identical (order-normalized) verdicts vs an unkilled run."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from deepfake_detection_tpu.backfill import (collect_books,
+                                                 load_manifest)
+    manifest_path = _cmd_flag(cmd, "--manifest")
+    out_dir = _cmd_flag(cmd, "--out")
+    if not manifest_path or not out_dir:
+        print("[chaos] FAIL: backfill command must carry --manifest "
+              "and --out")
+        return 1
+    manifest = load_manifest(manifest_path)
+
+    env = dict(os.environ, DFD_CHAOS=fault)
+    print(f"[chaos] backfill launch 0: DFD_CHAOS={fault!r}", flush=True)
+    rc = subprocess.run(cmd, env=env, timeout=timeout).returncode
+    print(f"[chaos] launch 0 exited {rc} (expected {expect})", flush=True)
+    if rc != expect:
+        print(f"[chaos] FAIL: expected exit {expect}, got {rc}")
+        return 1
+    env = {k: v for k, v in os.environ.items() if k != "DFD_CHAOS"}
+    for attempt in range(1, max_restarts + 1):
+        print(f"[chaos] relaunch {attempt}/{max_restarts}", flush=True)
+        rc = subprocess.run(cmd, env=env, timeout=timeout).returncode
+        print(f"[chaos] relaunch {attempt} exited {rc}", flush=True)
+        if rc == 0:
+            break
+        if rc != EXIT_PREEMPTED:
+            print(f"[chaos] FAIL: relaunch died with exit {rc}")
+            return 1
+    else:
+        print(f"[chaos] FAIL: restart budget ({max_restarts}) exhausted")
+        return 1
+    books = collect_books(out_dir, manifest)
+    if not books["balanced"]:
+        print(f"[chaos] FAIL: books do not balance after recovery: "
+              f"{books}")
+        return 1
+    print(f"[chaos] books balanced: {books['manifest_clips']} manifest "
+          f"== {books['scored']} scored + {books['failed']} failed",
+          flush=True)
+    # the unkilled reference: same command, pristine out dir (handle
+    # both `--out DIR` and `--out=DIR` — a missed rewrite would compare
+    # the killed run's verdicts against THEMSELVES and pass vacuously)
+    ref_out = out_dir.rstrip("/") + ".ref"
+    ref_cmd = []
+    for a in cmd:
+        if a == out_dir:
+            ref_cmd.append(ref_out)
+        elif a == f"--out={out_dir}":
+            ref_cmd.append(f"--out={ref_out}")
+        else:
+            ref_cmd.append(a)
+    if ref_cmd == cmd:
+        print("[chaos] FAIL: could not rewrite --out for the reference "
+              "run")
+        return 1
+    print(f"[chaos] reference run -> {ref_out}", flush=True)
+    rc = subprocess.run(ref_cmd, env=env, timeout=timeout).returncode
+    if rc != 0:
+        print(f"[chaos] FAIL: reference run exited {rc}")
+        return 1
+    ref_books = collect_books(ref_out, manifest)
+    if not ref_books["balanced"]:
+        print(f"[chaos] FAIL: reference books imbalance: {ref_books}")
+        return 1
+    a = _normalized_verdicts(out_dir, manifest)
+    b = _normalized_verdicts(ref_out, manifest)
+    if a != b:
+        diff = set(a) ^ set(b)
+        print(f"[chaos] FAIL: killed+resumed verdicts differ from the "
+              f"unkilled run's ({len(diff)} records differ): "
+              f"{sorted(diff)[:3]}")
+        return 1
+    print(f"[chaos] PASS: {len(a)} verdicts identical (order-normalized) "
+          f"to the unkilled run")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "backfill":
+        p = argparse.ArgumentParser(prog="chaos.py backfill")
+        p.add_argument("--fault", required=True,
+                       help="DFD_CHAOS spec, e.g. backfill_kill@2 or "
+                            "backfill_torn_shard@1:137")
+        p.add_argument("--expect", type=int, default=EXIT_PREEMPTED,
+                       help="exit code the faulted launch must produce "
+                            "(75 for SIGTERM-style kills, 137 for the "
+                            "hard-death points)")
+        p.add_argument("--max-restarts", type=int, default=3)
+        p.add_argument("--timeout", type=float, default=900.0,
+                       help="per-launch wall bound (the backfill runner "
+                            "has no in-process watchdog)")
+        p.add_argument("cmd", nargs=argparse.REMAINDER)
+        ns = p.parse_args(argv[1:])
+        cmd = ns.cmd[1:] if ns.cmd and ns.cmd[0] == "--" else ns.cmd
+        if not cmd:
+            p.error("backfill command missing (append: -- python -m "
+                    "deepfake_detection_tpu.runners.backfill ...)")
+        return run_backfill_scenario(ns.fault, cmd, ns.expect,
+                                     ns.max_restarts, ns.timeout)
     if argv and argv[0] == "truncate":
         p = argparse.ArgumentParser(prog="chaos.py truncate")
         p.add_argument("path")

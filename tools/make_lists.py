@@ -29,6 +29,14 @@ holds, frame-count mismatches, and truncated/corrupt shards
 (``data/packed.py::verify_pack``) — one command audits both
 representations.
 
+``--manifest OUT.json`` additionally emits the sharded **backfill work
+manifest** (``deepfake_detection_tpu/backfill``, schema
+``dfd.backfill.manifest.v1``) in the same pass: the freshly written
+lists (or, with ``--packed DIR``, the pack's own index) chopped into
+``--shard-clips``-sized leaseable shards, fingerprinted against the
+source so ``runners/backfill.py`` refuses to score a drifted corpus
+(the PackedCacheStale contract).
+
 Exit code is 1 when ``--validate --strict`` finds problems.
 
 Usage (see README "Data lists" recipe)::
@@ -181,6 +189,12 @@ def main(argv=None) -> int:
                          "(tools/pack_dataset.py) against the scanned tree")
     ap.add_argument("--strict", action="store_true",
                     help="with --validate: exit 1 when problems found")
+    ap.add_argument("--manifest", default="", metavar="OUT.json",
+                    help="also emit the sharded backfill work manifest "
+                         "(from the written lists, or from --packed's "
+                         "index when given)")
+    ap.add_argument("--shard-clips", type=int, default=256,
+                    help="with --manifest: clips per leaseable shard")
     args = ap.parse_args(argv)
 
     out_dir = args.out_dir or args.root
@@ -205,6 +219,29 @@ def main(argv=None) -> int:
         totals.append((kind, n_listed, frames))
     if args.validate and args.packed:
         problems += validate_packed(args.packed, scanned)
+
+    if args.manifest:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from deepfake_detection_tpu.backfill.manifest import (
+            build_manifest_from_lists, build_manifest_from_pack,
+            save_manifest)
+        if args.packed:
+            # the pack is the source the backfill will read: fingerprint
+            # the manifest against ITS index, not the (already cross-
+            # checked) tree
+            manifest = build_manifest_from_pack(
+                args.packed, shard_clips=args.shard_clips)
+        else:
+            # from the lists just written above, so the manifest's
+            # fingerprint matches what the runner re-reads at launch
+            manifest = build_manifest_from_lists(
+                out_dir, shard_clips=args.shard_clips)
+        save_manifest(args.manifest, manifest)
+        print(f"manifest: {manifest['num_clips']} clips in "
+              f"{len(manifest['shards'])} shard(s) of "
+              f"{args.shard_clips} -> {args.manifest} "
+              f"(fingerprint {manifest['fingerprint'][:12]}…)")
 
     for kind, n, frames in totals:
         print(f"{kind}: {n} clips, {frames} reachable frames "

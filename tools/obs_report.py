@@ -27,12 +27,27 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from deepfake_detection_tpu.obs.events import iter_records  # noqa: E402
 
 
-def _resolve(path: str) -> str:
+def _resolve(path: str) -> list:
+    """Telemetry files for a run: the file itself, or — for a run dir —
+    every ``telemetry*.jsonl`` in it (the trainer writes ONE
+    ``telemetry.jsonl``; backfill workers write one
+    ``telemetry-<worker>.jsonl`` EACH, and the report merges them)."""
     if os.path.isdir(path):
-        path = os.path.join(path, "telemetry.jsonl")
+        import glob as _glob
+        found = sorted(_glob.glob(os.path.join(path, "telemetry*.jsonl")))
+        if not found:
+            raise SystemExit(f"no telemetry log under {path}")
+        return found
     if not os.path.isfile(path):
         raise SystemExit(f"no telemetry log at {path}")
-    return path
+    return [path]
+
+
+def _read_all(paths: list) -> list:
+    """All records of a run, merged across worker files in time order."""
+    recs = [rec for p in paths for rec in iter_records(p)]
+    recs.sort(key=lambda r: r.get("t") or 0)
+    return recs
 
 
 def _fmt(v, nd=1):
@@ -67,12 +82,66 @@ def _epoch_rows(metrics):
     return rows
 
 
-def summarize(path: str) -> None:
+def summarize_backfill(path, metrics, events) -> None:
+    """The backfill shape of the report: per-shard progress/throughput
+    (runners/backfill.py emits one metrics record per committed or
+    abandoned shard) plus the run_end books line — same vocabulary as
+    BACKFILL_BENCH.md."""
+    print(f"# {path}: backfill — {len(metrics)} shard records, "
+          f"{len(events)} events")
+    start = next((e for e in events if e.get("event") == "run_start"),
+                 None)
+    if start is not None:
+        print(f"manifest: {start.get('num_clips')} clips / "
+              f"{start.get('shards_total')} shards "
+              f"(fingerprint {str(start.get('fingerprint'))[:12]}…), "
+              f"batch {start.get('batch_size')}, "
+              f"worker {start.get('worker')}")
+    print()
+    if metrics:
+        print("| shard | clips | scored | failed | resumed | clips/s | "
+              "data-wait | device-wait | host | recompiles |")
+        print("|---|---|---|---|---|---|---|---|---|---|")
+        for m in metrics:
+            print(f"| {m.get('shard')} | {m.get('clips')} "
+                  f"| {m.get('scored')} | {m.get('failed')} "
+                  f"| {m.get('resumed')} | {_fmt(m.get('clips_per_s'))} "
+                  f"| {_fmt(m.get('data_wait_s'), 2)}s "
+                  f"| {_fmt(m.get('device_wait_s'), 2)}s "
+                  f"| {_fmt(m.get('host_s'), 2)}s "
+                  f"| {m.get('backend_compiles', 0)} |")
+    steals = [e for e in events if e.get("event") == "lease_steal"]
+    for e in steals:
+        print(f"\nlease steal: {e.get('shard')} re-leased from dead "
+              f"worker {e.get('prev_owner')}")
+    end = next((e for e in reversed(events)
+                if e.get("event") == "run_end"), None)
+    if end is not None:
+        b = end.get("books") or {}
+        verdict = "BALANCED" if b.get("balanced") else (
+            "incomplete" if not b.get("complete") else "IMBALANCED")
+        print(f"\nbooks: {b.get('manifest_clips')} manifest == "
+              f"{b.get('scored')} scored + {b.get('failed')} failed — "
+              f"{verdict} ({b.get('shards_done')}/"
+              f"{b.get('shards_total')} shards done); this worker "
+              f"{end.get('clips_this_proc')} clips @ "
+              f"{_fmt(end.get('clips_per_s'))} clips/s, "
+              f"{end.get('steady_recompiles')} steady-state recompiles")
+
+
+def summarize(paths: list) -> None:
+    path = paths[0] if len(paths) == 1 else \
+        f"{os.path.dirname(paths[0])} ({len(paths)} worker streams)"
     metrics, events = [], []
-    for rec in iter_records(path):
+    for rec in _read_all(paths):
         (metrics if rec.get("type") == "metrics" else events).append(rec)
     if not metrics and not events:
         raise SystemExit(f"{path}: no records")
+    if any("shard" in m for m in metrics) or any(
+            e.get("mode") == "backfill" for e in events
+            if e.get("event") == "run_start"):
+        summarize_backfill(path, metrics, events)
+        return
     print(f"# {path}: {len(metrics)} metrics records, "
           f"{len(events)} events")
     # the mesh line (ISSUE 12): which topology the run compiled for — the
@@ -138,15 +207,14 @@ def summarize(path: str) -> None:
             print(f"  {e['event']}: {extra}")
 
 
-def show_events(path: str) -> None:
-    for rec in iter_records(path):
+def show_events(paths: list) -> None:
+    for rec in _read_all(paths):
         if rec.get("type") == "event":
             print(json.dumps(rec))
 
 
-def show_tail(path: str, n: int) -> None:
-    recs = list(iter_records(path))
-    for rec in recs[-n:]:
+def show_tail(paths: list, n: int) -> None:
+    for rec in _read_all(paths)[-n:]:
         print(json.dumps(rec))
 
 
@@ -159,13 +227,13 @@ def main(argv=None) -> None:
     p.add_argument("--events", action="store_true",
                    help="print lifecycle events only")
     args = p.parse_args(argv)
-    path = _resolve(args.path)
+    paths = _resolve(args.path)
     if args.tail:
-        show_tail(path, args.tail)
+        show_tail(paths, args.tail)
     elif args.events:
-        show_events(path)
+        show_events(paths)
     else:
-        summarize(path)
+        summarize(paths)
 
 
 if __name__ == "__main__":
