@@ -484,6 +484,64 @@ class TrainConfig:
 # Serving config (runners/serve.py)
 # ---------------------------------------------------------------------------
 
+#: serving PTQ dtypes (canonical + accepted aliases; serving/quant.py
+#: owns the transform — config stays jax-free so only the names live here)
+_QUANT_DTYPES = {"f32": "f32", "float32": "f32",
+                 "bf16": "bf16", "bfloat16": "bf16", "int8": "int8"}
+
+
+def _canon_quant_dtype(s: str, flag: str) -> str:
+    try:
+        return _QUANT_DTYPES[str(s).lower()]
+    except KeyError:
+        raise ValueError(f"{flag} must be one of f32|bf16|int8 (aliases "
+                         f"float32, bfloat16), got {s!r}") from None
+
+
+def parse_model_spec(spec: str, *, default_size: int,
+                     default_img_num: int) -> Dict[str, Any]:
+    """One ``--models`` entry → spec dict.
+
+    Grammar: ``id=family[,path=CKPT][,size=N][,img_num=K][,dtype=D]
+    [,reload=DIR]`` — the first token names the table id and the model
+    family; the rest override the primary model's geometry/dtype
+    defaults.  Example::
+
+        student=mobilenetv3_small_100,size=224,dtype=int8
+    """
+    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    if not parts or "=" not in parts[0]:
+        raise ValueError(f"--models entry {spec!r} must start with "
+                         f"id=family")
+    model_id, family = parts[0].split("=", 1)
+    out: Dict[str, Any] = {"id": model_id.strip(),
+                           "family": family.strip(), "path": "",
+                           "size": int(default_size),
+                           "img_num": int(default_img_num),
+                           "dtype": "f32", "reload": ""}
+    if not out["id"] or not out["family"]:
+        raise ValueError(f"--models entry {spec!r}: empty id or family")
+    for part in parts[1:]:
+        if "=" not in part:
+            raise ValueError(f"--models entry {spec!r}: {part!r} is not "
+                             f"key=value")
+        k, v = part.split("=", 1)
+        k, v = k.strip(), v.strip()
+        if k == "path" or k == "reload":
+            out[k] = v
+        elif k == "size" or k == "img_num":
+            out[k] = int(v)
+            if out[k] < 1:
+                raise ValueError(f"--models entry {spec!r}: {k} must be "
+                                 f">= 1")
+        elif k == "dtype":
+            out[k] = _canon_quant_dtype(v, f"--models {out['id']} dtype")
+        else:
+            raise ValueError(f"--models entry {spec!r}: unknown key "
+                             f"{k!r} (path|size|img_num|dtype|reload)")
+    return out
+
+
 @dataclass
 class ServeConfig:
     """Knob surface of the dynamic-batching inference server.
@@ -517,6 +575,31 @@ class ServeConfig:
     # scores single frames can opt out (float32 wire serves clips for
     # free either way, so this flag is a no-op there)
     single_frame_only: bool = False
+
+    # --- post-training quantization (serving/quant.py) ---
+    # serving dtype of the PRIMARY model's device-resident weights:
+    # 'f32' = reference parity, 'bf16' = params cast, 'int8' = weight-only
+    # per-output-channel symmetric kernels, dequant fused into the
+    # compiled call.  Checkpoints on disk (incl. hot reloads) stay f32;
+    # tools/quant_parity.py measures the score drift/AUC bounds
+    dtype: str = "f32"
+
+    # --- multi-model serving (ISSUE 14) ---
+    # extra model-table entries, ';'-separated specs:
+    #   id=family[,path=CKPT][,size=N][,img_num=K][,dtype=D][,reload=DIR]
+    # every entry is AOT-warmed before /readyz; POST /score routes via
+    # its 'model' field / ?model= query param (default: the flagship)
+    models: str = ""
+
+    # --- two-tier cascade (serving/cascade.py) ---
+    # model-table id of the triage student ("" = no cascade).  When set,
+    # un-routed requests score student-first; student fake scores inside
+    # [cascade_low, cascade_high] escalate to the flagship, everything
+    # else returns the student verdict.  The student must share the
+    # flagship's img_num (same clips flow through both tiers)
+    cascade: str = ""
+    cascade_low: float = 0.2
+    cascade_high: float = 0.8
 
     # --- micro-batching / compile cache ---
     buckets: Tuple[int, ...] = (1, 4, 16, 64)
@@ -584,6 +667,38 @@ class ServeConfig:
             raise ValueError("--breaker-threshold must be >= 0 (0 = off)")
         if self.breaker_open_s <= 0:
             raise ValueError("--breaker-open-s must be > 0")
+        self.dtype = _canon_quant_dtype(self.dtype, "--dtype")
+        specs = self.model_specs()          # validates the grammar
+        ids = [s["id"] for s in specs]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"--models ids must be unique, got {ids}")
+        if self.model in ids:
+            raise ValueError(f"--models id {self.model!r} collides with "
+                             f"the primary --model")
+        if not 0.0 <= float(self.cascade_low) <= \
+                float(self.cascade_high) <= 1.0:
+            raise ValueError(
+                f"--cascade-low/--cascade-high must satisfy 0 <= low <= "
+                f"high <= 1, got [{self.cascade_low}, "
+                f"{self.cascade_high}]")
+        if self.cascade:
+            by_id = {s["id"]: s for s in specs}
+            if self.cascade not in by_id:
+                raise ValueError(
+                    f"--cascade {self.cascade!r} must name a --models "
+                    f"entry (got {sorted(by_id) or 'none'})")
+            if by_id[self.cascade]["img_num"] != self.img_num:
+                raise ValueError(
+                    f"--cascade student img_num "
+                    f"{by_id[self.cascade]['img_num']} != flagship "
+                    f"img_num {self.img_num}: the same clips must flow "
+                    f"through both tiers")
+
+    def model_specs(self) -> List[Dict[str, Any]]:
+        """Parsed ``--models`` entries (see :func:`parse_model_spec`)."""
+        return [parse_model_spec(s, default_size=self.image_size,
+                                 default_img_num=self.img_num)
+                for s in str(self.models).split(";") if s.strip()]
 
     @property
     def max_batch_size(self) -> int:

@@ -112,11 +112,18 @@ def make_score_fn(model, variables):
     rounding drifts ~1 ulp from the argument-passing form), and the
     serving engine (serving/engine.py) compiles this exact
     variables-as-argument program — so CLI and server scores agree
-    bit-for-bit."""
+    bit-for-bit.
+
+    ``variables`` may also be a ``serving/quant.py`` post-training-
+    quantized tree (bf16 cast or int8 containers): the in-trace
+    ``realize_tree`` dequantizes it inside the compiled call, and is a
+    structural no-op on plain f32 trees — the bit-parity contract above
+    is untouched at f32 (tests/test_serving_quant.py pins both)."""
+    from .serving.quant import realize_tree
 
     @jax.jit
     def score(variables, x: jnp.ndarray) -> jnp.ndarray:
-        logits = model.apply(variables, x, training=False)
+        logits = model.apply(realize_tree(variables), x, training=False)
         return jax.nn.softmax(logits, axis=-1)
 
     return lambda x: score(variables, x)

@@ -36,53 +36,75 @@ _logger = logging.getLogger(__name__)
 __all__ = ["build_engine", "build_server", "main"]
 
 
-def _load_variables(model, cfg):
-    """Checkpoint load, mirroring ``runners/test.py::test_img``."""
+def _load_model_variables(model, model_path, *, image_size, in_chans,
+                          use_ema, name):
+    """Checkpoint load for one model-table entry, mirroring
+    ``runners/test.py::test_img``."""
     import jax
 
     from ..models import init_model
     from ..models.helpers import load_checkpoint
 
     variables = init_model(model, jax.random.PRNGKey(0),
-                           (1, cfg.image_size, cfg.image_size, cfg.in_chans))
-    if cfg.model_path and os.path.isdir(cfg.model_path):
+                           (1, image_size, image_size, in_chans))
+    if model_path and os.path.isdir(model_path):
         from ..train.checkpoint import load_sharded_for_eval
-        variables = load_sharded_for_eval(cfg.model_path, variables)
-    elif cfg.model_path:
-        variables = load_checkpoint(variables, cfg.model_path,
-                                    use_ema=cfg.use_ema, strict=False)
+        variables = load_sharded_for_eval(model_path, variables)
+    elif model_path:
+        variables = load_checkpoint(variables, model_path,
+                                    use_ema=use_ema, strict=False)
     else:
-        _logger.warning("no --model-path: serving a seed-0 random init "
-                        "(bench/demo mode)")
+        _logger.warning("no checkpoint for model %r: serving a seed-0 "
+                        "random init (bench/demo mode)", name)
     return variables
 
 
 def build_engine(cfg):
-    """Model → warmed engine + micro-batcher + metrics — the device half
-    every front end shares (``runners/serve.py``'s single-request HTTP
-    server and ``runners/stream.py``'s streaming pipeline both sit on
-    exactly this stack)."""
+    """Model table → warmed engine + micro-batcher + metrics — the device
+    half every front end shares (``runners/serve.py``'s single-request
+    HTTP server and ``runners/stream.py``'s streaming pipeline both sit
+    on exactly this stack).  The primary --model is the flagship entry;
+    every --models spec adds one more, all AOT-warmed before ready."""
     from ..models import create_model
     from ..serving.batcher import MicroBatcher
     from ..serving.engine import InferenceEngine
     from ..serving.metrics import ServingMetrics
 
-    _logger.info("building %s (in_chans=%d, canvas %d²)", cfg.model,
-                 cfg.in_chans, cfg.image_size)
+    _logger.info("building %s (in_chans=%d, canvas %d², dtype=%s)",
+                 cfg.model, cfg.in_chans, cfg.image_size, cfg.dtype)
     model = create_model(cfg.model, num_classes=cfg.num_classes,
                          in_chans=cfg.in_chans)
-    variables = _load_variables(model, cfg)
+    variables = _load_model_variables(
+        model, cfg.model_path, image_size=cfg.image_size,
+        in_chans=cfg.in_chans, use_ema=cfg.use_ema, name=cfg.model)
     metrics = ServingMetrics(throughput_window_s=cfg.throughput_window_s)
-    _logger.info("AOT-warming buckets %s ...", list(cfg.buckets))
     engine = InferenceEngine(
         model, variables, image_size=cfg.image_size, img_num=cfg.img_num,
         buckets=cfg.buckets, metrics=metrics, wire=cfg.wire,
         multi_frame=not cfg.single_frame_only,
+        dtype=cfg.dtype, model_id=cfg.model, warmup=False,
         watchdog_timeout_s=cfg.watchdog_timeout_s,
         breaker_threshold=cfg.breaker_threshold,
         breaker_open_s=cfg.breaker_open_s,
         reload_drift_tol=cfg.reload_drift_tol,
         retry_jitter_s=cfg.retry_jitter_s)
+    specs = cfg.model_specs()
+    for spec in specs:
+        in_chans = 3 * spec["img_num"]
+        _logger.info("adding model %r: %s (in_chans=%d, canvas %d², "
+                     "dtype=%s)", spec["id"], spec["family"], in_chans,
+                     spec["size"], spec["dtype"])
+        extra = create_model(spec["family"], num_classes=cfg.num_classes,
+                             in_chans=in_chans)
+        extra_vars = _load_model_variables(
+            extra, spec["path"], image_size=spec["size"],
+            in_chans=in_chans, use_ema=cfg.use_ema, name=spec["id"])
+        engine.add_model(spec["id"], extra, extra_vars,
+                         image_size=spec["size"], img_num=spec["img_num"],
+                         dtype=spec["dtype"])
+    _logger.info("AOT-warming buckets %s × %d model(s) ...",
+                 list(cfg.buckets), 1 + len(specs))
+    engine.warmup()
     if engine.chaos.active:
         _logger.warning("DFD_CHAOS active: %s", sorted(engine.chaos.points))
     batcher = MicroBatcher(max_batch=cfg.max_batch_size,
@@ -95,17 +117,39 @@ def build_engine(cfg):
                                     use_ema=cfg.use_ema)
         _logger.info("hot-reload watcher on %s (every %.1fs)",
                      cfg.reload_dir, cfg.reload_interval_s)
+    for spec in specs:
+        if spec["reload"]:
+            engine.start_reload_watcher(spec["reload"],
+                                        interval_s=cfg.reload_interval_s,
+                                        use_ema=cfg.use_ema,
+                                        model_id=spec["id"])
+            _logger.info("hot-reload watcher for model %r on %s",
+                         spec["id"], spec["reload"])
     return engine, batcher, metrics
 
 
 def build_server(cfg):
-    """Wire model → engine → batcher → HTTP server; returns the (not yet
-    started) :class:`ServingServer` with engine/batcher attached."""
+    """Wire model table → engine → batcher → (optional cascade) → HTTP
+    server; returns the (not yet started) :class:`ServingServer` with
+    engine/batcher attached."""
     from ..serving.http import make_server
 
     engine, batcher, metrics = build_engine(cfg)
+    cascade = None
+    if cfg.cascade:
+        from ..serving.cascade import CascadeRouter
+        cascade = CascadeRouter(
+            batcher, metrics, student_id=cfg.cascade,
+            flagship_id=engine.default_model_id,
+            low=cfg.cascade_low, high=cfg.cascade_high,
+            timeout_s=cfg.request_timeout_ms / 1000.0)
+        _logger.info("cascade: student %r triages, suspect band "
+                     "[%.3f, %.3f] escalates to %r", cfg.cascade,
+                     cfg.cascade_low, cfg.cascade_high,
+                     engine.default_model_id)
     return make_server(cfg.host, cfg.port, engine, batcher, metrics,
-                       request_timeout_s=cfg.request_timeout_ms / 1000.0)
+                       request_timeout_s=cfg.request_timeout_ms / 1000.0,
+                       cascade=cascade)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:

@@ -55,11 +55,19 @@ def preprocess_clip(img_files, size: int = image_max_height,
 
 
 def test_img(model_path: Optional[str], img_files: Sequence[str],
-             size: int = image_max_height, clip: bool = False) -> List[float]:
+             size: int = image_max_height, clip: bool = False,
+             dtype: str = "f32") -> List[float]:
     """Score images one at a time (replicate ×img_num, reference parity),
     or — with ``clip=True`` — in groups of ``img_num`` distinct frames
     channel-concatenated into temporal clips (the streaming windower's
-    layout; scores are bit-identical to the serving float32 wire)."""
+    layout; scores are bit-identical to the serving float32 wire).
+
+    ``dtype`` applies the serving PTQ transform (``serving/quant.py``)
+    to the loaded f32 weights before scoring — the same quantized tree
+    and the same variables-as-argument program the engine serves, so
+    this CLI is the parity harness's non-server oracle: bit-identical
+    to the engine's float32 wire at f32, and within the measured
+    SERVE_BENCH.md tolerance under bf16/int8."""
     assert all(os.path.isfile(f) for f in img_files), "file not exist!"
     if clip and len(img_files) % img_num:
         raise ValueError(f"--clip needs a multiple of img_num={img_num} "
@@ -77,6 +85,10 @@ def test_img(model_path: Optional[str], img_files: Sequence[str],
     elif model_path:
         variables = load_checkpoint(variables, model_path, strict=False)
     print("Model loaded!")
+    if dtype not in ("f32", "float32"):
+        from ..serving.quant import quant_summary, quantize_tree
+        variables = quantize_tree(variables, dtype)
+        print(f"Quantized weights to {dtype}: {quant_summary(variables)}")
     score_fn = make_score_fn(model, variables)
     scores_out: List[float] = []
     if clip:
@@ -105,13 +117,19 @@ def main(argv=None) -> None:
                    help=f"score groups of img_num={img_num} distinct "
                         f"frames as temporal clips instead of replicating "
                         f"each image")
+    p.add_argument("--dtype", default="f32",
+                   choices=["f32", "bf16", "int8"],
+                   help="post-training quantization of the loaded f32 "
+                        "weights (serving/quant.py): f32 = reference "
+                        "parity, bf16/int8 = the engine's PTQ serving "
+                        "modes (tools/quant_parity.py measures the drift)")
     args = p.parse_args(argv)
     if not args.images:
         print("Please input your images. e.g. python -m "
               "deepfake_detection_tpu.runners.test image1 image2")
         return
     test_img(args.model_path or None, args.images, size=args.image_size,
-             clip=args.clip)
+             clip=args.clip, dtype=args.dtype)
 
 
 if __name__ == "__main__":

@@ -21,6 +21,9 @@ _LAZY = {
     "ServingMetrics": "metrics",
     "ServingServer": "http", "make_server": "http",
     "serve_forever_in_thread": "http",
+    "quantize_tree": "quant", "realize_tree": "quant",
+    "canonical_mode": "quant", "QUANT_MODES": "quant",
+    "CascadeRouter": "cascade", "CascadeResult": "cascade",
 }
 
 __all__ = sorted(_LAZY)

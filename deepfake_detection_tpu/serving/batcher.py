@@ -70,12 +70,15 @@ class Request:
     # a per-instance lock would cost an allocation per HTTP request
     _claim_guard = threading.Lock()
 
-    __slots__ = ("id", "array", "enqueue_t", "deadline_t", "timings",
-                 "_event", "_result", "_error", "_claimed")
+    __slots__ = ("id", "array", "model_id", "enqueue_t", "deadline_t",
+                 "timings", "_event", "_result", "_error", "_claimed")
 
-    def __init__(self, array: Any, timeout_s: Optional[float] = None):
+    def __init__(self, array: Any, timeout_s: Optional[float] = None,
+                 model_id: str = "default"):
         self.id = next(self._ids)
         self.array = array
+        self.model_id = model_id    # engine model-table key (per-model
+        # books + compiled-program routing; "default" = primary model)
         self.enqueue_t = time.monotonic()
         self.deadline_t = (self.enqueue_t + timeout_s
                            if timeout_s and timeout_s > 0 else None)
@@ -140,6 +143,9 @@ class MicroBatcher:
         self.max_queue = int(max_queue)
         self.metrics = metrics
         self.retry_jitter_s = float(retry_jitter_s)
+        #: label unrouted submits carry in the per-model books; the
+        #: engine overwrites it with its primary model id at start()
+        self.default_model_id = "default"
         self._retry_rng = random.Random(0x5EED)
         self._q: "queue.Queue[Request]" = queue.Queue()
         self._depth = 0
@@ -161,15 +167,20 @@ class MicroBatcher:
 
     # ------------------------------------------------------------------
     def submit(self, array: Any,
-               timeout_s: Optional[float] = None) -> Request:
+               timeout_s: Optional[float] = None,
+               model_id: Optional[str] = None) -> Request:
         """Enqueue one preprocessed request; raises :class:`QueueFull` past
-        ``max_queue`` depth."""
+        ``max_queue`` depth.  ``model_id`` routes it to one entry of the
+        engine's model table (None = the primary model)."""
         if self._closed.is_set():
             raise RuntimeError("batcher is closed")
+        model_id = model_id or self.default_model_id
         if self.metrics is not None:
             # the books ledger: every submit attempt is accepted, then
-            # resolves exactly once as scored/shed/deadline/failed
+            # resolves exactly once as scored/shed/deadline/failed (the
+            # model= labeled books mirror each increment)
             self.metrics.accepted_total.inc()
+            self.metrics.count_model("accepted", model_id)
         with self._depth_lock:
             if self._depth >= self.max_queue:
                 depth = self._depth
@@ -183,6 +194,7 @@ class MicroBatcher:
         if full:
             if self.metrics is not None:
                 self.metrics.shed_total.inc()
+                self.metrics.count_model("shed", model_id)
             # Retry-After estimate: drain time of the current backlog at
             # one deadline-window per max_batch, floored at 1s (the
             # HTTP-date alternative needs no clock sync this way), plus a
@@ -192,7 +204,7 @@ class MicroBatcher:
                 max(1.0, depth / self.max_batch * self.deadline_s),
                 self.retry_jitter_s, self._retry_rng)
             raise QueueFull(depth, retry)
-        req = Request(array, timeout_s)
+        req = Request(array, timeout_s, model_id=model_id)
         self._q.put(req)
         if self._closed.is_set():
             # close() raced us: its drain may have run before our put
@@ -202,6 +214,7 @@ class MicroBatcher:
             if req.claim():
                 if self.metrics is not None:
                     self.metrics.failed_total.inc()
+                    self.metrics.count_model("failed", req.model_id)
                 req.set_exception(RuntimeError("batcher is closed"))
         return req
 
@@ -225,6 +238,7 @@ class MicroBatcher:
                 if req.claim():
                     if self.metrics is not None:
                         self.metrics.deadline_total.inc()
+                        self.metrics.count_model("deadline", req.model_id)
                     req.set_exception(DeadlineExceeded(
                         f"request {req.id} expired after "
                         f"{req.timings['queue'] * 1000:.0f} ms in queue"))
@@ -270,4 +284,5 @@ class MicroBatcher:
             if req.claim():
                 if self.metrics is not None:
                     self.metrics.failed_total.inc()
+                    self.metrics.count_model("failed", req.model_id)
                 req.set_exception(RuntimeError("server shutting down"))

@@ -1,17 +1,28 @@
-"""Inference engine: bucketed AOT compile cache, double-buffered staging,
-hot weight reload.
+"""Inference engine: multi-model table over a bucketed AOT compile cache,
+double-buffered staging, hot weight reload, weight-only PTQ.
 
 Design (mirrors what ``data/loader.py`` does for training input):
 
+* **Model table** — the engine serves N models from ONE worker loop and
+  ONE micro-batch queue: each :class:`_ModelEntry` owns its params, its
+  canvas geometry, its compiled executables and its reload/canary state.
+  The compile cache is keyed ``(model_id, bucket, chans, wire)``; every
+  executable is AOT-warmed before the server reports ready, and a model
+  added to a warmed engine DROPS readiness until its own warmup ran —
+  ``/readyz`` never lies about a cold model.  Requests carry a
+  ``model_id`` (HTTP: the ``model`` field / query param, defaulting to
+  the primary model) and the request books are mirrored per model.
+
 * **Bucketed compile cache** — the scoring function is AOT-compiled once
-  per batch bucket (default 1/4/16/64) at startup, *before* the server
-  reports ready.  Every device call thereafter hits a pre-compiled
-  executable: a partial batch pads up to the nearest bucket and the pad
-  rows are sliced off the result.  Because batch rows are independent in
-  eval mode (running-stat BN, per-row softmax), the real rows of a padded
+  per (model, batch bucket) at startup, *before* the server reports
+  ready.  Every device call thereafter hits a pre-compiled executable: a
+  partial batch pads up to the nearest bucket and the pad rows are
+  sliced off the result.  Because batch rows are independent in eval
+  mode (running-stat BN, per-row softmax), the real rows of a padded
   bucket are bit-identical to an unpadded call (tests/test_serving.py).
-  Novel shapes cannot recompile silently — an unknown bucket is a hard
-  error, and ``compiles_total`` growing after ready=1 is the alarm.
+  Novel shapes cannot recompile silently — an unknown bucket or channel
+  width is a hard error, and ``compiles_total`` growing after ready=1 is
+  the alarm.
 
 * **uint8 wire** — HTTP threads ship the geometric canvas
   (``params.prepare_canvas``, uint8 HWC); normalize + ×img_num replication
@@ -20,6 +31,14 @@ Design (mirrors what ``data/loader.py`` does for training input):
   idiom as the training loader's device prologue: 4× less host→device
   traffic and the photometrics get batched for free.
 
+* **Post-training quantization** (serving/quant.py) — ``dtype`` bf16
+  casts the params, ``int8`` quantizes conv/dense kernels with
+  per-output-channel symmetric scales; the in-trace ``realize_tree``
+  dequant fuses into the compiled program next to the normalize
+  epilogue.  The transform applies at warmup AND to every hot reload
+  from its f32 checkpoint (the canary then gates the *quantized* swap),
+  while the shape gate keeps comparing against the f32 template.
+
 * **Double-buffered staging** — while batch k executes, the engine drains
   already-queued requests into batch k+1 and dispatches it (JAX async
   dispatch) before blocking on k's result: transfer/stage of k+1 overlaps
@@ -27,10 +46,12 @@ Design (mirrors what ``data/loader.py`` does for training input):
 
 * **Hot weight reload** — params ride the compiled call as an *argument*
   (not a closure constant), so swapping them is aval-compatible and free
-  of recompiles.  A watcher thread polls a checkpoint dir; a new file is
-  loaded host-side through ``models/helpers.py`` and swapped in atomically
-  between batches.  Shape-incompatible checkpoints are rejected, counted,
-  and the old weights keep serving.
+  of recompiles.  A watcher thread per watched model polls a checkpoint
+  dir; a new file is loaded host-side through ``models/helpers.py`` and
+  swapped in atomically between batches (the A/B path).  Shape-
+  incompatible checkpoints — including a checkpoint of a DIFFERENT
+  model's tree — are rejected loudly, counted, and the old weights keep
+  serving.
 
 * **Crash recovery** — an exception anywhere in the serve loop fails the
   affected requests (HTTP 500) and restarts the loop; the worker thread
@@ -48,15 +69,16 @@ Design (mirrors what ``data/loader.py`` does for training input):
   - a batch that **never completes** (or a worker that died outright)
     trips the stuck-batch watchdog: in-flight requests fail 503,
     readiness DROPS, a new worker generation starts, and every AOT
-    bucket is re-executed (no recompiles — the executables survive)
-    before ``/readyz`` goes true again;
+    bucket of every model is re-executed (no recompiles — the
+    executables survive) before ``/readyz`` goes true again;
   - **consecutive batch failures** open a circuit breaker (immediate
     503 + Retry-After at the HTTP edge, half-open probe after the
     cooldown, close on probe success);
   - a **hot reload** must pass a golden-batch canary (finite,
-    shape-correct, optionally drift-bounded scores) before the swap;
-    torn/garbage/mismatched checkpoints are rejected loudly and the old
-    weights keep serving bit-identically.
+    shape-correct, optionally drift-bounded scores — run on the
+    QUANTIZED candidate under the target's serving dtype) before the
+    swap; torn/garbage/mismatched checkpoints are rejected loudly and
+    the old weights keep serving bit-identically.
 """
 
 from __future__ import annotations
@@ -77,6 +99,7 @@ from ..params import image_max_height, img_mean, img_num as _default_img_num, \
     img_std
 from .batcher import MicroBatcher, Request, pick_bucket
 from .metrics import ServingMetrics
+from .quant import canonical_mode, quant_summary, quantize_tree, realize_tree
 from .resilience import (CircuitBreaker, EngineStalled, NonFiniteScores,
                          ServeWatchdog, torn_copy)
 
@@ -91,16 +114,69 @@ DEFAULT_BUCKETS = (1, 4, 16, 64)
 _CKPT_SUFFIXES = (".msgpack", ".ckpt", ".flax", ".pkt")
 
 
+class _ModelEntry:
+    """One served model: params, geometry, compiled programs, reload and
+    canary state.  The engine's model table maps ``model_id`` → entry."""
+
+    __slots__ = ("model_id", "model", "image_size", "img_num", "dtype",
+                 "multi_frame", "host_template", "var_shapes", "variables",
+                 "mean", "std", "mean_multi", "std_multi", "compiled",
+                 "golden", "golden_ref", "reload_count", "last_reload_key",
+                 "reload_attempts", "watcher", "warmed")
+
+    def __init__(self, model_id: str, model, variables, *,
+                 image_size: int, img_num: int, dtype: str,
+                 wire: str, multi_frame: bool):
+        self.model_id = model_id
+        self.model = model
+        self.image_size = int(image_size)
+        self.img_num = int(img_num)
+        self.dtype = canonical_mode(dtype)
+        # multi-frame needs a second program per bucket only on the uint8
+        # wire (float32 payloads share the (·, ·, 3·img_num) shape)
+        self.multi_frame = bool(multi_frame) and wire == "uint8" \
+            and self.img_num > 1
+        # host-side f32 template: the reload merge target AND the shape
+        # gate — reloads stay f32 on disk regardless of serving dtype
+        self.host_template = jax.tree.map(np.asarray, variables)
+        self.var_shapes = jax.tree.map(
+            lambda a: (tuple(np.shape(a)), np.asarray(a).dtype),
+            self.host_template)
+        # the device copy is what executes: PTQ applies here (and to
+        # every reload), never to the template
+        self.variables = jax.device_put(quantize_tree(variables,
+                                                      self.dtype))
+        self.mean = jax.device_put(jnp.asarray(img_mean))
+        self.std = jax.device_put(jnp.asarray(img_std))
+        # multi-frame wire: mean/std tiled to the 3·img_num clip channels
+        # so the SAME per-element arithmetic runs whether the channels
+        # came from replication or img_num distinct frames
+        self.mean_multi = jax.device_put(jnp.asarray(
+            np.tile(img_mean, self.img_num)))
+        self.std_multi = jax.device_put(jnp.asarray(
+            np.tile(img_std, self.img_num)))
+        self.compiled: Dict[Tuple[int, int], Any] = {}  # (bucket, chans)
+        self.golden: Optional[np.ndarray] = None
+        self.golden_ref: Optional[np.ndarray] = None
+        self.reload_count = 0
+        self.last_reload_key: Optional[Tuple[str, float, int]] = None
+        self.reload_attempts = 0           # torn_reload chaos step counter
+        self.watcher: Optional[threading.Thread] = None
+        self.warmed = False
+
+
 class _Staged:
-    __slots__ = ("requests", "out", "bucket", "dispatch_t", "seq")
+    __slots__ = ("requests", "out", "bucket", "dispatch_t", "seq",
+                 "model_id")
 
     def __init__(self, requests: List[Request], out: Any, bucket: int,
-                 dispatch_t: float, seq: int):
+                 dispatch_t: float, seq: int, model_id: str):
         self.requests = requests
         self.out = out
         self.bucket = bucket
         self.dispatch_t = dispatch_t
         self.seq = seq          # device-batch sequence (the chaos step)
+        self.model_id = model_id
 
 
 class InferenceEngine:
@@ -112,50 +188,43 @@ class InferenceEngine:
                  wire: str = "float32",
                  multi_frame: bool = True,
                  warmup: bool = True,
+                 dtype: str = "f32",
+                 model_id: str = "default",
                  watchdog_timeout_s: float = 30.0,
                  breaker_threshold: int = 5,
                  breaker_open_s: float = 5.0,
                  reload_drift_tol: float = -1.0,
                  retry_jitter_s: float = 2.0,
                  chaos=None):
-        self.model = model
-        self.image_size = int(image_size)
-        self.img_num = int(img_num)
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not self.buckets or self.buckets[0] < 1:
             raise ValueError(f"invalid buckets {buckets}")
         if wire not in ("float32", "uint8"):
             raise ValueError(f"wire must be float32|uint8, got {wire!r}")
         self.wire = wire
+        self._multi_frame_opt = bool(multi_frame)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         # real-compile observer: a silent recompile anywhere in the process
         # shows up in /metrics as backend_compiles_total growth (the
         # engine's own counter below only counts its AOT bucket builds)
         from .metrics import install_backend_compile_listener
         install_backend_compile_listener()
-        # host-side template for non-strict reload merging; the device copy
-        # is what executes
-        self._host_template = jax.tree.map(np.asarray, variables)
-        self._variables = jax.device_put(variables)
-        self._var_shapes = jax.tree.map(
-            lambda a: (tuple(np.shape(a)), np.asarray(a).dtype),
-            self._host_template)
-        self._compiled: Dict[int, Any] = {}
-        self._compiled_multi: Dict[int, Any] = {}
+        # the model table; insertion order is stable, the FIRST entry is
+        # the primary (default-routed) model
+        self._models: Dict[str, _ModelEntry] = {}
+        self.default_model_id = str(model_id)
         #: authoritative in-flight ledger — staged sub-batches live here
         #: from dispatch until completion, so the stuck-batch watchdog
         #: can read the oldest dispatch time even while the worker is
         #: blocked inside a completion
         self._pending: List[_Staged] = []
         self._pending_lock = threading.Lock()
-        self._reload_box: List[Tuple[Any, str]] = []   # [(host_tree, path)]
+        # reload box: latest submitted host tree per model id
+        self._reload_box: Dict[str, Tuple[Any, str]] = {}
         self._reload_lock = threading.Lock()
-        self._last_reload_key: Optional[Tuple[str, float, int]] = None
-        self.reload_count = 0
-        self._reload_attempts = 0          # torn_reload chaos step counter
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
-        self._watcher: Optional[threading.Thread] = None
+        self._watcher: Optional[threading.Thread] = None   # primary's
         self._batcher: Optional[MicroBatcher] = None
         # resilience: chaos injector, worker generations, breaker, watchdog
         self.chaos = chaos if chaos is not None else chaos_from_env()
@@ -174,92 +243,153 @@ class InferenceEngine:
         # watchdog thread forever in block_until_ready — run it bounded
         self._rewarm_timeout_s = max(30.0, 4.0 * float(watchdog_timeout_s))
         self._rewarm_thread: Optional[threading.Thread] = None
-        self._golden: Optional[np.ndarray] = None     # canary input batch
-        self._golden_ref: Optional[np.ndarray] = None  # current weights'
-        # scores on it (the drift baseline)
         self._canary_hook = None           # test seam: runs mid-canary
 
-        # Wire formats:
-        #
-        # * ``float32`` (default) — HTTP threads run the FULL CLI
-        #   preprocess (``params.normalize_replicate`` incl. ×img_num
-        #   replication) and ship normalized float32; the compiled program
-        #   is exactly the CLI's score fn, so server scores reproduce
-        #   ``runners/test.py`` bit-for-bit (tested).
-        # * ``uint8`` — HTTP threads ship the uint8 canvas and normalize +
-        #   replicate run inside the batched device call (the training
-        #   loader's device-prologue idiom): 4·img_num× less host→device
-        #   traffic — the deployment mode for real accelerators.  Mean/std
-        #   ride the call as ARGUMENTS (a constant divisor would be
-        #   strength-reduced to multiply-by-reciprocal, ~1 ulp off host
-        #   division), but cross-program fusion still allows ulp-level
-        #   drift vs the CLI, so this mode is "allclose", not bit-equal.
-        self._mean = jax.device_put(jnp.asarray(img_mean))
-        self._std = jax.device_put(jnp.asarray(img_std))
-        # multi-frame wire: mean/std tiled to the 3·img_num clip channels
-        # so the SAME per-element arithmetic runs whether the channels came
-        # from replication or from img_num distinct frames
-        self._mean_multi = jax.device_put(jnp.asarray(
-            np.tile(img_mean, self.img_num)))
-        self._std_multi = jax.device_put(jnp.asarray(
-            np.tile(img_std, self.img_num)))
-        n_rep = self.img_num
-        # uint8 wire with img_num == 1 needs no second program: a 1-frame
-        # "clip" IS the single-frame sample.  float32 wire never needs one
-        # (replicate and concat payloads share the (·, ·, 3·img_num)
-        # float32 shape, so the CLI-parity program serves both).
-        self.multi_frame = bool(multi_frame) and self.wire == "uint8" \
-            and self.img_num > 1
-
-        if self.wire == "uint8":
-            def _score(variables, x_u8, mean, std):
-                x = (x_u8.astype(jnp.float32) - mean) / std
-                if n_rep > 1:
-                    x = jnp.tile(x, (1, 1, 1, n_rep))
-                logits = self.model.apply(variables, x, training=False)
-                return jax.nn.softmax(logits, axis=-1)
-
-            def _score_multi(variables, x_u8, mean, std):
-                # x_u8 already carries img_num distinct frames channel-
-                # concatenated; normalize elementwise (tiled mean/std), no
-                # replication
-                x = (x_u8.astype(jnp.float32) - mean) / std
-                logits = self.model.apply(variables, x, training=False)
-                return jax.nn.softmax(logits, axis=-1)
-        else:
-            def _score(variables, x):
-                logits = self.model.apply(variables, x, training=False)
-                return jax.nn.softmax(logits, axis=-1)
-
-            _score_multi = None
-
-        self._score = _score
-        self._score_multi = _score_multi
+        self.add_model(self.default_model_id, model, variables,
+                       image_size=image_size, img_num=img_num, dtype=dtype)
         if warmup:
             self.warmup()
 
+    # ------------------------------------------------------------------
+    # model table
+    # ------------------------------------------------------------------
+    def add_model(self, model_id: str, model, variables, *,
+                  image_size: Optional[int] = None,
+                  img_num: Optional[int] = None,
+                  dtype: str = "f32") -> None:
+        """Register one more model in the table.  Readiness DROPS until
+        :meth:`warmup` has AOT-compiled + warmed the new entry's buckets
+        — a cold model must never be routable behind a ready /readyz."""
+        model_id = str(model_id)
+        # table mutation rides the recovery lock: the watchdog's
+        # recovery (and its re-warm probe) iterates this dict from
+        # another thread
+        with self._recover_lock:
+            if model_id in self._models:
+                raise ValueError(
+                    f"model id {model_id!r} already registered")
+            primary = next(iter(self._models.values()), None)
+            entry = _ModelEntry(
+                model_id, model, variables,
+                image_size=(image_size if image_size is not None
+                            else (primary.image_size if primary
+                                  else image_max_height)),
+                img_num=(img_num if img_num is not None
+                         else (primary.img_num if primary
+                               else _default_img_num)),
+                dtype=dtype, wire=self.wire,
+                multi_frame=self._multi_frame_opt)
+            self._models[model_id] = entry
+        if entry.dtype != "f32":
+            _logger.info("model %r quantized to %s: %s", model_id,
+                         entry.dtype, quant_summary(entry.variables))
+        self.metrics.ready = False         # one cold model => not ready
+
+    def entry(self, model_id: Optional[str] = None) -> _ModelEntry:
+        """The table entry for ``model_id`` (None = primary); unknown ids
+        are a loud error, never a fallback to some other model."""
+        if model_id is None:
+            model_id = self.default_model_id
+        try:
+            return self._models[model_id]
+        except KeyError:
+            raise ValueError(
+                f"unknown model {model_id!r}; this engine serves "
+                f"{self.model_ids()}") from None
+
+    def has_model(self, model_id: str) -> bool:
+        return model_id in self._models
+
+    def model_ids(self) -> Tuple[str, ...]:
+        return tuple(self._models)
+
+    # --- single-model back-compat surface (primary entry) -------------
     @property
-    def _wire_spec(self) -> Tuple[int, Any]:
+    def model(self):
+        return self.entry().model
+
+    @property
+    def image_size(self) -> int:
+        return self.entry().image_size
+
+    @property
+    def img_num(self) -> int:
+        return self.entry().img_num
+
+    @property
+    def multi_frame(self) -> bool:
+        return self.entry().multi_frame
+
+    @property
+    def _variables(self):
+        return self.entry().variables
+
+    @property
+    def _host_template(self):
+        return self.entry().host_template
+
+    @property
+    def reload_count(self) -> int:
+        return sum(e.reload_count for e in self._models.values())
+
+    # ------------------------------------------------------------------
+    # wire / program shapes
+    # ------------------------------------------------------------------
+    def _entry_wire_spec(self, entry: _ModelEntry) -> Tuple[int, Any]:
         """(channels, dtype) of one SINGLE-frame wire sample."""
         if self.wire == "uint8":
             return 3, np.uint8
-        return 3 * self.img_num, np.float32
+        return 3 * entry.img_num, np.float32
 
-    def allowed_chans(self) -> Tuple[int, ...]:
-        """Channel counts a request array may carry on this wire."""
-        base, _ = self._wire_spec
-        if self.multi_frame:
-            return (base, 3 * self.img_num)
+    @property
+    def _wire_spec(self) -> Tuple[int, Any]:
+        return self._entry_wire_spec(self.entry())
+
+    def _entry_chans(self, entry: _ModelEntry) -> Tuple[int, ...]:
+        """Channel widths this entry compiles (one program per width per
+        bucket): the single-frame wire width plus, on a multi-frame uint8
+        wire, the channel-concatenated clip width."""
+        base, _ = self._entry_wire_spec(entry)
+        if entry.multi_frame:
+            return (base, 3 * entry.img_num)
         return (base,)
 
-    def _run(self, bucket: int, variables, x, multi: bool = False):
+    def allowed_chans(self, model_id: Optional[str] = None
+                      ) -> Tuple[int, ...]:
+        """Channel counts a request array may carry on this wire."""
+        return self._entry_chans(self.entry(model_id))
+
+    def _make_program(self, entry: _ModelEntry, chans: int):
+        """The traced score function for one (model, channel-width): the
+        uint8 wire fuses normalize (+ replicate) with the model, and
+        quantized params dequantize in-trace (realize_tree — a no-op at
+        f32, preserving the CLI bit-parity contract)."""
+        model, n_rep = entry.model, entry.img_num
         if self.wire == "uint8":
-            if multi:
-                return self._compiled_multi[bucket](
-                    variables, x, self._mean_multi, self._std_multi)
-            return self._compiled[bucket](variables, x, self._mean,
-                                          self._std)
-        return self._compiled[bucket](variables, x)
+            replicate = (chans == 3 and n_rep > 1)
+
+            def _score(variables, x_u8, mean, std):
+                x = (x_u8.astype(jnp.float32) - mean) / std
+                if replicate:
+                    x = jnp.tile(x, (1, 1, 1, n_rep))
+                logits = model.apply(realize_tree(variables), x,
+                                     training=False)
+                return jax.nn.softmax(logits, axis=-1)
+        else:
+            def _score(variables, x):
+                logits = model.apply(realize_tree(variables), x,
+                                     training=False)
+                return jax.nn.softmax(logits, axis=-1)
+        return _score
+
+    def _run(self, entry: _ModelEntry, bucket: int, chans: int,
+             variables, x):
+        ex = entry.compiled[(bucket, chans)]
+        if self.wire == "uint8":
+            if chans == 3:
+                return ex(variables, x, entry.mean, entry.std)
+            return ex(variables, x, entry.mean_multi, entry.std_multi)
+        return ex(variables, x)
 
     # ------------------------------------------------------------------
     # compile cache
@@ -273,109 +403,117 @@ class InferenceEngine:
         return self.metrics.ready
 
     def warmup(self) -> None:
-        """AOT-compile every bucket (plus, on a multi-frame uint8 wire,
-        every bucket's multi-frame executable) and execute each once
-        (primes any first-run allocation paths), then flip ready."""
-        s = self.image_size
-        chans, dtype = self._wire_spec
-        for b in self.buckets:
-            if b in self._compiled:
-                continue
-            t0 = time.monotonic()
-            x_spec = jax.ShapeDtypeStruct((b, s, s, chans),
-                                          jnp.dtype(dtype))
-            # per-bucket AOT lowering is the POINT of this loop: one
-            # deliberate compile per declared bucket at warmup, counted in
-            # compiles_total, zero recompiles after ready
-            if self.wire == "uint8":
-                lowered = jax.jit(self._score).lower(  # dfdlint: disable=DFD004
-                    self._variables, x_spec, self._mean, self._std)
-            else:
-                lowered = jax.jit(self._score).lower(self._variables,  # dfdlint: disable=DFD004
-                                                     x_spec)
-            self._compiled[b] = lowered.compile()
-            self.metrics.compiles_total.inc()
-            out = self._run(b, self._variables,
-                            jnp.zeros((b, s, s, chans), dtype))
-            jax.block_until_ready(out)
-            _logger.info("bucket %d compiled + warmed in %.1fs", b,
-                         time.monotonic() - t0)
-        if self.multi_frame:
-            mchans = 3 * self.img_num
+        """AOT-compile every (model, bucket, chans) executable and execute
+        each once (primes any first-run allocation paths), then flip
+        ready.  Idempotent per entry: adding a model to a warmed engine
+        only compiles the new entry's programs."""
+        gen = self._gen
+        # snapshot: a concurrent add_model may grow the table mid-loop
+        for entry in list(self._models.values()):
+            self._warm_entry(entry)
+        # the live add_model path runs this on the caller's thread while
+        # the watchdog (or a reload canary) may be mid-recovery: only the
+        # generation that was current for the WHOLE warmup may declare
+        # readiness — a recovery in between owns the flag (its own
+        # re-warm proves the device before it restores ready)
+        with self._recover_lock:
+            if gen == self._gen:
+                self.metrics.ready = True
+
+    def _warm_entry(self, entry: _ModelEntry) -> None:
+        s = entry.image_size
+        _, dtype = self._entry_wire_spec(entry)
+        for chans in self._entry_chans(entry):
             for b in self.buckets:
-                if b in self._compiled_multi:
+                if (b, chans) in entry.compiled:
                     continue
                 t0 = time.monotonic()
-                x_spec = jax.ShapeDtypeStruct((b, s, s, mchans),
-                                              jnp.dtype(np.uint8))
-                # same deliberate per-bucket AOT warmup as above
-                lowered = jax.jit(self._score_multi).lower(  # dfdlint: disable=DFD004
-                    self._variables, x_spec, self._mean_multi,
-                    self._std_multi)
-                self._compiled_multi[b] = lowered.compile()
+                x_spec = jax.ShapeDtypeStruct((b, s, s, chans),
+                                              jnp.dtype(dtype))
+                fn = self._make_program(entry, chans)
+                # per-bucket AOT lowering is the POINT of this loop: one
+                # deliberate compile per declared (model, bucket, chans)
+                # at warmup, counted in compiles_total, zero recompiles
+                # after ready
+                if self.wire == "uint8":
+                    mean, std = (entry.mean, entry.std) if chans == 3 \
+                        else (entry.mean_multi, entry.std_multi)
+                    lowered = jax.jit(fn).lower(  # dfdlint: disable=DFD004
+                        entry.variables, x_spec, mean, std)
+                else:
+                    lowered = jax.jit(fn).lower(entry.variables,  # dfdlint: disable=DFD004
+                                                x_spec)
+                entry.compiled[(b, chans)] = lowered.compile()
                 self.metrics.compiles_total.inc()
-                out = self._run(b, self._variables,
-                                jnp.zeros((b, s, s, mchans), np.uint8),
-                                multi=True)
+                out = self._run(entry, b, chans, entry.variables,
+                                jnp.zeros((b, s, s, chans), dtype))
                 jax.block_until_ready(out)
-                _logger.info("bucket %d (multi-frame) compiled + warmed "
-                             "in %.1fs", b, time.monotonic() - t0)
+                _logger.info("model %r bucket %d (%dch) compiled + "
+                             "warmed in %.1fs", entry.model_id, b, chans,
+                             time.monotonic() - t0)
         # golden canary batch: a fixed seeded input whose scores under the
         # CURRENT weights baseline both the reload canary and (optionally)
         # its drift tolerance
-        if self._golden is None:
+        if entry.golden is None:
             b0 = self.buckets[0]
+            chans, dtype = self._entry_wire_spec(entry)
             rng = np.random.default_rng(0xCA9A87)
             if np.dtype(dtype) == np.uint8:
-                self._golden = rng.integers(0, 256, (b0, s, s, chans),
+                entry.golden = rng.integers(0, 256, (b0, s, s, chans),
                                             dtype=np.uint8)
             else:
-                self._golden = rng.random((b0, s, s, chans),
+                entry.golden = rng.random((b0, s, s, chans),
                                           dtype=np.float32)
-        self._golden_ref = np.asarray(
-            self._run(self.buckets[0], self._variables, self._golden))
-        self.metrics.ready = True
+        chans, _ = self._entry_wire_spec(entry)
+        entry.golden_ref = np.asarray(
+            self._run(entry, self.buckets[0], chans, entry.variables,
+                      entry.golden))
+        entry.warmed = True
 
     def _rewarm(self) -> None:
-        """Execute every AOT bucket once against the serving weights (the
-        recovery path's proof that the device answers again).  Runs the
-        EXISTING compiled executables — a recovery never recompiles, which
-        is what lets chaos_serve assert zero post-recovery backend
-        compiles."""
-        s = self.image_size
-        chans, dtype = self._wire_spec
-        for b in self.buckets:
-            jax.block_until_ready(self._run(
-                b, self._variables, jnp.zeros((b, s, s, chans), dtype)))
-        if self.multi_frame:
-            mchans = 3 * self.img_num
-            for b in self.buckets:
-                jax.block_until_ready(self._run(
-                    b, self._variables,
-                    jnp.zeros((b, s, s, mchans), np.uint8), multi=True))
+        """Execute every AOT (model, bucket, chans) executable once
+        against the serving weights (the recovery path's proof that the
+        device answers again).  Runs the EXISTING compiled executables —
+        a recovery never recompiles, which is what lets chaos_serve
+        assert zero post-recovery backend compiles.  Snapshot the table:
+        a timed-out recovery releases _recover_lock while this probe is
+        still running, so a live add_model may grow the dict mid-loop
+        (the new entry's own warmup proves it; this probe owes it
+        nothing)."""
+        for entry in list(self._models.values()):
+            if not entry.warmed:
+                continue       # cold add_model entry: no executables yet
+            s = entry.image_size
+            _, dtype = self._entry_wire_spec(entry)
+            for chans in self._entry_chans(entry):
+                for b in self.buckets:
+                    jax.block_until_ready(self._run(
+                        entry, b, chans, entry.variables,
+                        jnp.zeros((b, s, s, chans), dtype)))
         self.metrics.rewarms_total.inc()
 
     # ------------------------------------------------------------------
     # scoring
     # ------------------------------------------------------------------
-    def _chans_of(self, array) -> int:
+    def _chans_of(self, entry: _ModelEntry, array) -> int:
         """Wire channel count of one request array, validated against the
-        engine's compiled programs (unknown widths must fail loudly here,
+        entry's compiled programs (unknown widths must fail loudly here,
         never reach an uncompiled shape)."""
         chans = int(np.shape(array)[-1]) if np.ndim(array) else 0
-        if chans not in self.allowed_chans():
+        if chans not in self._entry_chans(entry):
             raise ValueError(
-                f"request carries {chans} channels; this engine accepts "
-                f"{self.allowed_chans()} (wire={self.wire}, "
-                f"img_num={self.img_num}, multi_frame={self.multi_frame})")
+                f"request carries {chans} channels; model "
+                f"{entry.model_id!r} accepts {self._entry_chans(entry)} "
+                f"(wire={self.wire}, img_num={entry.img_num}, "
+                f"multi_frame={entry.multi_frame})")
         return chans
 
-    def _pad_batch(self, arrays: List[np.ndarray],
+    def _pad_batch(self, entry: _ModelEntry, arrays: List[np.ndarray],
                    chans: int) -> Tuple[np.ndarray, int]:
         n = len(arrays)
         bucket = pick_bucket(n, self.buckets)
-        s = self.image_size
-        _, dtype = self._wire_spec
+        s = entry.image_size
+        _, dtype = self._entry_wire_spec(entry)
         # fresh buffer every batch: jax CPU device_put zero-copies aligned
         # host memory, so reusing one buffer would race the still-executing
         # previous batch (same hazard data/loader.py guards with
@@ -385,54 +523,69 @@ class InferenceEngine:
             buf[i] = a
         return buf, bucket
 
-    def _is_multi(self, chans: int) -> bool:
-        return self.multi_frame and chans == 3 * self.img_num
-
-    def score_batch(self, arrays: List[np.ndarray]) -> np.ndarray:
+    def score_batch(self, arrays: List[np.ndarray],
+                    model_id: Optional[str] = None) -> np.ndarray:
         """Synchronous scoring of up to max-bucket wire-format samples
-        (tests, warm checks); one uniform channel width per call — the
-        serving path goes through stage/complete instead and may mix."""
-        chans = self._chans_of(arrays[0])
+        (tests, warm checks) against one model; one uniform channel width
+        per call — the serving path goes through stage/complete instead
+        and may mix widths and models."""
+        entry = self.entry(model_id)
+        chans = self._chans_of(entry, arrays[0])
         for a in arrays[1:]:
-            if self._chans_of(a) != chans:
+            if self._chans_of(entry, a) != chans:
                 raise ValueError("score_batch arrays must share one "
                                  "channel width; the async path handles "
                                  "mixed single/multi-frame traffic")
-        buf, bucket = self._pad_batch(arrays, chans)
-        out = self._run(bucket, self._variables, jax.device_put(buf),
-                        multi=self._is_multi(chans))
+        buf, bucket = self._pad_batch(entry, arrays, chans)
+        out = self._run(entry, bucket, chans, entry.variables,
+                        jax.device_put(buf))
         return np.asarray(out)[:len(arrays)]
 
     def _stage(self, requests: List[Request]) -> List[_Staged]:
-        """Dispatch requests as one device batch per channel width.
+        """Dispatch requests as one device batch per (model, channel
+        width).
 
-        Single-frame and multi-frame requests ride different compiled
-        programs, so a coalesced batch that mixes them splits into (at
-        most two) staged sub-batches — each still a pre-compiled bucket,
-        dispatched back-to-back so both overlap the previous batch's
-        completion.  Every sub-batch enters the ``_pending`` ledger at
-        dispatch so the watchdog sees its age."""
-        groups: Dict[int, List[Request]] = {}
+        Requests for different models (or different frame layouts) ride
+        different compiled programs, so a coalesced batch that mixes them
+        splits into staged sub-batches — each still a pre-compiled
+        bucket, dispatched back-to-back so all overlap the previous
+        batch's completion.  Every sub-batch enters the ``_pending``
+        ledger at dispatch so the watchdog sees its age."""
+        groups: Dict[Tuple[str, int], List[Request]] = {}
         for r in requests:
-            groups.setdefault(self._chans_of(r.array), []).append(r)
+            # per-request validation: an unknown model id or channel
+            # width (possible on direct library submits — the HTTP edge
+            # pre-validates) must fail THAT request, never the whole
+            # coalesced batch (which would 500 innocent riders and feed
+            # the circuit breaker a non-device failure)
+            try:
+                entry = self.entry(r.model_id)
+                key = (entry.model_id, self._chans_of(entry, r.array))
+            except ValueError as e:
+                if r.claim():
+                    self.metrics.failed_total.inc()
+                    self.metrics.count_model("failed", r.model_id)
+                    r.set_exception(e)
+                continue
+            groups.setdefault(key, []).append(r)
         staged: List[_Staged] = []
         try:
-            for chans, grp in groups.items():
+            for (model_id, chans), grp in groups.items():
+                entry = self._models[model_id]
                 seq = self._batch_seq
                 self._batch_seq += 1
                 if self.chaos.active and self.chaos.fires("serve_exc", seq):
                     self.metrics.count_chaos("serve_exc")
                     raise RuntimeError(
                         f"chaos: injected score-fn exception (batch {seq})")
-                buf, bucket = self._pad_batch([r.array for r in grp],
-                                              chans)
-                out = self._run(bucket, self._variables,
-                                jax.device_put(buf),
-                                multi=self._is_multi(chans))
+                buf, bucket = self._pad_batch(
+                    entry, [r.array for r in grp], chans)
+                out = self._run(entry, bucket, chans, entry.variables,
+                                jax.device_put(buf))
                 now = time.monotonic()
                 for r in grp:
                     r.timings["queue"] = now - r.enqueue_t
-                st = _Staged(grp, out, bucket, now, seq)
+                st = _Staged(grp, out, bucket, now, seq, model_id)
                 # gauge bump + ledger entry are ONE atom vs the recovery
                 # path (which zeroes the gauge and clears the ledger under
                 # the same lock) — split, a recovery landing between them
@@ -501,6 +654,8 @@ class InferenceEngine:
         m.batches_total.inc()
         m.batch_rows_total.inc(n)
         m.padded_rows_total.inc(staged.bucket - n)
+        m.count_bucket_rows(staged.model_id, staged.bucket, n,
+                            staged.bucket - n)
         m.latency["device"].observe(device_dt)
         m.count_completion(n, now)
         for i, r in enumerate(staged.requests):
@@ -508,6 +663,7 @@ class InferenceEngine:
             m.latency["queue"].observe(r.timings.get("queue", 0.0))
             if r.claim():
                 m.scored_total.inc()
+                m.count_model("scored", r.model_id)
                 r.set_result(scores[i])
         self.breaker.record_success()
 
@@ -515,6 +671,7 @@ class InferenceEngine:
         for r in requests:
             if r.claim():
                 self.metrics.failed_total.inc()
+                self.metrics.count_model("failed", r.model_id)
                 r.set_exception(err)
 
     # ------------------------------------------------------------------
@@ -640,6 +797,8 @@ class InferenceEngine:
     def start(self, batcher: MicroBatcher) -> None:
         assert self._batcher is None, "engine already started"
         self._batcher = batcher
+        # unrouted submits land on the primary model's books
+        batcher.default_model_id = self.default_model_id
         self._spawn_worker()
         self.watchdog.start()
 
@@ -669,9 +828,9 @@ class InferenceEngine:
     def _recover(self, reason: str) -> None:
         """Watchdog-thread recovery: fail everything in flight, retire the
         current worker generation, prove the device answers by re-warming
-        every AOT bucket (readiness stays FALSE until it does), then start
-        a fresh worker.  Zero recompiles by construction — the bucket
-        executables survive the restart."""
+        every AOT bucket of every model (readiness stays FALSE until it
+        does), then start a fresh worker.  Zero recompiles by
+        construction — the bucket executables survive the restart."""
         with self._recover_lock:
             if self._stop.is_set():
                 return
@@ -683,7 +842,8 @@ class InferenceEngine:
                 return
             _logger.error("engine recovery (%s): failing in-flight "
                           "requests, restarting worker, re-warming %d "
-                          "bucket(s)", reason, len(self.buckets))
+                          "bucket(s) x %d model(s)", reason,
+                          len(self.buckets), len(self._models))
             self.metrics.ready = False
             self.metrics.watchdog_recoveries_total.inc()
             self.breaker.record_failure()
@@ -739,17 +899,27 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # hot weight reload
     # ------------------------------------------------------------------
-    def submit_reload(self, host_tree: Any, source: str = "<api>") -> None:
-        """Queue a host-side variable tree for an atomic between-batch swap
-        (called by the watcher thread, or directly in tests)."""
+    def submit_reload(self, host_tree: Any, source: str = "<api>",
+                      model_id: Optional[str] = None) -> None:
+        """Queue a host-side f32 variable tree for an atomic between-batch
+        swap of one model's weights (called by the watcher threads, or
+        directly in tests)."""
+        if model_id is None:
+            model_id = self.default_model_id
         with self._reload_lock:
-            self._reload_box = [(host_tree, source)]
+            self._reload_box[model_id] = (host_tree, source)
 
     def _maybe_apply_reload(self) -> None:
         with self._reload_lock:
             if not self._reload_box:
                 return
-            host_tree, source = self._reload_box.pop()
+            model_id, (host_tree, source) = self._reload_box.popitem()
+        try:
+            entry = self.entry(model_id)
+        except ValueError:
+            _logger.error("reload for unknown model %r dropped", model_id)
+            self.metrics.reload_errors_total.inc()
+            return
         # Readiness must not lie while the canary runs: the worker thread
         # is busy proving the candidate weights, not dispatching batches,
         # so /readyz drops for the canary window (/healthz stays up) and
@@ -768,62 +938,76 @@ class InferenceEngine:
                 shapes = jax.tree.map(
                     lambda a: (tuple(np.shape(a)), np.asarray(a).dtype),
                     host_tree)
-                if shapes != self._var_shapes:
-                    raise ValueError("checkpoint tree/shape mismatch vs "
-                                     "the serving model")
-                new_vars = jax.device_put(host_tree)
-                canary = self._canary_scores(new_vars)
+                if shapes != entry.var_shapes:
+                    # a checkpoint of some OTHER model's tree lands here
+                    # too: cross-model swaps are rejected loudly, never
+                    # silently served
+                    raise ValueError(
+                        f"checkpoint tree/shape mismatch vs serving "
+                        f"model {entry.model_id!r}")
+                # the serving copy is quantized; the canary then gates
+                # the QUANTIZED candidate — a quantization-broken swap
+                # (NaN after dequant, drifted scores) rolls back here
+                new_vars = jax.device_put(
+                    quantize_tree(host_tree, entry.dtype))
+                canary = self._canary_scores(entry, new_vars)
             except Exception:                      # noqa: BLE001
-                _logger.exception("hot reload from %s rejected; previous "
-                                  "weights keep serving", source)
+                _logger.exception("hot reload of model %r from %s "
+                                  "rejected; previous weights keep "
+                                  "serving", entry.model_id, source)
                 self.metrics.reload_errors_total.inc()
                 return
             with self._recover_lock:   # serialize the commit vs recovery
                 if gen != self._gen:
-                    self.submit_reload(host_tree, source)   # retry fresh
+                    self.submit_reload(host_tree, source,
+                                       model_id=model_id)   # retry fresh
                     return
-                self._variables = new_vars
+                entry.variables = new_vars
                 if canary is not None:
-                    self._golden_ref = canary      # new drift baseline
-                self.reload_count += 1
+                    entry.golden_ref = canary      # new drift baseline
+                entry.reload_count += 1
             self.metrics.reloads_total.inc()
-            _logger.info("hot-reloaded weights from %s (reload #%d)",
-                         source, self.reload_count)
+            self.metrics.count_model("reloads", entry.model_id)
+            _logger.info("hot-reloaded model %r weights from %s "
+                         "(reload #%d)", entry.model_id, source,
+                         entry.reload_count)
         finally:
             with self._recover_lock:
                 if gen == self._gen:
                     self.metrics.ready = was_ready
 
-    def _canary_scores(self, new_vars) -> Optional[np.ndarray]:
+    def _canary_scores(self, entry: _ModelEntry,
+                       new_vars) -> Optional[np.ndarray]:
         """Golden-batch canary: the candidate weights must produce finite,
         shape-correct scores — and, when ``reload_drift_tol`` >= 0, scores
         within that tolerance of the serving weights' on the SAME input —
         before they may serve.  Raises on any violation (the caller
         rejects and rolls back to the serving set).  Doubles as the aval-
         compatibility probe: it executes a compiled bucket with the new
-        params, so a dtype drift fails here, not on live traffic."""
-        if self._golden is None:                   # warmup=False engines
-            chans, dtype = self._wire_spec
+        (quantized) params, so a dtype drift fails here, not on live
+        traffic."""
+        chans, dtype = self._entry_wire_spec(entry)
+        if entry.golden is None:                   # warmup=False engines
+            s = entry.image_size
             probe = self._run(
-                self.buckets[0], new_vars,
-                jnp.zeros((self.buckets[0], self.image_size,
-                           self.image_size, chans), dtype))
+                entry, self.buckets[0], chans, new_vars,
+                jnp.zeros((self.buckets[0], s, s, chans), dtype))
             jax.block_until_ready(probe)
             return None
-        canary = np.asarray(self._run(self.buckets[0], new_vars,
-                                      self._golden))
-        if self._golden_ref is not None and \
-                canary.shape != self._golden_ref.shape:
+        canary = np.asarray(self._run(entry, self.buckets[0], chans,
+                                      new_vars, entry.golden))
+        if entry.golden_ref is not None and \
+                canary.shape != entry.golden_ref.shape:
             self.metrics.reload_canary_failures_total.inc()
             raise ValueError(
                 f"canary: golden-batch scores have shape {canary.shape}, "
-                f"serving weights produce {self._golden_ref.shape}")
+                f"serving weights produce {entry.golden_ref.shape}")
         if not np.isfinite(canary).all():
             self.metrics.reload_canary_failures_total.inc()
             raise ValueError("canary: candidate weights produce "
                              "non-finite scores on the golden batch")
-        if self.reload_drift_tol >= 0 and self._golden_ref is not None:
-            drift = float(np.max(np.abs(canary - self._golden_ref)))
+        if self.reload_drift_tol >= 0 and entry.golden_ref is not None:
+            drift = float(np.max(np.abs(canary - entry.golden_ref)))
             if drift > self.reload_drift_tol:
                 self.metrics.reload_canary_failures_total.inc()
                 raise ValueError(
@@ -855,15 +1039,16 @@ class InferenceEngine:
         return best
 
     def _watch_loop(self, ckpt_dir: str, interval_s: float,
-                    use_ema: bool) -> None:
+                    use_ema: bool, model_id: str) -> None:
         from ..models.helpers import load_checkpoint
+        entry = self.entry(model_id)
         while not self._stop.wait(interval_s):
             newest = self._newest_checkpoint(ckpt_dir)
-            if newest is None or newest == self._last_reload_key:
+            if newest is None or newest == entry.last_reload_key:
                 continue
             path = load_path = newest[0]
-            seq = self._reload_attempts
-            self._reload_attempts += 1
+            seq = entry.reload_attempts
+            entry.reload_attempts += 1
             if self.chaos.active and self.chaos.fires("torn_reload", seq):
                 # route the load through a half-truncated copy so the
                 # REAL torn-msgpack rejection (CheckpointCorrupt naming
@@ -873,18 +1058,18 @@ class InferenceEngine:
                 _logger.error("chaos: reloading torn checkpoint copy %s",
                               load_path)
             try:
-                loaded = load_checkpoint(self._host_template, load_path,
+                loaded = load_checkpoint(entry.host_template, load_path,
                                          use_ema=use_ema, strict=False)
             except Exception:                      # noqa: BLE001
-                _logger.exception("reload watcher: cannot load %s; "
+                _logger.exception("reload watcher (%s): cannot load %s; "
                                   "previous weights keep serving",
-                                  load_path)
+                                  entry.model_id, load_path)
                 self.metrics.reload_errors_total.inc()
                 if load_path == path:
                     # don't re-log a genuinely corrupt file every tick —
                     # but a chaos-torn COPY leaves the real file untried,
                     # so the next tick retries it clean (fire-once)
-                    self._last_reload_key = newest
+                    entry.last_reload_key = newest
                 continue
             finally:
                 if load_path != path:
@@ -892,20 +1077,28 @@ class InferenceEngine:
                         os.unlink(load_path)
                     except OSError:
                         pass
-            self._last_reload_key = newest
-            self.submit_reload(loaded, source=path)
+            entry.last_reload_key = newest
+            self.submit_reload(loaded, source=path,
+                               model_id=entry.model_id)
 
     def start_reload_watcher(self, ckpt_dir: str, interval_s: float = 5.0,
-                             use_ema: bool = False) -> None:
+                             use_ema: bool = False,
+                             model_id: Optional[str] = None) -> None:
         """Poll ``ckpt_dir`` for new ``models/helpers.py`` checkpoints and
-        hot-swap them in.  Writers must rename atomically into place (the
-        repo's ``save_model_checkpoint`` does)."""
-        assert self._watcher is None, "watcher already started"
+        hot-swap them into ``model_id``'s slot (None = the primary
+        model).  Writers must rename atomically into place (the repo's
+        ``save_model_checkpoint`` does)."""
+        entry = self.entry(model_id)
+        assert entry.watcher is None, \
+            f"watcher already started for model {entry.model_id!r}"
         # remember the current newest so only files appearing AFTER start
         # trigger a reload (the serving checkpoint itself usually lives in
         # the watched dir)
-        self._last_reload_key = self._newest_checkpoint(ckpt_dir)
-        self._watcher = threading.Thread(
-            target=self._watch_loop, args=(ckpt_dir, interval_s, use_ema),
-            name="serving-reload-watcher", daemon=True)
-        self._watcher.start()
+        entry.last_reload_key = self._newest_checkpoint(ckpt_dir)
+        entry.watcher = threading.Thread(
+            target=self._watch_loop,
+            args=(ckpt_dir, interval_s, use_ema, entry.model_id),
+            name=f"serving-reload-watcher-{entry.model_id}", daemon=True)
+        if entry.model_id == self.default_model_id:
+            self._watcher = entry.watcher      # single-model back-compat
+        entry.watcher.start()

@@ -17,12 +17,18 @@ Endpoints:
   replicated ×``img_num`` (the reference CLI's semantics); ``img_num``
   distinct frames are channel-concatenated into one temporal clip — and
   a clip of identical frames scores bit-identically to the replicate
-  path (tests/test_serving.py).  Responds ``{"fake_score": p, "scores":
-  [...], "frames": n, "timings_ms": {...}}``; 400 undecodable or a frame
-  count other than 1/``img_num``, 429 + jittered ``Retry-After`` when
-  load-shedding, 503 before warmup / while the circuit breaker is open /
-  when the batch produced non-finite scores or was abandoned by the
-  watchdog, 504 past the request deadline.
+  path (tests/test_serving.py).  On a multi-model engine a ``model``
+  JSON field or ``?model=`` query param routes to one entry of the model
+  table (unknown id = 400 listing the table); no ``model`` defaults to
+  the flagship — or, when a cascade is configured, to student-first
+  triage (suspects escalate to the flagship, the response then carries a
+  ``cascade`` object with tier/student_score).  Responds
+  ``{"fake_score": p, "scores": [...], "frames": n, "model": id,
+  "timings_ms": {...}}``; 400 undecodable or a frame count other than
+  1/``img_num``, 429 + jittered ``Retry-After`` when load-shedding, 503
+  before warmup / while the circuit breaker is open / when the batch
+  produced non-finite scores or was abandoned by the watchdog, 504 past
+  the request deadline.
 * ``GET /healthz`` — process liveness (200 while the process serves,
   INCLUDING during recovery re-warms — only readiness drops).
 * ``GET /readyz`` — 200 only while every bucket is compiled+warmed AND
@@ -40,6 +46,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
+from urllib.parse import parse_qs
 
 import numpy as np
 from PIL import Image
@@ -119,12 +126,15 @@ class ServingServer(ThreadingHTTPServer):
 
     def __init__(self, addr: Tuple[str, int], engine: InferenceEngine,
                  batcher: MicroBatcher, metrics: ServingMetrics,
-                 request_timeout_s: float = 2.0):
+                 request_timeout_s: float = 2.0, cascade=None):
         super().__init__(addr, _Handler)
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
         self.request_timeout_s = float(request_timeout_s)
+        #: optional serving/cascade.py CascadeRouter: when set, requests
+        #: with no explicit ``model`` run student-first triage
+        self.cascade = cascade
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -194,16 +204,19 @@ class _Handler(BaseHTTPRequestHandler):
         return self.rfile.read(length)
 
     @staticmethod
-    def _decode_frames(body: bytes,
-                       ctype_full: str) -> Optional[list]:
-        """Body bytes → list of uint8 RGB arrays (one per frame), or None
-        if any frame is undecodable."""
+    def _decode_frames(body: bytes, ctype_full: str
+                       ) -> Tuple[Optional[list], Optional[str]]:
+        """Body bytes → (list of uint8 RGB frame arrays, JSON ``model``
+        routing field); (None, _) if any frame is undecodable."""
         ctype = ctype_full.split(";")[0].strip()
+        model = None
         if ctype == "application/json":
             try:
                 payload = json.loads(body)
                 if not isinstance(payload, dict):
-                    return None
+                    return None, None
+                m = payload.get("model")
+                model = m if isinstance(m, str) and m else None
                 if "frames_b64" in payload:
                     blobs = [base64.b64decode(b, validate=True)
                              for b in payload["frames_b64"]]
@@ -211,11 +224,11 @@ class _Handler(BaseHTTPRequestHandler):
                     b64 = payload.get("image_b64") or payload.get("image")
                     blobs = [base64.b64decode(b64, validate=True)]
             except (ValueError, TypeError, KeyError):
-                return None
+                return None, model
         elif ctype.startswith("multipart/"):
             boundary = multipart_boundary(ctype_full)
             if not boundary:
-                return None
+                return None, None
             blobs = split_multipart(body, boundary)
         else:
             blobs = [body]
@@ -225,22 +238,45 @@ class _Handler(BaseHTTPRequestHandler):
                 img = Image.open(io.BytesIO(blob))
                 frames.append(np.asarray(img.convert("RGB"), np.uint8))
             except Exception:                      # noqa: BLE001 — 400 path
-                return None
-        return frames or None
+                return None, model
+        return frames or None, model
+
+    @staticmethod
+    def _payload_for(srv, entry, frames: list):
+        """Frames → one wire payload for ``entry`` (its canvas size, its
+        img_num): the float32 wire runs the full CLI preprocess on the
+        handler thread, the uint8 wire ships the canvas and defers the
+        photometrics to the device prologue.  One frame replicates
+        ×img_num (reference CLI semantics), img_num distinct frames
+        concatenate into one temporal clip.  Raises ValueError for a
+        clip this entry can't take (the 400 path)."""
+        canvases = [prepare_canvas(f, entry.image_size) for f in frames]
+        if srv.engine.wire == "float32":
+            if len(canvases) == 1:
+                return normalize_replicate(canvases[0], entry.img_num)
+            return normalize_concat(canvases)
+        if len(canvases) == 1:
+            return canvases[0]
+        if not entry.multi_frame:
+            raise ValueError(f"multi-frame clips are disabled for model "
+                             f"{entry.model_id!r} on this uint8-wire "
+                             f"engine")
+        return np.concatenate(canvases, axis=-1)
 
     def do_POST(self) -> None:                    # noqa: N802 (stdlib API)
         t0 = time.monotonic()
         body = self._read_body()        # always drain before responding
         t_body = time.monotonic()       # preprocess stage must not bill a
-        path = self.path.split("?", 1)[0]       # slow client's socket time
+        path, _, query = self.path.partition("?")   # slow client's socket
         if path != "/score":
             self._respond_json(404, {"error": f"no route {path!r}"})
             return
         srv = self.server
         if not srv.engine.ready:
-            # warming up, or the watchdog is re-warming buckets after a
-            # recovery, or a reload canary is in flight — /healthz stays
-            # 200 throughout, only readiness drops
+            # warming up (any model of the table still cold), or the
+            # watchdog is re-warming buckets after a recovery, or a
+            # reload canary is in flight — /healthz stays 200 throughout,
+            # only readiness drops
             self._respond_json(503, {"error": "model warming up"},
                                extra_headers={"Retry-After": 1})
             return
@@ -255,42 +291,55 @@ class _Handler(BaseHTTPRequestHandler):
                                max(1, int(round(e.retry_after_s)))})
             return
         ctype_full = self.headers.get("Content-Type") or ""
-        frames = self._decode_frames(body, ctype_full) if body else None
+        frames, json_model = (self._decode_frames(body, ctype_full)
+                              if body else (None, None))
         if frames is None:
             self._respond_json(400, {"error": "undecodable image payload"})
             return
-        if len(frames) not in (1, srv.engine.img_num):
+        # model routing: explicit ?model= / JSON field beats the default
+        # (flagship, or student-first cascade when one is configured)
+        requested = parse_qs(query).get("model", [None])[0] or json_model
+        if requested is not None and not srv.engine.has_model(requested):
             self._respond_json(
-                400, {"error": f"need 1 or img_num={srv.engine.img_num} "
+                400, {"error": f"unknown model {requested!r}",
+                      "models": list(srv.engine.model_ids())})
+            return
+        cascade = srv.cascade if (srv.cascade is not None
+                                  and requested is None) else None
+        entry = srv.engine.entry(
+            cascade.student_id if cascade else requested)
+        if len(frames) not in (1, entry.img_num):
+            self._respond_json(
+                400, {"error": f"need 1 or img_num={entry.img_num} "
                                f"frames, got {len(frames)}"})
             return
-        canvases = [prepare_canvas(f, srv.engine.image_size)
-                    for f in frames]
-        if srv.engine.wire == "float32":
-            # full CLI preprocess on the handler thread (bit-exact parity
-            # mode); the uint8 wire defers this to the device prologue.
-            # One frame replicates ×img_num (reference CLI semantics),
-            # img_num distinct frames concatenate into one temporal clip
-            # — both land on the same (·, ·, 3·img_num) float32 program.
-            if len(canvases) == 1:
-                payload = normalize_replicate(canvases[0],
-                                              srv.engine.img_num)
-            else:
-                payload = normalize_concat(canvases)
-        elif len(canvases) == 1:
-            payload = canvases[0]
-        else:
-            if not srv.engine.multi_frame:
-                self._respond_json(
-                    400, {"error": "multi-frame clips are disabled on "
-                                   "this uint8-wire engine"})
-                return
-            payload = np.concatenate(canvases, axis=-1)
+        try:
+            payload = self._payload_for(srv, entry, frames)
+        except ValueError as e:
+            self._respond_json(400, {"error": str(e)})
+            return
         t_pre = time.monotonic() - t_body     # decode+canvas only
         srv.metrics.latency["preprocess"].observe(t_pre)
+        cas_result = None
+        req = None
         try:
-            req = srv.batcher.submit(payload,
-                                     timeout_s=srv.request_timeout_s)
+            if cascade is not None:
+                flagship_entry = srv.engine.entry(cascade.flagship_id)
+                # the flagship canvas is only prepared for the escalated
+                # fraction (the thunk runs on this handler thread)
+                cas_result = cascade.score(
+                    payload,
+                    lambda: self._payload_for(srv, flagship_entry,
+                                              frames))
+                scores = cas_result.scores
+            else:
+                req = srv.batcher.submit(payload,
+                                         timeout_s=srv.request_timeout_s,
+                                         model_id=entry.model_id)
+                # the batcher/engine enforce the queue-side deadline; the
+                # extra 5s here only catches a wedged engine so the HTTP
+                # thread can never hang forever
+                scores = req.result(timeout=srv.request_timeout_s + 5.0)
         except QueueFull as e:
             self._respond_json(
                 429, {"error": "overloaded, retry later",
@@ -298,11 +347,6 @@ class _Handler(BaseHTTPRequestHandler):
                 extra_headers={"Retry-After":
                                max(1, int(round(e.retry_after_s)))})
             return
-        try:
-            # the batcher/engine enforce the queue-side deadline; the extra
-            # 5s here only catches a wedged engine so the HTTP thread can
-            # never hang forever
-            scores = req.result(timeout=srv.request_timeout_s + 5.0)
         except DeadlineExceeded:
             self._respond_json(504, {"error": "deadline exceeded"})
             return
@@ -318,24 +362,45 @@ class _Handler(BaseHTTPRequestHandler):
             return
         total = time.monotonic() - t0
         srv.metrics.latency["total"].observe(total)
-        self._respond_json(200, {
+        served_model = entry.model_id if cas_result is None else (
+            cascade.flagship_id if cas_result.tier == "flagship"
+            else cascade.student_id)
+        out = {
             "fake_score": float(scores[0]),
             "scores": [float(s) for s in scores],
             "frames": len(frames),
+            "model": served_model,
             "timings_ms": {
                 "preprocess": round(t_pre * 1000, 3),
-                "queue": round(req.timings.get("queue", 0.0) * 1000, 3),
-                "device": round(req.timings.get("device", 0.0) * 1000, 3),
+                # cascade traffic reports the served tier's request
+                # timings (CascadeResult.timings), not zeros
+                "queue": round((req.timings if req is not None
+                                else cas_result.timings
+                                ).get("queue", 0.0) * 1000, 3),
+                "device": round((req.timings if req is not None
+                                 else cas_result.timings
+                                 ).get("device", 0.0) * 1000, 3),
                 "total": round(total * 1000, 3),
             },
-        })
+        }
+        if cas_result is not None:
+            out["cascade"] = {
+                "tier": cas_result.tier,
+                "student_score": cas_result.student_score,
+                "escalated": cas_result.escalated,
+            }
+            if cas_result.escalation_error:
+                out["cascade"]["escalation_error"] = \
+                    cas_result.escalation_error
+        self._respond_json(200, out)
 
 
 def make_server(host: str, port: int, engine: InferenceEngine,
                 batcher: MicroBatcher, metrics: ServingMetrics,
-                request_timeout_s: float = 2.0) -> ServingServer:
+                request_timeout_s: float = 2.0,
+                cascade=None) -> ServingServer:
     return ServingServer((host, port), engine, batcher, metrics,
-                         request_timeout_s)
+                         request_timeout_s, cascade=cascade)
 
 
 def serve_forever_in_thread(server: ServingServer) -> threading.Thread:

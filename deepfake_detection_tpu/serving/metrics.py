@@ -76,6 +76,15 @@ _BOUNDS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
 
 STAGES = ("queue", "preprocess", "device", "total")
 
+#: per-model request-book resolutions (the ``model=`` labeled mirror of
+#: the global books: per model, accepted == scored + shed + deadline +
+#: failed holds exactly, plus reloads for A/B observability)
+MODEL_BOOK_KINDS = ("accepted", "scored", "failed", "shed", "deadline",
+                    "reloads")
+
+#: cascade tiers (serving/cascade.py latency histograms)
+CASCADE_TIERS = ("student", "flagship")
+
 
 class ServingMetrics:
     """One registry per server process."""
@@ -111,6 +120,26 @@ class ServingMetrics:
         self.breaker_rejected_total = _Counter()
         self.chaos_injections_total: Dict[str, _Counter] = {}
         self._chaos_lock = threading.Lock()
+        # per-model request books (ISSUE 14 multi-model engine): the
+        # same resolution ledger as the global books, keyed by model id
+        # — (kind, model) -> Counter, kinds from MODEL_BOOK_KINDS
+        self.model_books: Dict[Tuple[str, str], _Counter] = {}
+        self._model_lock = threading.Lock()
+        # per-(model, bucket) row accounting: (model, bucket, kind) ->
+        # Counter with kind in {"real", "pad"} — bench_serve's per-bucket
+        # padding-fraction report reads these
+        self.bucket_rows: Dict[Tuple[str, int, str], _Counter] = {}
+        self._bucket_lock = threading.Lock()
+        # cascade books (serving/cascade.py): triaged == cleared +
+        # escalated; escalated == flagship_scored + escalation_failed —
+        # both identities hold EXACTLY through every fault
+        self.cascade_triaged_total = _Counter()
+        self.cascade_cleared_total = _Counter()
+        self.cascade_escalated_total = _Counter()
+        self.cascade_flagship_scored_total = _Counter()
+        self.cascade_escalation_failed_total = _Counter()
+        self.cascade_latency: Dict[str, LatencyHistogram] = {
+            t: LatencyHistogram(_BOUNDS) for t in CASCADE_TIERS}
         self.queue_depth = 0            # gauge, written by the batcher
         self.inflight = 0               # gauge, written by the engine
         self.ready = False              # gauge, flipped after warmup and
@@ -137,6 +166,37 @@ class ServingMetrics:
             if c is None:
                 c = self.chaos_injections_total[point] = _Counter()
         c.inc()
+
+    def count_model(self, kind: str, model: str, n: int = 1) -> None:
+        """One per-model book resolution (``kind`` from
+        MODEL_BOOK_KINDS); rides next to every global-book increment so
+        the labeled ledger balances exactly like the global one."""
+        key = (kind, model or "default")
+        with self._model_lock:
+            c = self.model_books.get(key)
+            if c is None:
+                c = self.model_books[key] = _Counter()
+        c.inc(n)
+
+    def model_book(self, kind: str, model: str) -> int:
+        """Current value of one per-model book counter (0 if untouched)."""
+        with self._model_lock:
+            c = self.model_books.get((kind, model or "default"))
+        return c.value if c is not None else 0
+
+    def count_bucket_rows(self, model: str, bucket: int, real: int,
+                          pad: int) -> None:
+        """Real/pad row counts of one executed (model, bucket) batch."""
+        model = model or "default"
+        for kind, n in (("real", real), ("pad", pad)):
+            if n <= 0:
+                continue
+            key = (model, int(bucket), kind)
+            with self._bucket_lock:
+                c = self.bucket_rows.get(key)
+                if c is None:
+                    c = self.bucket_rows[key] = _Counter()
+            c.inc(n)
 
     def count_completion(self, n: int, now: float | None = None) -> None:
         """Record ``n`` scored requests for the rolling-throughput gauge."""
@@ -222,6 +282,45 @@ class ServingMetrics:
                 self.breaker_probes_total.value)
         counter("breaker_rejected_total", "Requests shed 503 by the open "
                 "breaker", self.breaker_rejected_total.value)
+        # per-model request books (multi-model engine): one labeled
+        # family per resolution kind, mirroring the global ledger
+        with self._model_lock:
+            model_items = sorted(
+                ((kind, model), c.value)
+                for (kind, model), c in self.model_books.items())
+        for kind in MODEL_BOOK_KINDS:
+            doc.header(f"model_{kind}_total",
+                       f"Per-model request books: {kind}", "counter")
+            for (k, model), value in model_items:
+                if k == kind:
+                    doc.sample(f"model_{kind}_total",
+                               f'{{model="{model}"}}', value)
+        doc.header("bucket_rows_total", "Rows per executed (model, "
+                   "bucket) batch, split real|pad (bench_serve's "
+                   "per-bucket padding report)", "counter")
+        with self._bucket_lock:
+            bucket_items = sorted((k, c.value)
+                                  for k, c in self.bucket_rows.items())
+        for (model, bucket, kind), value in bucket_items:
+            doc.sample("bucket_rows_total",
+                       f'{{model="{model}",bucket="{bucket}",'
+                       f'kind="{kind}"}}', value)
+        counter("cascade_triaged_total", "Clips scored by the cascade "
+                "student (books: triaged == cleared + escalated)",
+                self.cascade_triaged_total.value)
+        counter("cascade_cleared_total", "Cascade clips resolved by the "
+                "student verdict (score outside the suspect band)",
+                self.cascade_cleared_total.value)
+        counter("cascade_escalated_total", "Cascade clips escalated to "
+                "the flagship (books: escalated == flagship_scored + "
+                "escalation_failed)", self.cascade_escalated_total.value)
+        counter("cascade_flagship_scored_total", "Escalated clips "
+                "resolved by a flagship score",
+                self.cascade_flagship_scored_total.value)
+        counter("cascade_escalation_failed_total", "Escalations that "
+                "failed (shed/deadline/engine fault): the student "
+                "verdict is served instead — never a silent drop",
+                self.cascade_escalation_failed_total.value)
         doc.header("chaos_injections_total",
                    "Injected faults fired (DFD_CHAOS), by point", "counter")
         with self._chaos_lock:
@@ -245,4 +344,9 @@ class ServingMetrics:
             # one-snapshot consistency per stage lives in PromText.histogram
             doc.histogram("latency_seconds", "Per-stage request latency",
                           self.latency[stage], labels=f'stage="{stage}"')
+        for tier in CASCADE_TIERS:
+            doc.histogram("cascade_latency_seconds",
+                          "Per-tier cascade latency (submit -> verdict)",
+                          self.cascade_latency[tier],
+                          labels=f'tier="{tier}"')
         return doc.render()

@@ -1,0 +1,177 @@
+"""Post-training quantization for the inference path (ISSUE 14).
+
+Weight-only PTQ applied at engine warmup from an f32 checkpoint — the
+checkpoint on disk, the reload watcher's input, and the canary's shape
+gate all stay f32; only the *device-resident serving copy* is compressed:
+
+* ``bf16`` — every float32 leaf under the ``params`` collection is cast
+  to bfloat16 (half the HBM per weight).  The compiled program upcasts
+  via jax's normal type promotion at each use, so compute stays float32
+  against bf16-rounded weights: classic weight-only bf16.
+* ``int8`` — conv/dense kernels (the ``params``-collection ``kernel``
+  leaves with ndim >= 2) are quantized to int8 with **per-output-channel
+  symmetric scales** (scale over all axes but the last, the flax HWIO /
+  (I, O) output axis).  Each quantized leaf is replaced in-tree by a
+  two-leaf container ``{__q8__, __q8_scale__}``; :func:`realize_tree`
+  dequantizes it *inside* the jitted call (``q.astype(f32) * scale``) so
+  the dequant fuses into the program right next to the uint8-wire
+  normalize epilogue — the weights cross host->device and live in HBM as
+  int8, and XLA materializes f32 tiles on the fly.  Everything that is
+  not a kernel (biases, BN scale/bias, batch_stats) stays f32: those
+  leaves are tiny and the BN statistics are numerically load-bearing.
+
+The quantized tree is an ordinary pytree (nested dicts + arrays), so the
+engine's whole params-as-arguments machinery — ``jax.device_put``, AOT
+``lower().compile()`` avals, the hot-reload A/B swap — works unchanged;
+``quantize_tree`` is deterministic, so a reloaded f32 checkpoint
+re-quantizes to aval-identical arguments for the existing executables.
+
+``realize_tree`` on a plain (un-quantized) tree returns it untouched —
+zero inserted ops — which is what keeps the f32 path bit-identical to
+the pre-quant programs (the CLI-parity contract of tests/test_serving).
+
+Accuracy is *measured*, never assumed: ``tools/quant_parity.py`` scores
+a seeded eval list under f32/bf16/int8 and hard-fails past the
+pre-registered score-drift/AUC bounds recorded in SERVE_BENCH.md.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["QUANT_MODES", "canonical_mode", "quantize_tree",
+           "realize_tree", "is_quantized_leaf", "quant_summary",
+           "quantize_leaf"]
+
+#: canonical serving dtypes (aliases accepted by :func:`canonical_mode`)
+QUANT_MODES = ("f32", "bf16", "int8")
+
+_ALIASES = {"f32": "f32", "float32": "f32",
+            "bf16": "bf16", "bfloat16": "bf16",
+            "int8": "int8"}
+
+#: container keys of one quantized leaf — dunder-prefixed so no flax
+#: module name can collide with them
+_QKEY = "__q8__"
+_SKEY = "__q8_scale__"
+
+
+def canonical_mode(mode: str) -> str:
+    """``float32``/``bfloat16`` aliases → the canonical short names."""
+    try:
+        return _ALIASES[str(mode).lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown quantization dtype {mode!r}; pick one of "
+            f"{QUANT_MODES} (aliases: float32, bfloat16)") from None
+
+
+def quantize_leaf(w: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """One kernel → (int8 values, per-output-channel f32 scales).
+
+    Symmetric: ``scale = amax(|w|) / 127`` over every axis but the last,
+    ``q = round(w / scale)`` clipped to [-127, 127].  An all-zero output
+    channel gets scale 1.0 (its rows quantize to exact zeros either
+    way), so dequant never divides by zero; a NON-FINITE channel gets
+    scale NaN so the poison survives dequant for the canary to see."""
+    w = np.asarray(w, np.float32)
+    axes = tuple(range(w.ndim - 1))
+    amax = np.max(np.abs(w), axis=axes)
+    scale = np.where(amax > 0, amax / 127.0, 1.0)
+    # a non-finite channel gets a NaN scale so dequant REPRODUCES the
+    # poison: int8 must fail the canary's finite-scores gate exactly
+    # like the f32/bf16 paths do — casting NaN through int8 would
+    # launder it into finite garbage the canary cannot see
+    scale = np.where(np.isfinite(amax), scale, np.nan).astype(np.float32)
+    with np.errstate(invalid="ignore"):
+        q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def is_quantized_leaf(x: Any) -> bool:
+    """True for the two-leaf int8 container ``realize_tree`` dequantizes."""
+    return isinstance(x, dict) and _QKEY in x and _SKEY in x
+
+
+def _path_keys(path) -> list:
+    return [getattr(p, "key", getattr(p, "idx", None)) for p in path]
+
+
+def _int8_eligible(path, leaf) -> bool:
+    keys = _path_keys(path)
+    return ("params" in keys and keys[-1] == "kernel"
+            and np.ndim(leaf) >= 2
+            and np.asarray(leaf).dtype == np.float32)
+
+
+def _bf16_eligible(path, leaf) -> bool:
+    return ("params" in _path_keys(path)
+            and np.asarray(leaf).dtype == np.float32)
+
+
+def quantize_tree(variables: Any, mode: str) -> Any:
+    """Host-side PTQ transform of an f32 variables tree.
+
+    ``f32`` returns the tree untouched (same object — the identity
+    contract the bit-parity tests pin).  ``bf16``/``int8`` return a new
+    tree as described in the module docstring; feed it to
+    :func:`realize_tree` inside the compiled call."""
+    mode = canonical_mode(mode)
+    if mode == "f32":
+        return variables
+    if mode == "bf16":
+        def cast(path, leaf):
+            if _bf16_eligible(path, leaf):
+                return np.asarray(jnp.asarray(leaf).astype(jnp.bfloat16))
+            return leaf
+        return jax.tree_util.tree_map_with_path(cast, variables)
+
+    def q(path, leaf):
+        if _int8_eligible(path, leaf):
+            q8, scale = quantize_leaf(np.asarray(leaf))
+            return {_QKEY: q8, _SKEY: scale}
+        return leaf
+    return jax.tree_util.tree_map_with_path(
+        q, variables, is_leaf=is_quantized_leaf)
+
+
+def realize_tree(variables: Any) -> Any:
+    """Trace-compatible dequantization: int8 containers become
+    ``q.astype(f32) * scale`` (the per-output-channel broadcast over the
+    last axis); every other leaf — incl. bf16 casts, which jax's type
+    promotion upcasts at the op that consumes them — passes through.
+
+    A tree with no quantized leaves is returned *as-is* (not rebuilt),
+    so un-quantized programs trace identically to pre-quant ones."""
+    leaves = jax.tree.leaves(variables, is_leaf=is_quantized_leaf)
+    if not any(is_quantized_leaf(l) for l in leaves):
+        return variables
+
+    def deq(x):
+        if is_quantized_leaf(x):
+            return x[_QKEY].astype(jnp.float32) * x[_SKEY]
+        return x
+    return jax.tree.map(deq, variables, is_leaf=is_quantized_leaf)
+
+
+def quant_summary(variables: Any) -> Dict[str, int]:
+    """{quantized_leaves, quantized_bytes, bf16_leaves, total_leaves} —
+    what the engine logs at warmup so an operator can see the transform
+    actually happened."""
+    n_q = n_bf16 = n_total = q_bytes = 0
+    # attribute reads only (dtype/size exist on numpy AND jax arrays):
+    # np.asarray on a device-resident leaf would download the weights
+    # just for a log line
+    for leaf in jax.tree.leaves(variables, is_leaf=is_quantized_leaf):
+        n_total += 1
+        if is_quantized_leaf(leaf):
+            n_q += 1
+            q_bytes += int(leaf[_QKEY].size)
+        elif getattr(leaf, "dtype", None) == jnp.bfloat16:
+            n_bf16 += 1
+    return {"quantized_leaves": n_q, "quantized_bytes": q_bytes,
+            "bf16_leaves": n_bf16, "total_leaves": n_total}

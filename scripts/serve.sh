@@ -2,5 +2,13 @@
 # Dynamic-batching inference server (ISSUE 2; flag conventions mirror
 # scripts/test.sh: MODEL_PATH env overrides the checkpoint, extra flags
 # pass through).
+#
+# ISSUE 14 flags pass straight through, e.g.:
+#   scripts/serve.sh --dtype int8                 # PTQ the flagship
+#   scripts/serve.sh \
+#     --models "student=mobilenetv3_small_100,size=224,dtype=int8" \
+#     --cascade student --cascade-low 0.2 --cascade-high 0.8
+# (the student triages every un-routed clip; POST /score with
+#  {"model": "student"} or ?model=student addresses one table entry)
 python -m deepfake_detection_tpu.runners.serve \
     --model-path "${MODEL_PATH:-../models/model_best.ckpt}" "$@"

@@ -319,6 +319,9 @@ class TestRunner:
             {sh["id"] for sh in corpus["manifest"]["shards"]}
         assert all(r["backend_compiles"] == 0 for r in shard_recs)
 
+    @pytest.mark.slow   # tier-1 budget: a second full corpus run (~3 s)
+    # re-proving determinism the slow-tier kill/resume identity drive
+    # also pins; the books/zero-recompile runner e2e stays fast
     def test_verdicts_deterministic_across_runs(self, tmp_path, corpus):
         from deepfake_detection_tpu.runners.backfill import run_backfill
 

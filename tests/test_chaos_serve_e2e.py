@@ -38,5 +38,18 @@ def test_torn_reload_rejected_then_clean_reload_lands():
     assert chaos_serve.main(["--scenario", "torn_reload"] + _BASE) == 0
 
 
+def test_two_model_cascade_faults_recover_books_balance():
+    """ISSUE 14 acceptance: the PR 10 invariants survive with TWO models
+    loaded and cascade routing — recovery re-warms both models' buckets
+    with zero recompiles, the global books balance, and the cascade
+    books (triaged == cleared + escalated; escalated == flagship_scored
+    + escalation_failed) stay exact while faults turn escalations into
+    counted student-verdict fallbacks."""
+    assert chaos_serve.main(
+        ["--scenario", "exc,kill",
+         "--models", "student=vit_tiny_patch16_224,size=32,dtype=int8",
+         "--cascade", "student"] + _BASE) == 0
+
+
 def test_stream_server_bounce_resumes_verdicts_bit_identically():
     assert chaos_serve.main(["--scenario", "stream_resume"] + _BASE) == 0

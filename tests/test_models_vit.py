@@ -50,7 +50,13 @@ class TestSequenceParallel:
                             attn_impl=impl, sp_mesh=mesh)
         return m_full, m_sp
 
-    @pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
+    @pytest.mark.parametrize("impl", [
+        "ring",
+        # tier-1 budget: ring_flash is env-broken on this jaxlib
+        # (PartitionId, pre-existing) and burns ~5 s failing; it stays
+        # in the slow tier with the other ring_flash pins
+        pytest.param("ring_flash", marks=pytest.mark.slow),
+        "ulysses"])
     def test_sp_attention_matches_full(self, devices, impl):
         """128 tokens sharded 8-ways through the SP kernels must match the
         dense forward (BASELINE.json: 'ViT … stress XLA attention path')."""
@@ -67,6 +73,9 @@ class TestSequenceParallel:
         np.testing.assert_allclose(np.asarray(out_full),
                                    np.asarray(out_sp), atol=2e-5)
 
+    @pytest.mark.slow   # tier-1 budget: full SP train-step grads vs the
+    # dense path (~11 s); SP forward parity (ring/ulysses above) stays
+    # fast and train-step grads ride test_train's unified-step coverage
     def test_sp_train_step_grads(self, devices):
         """One jitted train step with the token axis ring-sharded: grads
         flow and match the dense path."""

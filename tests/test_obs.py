@@ -102,6 +102,46 @@ def _old_serving_render(self) -> str:
             self.breaker_probes_total.value)
     counter("breaker_rejected_total", "Requests shed 503 by the open "
             "breaker", self.breaker_rejected_total.value)
+    # per-model request books (ISSUE 14 multi-model engine)
+    from deepfake_detection_tpu.serving.metrics import MODEL_BOOK_KINDS
+    with self._model_lock:
+        model_items = sorted(
+            ((kind, model), c.value)
+            for (kind, model), c in self.model_books.items())
+    for kind in MODEL_BOOK_KINDS:
+        lines.append(f"# HELP {_PREFIX}_model_{kind}_total Per-model "
+                     f"request books: {kind}")
+        lines.append(f"# TYPE {_PREFIX}_model_{kind}_total counter")
+        for (k, model), value in model_items:
+            if k == kind:
+                lines.append(f'{_PREFIX}_model_{kind}_total'
+                             f'{{model="{model}"}} {value}')
+    lines.append(f"# HELP {_PREFIX}_bucket_rows_total Rows per executed "
+                 "(model, bucket) batch, split real|pad (bench_serve's "
+                 "per-bucket padding report)")
+    lines.append(f"# TYPE {_PREFIX}_bucket_rows_total counter")
+    with self._bucket_lock:
+        bucket_items = sorted((k, c.value)
+                              for k, c in self.bucket_rows.items())
+    for (model, bucket, kind), value in bucket_items:
+        lines.append(f'{_PREFIX}_bucket_rows_total{{model="{model}",'
+                     f'bucket="{bucket}",kind="{kind}"}} {value}')
+    counter("cascade_triaged_total", "Clips scored by the cascade "
+            "student (books: triaged == cleared + escalated)",
+            self.cascade_triaged_total.value)
+    counter("cascade_cleared_total", "Cascade clips resolved by the "
+            "student verdict (score outside the suspect band)",
+            self.cascade_cleared_total.value)
+    counter("cascade_escalated_total", "Cascade clips escalated to "
+            "the flagship (books: escalated == flagship_scored + "
+            "escalation_failed)", self.cascade_escalated_total.value)
+    counter("cascade_flagship_scored_total", "Escalated clips "
+            "resolved by a flagship score",
+            self.cascade_flagship_scored_total.value)
+    counter("cascade_escalation_failed_total", "Escalations that "
+            "failed (shed/deadline/engine fault): the student "
+            "verdict is served instead — never a silent drop",
+            self.cascade_escalation_failed_total.value)
     lines.append(f"# HELP {_PREFIX}_chaos_injections_total Injected "
                  "faults fired (DFD_CHAOS), by point")
     lines.append(f"# TYPE {_PREFIX}_chaos_injections_total counter")
@@ -136,6 +176,23 @@ def _old_serving_render(self) -> str:
             f'{name}_bucket{{stage="{stage}",le="+Inf"}} {c}')
         lines.append(f'{name}_sum{{stage="{stage}"}} {s}')
         lines.append(f'{name}_count{{stage="{stage}"}} {c}')
+    from deepfake_detection_tpu.serving.metrics import CASCADE_TIERS
+    for tier in CASCADE_TIERS:
+        h = self.cascade_latency[tier]
+        name = f"{_PREFIX}_cascade_latency_seconds"
+        lines.append(f"# HELP {name} Per-tier cascade latency "
+                     "(submit -> verdict)")
+        lines.append(f"# TYPE {name} histogram")
+        counts, s, c = h.snapshot()
+        acc = 0
+        for bound, n in zip(h.bounds, counts):
+            acc += n
+            lines.append(f'{name}_bucket{{tier="{tier}",'
+                         f'le="{bound!r}"}} {acc}')
+        lines.append(
+            f'{name}_bucket{{tier="{tier}",le="+Inf"}} {c}')
+        lines.append(f'{name}_sum{{tier="{tier}"}} {s}')
+        lines.append(f'{name}_count{{tier="{tier}"}} {c}')
     return "\n".join(lines) + "\n"
 
 
@@ -169,6 +226,19 @@ class TestSharedRenderer:
         m.padded_rows_total.inc(9)
         m.compiles_total.inc(4)
         m.reloads_total.inc()
+        # the ISSUE 14 labeled families: per-model books, per-bucket
+        # rows, cascade books + per-tier latency
+        m.count_model("accepted", "flagship", 3)
+        m.count_model("scored", "flagship", 2)
+        m.count_model("scored", "student", 5)
+        m.count_bucket_rows("flagship", 4, 3, 1)
+        m.count_bucket_rows("student", 16, 12, 4)
+        m.cascade_triaged_total.inc(5)
+        m.cascade_cleared_total.inc(4)
+        m.cascade_escalated_total.inc()
+        m.cascade_flagship_scored_total.inc()
+        m.cascade_latency["student"].observe(0.003)
+        m.cascade_latency["flagship"].observe(0.4)
         m.queue_depth = 5
         m.inflight = 2
         m.ready = True

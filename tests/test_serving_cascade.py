@@ -1,0 +1,579 @@
+"""Multi-model engine + two-tier cascade tests (ISSUE 14).
+
+Fast tier (``cascade`` marker): multi-model routing and A/B swaps run
+real small models; the cascade fault matrix runs against a stub batcher
+so every shed/deadline/engine-fault sequencing is deterministic (the
+live-fault system drive is ``tools/chaos_serve.py --models``).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepfake_detection_tpu.models import create_model, init_model
+from deepfake_detection_tpu.params import normalize_replicate, prepare_canvas
+from deepfake_detection_tpu.serving.batcher import (DeadlineExceeded,
+                                                    MicroBatcher, QueueFull)
+from deepfake_detection_tpu.serving.cascade import CascadeRouter
+from deepfake_detection_tpu.serving.engine import InferenceEngine
+from deepfake_detection_tpu.serving.http import (make_server,
+                                                 serve_forever_in_thread)
+from deepfake_detection_tpu.serving.metrics import ServingMetrics
+from deepfake_detection_tpu.serving.resilience import (EngineStalled,
+                                                       NonFiniteScores)
+
+pytestmark = [pytest.mark.serving, pytest.mark.cascade]
+
+_FLAGSHIP = "mobilenetv3_small_100"
+_STUDENT = "vit_tiny_patch16_224"
+_SIZE = 24          # flagship canvas
+_S_SIZE = 32        # student canvas (vit patch16 needs a multiple of 16)
+
+
+def _perturbed_variables(model, size, chans, seed=0):
+    variables = init_model(model, jax.random.PRNGKey(0),
+                           (1, size, size, chans))
+    rng = np.random.default_rng(seed)
+    return jax.tree.map(
+        lambda a: a + jnp.asarray(
+            0.02 * rng.standard_normal(np.shape(a)).astype(np.float32)
+        ).astype(a.dtype),
+        variables)
+
+
+def _canvases(n, size=_SIZE, seed=0):
+    rng = np.random.default_rng(seed)
+    return [prepare_canvas(
+        rng.integers(0, 255, (40, 36, 3), dtype=np.uint8), size)
+        for _ in range(n)]
+
+
+def _two_model_engine(metrics=None, buckets=(1, 4), warm=True,
+                      student_size=_S_SIZE, student_dtype="f32"):
+    flagship = create_model(_FLAGSHIP, num_classes=2, in_chans=3)
+    fv = _perturbed_variables(flagship, _SIZE, 3, seed=1)
+    engine = InferenceEngine(flagship, fv, image_size=_SIZE, img_num=1,
+                             buckets=buckets, metrics=metrics,
+                             model_id="flagship", warmup=False)
+    student = create_model(_STUDENT, num_classes=2, in_chans=3)
+    sv = _perturbed_variables(student, student_size, 3, seed=2)
+    engine.add_model("student", student, sv, image_size=student_size,
+                     dtype=student_dtype)
+    if warm:
+        engine.warmup()
+    return engine
+
+
+# ---------------------------------------------------------------------------
+# multi-model engine
+# ---------------------------------------------------------------------------
+
+def test_multi_model_warmup_compiles_every_entry_and_routes():
+    engine = _two_model_engine()
+    # 2 buckets × 2 models on the float32 wire
+    assert engine.compile_count == 4
+    assert engine.model_ids() == ("flagship", "student")
+    payloads = [normalize_replicate(c, 1) for c in _canvases(3, seed=5)]
+    s_payloads = [normalize_replicate(c, 1)
+                  for c in _canvases(3, _S_SIZE, seed=5)]
+    sf = engine.score_batch(payloads, model_id="flagship")
+    ss = engine.score_batch(s_payloads, model_id="student")
+    assert sf.shape == ss.shape == (3, 2)
+    assert not np.array_equal(sf, ss)          # different models answered
+    # default routing = the primary (flagship) entry
+    np.testing.assert_array_equal(engine.score_batch(payloads), sf)
+    with pytest.raises(ValueError):
+        engine.score_batch(payloads, model_id="nope")
+
+
+@pytest.mark.slow   # tier-1 budget: duplicated full-parity sweep (~10 s,
+# builds two extra solo engines); the fast tier keeps table==solo parity
+# pinned via test_ab_swap_zero_recompiles_and_isolated's fresh-engine
+# comparison and routing via test_multi_model_warmup_compiles_every_entry
+def test_multi_model_scores_match_single_model_engines():
+    """The table is a routing detail: each entry scores bit-identically
+    to a dedicated single-model engine over the same weights (same
+    programs, same buckets)."""
+    engine = _two_model_engine()
+    payloads = [normalize_replicate(c, 1) for c in _canvases(2, seed=8)]
+    s_payloads = [normalize_replicate(c, 1)
+                  for c in _canvases(2, _S_SIZE, seed=8)]
+    flagship = create_model(_FLAGSHIP, num_classes=2, in_chans=3)
+    solo_f = InferenceEngine(flagship,
+                             _perturbed_variables(flagship, _SIZE, 3,
+                                                  seed=1),
+                             image_size=_SIZE, img_num=1, buckets=(1, 4))
+    np.testing.assert_array_equal(
+        engine.score_batch(payloads, model_id="flagship"),
+        solo_f.score_batch(payloads))
+    student = create_model(_STUDENT, num_classes=2, in_chans=3)
+    solo_s = InferenceEngine(student,
+                             _perturbed_variables(student, _S_SIZE, 3,
+                                                  seed=2),
+                             image_size=_S_SIZE, img_num=1,
+                             buckets=(1, 4))
+    np.testing.assert_array_equal(
+        engine.score_batch(s_payloads, model_id="student"),
+        solo_s.score_batch(s_payloads))
+
+
+def test_cold_model_drops_readiness_until_warmed():
+    """/readyz gating: adding a model to a READY engine must drop
+    readiness until warmup covered the new entry — a cold model behind a
+    ready endpoint would be the first silent mid-traffic compile."""
+    flagship = create_model(_FLAGSHIP, num_classes=2, in_chans=3)
+    fv = _perturbed_variables(flagship, _SIZE, 3, seed=1)
+    engine = InferenceEngine(flagship, fv, image_size=_SIZE, img_num=1,
+                             buckets=(1,), model_id="flagship")
+    assert engine.ready
+    student = create_model(_STUDENT, num_classes=2, in_chans=3)
+    sv = _perturbed_variables(student, _S_SIZE, 3, seed=2)
+    engine.add_model("student", student, sv, image_size=_S_SIZE)
+    assert not engine.ready                    # one cold model => not ready
+    engine.warmup()
+    assert engine.ready
+
+
+def test_rewarm_skips_cold_entry_instead_of_crashing():
+    """A watchdog recovery racing a live add_model must skip the cold
+    entry (its own warmup proves it), not KeyError on its empty compile
+    cache and abort the recovery with the engine stuck not-ready."""
+    flagship = create_model(_FLAGSHIP, num_classes=2, in_chans=3)
+    fv = _perturbed_variables(flagship, _SIZE, 3, seed=1)
+    engine = InferenceEngine(flagship, fv, image_size=_SIZE, img_num=1,
+                             buckets=(1,), model_id="flagship")
+    student = create_model(_STUDENT, num_classes=2, in_chans=3)
+    sv = _perturbed_variables(student, _S_SIZE, 3, seed=2)
+    engine.add_model("student", student, sv, image_size=_S_SIZE)
+    rewarms0 = engine.metrics.rewarms_total.value
+    engine._rewarm()                       # student entry is still cold
+    assert engine.metrics.rewarms_total.value == rewarms0 + 1
+
+
+def test_mixed_model_batch_splits_into_per_model_sub_batches():
+    """One coalesced batch carrying both models' requests splits into
+    per-model staged sub-batches; every request resolves with its own
+    model's bucket scores, bit-identical to the direct path."""
+    metrics = ServingMetrics()
+    engine = _two_model_engine(metrics=metrics)
+    batcher = MicroBatcher(max_batch=4, deadline_ms=20.0, max_queue=16,
+                           metrics=metrics)
+    payloads = [normalize_replicate(c, 1) for c in _canvases(2, seed=3)] \
+        + [normalize_replicate(c, 1)
+           for c in _canvases(2, _S_SIZE, seed=13)]
+    want_f = engine.score_batch(payloads[:2], model_id="flagship")
+    want_s = engine.score_batch(payloads[2:], model_id="student")
+    # queue everything BEFORE the worker starts so all four coalesce
+    # into ONE mixed batch deterministically
+    reqs = [batcher.submit(p, timeout_s=10, model_id=m)
+            for p, m in zip(payloads, ["flagship", "flagship",
+                                       "student", "student"])]
+    # an unknown model id riding the same coalesced batch must fail
+    # alone (claimed + booked failed), never poison its co-batched
+    # riders or feed the breaker a non-device failure
+    bad = batcher.submit(payloads[0], timeout_s=10, model_id="nope")
+    engine.start(batcher)
+    try:
+        got = [r.result(timeout=10) for r in reqs]
+        np.testing.assert_array_equal(np.stack(got[:2]), want_f)
+        np.testing.assert_array_equal(np.stack(got[2:]), want_s)
+        with pytest.raises(ValueError, match="unknown model"):
+            bad.result(timeout=10)
+        assert metrics.model_book("failed", "nope") == 1
+        assert metrics.failed_total.value == 1
+    finally:
+        engine.stop()
+        batcher.close()
+
+
+def test_per_model_books_balance_through_shed_and_deadline():
+    """The model= labeled ledger holds the books identity per model
+    through clean scores, sheds and queue-expired deadlines."""
+    metrics = ServingMetrics()
+    engine = _two_model_engine(metrics=metrics)
+    batcher = MicroBatcher(max_batch=4, deadline_ms=5.0, max_queue=3,
+                           metrics=metrics)
+    payloads = [normalize_replicate(c, 1)
+                for c in _canvases(3, _S_SIZE, seed=6)] \
+        + [normalize_replicate(c, 1) for c in _canvases(1, seed=6)]
+    r1 = batcher.submit(payloads[0], timeout_s=10, model_id="student")
+    r2 = batcher.submit(payloads[1], timeout_s=10, model_id="student")
+    # deadline: a flagship request that expires in-queue
+    r3 = batcher.submit(payloads[3], timeout_s=0.001, model_id="flagship")
+    # shed: the 3-slot queue is now full, the next student submit sheds
+    with pytest.raises(QueueFull):
+        batcher.submit(payloads[2], timeout_s=10, model_id="student")
+    import time as _time
+    _time.sleep(0.05)
+    engine.start(batcher)
+    try:
+        assert r1.result(timeout=10).shape == (2,)
+        assert r2.result(timeout=10).shape == (2,)
+        with pytest.raises(DeadlineExceeded):
+            r3.result(timeout=10)
+    finally:
+        engine.stop()
+        batcher.close()
+    for model in ("student", "flagship"):
+        acc = metrics.model_book("accepted", model)
+        resolved = (metrics.model_book("scored", model) +
+                    metrics.model_book("shed", model) +
+                    metrics.model_book("deadline", model) +
+                    metrics.model_book("failed", model))
+        assert acc == resolved, (model, acc, resolved)
+    assert metrics.model_book("shed", "student") == 1
+    assert metrics.model_book("deadline", "flagship") == 1
+
+
+def test_ab_swap_zero_recompiles_and_isolated():
+    """A/B weight swap on one table entry: zero backend compiles (the
+    params-as-arguments path), the OTHER model's scores bit-unchanged,
+    the swapped model matches a fresh engine over the new weights."""
+    from deepfake_detection_tpu.serving.metrics import \
+        backend_compile_count
+
+    engine = _two_model_engine()
+    payloads = [normalize_replicate(c, 1) for c in _canvases(2, seed=9)]
+    s_payloads = [normalize_replicate(c, 1)
+                  for c in _canvases(2, _S_SIZE, seed=9)]
+    f_before = engine.score_batch(payloads, model_id="flagship")
+    s_before = engine.score_batch(s_payloads, model_id="student")
+    student = create_model(_STUDENT, num_classes=2, in_chans=3)
+    new_sv = jax.tree.map(np.asarray,
+                          _perturbed_variables(student, _S_SIZE, 3,
+                                               seed=7))
+    backend0 = backend_compile_count()
+    compiles0 = engine.compile_count
+    engine.submit_reload(new_sv, source="<ab>", model_id="student")
+    engine._maybe_apply_reload()
+    assert engine.reload_count == 1
+    assert engine.compile_count == compiles0
+    assert backend_compile_count() == backend0     # zero recompiles
+    np.testing.assert_array_equal(
+        engine.score_batch(payloads, model_id="flagship"), f_before)
+    s_after = engine.score_batch(s_payloads, model_id="student")
+    assert not np.array_equal(s_before, s_after)
+    oracle = InferenceEngine(student, new_sv, image_size=_S_SIZE,
+                             img_num=1, buckets=(1, 4))
+    np.testing.assert_array_equal(s_after,
+                                  oracle.score_batch(s_payloads))
+
+
+def test_cross_model_shape_swap_rejected_loudly():
+    """A checkpoint of the WRONG model's tree must be rejected (counted,
+    scores bit-unchanged) — never silently served into the other slot."""
+    engine = _two_model_engine()
+    payloads = [normalize_replicate(c, 1)
+                for c in _canvases(1, _S_SIZE, seed=4)]
+    s_before = engine.score_batch(payloads, model_id="student")
+    flagship_tree = jax.tree.map(np.asarray, engine.entry("flagship")
+                                 .host_template)
+    errors0 = engine.metrics.reload_errors_total.value
+    engine.submit_reload(flagship_tree, source="<cross>",
+                         model_id="student")
+    engine._maybe_apply_reload()
+    assert engine.reload_count == 0
+    assert engine.metrics.reload_errors_total.value == errors0 + 1
+    np.testing.assert_array_equal(
+        engine.score_batch(payloads, model_id="student"), s_before)
+
+
+# ---------------------------------------------------------------------------
+# cascade router: deterministic fault matrix over a stub batcher
+# ---------------------------------------------------------------------------
+
+class _StubRequest:
+    def __init__(self, outcome):
+        self._outcome = outcome
+
+    def result(self, timeout=None):
+        if isinstance(self._outcome, Exception):
+            raise self._outcome
+        return self._outcome
+
+
+class _StubBatcher:
+    """Scripted per-model outcomes: each submit pops the next outcome for
+    its model_id; an Exception instance raised at submit() time when
+    wrapped in ('submit', exc)."""
+
+    def __init__(self, outcomes):
+        self.outcomes = outcomes            # model_id -> list
+        self.submits = []
+
+    def submit(self, array, timeout_s=None, model_id=None):
+        self.submits.append(model_id)
+        nxt = self.outcomes[model_id].pop(0)
+        if isinstance(nxt, tuple) and nxt[0] == "submit":
+            raise nxt[1]
+        return _StubRequest(nxt)
+
+
+def _router(batcher, metrics, low=0.4, high=0.8):
+    return CascadeRouter(batcher, metrics, student_id="student",
+                         flagship_id="flagship", low=low, high=high,
+                         timeout_s=1.0)
+
+
+def _books(m):
+    return (m.cascade_triaged_total.value, m.cascade_cleared_total.value,
+            m.cascade_escalated_total.value,
+            m.cascade_flagship_scored_total.value,
+            m.cascade_escalation_failed_total.value)
+
+
+def test_cascade_clears_outside_band():
+    m = ServingMetrics()
+    b = _StubBatcher({"student": [np.asarray([0.1, 0.9])]})
+    res = _router(b, m).score("canvas", lambda: pytest.fail(
+        "flagship payload must not be prepared for a cleared clip"))
+    assert res.tier == "student" and not res.escalated
+    assert res.student_score == pytest.approx(0.1)
+    assert _books(m) == (1, 1, 0, 0, 0)
+    assert b.submits == ["student"]
+
+
+def test_cascade_escalates_inside_band():
+    m = ServingMetrics()
+    b = _StubBatcher({"student": [np.asarray([0.5, 0.5])],
+                      "flagship": [np.asarray([0.93, 0.07])]})
+    res = _router(b, m).score("canvas", lambda: "flagship-payload")
+    assert res.tier == "flagship" and res.escalated
+    assert res.scores[0] == pytest.approx(0.93)
+    assert _books(m) == (1, 0, 1, 1, 0)
+    assert b.submits == ["student", "flagship"]
+    assert m.cascade_latency["flagship"].snapshot()[2] == 1
+
+
+def test_cascade_band_is_inclusive():
+    m = ServingMetrics()
+    r = _router(_StubBatcher({}), m, low=0.4, high=0.8)
+    assert r.suspect(0.4) and r.suspect(0.8)
+    assert not r.suspect(0.39999) and not r.suspect(0.80001)
+    with pytest.raises(ValueError):
+        _router(_StubBatcher({}), m, low=0.9, high=0.1)
+
+
+@pytest.mark.parametrize("fault", [
+    ("submit", QueueFull(8, 1.0)),           # flagship shed at submit
+    DeadlineExceeded("expired"),             # flagship queue deadline
+    EngineStalled("watchdog recovery"),      # crash-recovery fault
+    NonFiniteScores("nan batch"),            # non-finite flagship batch
+])
+def test_cascade_escalation_failure_serves_student_verdict(fault):
+    """Every flagship-phase failure mode degrades to the student verdict
+    + counter — never a silent drop, never a client error for a clip the
+    student already scored — and the cascade books stay exact."""
+    m = ServingMetrics()
+    b = _StubBatcher({"student": [np.asarray([0.6, 0.4])],
+                      "flagship": [fault]})
+    res = _router(b, m).score("canvas", lambda: "flagship-payload")
+    assert res.tier == "student" and res.escalated
+    assert res.escalation_error
+    assert res.scores[0] == pytest.approx(0.6)
+    assert _books(m) == (1, 0, 1, 0, 1)
+
+
+def test_cascade_flagship_leg_gets_only_the_remaining_budget():
+    """The two tiers share ONE timeout budget: a student phase that
+    spends it all turns the escalation into a counted flagship-phase
+    failure (student verdict served, flagship never submitted) — an
+    escalated request can never take ~2x the deadline behind a 200."""
+    import time as _time
+
+    class _SlowStudentBatcher(_StubBatcher):
+        def submit(self, array, timeout_s=None, model_id=None):
+            req = super().submit(array, timeout_s=timeout_s,
+                                 model_id=model_id)
+            if model_id == "student":
+                _time.sleep(0.05)       # burn the whole 0.02s budget
+            return req
+
+    m = ServingMetrics()
+    b = _SlowStudentBatcher({"student": [np.asarray([0.6, 0.4])]})
+    r = CascadeRouter(b, m, student_id="student", flagship_id="flagship",
+                      low=0.4, high=0.8, timeout_s=0.02)
+    res = r.score("canvas", lambda: "flagship-payload")
+    assert res.tier == "student" and res.escalated
+    assert "budget" in res.escalation_error
+    assert b.submits == ["student"]     # flagship leg never submitted
+    assert _books(m) == (1, 0, 1, 0, 1)
+
+
+def test_cascade_result_carries_served_tier_timings():
+    """CascadeResult.timings reports the SERVED request's queue/device
+    timings (the HTTP layer surfaces them instead of zeros)."""
+    class _TimedRequest(_StubRequest):
+        timings = {"queue": 0.005, "device": 0.003}
+
+    class _TimedBatcher(_StubBatcher):
+        def submit(self, array, timeout_s=None, model_id=None):
+            req = super().submit(array, timeout_s=timeout_s,
+                                 model_id=model_id)
+            return _TimedRequest(req._outcome)
+
+    m = ServingMetrics()
+    b = _TimedBatcher({"student": [np.asarray([0.6, 0.4]),
+                                   np.asarray([0.9, 0.1])],
+                       "flagship": [np.asarray([0.7, 0.3])]})
+    res = _router(b, m).score("canvas", lambda: "flagship-payload")
+    assert res.tier == "flagship"
+    assert res.timings == {"queue": 0.005, "device": 0.003}
+    res2 = _router(b, m, low=0.0, high=0.2).score("canvas", lambda: "x")
+    assert res2.tier == "student" and res2.timings["device"] == 0.003
+
+
+def test_cascade_student_phase_failures_propagate():
+    """Student-phase faults mean the clip was never triaged: the error
+    propagates (the per-model books own it) and NO cascade counter
+    moves."""
+    m = ServingMetrics()
+    b = _StubBatcher({"student": [("submit", QueueFull(8, 1.0))]})
+    with pytest.raises(QueueFull):
+        _router(b, m).score("canvas", lambda: "unused")
+    b2 = _StubBatcher({"student": [EngineStalled("recovery")]})
+    with pytest.raises(EngineStalled):
+        _router(b2, m).score("canvas", lambda: "unused")
+    assert _books(m) == (0, 0, 0, 0, 0)
+
+
+def test_cascade_books_balance_through_mixed_fault_sequence():
+    """A seeded mixed sequence of clears, escalations, escalation faults
+    and student faults: both identities hold exactly at every step."""
+    m = ServingMetrics()
+    rng = np.random.default_rng(0xCA5CADE)
+    router = _router(_StubBatcher({}), m)
+    for _ in range(200):
+        roll = rng.uniform()
+        p_student = float(rng.uniform())
+        suspect = router.suspect(p_student)
+        outcomes = {"student": [np.asarray([p_student, 1 - p_student])],
+                    "flagship": []}
+        if roll < 0.1:                     # student fault
+            outcomes["student"] = [("submit", QueueFull(8, 1.0))]
+        elif suspect and roll < 0.3:       # flagship fault
+            outcomes["flagship"] = [EngineStalled("boom")]
+        elif suspect:
+            outcomes["flagship"] = [np.asarray([0.9, 0.1])]
+        router.batcher = _StubBatcher(outcomes)
+        try:
+            router.score("canvas", lambda: "payload")
+        except QueueFull:
+            pass
+        tri, clr, esc, fs, ef = _books(m)
+        assert tri == clr + esc
+        assert esc == fs + ef
+
+
+# ---------------------------------------------------------------------------
+# HTTP end-to-end: routing + cascade over a live localhost server
+# ---------------------------------------------------------------------------
+
+def _post(port, path, body, ctype, timeout=30):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 data=body,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _jpeg_bytes(seed=0, wh=48):
+    import io
+
+    from PIL import Image
+    rng = np.random.default_rng(seed)
+    buf = io.BytesIO()
+    Image.fromarray(rng.integers(0, 255, (wh, wh, 3), dtype=np.uint8)
+                    ).save(buf, "JPEG", quality=90)
+    return buf.getvalue()
+
+
+@pytest.mark.slow   # tier-1 budget: live-server drive (~5 s); the fast
+# tier keeps the router fault matrix + engine routing units, and the
+# two-model chaos e2e (test_chaos_serve_e2e) drives live HTTP cascade
+def test_http_cascade_and_model_routing():
+    metrics = ServingMetrics()
+    engine = _two_model_engine(metrics=metrics)
+    batcher = MicroBatcher(max_batch=4, deadline_ms=10.0, max_queue=16,
+                           metrics=metrics)
+    engine.start(batcher)
+    # band [0, 1]: every triaged clip escalates -> deterministic tier
+    cascade = CascadeRouter(batcher, metrics, student_id="student",
+                            flagship_id="flagship", low=0.0, high=1.0,
+                            timeout_s=10.0)
+    server = make_server("127.0.0.1", 0, engine, batcher, metrics,
+                         request_timeout_s=10.0, cascade=cascade)
+    serve_forever_in_thread(server)
+    port = server.server_address[1]
+    try:
+        jpeg = _jpeg_bytes(seed=3)
+        # default route: cascade (always-escalate band -> flagship tier)
+        status, out = _post(port, "/score", jpeg, "image/jpeg")
+        assert status == 200
+        assert out["model"] == "flagship"
+        assert out["cascade"]["tier"] == "flagship"
+        assert out["cascade"]["escalated"] is True
+        assert 0.0 <= out["cascade"]["student_score"] <= 1.0
+        # explicit model routing bypasses the cascade
+        status, out_s = _post(port, "/score?model=student", jpeg,
+                              "image/jpeg")
+        assert status == 200 and out_s["model"] == "student"
+        assert "cascade" not in out_s
+        # JSON model field routes too, and matches the query param
+        payload = json.dumps({"image_b64": __import__("base64")
+                              .b64encode(jpeg).decode(),
+                              "model": "student"}).encode()
+        status, out_j = _post(port, "/score", payload, "application/json")
+        assert status == 200 and out_j["model"] == "student"
+        assert out_j["fake_score"] == out_s["fake_score"]
+        # unknown model -> 400 naming the table
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/score?model=nope", jpeg, "image/jpeg")
+        assert ei.value.code == 400
+        assert "models" in json.loads(ei.value.read())
+        # books: 1 triage escalated + 2 explicit student requests
+        assert metrics.cascade_triaged_total.value == 1
+        assert metrics.cascade_flagship_scored_total.value == 1
+        assert metrics.model_book("scored", "student") >= 3
+        # exposition carries the new families
+        text = metrics.render_prometheus()
+        assert "dfd_serving_cascade_triaged_total 1" in text
+        assert 'dfd_serving_model_scored_total{model="student"}' in text
+        assert ('dfd_serving_cascade_latency_seconds_count'
+                '{tier="student"} 1') in text
+    finally:
+        server.shutdown()
+        engine.stop()
+        batcher.close()
+        server.server_close()
+
+
+def test_serve_config_cascade_surface():
+    from deepfake_detection_tpu.config import ServeConfig
+    cfg = ServeConfig.from_args([
+        "--models", "student=vit_tiny_patch16_224,size=32,dtype=int8",
+        "--cascade", "student", "--cascade-low", "0.3",
+        "--cascade-high", "0.7", "--dtype", "bf16"])
+    assert cfg.dtype == "bf16"
+    specs = cfg.model_specs()
+    assert specs[0]["id"] == "student"
+    assert specs[0]["family"] == "vit_tiny_patch16_224"
+    assert specs[0]["size"] == 32 and specs[0]["dtype"] == "int8"
+    assert specs[0]["img_num"] == cfg.img_num     # inherited default
+    with pytest.raises(ValueError):               # unknown cascade id
+        ServeConfig(cascade="ghost")
+    with pytest.raises(ValueError):               # inverted band
+        ServeConfig(models="s=vit_tiny_patch16_224", cascade="s",
+                    cascade_low=0.9, cascade_high=0.1)
+    with pytest.raises(ValueError):               # img_num mismatch
+        ServeConfig(models="s=vit_tiny_patch16_224,img_num=2",
+                    cascade="s")
+    with pytest.raises(ValueError):               # id collides w/ primary
+        ServeConfig(models="efficientnet_deepfake_v4=resnet50")
+    with pytest.raises(ValueError):               # bad dtype
+        ServeConfig(dtype="fp8")

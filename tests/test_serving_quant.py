@@ -1,0 +1,285 @@
+"""PTQ serving-path tests (ISSUE 14): quantize/realize units, padded-
+bucket bit-identity under bf16 and int8 (the PR 2 idiom), CLI-oracle
+parity, and the quantized reload canary.
+
+Fast tier (``quant`` marker): everything runs a small model at a tiny
+canvas so the bucket compiles stay cheap and hit the persistent
+compilation cache on reruns.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepfake_detection_tpu.models import create_model, init_model
+from deepfake_detection_tpu.params import (make_score_fn,
+                                           normalize_replicate,
+                                           prepare_canvas)
+from deepfake_detection_tpu.serving.engine import InferenceEngine
+from deepfake_detection_tpu.serving.quant import (canonical_mode,
+                                                  is_quantized_leaf,
+                                                  quant_summary,
+                                                  quantize_leaf,
+                                                  quantize_tree,
+                                                  realize_tree)
+
+pytestmark = [pytest.mark.serving, pytest.mark.quant]
+
+_MODEL = "mobilenetv3_small_100"
+_SIZE = 24
+
+
+def _perturbed_variables(model, size, chans, seed=0):
+    variables = init_model(model, jax.random.PRNGKey(0),
+                           (1, size, size, chans))
+    rng = np.random.default_rng(seed)
+    return jax.tree.map(
+        lambda a: a + jnp.asarray(
+            0.02 * rng.standard_normal(np.shape(a)).astype(np.float32)
+        ).astype(a.dtype),
+        variables)
+
+
+def _canvases(n, size=_SIZE, seed=0):
+    rng = np.random.default_rng(seed)
+    return [prepare_canvas(
+        rng.integers(0, 255, (40, 36, 3), dtype=np.uint8), size)
+        for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# transform units
+# ---------------------------------------------------------------------------
+
+def test_canonical_mode_aliases():
+    assert canonical_mode("float32") == "f32"
+    assert canonical_mode("BF16") == "bf16"
+    assert canonical_mode("bfloat16") == "bf16"
+    assert canonical_mode("int8") == "int8"
+    with pytest.raises(ValueError):
+        canonical_mode("fp8")
+
+
+def test_quantize_leaf_per_output_channel_scales():
+    """Symmetric per-output-channel int8: the scale is the per-channel
+    absmax / 127, and every dequantized element is within scale/2 of the
+    original (round-to-nearest)."""
+    rng = np.random.default_rng(3)
+    # wildly different per-channel magnitudes: a per-TENSOR scale would
+    # crush the small channels to zero
+    w = rng.standard_normal((3, 3, 8, 4)).astype(np.float32)
+    w *= np.asarray([1e-3, 1.0, 50.0, 0.1], np.float32)
+    q, scale = quantize_leaf(w)
+    assert q.dtype == np.int8 and scale.shape == (4,)
+    np.testing.assert_allclose(scale, np.abs(w).max(axis=(0, 1, 2)) / 127,
+                               rtol=1e-6)
+    deq = q.astype(np.float32) * scale
+    assert np.all(np.abs(deq - w) <= scale / 2 + 1e-9)
+    # an all-zero output channel must not divide by zero
+    w0 = np.zeros((2, 2, 4, 3), np.float32)
+    q0, s0 = quantize_leaf(w0)
+    assert np.all(q0 == 0) and np.all(s0 == 1.0)
+    # a non-finite channel must get a NaN scale (dequant reproduces the
+    # poison for the canary) — int8 casting would launder NaN/inf into
+    # finite garbage the finite-scores gate cannot see
+    wn = np.ones((2, 2, 4, 3), np.float32)
+    wn[0, 0, 0, 0] = np.nan
+    wn[0, 0, 0, 2] = np.inf
+    qn, sn = quantize_leaf(wn)
+    assert np.isnan(sn[0]) and np.isnan(sn[2]) and sn[1] == 1.0 / 127
+    deq = qn.astype(np.float32) * sn
+    assert np.isnan(deq[..., 0]).all() and np.isnan(deq[..., 2]).all()
+    np.testing.assert_allclose(deq[..., 1], wn[..., 1], rtol=1e-6)
+
+
+def test_quantize_tree_modes():
+    model = create_model(_MODEL, num_classes=2, in_chans=3)
+    v = _perturbed_variables(model, _SIZE, 3)
+    # f32 is the identity (same object — no rebuild, no cast)
+    assert quantize_tree(v, "f32") is v
+    assert realize_tree(v) is v
+    qb = quantize_tree(v, "bf16")
+    sb = quant_summary(qb)
+    assert sb["bf16_leaves"] > 0 and sb["quantized_leaves"] == 0
+    # batch_stats stay f32 (numerically load-bearing)
+    assert str(jax.tree.leaves(qb["batch_stats"])[0].dtype) == "float32"
+    qi = quantize_tree(v, "int8")
+    si = quant_summary(qi)
+    assert si["quantized_leaves"] > 0 and si["bf16_leaves"] == 0
+    # realize rebuilds the ORIGINAL tree structure with close values
+    r = realize_tree(qi)
+    flat_v, tree_v = jax.tree.flatten(v)
+    flat_r, tree_r = jax.tree.flatten(r)
+    assert tree_v == tree_r
+    for a, b in zip(flat_v, flat_r):
+        assert np.shape(a) == np.shape(b)
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=0, atol=0.05)
+
+
+def test_int8_container_is_a_plain_pytree():
+    """device_put / flatten / AOT avals all work on the container — the
+    params-as-arguments machinery must not special-case quantization."""
+    model = create_model(_MODEL, num_classes=2, in_chans=3)
+    v = _perturbed_variables(model, _SIZE, 3)
+    qi = jax.device_put(quantize_tree(v, "int8"))
+    leaves = jax.tree.leaves(qi)
+    assert any(l.dtype == jnp.int8 for l in leaves)
+    assert any(is_quantized_leaf(l) for l in jax.tree.leaves(
+        qi, is_leaf=is_quantized_leaf))
+
+
+# ---------------------------------------------------------------------------
+# padded-bucket bit-identity under quantized serving (the PR 2 idiom)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("wire", ["float32", "uint8"])
+@pytest.mark.parametrize("dtype", ["bf16", "int8"])
+def test_padded_bucket_bit_identity_quantized(wire, dtype):
+    """Padding rows cannot perturb real rows on a quantized engine: the
+    same 3 requests score bit-for-bit whether they ride a zero-padded
+    bucket-4 batch or a full one — quantization changes the weights, not
+    the row-independence of eval mode."""
+    model = create_model(_MODEL, num_classes=2, in_chans=3)
+    variables = _perturbed_variables(model, _SIZE, 3, seed=7)
+    engine = InferenceEngine(model, variables, image_size=_SIZE,
+                             img_num=1, buckets=(4,), wire=wire,
+                             dtype=dtype)
+    canvases = _canvases(4, seed=11)
+    if wire == "float32":
+        payloads = [normalize_replicate(c, 1) for c in canvases]
+    else:
+        payloads = canvases
+    padded = engine.score_batch(payloads[:3])     # 3 -> bucket 4 + pad
+    full = engine.score_batch(payloads)           # full bucket 4
+    np.testing.assert_array_equal(padded, full[:3])
+    assert np.isfinite(padded).all()
+    assert np.allclose(padded.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_quantized_scores_near_f32():
+    """Sanity bound (the measured gate is tools/quant_parity.py): bf16
+    and int8 serving scores stay close to f32 on the same engine
+    geometry."""
+    model = create_model(_MODEL, num_classes=2, in_chans=3)
+    variables = _perturbed_variables(model, _SIZE, 3, seed=5)
+    payloads = [normalize_replicate(c, 1) for c in _canvases(4, seed=3)]
+    scores = {}
+    for dtype in ("f32", "bf16", "int8"):
+        engine = InferenceEngine(model, variables, image_size=_SIZE,
+                                 img_num=1, buckets=(4,), dtype=dtype)
+        scores[dtype] = engine.score_batch(payloads)
+    np.testing.assert_allclose(scores["bf16"], scores["f32"], atol=0.02)
+    np.testing.assert_allclose(scores["int8"], scores["f32"], atol=0.06)
+
+
+# ---------------------------------------------------------------------------
+# CLI oracle: runners/test.py --dtype === the engine's float32 wire
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["f32", "bf16", "int8"])
+def test_cli_score_fn_bit_identical_to_engine_f32_wire(dtype):
+    """`make_score_fn` over the quantized tree and the engine's float32-
+    wire program are the same variables-as-argument trace — the CLI is
+    the parity harness's non-server oracle, bit-identical at every
+    dtype (not just f32)."""
+    model = create_model(_MODEL, num_classes=2, in_chans=3)
+    variables = _perturbed_variables(model, _SIZE, 3, seed=9)
+    engine = InferenceEngine(model, variables, image_size=_SIZE,
+                             img_num=1, buckets=(1,), wire="float32",
+                             dtype=dtype)
+    payload = normalize_replicate(_canvases(1, seed=2)[0], 1)
+    got = engine.score_batch([payload])
+    cli = make_score_fn(model, quantize_tree(variables, dtype))
+    want = np.asarray(cli(jnp.asarray(payload[None])))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_runners_test_dtype_flag_parses():
+    """The --dtype surface exists and rejects junk (the heavy flagship
+    CLI e2e stays out of the fast tier)."""
+    from deepfake_detection_tpu.runners import test as test_runner
+    with pytest.raises(SystemExit):
+        test_runner.main(["--dtype", "fp8", "img.jpg"])
+
+
+# ---------------------------------------------------------------------------
+# quantized hot reload: canary gates the QUANTIZED candidate
+# ---------------------------------------------------------------------------
+
+def test_quantized_reload_swaps_and_matches_fresh_quantization():
+    """An f32 checkpoint reloaded into an int8 engine serves the same
+    scores as an engine freshly built from those weights at int8 — the
+    reload path re-quantizes deterministically."""
+    model = create_model(_MODEL, num_classes=2, in_chans=3)
+    v1 = _perturbed_variables(model, _SIZE, 3, seed=1)
+    v2 = _perturbed_variables(model, _SIZE, 3, seed=2)
+    engine = InferenceEngine(model, v1, image_size=_SIZE, img_num=1,
+                             buckets=(1,), dtype="int8")
+    payload = normalize_replicate(_canvases(1, seed=4)[0], 1)
+    before = engine.score_batch([payload])
+    host_v2 = jax.tree.map(np.asarray, v2)
+    engine.submit_reload(host_v2, source="<test>")
+    engine._maybe_apply_reload()
+    assert engine.reload_count == 1
+    after = engine.score_batch([payload])
+    assert not np.array_equal(before, after)
+    oracle = InferenceEngine(model, v2, image_size=_SIZE, img_num=1,
+                             buckets=(1,), dtype="int8")
+    np.testing.assert_array_equal(after, oracle.score_batch([payload]))
+
+
+def test_quantized_reload_canary_rejects_nan_checkpoint():
+    """A poisoned f32 checkpoint must fail the QUANTIZED canary (the
+    failure-mode table's 'quantized canary reject' row): weights roll
+    back bit-identically, the counter moves."""
+    model = create_model(_MODEL, num_classes=2, in_chans=3)
+    v = _perturbed_variables(model, _SIZE, 3, seed=1)
+    engine = InferenceEngine(model, v, image_size=_SIZE, img_num=1,
+                             buckets=(1,), dtype="int8")
+    payload = normalize_replicate(_canvases(1, seed=4)[0], 1)
+    before = engine.score_batch([payload])
+    host = jax.tree.map(np.asarray, v)
+    nan_tree = jax.tree.map(
+        lambda a: np.full_like(a, np.nan)
+        if np.issubdtype(a.dtype, np.floating) else a, host)
+    errors0 = engine.metrics.reload_errors_total.value
+    canary0 = engine.metrics.reload_canary_failures_total.value
+    engine.submit_reload(nan_tree, source="<nan>")
+    engine._maybe_apply_reload()
+    assert engine.reload_count == 0
+    assert engine.metrics.reload_errors_total.value == errors0 + 1
+    assert engine.metrics.reload_canary_failures_total.value == canary0 + 1
+    np.testing.assert_array_equal(engine.score_batch([payload]), before)
+
+
+def test_quantized_reload_canary_rejects_nan_kernels_only():
+    """NaN confined to the KERNELS (the int8-quantized leaves, every
+    other leaf healthy) must still fail the canary: quantize_leaf
+    propagates a NaN scale instead of laundering the poison into finite
+    int8 garbage that would score finite and commit the swap."""
+    model = create_model(_MODEL, num_classes=2, in_chans=3)
+    v = _perturbed_variables(model, _SIZE, 3, seed=1)
+    engine = InferenceEngine(model, v, image_size=_SIZE, img_num=1,
+                             buckets=(1,), dtype="int8")
+    payload = normalize_replicate(_canvases(1, seed=4)[0], 1)
+    before = engine.score_batch([payload])
+    host = jax.tree.map(np.asarray, v)
+
+    def poison(path, a):
+        keys = [getattr(p, "key", None) for p in path]
+        if "params" in keys and keys[-1] == "kernel" and a.ndim >= 2:
+            return np.full_like(a, np.nan)
+        return a
+
+    nan_tree = jax.tree_util.tree_map_with_path(poison, host)
+    errors0 = engine.metrics.reload_errors_total.value
+    canary0 = engine.metrics.reload_canary_failures_total.value
+    engine.submit_reload(nan_tree, source="<nan-kernels>")
+    engine._maybe_apply_reload()
+    assert engine.reload_count == 0
+    assert engine.metrics.reload_errors_total.value == errors0 + 1
+    assert engine.metrics.reload_canary_failures_total.value == canary0 + 1
+    np.testing.assert_array_equal(engine.score_batch([payload]), before)

@@ -71,6 +71,9 @@ def test_meta_json_default_converts_numpy_rejects_unknown():
 
 
 class TestShardedCheckpoint:
+    @pytest.mark.slow   # tier-1 budget: full FSDP save/restore sweep
+    # (~16 s); test_restore_reshards_onto_new_layout and the msgpack
+    # mesh-continuity tests keep the resharded-restore mechanism fast
     def test_fsdp_roundtrip_preserves_values_and_shardings(
             self, tmp_path, devices):
         mesh = make_mesh()
@@ -122,6 +125,9 @@ class TestShardedCheckpoint:
             assert r.sharding.is_equivalent_to(t.sharding, t.ndim)
             np.testing.assert_array_equal(np.asarray(r), np.asarray(s))
 
+    @pytest.mark.slow   # tier-1 budget: cross-optimizer resume policy
+    # drive (~8 s); the load_opt=False mechanism stays fast via
+    # test_train::TestCheckpointing::test_no_resume_opt
     def test_no_resume_opt_under_different_optimizer(self, tmp_path,
                                                      devices):
         """load_opt=False must not read or structure-match the saved
@@ -182,6 +188,9 @@ class TestShardedCheckpoint:
                                        "--resume", ckpt, "--epochs", "2"])
         assert out["best_metric"] is not None
 
+    @pytest.mark.slow   # tier-1 budget: full train-run fixture (~16 s);
+    # EMA-stream preference is also pinned fast by the ema helpers in
+    # test_train/test_utils and restore_reshards stays fast above
     def test_load_for_eval_prefers_ema(self, tmp_path, devices):
         """Serving path: load_sharded_for_eval pulls the EMA stream from a
         sharded TRAIN checkpoint (the reference ships its released model
@@ -316,6 +325,9 @@ class TestMsgpackMeshContinuity:
                                           np.asarray(orig))
         assert resharded > 0, "template had no FSDP-sharded leaf"
 
+    @pytest.mark.slow   # tier-1 budget: reverse direction of the mesh-
+    # continuity pair (~4 s); one_chip→eight_way stays fast and pins the
+    # same restore_resharded path
     def test_eight_way_checkpoint_restores_onto_one_chip(
             self, tmp_path, devices):
         from deepfake_detection_tpu.train import (restore_resharded,

@@ -426,6 +426,10 @@ class TestUnifiedStepParity:
             in_specs=(P(), P("data"), P("data"), P()),
             out_specs=(P(), P()), **shard_map_check_kwargs(True)))
 
+    @pytest.mark.slow   # tier-1 budget: the full pre-migration shard_map
+    # oracle (~14 s, compiles both step programs); the unified-step
+    # mechanism stays fast via test_unified_local_bn_differs_from_global
+    # and test_grad_accum_on_mesh
     def test_unified_step_matches_premigration_shard_map(self, devices):
         """Two steps, dp=8, drop 0 (dropout noise is drawn over the global
         batch now instead of per-device folds — the one documented
@@ -521,6 +525,9 @@ class TestUnifiedStepParity:
         assert worst > 1e-8, "local grouping had no effect on BN stats"
 
 
+@pytest.mark.slow   # tier-1 budget: duplicate-parity sweep (~7 s, two
+# full accumulation schedules); the mesh variant below — the production
+# path — stays fast
 def test_grad_accum_matches_single_step(devices):
     """A=2 over the same total batch produces the same update as A=1
     (no-BN model so stats don't differ between the two schedules)."""

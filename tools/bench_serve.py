@@ -76,7 +76,8 @@ def free_port() -> int:
 # server lifecycle
 # ---------------------------------------------------------------------------
 
-def spawn_server(args) -> Tuple[subprocess.Popen, str]:
+def spawn_server(args, extra: Optional[List[str]] = None
+                 ) -> Tuple[subprocess.Popen, str]:
     port = free_port()
     cmd = [sys.executable, "-m", "deepfake_detection_tpu.runners.serve",
            "--model", args.model, "--image-size", str(args.image_size),
@@ -90,6 +91,9 @@ def spawn_server(args) -> Tuple[subprocess.Popen, str]:
         cmd += ["--wire", args.wire]
     if args.model_path:
         cmd += ["--model-path", args.model_path]
+    if getattr(args, "dtype", ""):
+        cmd += ["--dtype", args.dtype]
+    cmd += list(extra or [])
     env = dict(os.environ)
     # the sitecustomize registers a (possibly dark) TPU relay whenever this
     # var is set; the server child must not block on it unless asked
@@ -117,6 +121,87 @@ def wait_ready(netloc: str, timeout: float = 900.0) -> None:
             pass
         time.sleep(0.5)
     raise TimeoutError(f"server at {netloc} not ready within {timeout}s")
+
+
+def scrape_metrics_labeled(netloc: str) -> Dict[str, float]:
+    """Labeled samples too: ``name{label="x"}`` -> value (the per-model /
+    per-bucket families scrape_metrics skips)."""
+    host, port = netloc.split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=5)
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        lhs, _, value = line.rpartition(" ")
+        if not lhs:
+            continue
+        try:
+            out[lhs] = float(value)
+        except ValueError:
+            pass
+    return out
+
+
+def labeled_family(labeled: Dict[str, float], family: str) -> Dict[str, float]:
+    """{label-string: value} for one family's samples."""
+    out = {}
+    prefix = family + "{"
+    for k, v in labeled.items():
+        if k.startswith(prefix) and k.endswith("}"):
+            out[k[len(prefix):-1]] = v
+    return out
+
+
+def _label_get(labels: str, key: str) -> str:
+    import re
+    m = re.search(key + r'="([^"]*)"', labels)
+    return m.group(1) if m else ""
+
+
+def per_bucket_padding_rows(labeled: Dict[str, float]) -> List[str]:
+    """Markdown rows: per-(model, bucket) real/pad split + padding
+    fraction (the aggregate number hides WHERE padded rows go — under a
+    cascade the student and flagship fill buckets very differently)."""
+    fam = labeled_family(labeled, "dfd_serving_bucket_rows_total")
+    acc: Dict[Tuple[str, int], Dict[str, float]] = {}
+    for labels, v in fam.items():
+        key = (_label_get(labels, "model"),
+               int(_label_get(labels, "bucket") or 0))
+        acc.setdefault(key, {})[_label_get(labels, "kind")] = v
+    rows = ["| model | bucket | real rows | pad rows | padding |",
+            "|---|---|---|---|---|"]
+    for (model, bucket) in sorted(acc):
+        real = acc[(model, bucket)].get("real", 0)
+        pad = acc[(model, bucket)].get("pad", 0)
+        frac = 100.0 * pad / max(1.0, real + pad)
+        rows.append(f"| {model} | {bucket} | {real:.0f} | {pad:.0f} | "
+                    f"{frac:.1f}% |")
+    return rows if len(rows) > 2 else []
+
+
+def per_model_rows(labeled: Dict[str, float]) -> List[str]:
+    """Markdown rows: per-model request books from the labeled ledger."""
+    kinds = ("accepted", "scored", "failed", "shed", "deadline")
+    models = set()
+    for kind in kinds:
+        fam = labeled_family(labeled,
+                             f"dfd_serving_model_{kind}_total")
+        models.update(_label_get(l, "model") for l in fam)
+    if not models:
+        return []
+    rows = ["| model | accepted | scored | failed | shed | deadline |",
+            "|---|---|---|---|---|---|"]
+    for model in sorted(models):
+        vals = []
+        for kind in kinds:
+            fam = labeled_family(labeled,
+                                 f"dfd_serving_model_{kind}_total")
+            vals.append(fam.get(f'model="{model}"', 0))
+        rows.append("| " + model + " | " +
+                    " | ".join(f"{v:.0f}" for v in vals) + " |")
+    return rows
 
 
 def scrape_metrics(netloc: str) -> Dict[str, float]:
@@ -417,6 +502,134 @@ def cold_oneshot_baseline(args, jpeg: bytes) -> Optional[float]:
 
 
 # ---------------------------------------------------------------------------
+# cascade matrix (--models/--cascade/--traffic-mix)
+# ---------------------------------------------------------------------------
+
+def calibrate_band(args, jpegs: List[bytes]) -> Tuple[float, float]:
+    """Suspect band [lo, 1.0] such that ~``--traffic-mix`` of the bench
+    traffic clears on the student.
+
+    Synthetic bench traffic has no ground truth, so the escalation
+    fraction is dialed in from the student's own score distribution on
+    the exact jpeg set the load generator cycles: lo = the traffic-mix
+    quantile of the student's fake scores (the server's student is the
+    same deterministic seed-0 init, so the in-process replica scores
+    identically).  Real deployments pick the band from validation data
+    instead — this keeps the measured mix honest on a random init."""
+    import io as _io
+
+    import jax
+    import jax.numpy as jnp
+    from PIL import Image
+
+    from deepfake_detection_tpu.config import parse_model_spec
+    from deepfake_detection_tpu.models import create_model, init_model
+    from deepfake_detection_tpu.params import (make_score_fn,
+                                               normalize_replicate,
+                                               prepare_canvas)
+    from deepfake_detection_tpu.serving.quant import quantize_tree
+
+    specs = {s["id"]: s for s in (
+        parse_model_spec(e, default_size=args.image_size,
+                         default_img_num=args.img_num)
+        for e in args.models.split(";") if e.strip())}
+    spec = specs[args.cascade]
+    size, num = spec["size"], spec["img_num"]
+    model = create_model(spec["family"], num_classes=2, in_chans=3 * num)
+    variables = init_model(model, jax.random.PRNGKey(0),
+                           (1, size, size, 3 * num))
+    if spec["path"]:
+        from deepfake_detection_tpu.models.helpers import load_checkpoint
+        variables = load_checkpoint(variables, spec["path"], strict=False)
+    variables = jax.device_put(quantize_tree(variables, spec["dtype"]))
+    # the engine's exact variables-as-argument program (bit-parity
+    # contract) — never a re-derived local copy of it
+    score_fn = make_score_fn(model, variables)
+
+    x = jnp.asarray(np.stack([
+        normalize_replicate(prepare_canvas(np.asarray(
+            Image.open(_io.BytesIO(j)).convert("RGB"), np.uint8), size),
+            num) for j in jpegs]))
+    p_fake = np.asarray(score_fn(x))[:, 0]
+    lo = float(np.quantile(p_fake, args.traffic_mix))
+    frac = float((p_fake >= lo).mean())
+    _log(f"calibrated suspect band [{lo:.4f}, 1.0]: {frac:.0%} of the "
+         f"bench traffic escalates (target {1 - args.traffic_mix:.0%})")
+    return lo, 1.0
+
+
+def assert_cascade_books(m: Dict[str, float]) -> None:
+    tri = m.get("dfd_serving_cascade_triaged_total", 0)
+    clr = m.get("dfd_serving_cascade_cleared_total", 0)
+    esc = m.get("dfd_serving_cascade_escalated_total", 0)
+    fs = m.get("dfd_serving_cascade_flagship_scored_total", 0)
+    ef = m.get("dfd_serving_cascade_escalation_failed_total", 0)
+    if tri != clr + esc or esc != fs + ef:
+        raise AssertionError(
+            f"cascade books do not balance: triaged {tri:.0f} != cleared "
+            f"{clr:.0f} + escalated {esc:.0f}, or escalated {esc:.0f} != "
+            f"flagship_scored {fs:.0f} + escalation_failed {ef:.0f}")
+    _log(f"cascade books balance: {tri:.0f} triaged == {clr:.0f} cleared "
+         f"+ {esc:.0f} escalated; {esc:.0f} escalated == {fs:.0f} "
+         f"flagship + {ef:.0f} failed")
+
+
+def run_cascade_phase(args, jpegs: List[bytes],
+                      concurrency: int) -> Tuple[dict, Dict[str, float]]:
+    """Spawn the two-model cascade server, drive the same closed loop,
+    and return (load stats incl. cascade counters, labeled metrics)."""
+    lo, hi = calibrate_band(args, jpegs)
+    extra = ["--models", args.models, "--cascade", args.cascade,
+             "--cascade-low", f"{lo:.6f}", "--cascade-high", f"{hi:.6f}"]
+    proc, netloc = spawn_server(args, extra=extra)
+    try:
+        wait_ready(netloc)
+        m0 = scrape_metrics(netloc)
+        backend0 = m0.get("dfd_serving_backend_compiles_total", 0)
+        compiles0 = m0.get("dfd_serving_compiles_total", 0)
+        _log(f"cascade closed loop: concurrency {concurrency}, "
+             f"{args.duration:.0f}s (+{args.warmup:.0f}s warmup)")
+        r = run_load(netloc, jpegs, concurrency, args.duration,
+                     args.warmup, retry_cap_s=args.retry_cap)
+        _log(f"  -> {r['rps']:.1f} req/s, p50 {r['p50']:.1f} ms, "
+             f"statuses {r['statuses']}")
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            m1 = scrape_metrics(netloc)
+            acc = m1.get("dfd_serving_accepted_total", 0)
+            resolved = (m1.get("dfd_serving_scored_total", 0) +
+                        m1.get("dfd_serving_shed_total", 0) +
+                        m1.get("dfd_serving_deadline_total", 0) +
+                        m1.get("dfd_serving_failed_total", 0))
+            if acc == resolved:
+                break
+            time.sleep(1.0)
+        if acc != resolved:
+            raise AssertionError(f"books do not balance after drain: "
+                                 f"accepted {acc:.0f} != {resolved:.0f}")
+        assert_cascade_books(m1)
+        recompiles = (m1.get("dfd_serving_compiles_total", 0) - compiles0)             + (m1.get("dfd_serving_backend_compiles_total", 0) - backend0)
+        if recompiles:
+            raise AssertionError(f"{recompiles:+.0f} recompiles during "
+                                 f"the cascade phase (must be zero)")
+        _log("cascade phase: zero post-warmup recompiles, books balanced")
+        labeled = scrape_metrics_labeled(netloc)
+        r["cascade"] = {k.rsplit("_total", 1)[0].split("cascade_")[-1]: v
+                        for k, v in m1.items()
+                        if k.startswith("dfd_serving_cascade_")}
+        r["band"] = (lo, hi)
+        return r, labeled
+    finally:
+        _terminate_proc(proc)
+
+
+def _terminate_proc(proc: subprocess.Popen) -> None:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
@@ -454,8 +667,28 @@ def main(argv=None) -> int:
     ap.add_argument("--no-engine-loop", action="store_true")
     ap.add_argument("--keep-env", action="store_true",
                     help="inherit the env as-is (e.g. to bench on TPU)")
+    ap.add_argument("--dtype", default="",
+                    help="serving PTQ dtype of the primary model "
+                         "(f32|bf16|int8; quant_parity.py owns the "
+                         "accuracy gate)")
+    ap.add_argument("--models", default="",
+                    help="extra model-table specs passed through to the "
+                         "server (ServeConfig --models grammar)")
+    ap.add_argument("--cascade", default="",
+                    help="run the two-tier cascade matrix: this --models "
+                         "id triages student-first in a SECOND server "
+                         "phase, compared against the flagship-only "
+                         "phase at the same concurrency")
+    ap.add_argument("--traffic-mix", type=float, default=0.8,
+                    help="fraction of bench traffic the calibrated "
+                         "suspect band lets the student clear (the rest "
+                         "escalates to the flagship)")
     ap.add_argument("--out", default="", help="write the markdown here")
     args = ap.parse_args(argv)
+    if args.cascade and not args.models:
+        ap.error("--cascade needs --models naming the student spec")
+    if args.cascade and not 0.0 < args.traffic_mix < 1.0:
+        ap.error("--traffic-mix must be in (0, 1)")
 
     jpegs = make_jpegs(32, args.src_size)
     _log(f"{len(jpegs)} synthetic JPEGs, ~{len(jpegs[0]) // 1024} KiB each")
@@ -492,6 +725,7 @@ def main(argv=None) -> int:
         batches = m1.get("dfd_serving_batches_total", 0)
         real_rows = m1.get("dfd_serving_batch_rows_total", 0)
         padded = m1.get("dfd_serving_padded_rows_total", 0)
+        labeled_main = scrape_metrics_labeled(netloc)
     finally:
         if proc is not None:
             proc.terminate()
@@ -506,6 +740,11 @@ def main(argv=None) -> int:
         _log(f"engine closed loop (no socket layer), concurrency {c} ...")
         eng = engine_closed_loop(args, jpegs, c, args.duration, args.warmup)
         _log(f"  -> {eng['rps']:.1f} req/s, p50 {eng['p50']:.1f} ms")
+
+    cas = cas_labeled = None
+    if args.cascade:
+        c = max(int(x) for x in args.concurrency.split(","))
+        cas, cas_labeled = run_cascade_phase(args, jpegs, c)
 
     seq = None
     if not args.no_baseline:
@@ -551,6 +790,28 @@ def main(argv=None) -> int:
         lines.append(f"| batcher+engine, no socket layer, concurrency {c} "
                      f"| {eng['rps']:.1f} | {ratio} | {eng['p50']:.1f} | "
                      f"{eng['p95']:.1f} | {eng['p99']:.1f} |")
+    if cas is not None:
+        c = max(int(x) for x in args.concurrency.split(","))
+        flag_row = next((r for cc, r in rows if cc == c), None)
+        ratio = (f"{cas['rps'] / flag_row['rps']:.2f}×"
+                 if flag_row and flag_row["rps"] else "–")
+        books = cas["cascade"]
+        vs_seq = f"{cas['rps'] / seq:.2f}×" if seq else "–"
+        lines.append(
+            f"| cascade ({args.cascade} triages, band "
+            f"[{cas['band'][0]:.3f}, {cas['band'][1]:.3f}]), "
+            f"concurrency {c} | {cas['rps']:.1f} | {vs_seq} | "
+            f"{cas['p50']:.1f} | {cas['p95']:.1f} | {cas['p99']:.1f} |")
+        lines.append("")
+        lines.append(
+            f"**Cascade vs flagship-only at concurrency {c}: {ratio} "
+            f"effective req/s** ({books.get('triaged', 0):.0f} triaged = "
+            f"{books.get('cleared', 0):.0f} cleared + "
+            f"{books.get('escalated', 0):.0f} escalated; "
+            f"{books.get('escalated', 0):.0f} escalated = "
+            f"{books.get('flagship_scored', 0):.0f} flagship-scored + "
+            f"{books.get('escalation_failed', 0):.0f} failed — books "
+            f"exact, zero recompiles).")
     lines.append("")
     lines.append(f"Compile probe: {compiles_at_ready:.0f} bucket "
                  f"executables at ready, **{recompiles:+.0f} after "
@@ -560,6 +821,22 @@ def main(argv=None) -> int:
                  f"{padded:.0f} padded rows "
                  f"({100 * padded / max(1, real_rows + padded):.1f}% "
                  f"padding).")
+    for title, labeled in (("flagship-only phase", labeled_main),
+                           ("cascade phase", cas_labeled)):
+        if not labeled:
+            continue
+        bucket_rows_md = per_bucket_padding_rows(labeled)
+        model_rows_md = per_model_rows(labeled)
+        if model_rows_md:
+            lines.append("")
+            lines.append(f"Per-model request books ({title}):")
+            lines.append("")
+            lines.extend(model_rows_md)
+        if bucket_rows_md:
+            lines.append("")
+            lines.append(f"Per-bucket padding ({title}):")
+            lines.append("")
+            lines.extend(bucket_rows_md)
     table = "\n".join(lines)
     print(table)
 

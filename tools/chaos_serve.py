@@ -105,6 +105,15 @@ def _spawn_serve(args, port: int, chaos: str,
            "--batch-deadline-ms", "5", "--max-queue", "64",
            "--watchdog-timeout-s", str(args.watchdog_timeout_s),
            "--breaker-threshold", str(args.breaker_threshold)]
+    if args.models:
+        # two-model mode (ISSUE 14): every serve scenario runs with the
+        # extra model(s) loaded — recovery re-warms BOTH models' buckets,
+        # books must balance across the whole table; --cascade routes
+        # the load student-first so faults hit cascade traffic too
+        cmd += ["--models", args.models]
+        if args.cascade:
+            cmd += ["--cascade", args.cascade,
+                    "--cascade-low", "0.0", "--cascade-high", "1.0"]
     cmd += list(extra or [])
     _log("spawn: DFD_CHAOS=%r %s" % (chaos, " ".join(cmd)))
     return subprocess.Popen(cmd, cwd=_REPO, env=_child_env(chaos),
@@ -223,6 +232,20 @@ def _assert_books_balance(netloc: str, settle_s: float = 2.0) -> dict:
             f"{m.get('dfd_serving_deadline_total', 0):.0f} + failed "
             f"{m.get('dfd_serving_failed_total', 0):.0f}")
     _log(f"books balance: accepted {acc:.0f} == resolved {resolved:.0f}")
+    tri = m.get("dfd_serving_cascade_triaged_total", 0)
+    clr = m.get("dfd_serving_cascade_cleared_total", 0)
+    esc = m.get("dfd_serving_cascade_escalated_total", 0)
+    fs = m.get("dfd_serving_cascade_flagship_scored_total", 0)
+    ef = m.get("dfd_serving_cascade_escalation_failed_total", 0)
+    if tri or esc:
+        # cascade mode: the triage books must hold through the fault too
+        if tri != clr + esc or esc != fs + ef:
+            raise AssertionError(
+                f"cascade books do not balance: {tri:.0f} triaged != "
+                f"{clr:.0f} cleared + {esc:.0f} escalated, or {esc:.0f} "
+                f"escalated != {fs:.0f} flagship + {ef:.0f} failed")
+        _log(f"cascade books balance: {tri:.0f} == {clr:.0f} + {esc:.0f};"
+             f" {esc:.0f} == {fs:.0f} + {ef:.0f}")
     return m
 
 
@@ -575,6 +598,15 @@ def main(argv=None) -> int:
                     help=f"comma list of {SCENARIOS} or 'all'")
     ap.add_argument("--model", default="mobilenetv3_small_100",
                     help="registered model (default sized for CPU boxes)")
+    ap.add_argument("--models", default="",
+                    help="extra model-table specs (ServeConfig --models "
+                         "grammar): serve scenarios then run with N "
+                         "models loaded — the ISSUE 14 invariant drive")
+    ap.add_argument("--cascade", default="",
+                    help="with --models: route un-addressed load "
+                         "student-first through this --models id "
+                         "(band [0,1], every clip escalates — both "
+                         "tiers see every fault)")
     ap.add_argument("--image-size", type=int, default=32)
     ap.add_argument("--src-size", type=int, default=64)
     ap.add_argument("--slo-s", type=float, default=15.0,
