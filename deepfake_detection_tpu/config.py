@@ -845,6 +845,119 @@ class BackfillConfig:
 
 
 # ---------------------------------------------------------------------------
+# Fleet router config (runners/router.py)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RouterConfig:
+    """Knob surface of the fleet replica router.
+
+    Same conventions as the other configs: every field is a
+    ``--dashed-flag``, a YAML ``-c`` file resets defaults, CLI
+    overrides.  The router attaches to running replicas
+    (``--replicas url,url``) and/or spawns its own local fleet
+    (``--spawn N`` children of ``--spawn-runner`` with
+    ``--replica-args`` passed through) — both sets join one registry.
+    """
+    # --- network ---
+    host: str = "127.0.0.1"
+    port: int = 8380                     # serve=8377, stream=8378
+
+    # --- fleet membership ---
+    replicas: str = ""                   # comma list of replica URLs
+    # (host:port or http://host:port) to attach to
+    spawn: int = 0                       # local replica children to spawn
+    spawn_runner: str = "serve"          # serve | stream
+    replica_args: str = ""               # extra CLI for every spawned
+    # replica (shlex-split), e.g. "--model ... --single-thread-xla"
+
+    # --- health (fleet/controller.py scraper) ---
+    scrape_interval_s: float = 0.5
+    health_fail_after: int = 3           # consecutive scrape failures
+    # before a replica is marked down
+    scrape_timeout_s: float = 2.0
+
+    # --- routing (fleet/router.py) ---
+    virtual_nodes: int = 64              # hash-ring vnodes per replica
+    route_retries: int = 2               # failover attempts past the
+    # first replica on shed/transport error (stateless traffic only)
+    upstream_timeout_s: float = 30.0
+    # router-level shed Retry-After: base + uniform [0, jitter) — the
+    # serving stack's anti-thundering-herd idiom at the fleet edge
+    shed_retry_after_s: float = 1.0
+    retry_jitter_s: float = 2.0
+
+    # --- migration (fleet/migrate.py) ---
+    migrate_timeout_s: float = 30.0      # per-stream export/restore bound
+    drain_on_exit: bool = False          # drain spawned replicas' streams
+    # before terminating them on shutdown
+
+    # ------------------------------------------------------------------
+    def __post_init__(self):
+        if self.spawn_runner not in ("serve", "stream"):
+            raise ValueError(f"--spawn-runner must be serve|stream, got "
+                             f"{self.spawn_runner!r}")
+        if int(self.spawn) < 0:
+            raise ValueError(f"--spawn must be >= 0, got {self.spawn}")
+        if int(self.virtual_nodes) < 1:
+            raise ValueError(f"--virtual-nodes must be >= 1, got "
+                             f"{self.virtual_nodes}")
+        if int(self.route_retries) < 0:
+            raise ValueError(f"--route-retries must be >= 0, got "
+                             f"{self.route_retries}")
+        if int(self.health_fail_after) < 1:
+            raise ValueError(f"--health-fail-after must be >= 1, got "
+                             f"{self.health_fail_after}")
+        for name in ("scrape_interval_s", "scrape_timeout_s",
+                     "upstream_timeout_s", "migrate_timeout_s",
+                     "shed_retry_after_s"):
+            if float(getattr(self, name)) <= 0:
+                raise ValueError(f"--{name.replace('_', '-')} must be "
+                                 f"> 0, got {getattr(self, name)}")
+        if float(self.retry_jitter_s) < 0:
+            raise ValueError(f"--retry-jitter-s must be >= 0, got "
+                             f"{self.retry_jitter_s}")
+
+    def replica_urls(self) -> List[str]:
+        return [u.strip() for u in str(self.replicas).split(",")
+                if u.strip()]
+
+    def validate_required(self) -> "RouterConfig":
+        """Launch-surface check (two-stage parse builds an all-defaults
+        instance first): the router needs a fleet to route over."""
+        if not self.replica_urls() and int(self.spawn) < 1:
+            raise ValueError("give the router a fleet: --replicas "
+                             "url[,url...] and/or --spawn N")
+        return self
+
+    # ------------------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RouterConfig":
+        known = {f_.name for f_ in fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "RouterConfig":
+        with open(path) as f:
+            d = yaml.safe_load(f) if _HAS_YAML else json.load(f)
+        return cls.from_dict(d or {})
+
+    @classmethod
+    def argument_parser(cls) -> argparse.ArgumentParser:
+        return _dataclass_parser(
+            cls, "fleet replica router (shared-nothing scale-out)")
+
+    @classmethod
+    def from_args(cls, argv: Optional[Sequence[str]] = None
+                  ) -> "RouterConfig":
+        return _two_stage_parse(
+            cls, argv, cls.argument_parser()).validate_required()
+
+
+# ---------------------------------------------------------------------------
 # Streaming config (runners/stream.py)
 # ---------------------------------------------------------------------------
 

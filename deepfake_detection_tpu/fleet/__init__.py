@@ -1,0 +1,50 @@
+"""Fleet scale-out: a shared-nothing replica router tier.
+
+One serve/stream process per unit of capacity, N of them behind a
+stdlib-HTTP router (``runners/router.py``): consistent-hash stream
+affinity, health derived from the signals the replicas already export
+(``/readyz`` + ``/metrics``), shed-aware retry routing honoring each
+replica's Retry-After, and live stream migration on drain via the
+PR 10 session snapshot/restore machinery.
+
+Deliberately **jax-free top to bottom** (dfdlint DFD001): the router
+tier must never pay — or wait on — an accelerator import; replicas are
+separate processes that do.
+
+PEP-562 lazy exports (the ``obs/`` idiom) keep ``import
+deepfake_detection_tpu.fleet`` cheap for config/tests.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "HashRing": "registry",
+    "Replica": "registry",
+    "Registry": "registry",
+    "normalize_netloc": "registry",
+    "RouterMetrics": "metrics",
+    "relabel_exposition": "metrics",
+    "HealthScraper": "controller",
+    "ReplicaProcess": "controller",
+    "spawn_replicas": "controller",
+    "free_port": "controller",
+    "http_request": "controller",
+    "parse_exposition": "controller",
+    "RouterServer": "router",
+    "make_router_server": "router",
+    "drain_replica": "migrate",
+    "undrain_replica": "migrate",
+    "migrate_stream": "migrate",
+    "list_streams": "migrate",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

@@ -1,0 +1,217 @@
+"""Fleet controller: replica health scraping + optional replica process
+management.
+
+**No new instrumentation in the engine**: replica health is derived
+entirely from signals the serve/stream stack already exports —
+
+* ``GET /readyz`` — the per-model JSON readiness detail (status 503 with
+  a parseable body means *cold model warming*; a connection error means
+  *engine down* — the distinction the router needs to route around a
+  re-warm without declaring the replica dead);
+* ``GET /metrics`` — breaker state (``dfd_serving_breaker_state``),
+  queue depth, inflight and the full exposition text (kept verbatim for
+  the router's ``replica=``-labeled re-export).
+
+A replica whose scrape fails ``fail_after`` consecutive times is marked
+down (an open breaker or a watchdog re-warm drains traffic away much
+earlier, via ready=False / breaker_state on the same scrape).
+
+:class:`ReplicaProcess` spawns one ``runners/serve.py`` /
+``runners/stream.py`` child per replica for the self-hosted topology
+(``runners/router.py --spawn N``); the harnesses spawn their own
+children and attach by URL instead.  The controller itself must stay
+jax-free (dfdlint DFD001) — children import the accelerator stack, the
+router tier never does.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import shlex
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .metrics import RouterMetrics
+from .registry import Registry, Replica
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["HealthScraper", "ReplicaProcess", "free_port",
+           "http_request", "parse_exposition"]
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def http_request(netloc: str, method: str, path: str, body: bytes = b"",
+                 headers: Optional[dict] = None, timeout: float = 5.0
+                 ) -> tuple:
+    """One short-lived HTTP round trip → (status, headers dict, body).
+    Raises OSError on transport failure (the caller's down-detection)."""
+    host, port = netloc.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request(method, path, body or None, headers or {})
+        resp = conn.getresponse()
+        data = resp.read()
+        hdrs = {k.lower(): v for k, v in resp.getheaders()}
+        return resp.status, hdrs, data
+    except http.client.HTTPException as e:
+        raise OSError(f"bad HTTP response from {netloc}: {e!r}") from e
+    finally:
+        conn.close()
+
+
+def parse_exposition(text: str) -> Dict[str, float]:
+    """Unlabeled samples of one exposition document → {name: value}."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) == 2 and "{" not in parts[0]:
+            try:
+                out[parts[0]] = float(parts[1])
+            except ValueError:
+                pass
+    return out
+
+
+class HealthScraper:
+    """One thread polling every replica's /readyz + /metrics on a fixed
+    cadence, folding the results into the registry's routing state."""
+
+    def __init__(self, registry: Registry, metrics: RouterMetrics,
+                 interval_s: float = 0.5, fail_after: int = 3,
+                 timeout_s: float = 2.0):
+        self.registry = registry
+        self.metrics = metrics
+        self.interval_s = float(interval_s)
+        self.fail_after = max(1, int(fail_after))
+        self.timeout_s = float(timeout_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def scrape_once(self, r: Replica) -> None:
+        """Scrape one replica; mutates its routing state in place."""
+        try:
+            status, _, body = http_request(
+                r.netloc, "GET", "/readyz", timeout=self.timeout_s)
+            try:
+                readiness = json.loads(body)
+            except ValueError:
+                readiness = None      # pre-JSON replicas: status rules
+            _, _, mtext = http_request(
+                r.netloc, "GET", "/metrics", timeout=self.timeout_s)
+        except OSError:
+            self.metrics.scrape_errors_total.inc()
+            r.consecutive_failures += 1
+            if r.consecutive_failures >= self.fail_after and r.healthy:
+                _logger.warning("replica %s: %d consecutive scrape "
+                                "failures — marking DOWN", r.id,
+                                r.consecutive_failures)
+                self.metrics.replicas_down_total.inc()
+                r.healthy = False
+                r.ready = False
+                r.exposition = None
+            return
+        text = mtext.decode("utf-8", "replace")
+        samples = parse_exposition(text)
+        was_healthy = r.healthy
+        r.consecutive_failures = 0
+        r.healthy = True
+        r.ready = status == 200
+        r.readiness = readiness if isinstance(readiness, dict) else None
+        r.breaker_state = int(samples.get("dfd_serving_breaker_state", 0))
+        r.queue_depth = int(samples.get("dfd_serving_queue_depth", 0))
+        r.inflight = int(samples.get("dfd_serving_inflight", 0))
+        r.exposition = text
+        r.last_scrape_t = time.monotonic()
+        if not was_healthy:
+            _logger.info("replica %s: back up (ready=%s)", r.id, r.ready)
+
+    def scrape_all(self) -> None:
+        for r in self.registry.all():
+            if self._stop.is_set():
+                return
+            self.scrape_once(r)
+        self.metrics.set_fleet_gauges(self.registry.counts())
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        assert self._thread is None, "scraper already started"
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-health-scraper",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            try:
+                self.scrape_all()
+            except Exception:                      # noqa: BLE001
+                _logger.exception("health scrape pass failed")
+            elapsed = time.monotonic() - t0
+            self._stop.wait(max(0.05, self.interval_s - elapsed))
+
+
+class ReplicaProcess:
+    """One spawned replica child (serve or stream runner) on a local
+    free port, with the terminate→kill shutdown escalation."""
+
+    RUNNERS = ("serve", "stream")
+
+    def __init__(self, runner: str, port: int, extra_args: str = "",
+                 env: Optional[dict] = None):
+        if runner not in self.RUNNERS:
+            raise ValueError(f"runner must be one of {self.RUNNERS}, "
+                             f"got {runner!r}")
+        self.runner = runner
+        self.port = int(port)
+        self.cmd = [sys.executable, "-m",
+                    f"deepfake_detection_tpu.runners.{runner}",
+                    "--port", str(self.port)] + shlex.split(extra_args)
+        _logger.info("spawning replica: %s", " ".join(self.cmd))
+        self.proc = subprocess.Popen(self.cmd, env=env)
+
+    @property
+    def netloc(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def stop(self, timeout_s: float = 15.0) -> Optional[int]:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=timeout_s)
+        return self.proc.returncode
+
+
+def spawn_replicas(n: int, runner: str, extra_args: str = "",
+                   env: Optional[dict] = None) -> List[ReplicaProcess]:
+    """``n`` replica children on free local ports (the --spawn path)."""
+    return [ReplicaProcess(runner, free_port(), extra_args, env=env)
+            for _ in range(n)]

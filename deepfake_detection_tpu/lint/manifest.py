@@ -60,6 +60,17 @@ JAX_FREE_MODULES = (
     "deepfake_detection_tpu.backfill.lease",
     "deepfake_detection_tpu.backfill.writer",
     "deepfake_detection_tpu.backfill.source",
+    # the fleet router tier (ISSUE 15): the routing process must never
+    # pay — or wait on — an accelerator import; replicas are separate
+    # processes that do.  utils.prometheus is the jax-free observability
+    # floor these share (utils/__init__ is PEP-562 lazy for exactly this)
+    "deepfake_detection_tpu.fleet",
+    "deepfake_detection_tpu.fleet.registry",
+    "deepfake_detection_tpu.fleet.metrics",
+    "deepfake_detection_tpu.fleet.controller",
+    "deepfake_detection_tpu.fleet.migrate",
+    "deepfake_detection_tpu.fleet.router",
+    "deepfake_detection_tpu.runners.router",
     "tools.pack_dataset",
     "tools.obs_report",
     "tools.make_lists",
@@ -75,12 +86,14 @@ RNG_DIRS = (
     "deepfake_detection_tpu/data",
     "deepfake_detection_tpu/streaming",
     "deepfake_detection_tpu/serving",
+    "deepfake_detection_tpu/fleet",
 )
 
 METRIC_REGISTRIES = {
     "deepfake_detection_tpu/serving/metrics.py": "dfd_serving",
     "deepfake_detection_tpu/streaming/metrics.py": "dfd_streaming",
     "deepfake_detection_tpu/obs/telemetry.py": "dfd_train",
+    "deepfake_detection_tpu/fleet/metrics.py": "dfd_router",
 }
 
 # obs collectors register gauge/counter names from runtime dicts (loader

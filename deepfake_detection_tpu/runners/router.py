@@ -1,0 +1,130 @@
+"""Fleet router entrypoint: N shared-nothing replicas behind one
+routing tier.
+
+Where ``runners/serve.py`` is ONE process (and therefore one GIL's
+worth of HTTP+dispatch host work, the measured ~200–250 req/s ceiling on
+this class of box), this runner fronts a *fleet*: stateless ``/score``
+load-balances by least queue depth, ``/streams/*`` sessions pin to
+replicas by consistent hash, health is scraped off each replica's
+``/readyz`` + ``/metrics``, and draining a replica live-migrates its
+stream sessions.  The router process itself NEVER imports jax — every
+replica is its own process with its own engine.
+
+Usage::
+
+    # attach to running replicas
+    python -m deepfake_detection_tpu.runners.router \
+        --replicas 127.0.0.1:8377,127.0.0.1:8379 [--port 8380]
+
+    # or spawn a local fleet of 4 serve children
+    python -m deepfake_detection_tpu.runners.router --spawn 4 \
+        --replica-args "--model vit_tiny_patch16_224 --image-size 32 \
+                        --single-thread-xla"
+
+    curl -s -X POST --data-binary @face.jpg -H 'Content-Type: image/jpeg' \
+        http://127.0.0.1:8380/score
+    curl -s http://127.0.0.1:8380/replicas
+    curl -s -X POST http://127.0.0.1:8380/replicas/127.0.0.1:8377/drain
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import sys
+import threading
+from typing import List, Optional, Sequence
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["build_router", "main"]
+
+
+def build_router(cfg):
+    """RouterConfig → (RouterServer, spawned ReplicaProcess list).
+
+    The server is not yet started; spawned children are launched but not
+    awaited (the health scraper's readiness view is the wait)."""
+    from ..fleet.controller import (HealthScraper, ReplicaProcess,
+                                    free_port)
+    from ..fleet.metrics import RouterMetrics
+    from ..fleet.registry import Registry
+    from ..fleet.router import make_router_server
+
+    registry = Registry(vnodes=cfg.virtual_nodes)
+    spawned: List[ReplicaProcess] = []
+    for _ in range(int(cfg.spawn)):
+        child = ReplicaProcess(cfg.spawn_runner, free_port(),
+                               cfg.replica_args)
+        spawned.append(child)
+        registry.add(child.netloc, process=child)
+    for url in cfg.replica_urls():
+        registry.add(url)
+    metrics = RouterMetrics()
+    scraper = HealthScraper(registry, metrics,
+                            interval_s=cfg.scrape_interval_s,
+                            fail_after=cfg.health_fail_after,
+                            timeout_s=cfg.scrape_timeout_s)
+    server = make_router_server(
+        cfg.host, cfg.port, registry, metrics, scraper,
+        route_retries=cfg.route_retries,
+        upstream_timeout_s=cfg.upstream_timeout_s,
+        shed_retry_after_s=cfg.shed_retry_after_s,
+        retry_jitter_s=cfg.retry_jitter_s,
+        migrate_timeout_s=cfg.migrate_timeout_s)
+    return server, spawned
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    # the serving runner's GIL-switch tuning: many proxy threads on few
+    # cores convoy tail latency at the default 5 ms interval
+    sys.setswitchinterval(0.002)
+    from ..config import RouterConfig
+    cfg = RouterConfig.from_args(argv)
+    server, spawned = build_router(cfg)
+    server.scraper.start()
+
+    stop = threading.Event()
+
+    def _sig(signum, frame):
+        _logger.info("signal %d: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    host, port = server.server_address[:2]
+    _logger.info(
+        "routing on http://%s:%d over %d replica(s): %s (POST /score, "
+        "/streams/*, GET /healthz /readyz /metrics /replicas, POST "
+        "/replicas/<id>/drain)", host, port,
+        len(server.registry.ids()), ", ".join(server.registry.ids()))
+    t = threading.Thread(target=server.serve_forever,
+                         kwargs={"poll_interval": 0.1}, daemon=True)
+    t.start()
+    try:
+        while not stop.is_set():
+            stop.wait(0.5)
+    finally:
+        server.shutdown()
+        server.scraper.stop()
+        if cfg.drain_on_exit and spawned:
+            from ..fleet.migrate import drain_replica
+            for child in spawned:
+                try:
+                    drain_replica(server.registry, server.metrics,
+                                  child.netloc,
+                                  timeout_s=cfg.migrate_timeout_s)
+                except Exception:                  # noqa: BLE001
+                    _logger.exception("drain of %s on exit failed",
+                                      child.netloc)
+        for child in spawned:
+            child.stop()
+        server.server_close()
+        _logger.info("bye")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -402,6 +402,28 @@ class InferenceEngine:
     def ready(self) -> bool:
         return self.metrics.ready
 
+    def readiness_detail(self) -> Dict[str, Any]:
+        """The ``/readyz`` JSON body: per-model readiness + the health
+        signals a fleet router scrapes.  A 503 with this body means
+        "process up, serving set not ready" (cold model warming,
+        watchdog re-warm, reload canary) — distinguishable from "engine
+        down" (no response at all) without parsing metrics text."""
+        return {
+            "ready": bool(self.metrics.ready),
+            # snapshot: a live add_model grows the table from another
+            # thread (the PR 14 warmup/_rewarm discipline)
+            "models": {
+                mid: {"warmed": e.warmed,
+                      "image_size": e.image_size,
+                      "img_num": e.img_num,
+                      "dtype": e.dtype,
+                      "reloads": e.reload_count}
+                for mid, e in list(self._models.items())},
+            "breaker": self.breaker.state,
+            "queue_depth": int(self.metrics.queue_depth),
+            "inflight": int(self.metrics.inflight),
+        }
+
     def warmup(self) -> None:
         """AOT-compile every (model, bucket, chans) executable and execute
         each once (primes any first-run allocation paths), then flip

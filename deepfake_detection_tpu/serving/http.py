@@ -32,7 +32,10 @@ Endpoints:
 * ``GET /healthz`` — process liveness (200 while the process serves,
   INCLUDING during recovery re-warms — only readiness drops).
 * ``GET /readyz`` — 200 only while every bucket is compiled+warmed AND
-  no recovery re-warm or reload canary is in flight.
+  no recovery re-warm or reload canary is in flight.  The body is the
+  per-model readiness JSON (``engine.readiness_detail()``): a 503 with
+  a parseable body tells a fleet router "cold model warming", no
+  response at all means "engine down".
 * ``GET /metrics`` — Prometheus text format (serving/metrics.py).
 """
 
@@ -123,6 +126,10 @@ class ServingServer(ThreadingHTTPServer):
     # keep-alive matters: the load generator and any sane client reuse
     # connections, and accept() is the single-threaded part of this server
     protocol_version = "HTTP/1.1"
+    # a router tier (or a bench loadgen) opens its whole connection pool
+    # in one burst; the stdlib backlog of 5 would drop SYNs into 1s
+    # retransmit stalls
+    request_queue_size = 256
 
     def __init__(self, addr: Tuple[str, int], engine: InferenceEngine,
                  batcher: MicroBatcher, metrics: ServingMetrics,
@@ -139,6 +146,9 @@ class ServingServer(ThreadingHTTPServer):
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # response headers + body are two writes; Nagle would hold the body
+    # for the client's delayed ACK (~40 ms) on every small response
+    disable_nagle_algorithm = True
     server: ServingServer   # typing aid
 
     # ------------------------------------------------------------------
@@ -168,10 +178,13 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/healthz":
             self._respond(200, b"ok\n", "text/plain")
         elif path == "/readyz":
-            if self.server.engine.ready:
-                self._respond(200, b"ready\n", "text/plain")
-            else:
-                self._respond(503, b"warming up\n", "text/plain")
+            # JSON per-model readiness detail (ISSUE 15): the fleet
+            # router's health scraper distinguishes "cold model warming"
+            # (503 + parseable body, some model warmed=false) from
+            # "engine down" (no response) without parsing metrics text
+            detail = self.server.engine.readiness_detail()
+            body = (json.dumps(detail, sort_keys=True) + "\n").encode()
+            self._respond(200 if detail["ready"] else 503, body)
         elif path == "/metrics":
             text = self.server.metrics.render_prometheus()
             self._respond(200, text.encode(),

@@ -22,6 +22,13 @@ new dependency, keep-alive for cheap chunking):
 * ``GET /streams`` / ``GET /streams/<id>`` → listing / full status
   (tracks, verdict snapshots, recent schema-versioned events, counters).
 * ``DELETE /streams/<id>``             → close, returning final status.
+* ``POST /streams/<id>/migrate``       → quiesce + export the session as
+  its ``dfd.streaming.session_state.v1`` snapshot (the PR 10 state-dir
+  machinery) and detach it; ``POST /streams/restore`` rebuilds the
+  session from such a snapshot — together the live-migration pair the
+  fleet router's drain path drives (ISSUE 15).  The one reserved id:
+  ``POST /streams/restore`` is this verb, not a frame push to a stream
+  named "restore".
 * ``GET /healthz /readyz /metrics``    → liveness / bucket-warmup
   readiness / Prometheus (serving + streaming catalogs concatenated).
 
@@ -327,6 +334,11 @@ class StreamSession:
         self.windows_failed = 0
         self.demuxer: Optional[FfmpegDemuxer] = None
         self.closed = False
+        # migration export set this: the session object may still be
+        # referenced by late collector callbacks, but its state has been
+        # snapshotted and shipped — nothing may mutate books or metrics
+        # behind the snapshot's back
+        self.detached = False
 
     # ------------------------------------------------------------------
     def _emit(self, events: List[dict]) -> None:
@@ -441,6 +453,10 @@ class StreamSession:
         """Collector-thread callback: fold one window score into the
         track + stream verdict machines."""
         with self._lock:
+            if self.detached:
+                # exported mid-flight: the snapshot already booked this
+                # window dropped — folding it here would double-count
+                return
             if error is not None:
                 self.windows_failed += 1
                 self.metrics.windows_failed_total.inc()
@@ -472,6 +488,8 @@ class StreamSession:
 
     def on_window_drop(self, job: WindowJob, reason: str) -> None:
         with self._lock:
+            if self.detached:
+                return         # already booked dropped by the snapshot
             if reason == "shed":
                 self.windows_shed += 1
                 self.metrics.windows_shed_total.inc()
@@ -777,6 +795,78 @@ class StreamManager:
         return restored
 
     # ------------------------------------------------------------------
+    # live migration (ISSUE 15): export one session as the exact
+    # --state-dir snapshot + restore it on another replica.  The fleet
+    # router's drain path drives these through POST /streams/<id>/migrate
+    # and POST /streams/restore; restart resume (PR 10) and migration
+    # ride the SAME state_dict/load_state code.
+    # ------------------------------------------------------------------
+    def export_session(self, stream_id: str,
+                       quiesce_s: float = 5.0) -> Optional[Dict[str, Any]]:
+        """Detach one live session and return its state snapshot (None =
+        unknown stream).
+
+        Quiesce discipline (the runner's shutdown order, per-session):
+        the session leaves the table first (no new chunks route to it),
+        its queued windows are dropped (counted), then in-flight windows
+        get up to ``quiesce_s`` to fold back before the snapshot books
+        any stragglers dropped — per-stream books (emitted == scored +
+        dropped + shed + failed) balance across the move exactly as they
+        do across a restart."""
+        with self._lock:
+            s = self._sessions.pop(stream_id, None)
+            self.metrics.active_streams = len(self._sessions)
+        if s is None:
+            return None
+        self.dispatcher.drop_stream(stream_id)
+        deadline = time.monotonic() + max(0.0, quiesce_s)
+        while time.monotonic() < deadline:
+            with s._lock:
+                pending = s.windows_emitted - s.windows_scored - \
+                    s.windows_dropped - s.windows_shed - s.windows_failed
+            if pending <= 0:
+                break
+            time.sleep(0.02)
+        with s._lock:
+            state = s.state_dict()     # books stragglers dropped
+            s.detached = True          # late results: touch nothing
+            if s._event_log is not None:
+                s._event_log.close()
+                s._event_log = None
+            s._event_log_path = None
+        if s.demuxer is not None:
+            try:
+                s.demuxer.close()
+            except Exception:                      # noqa: BLE001
+                pass
+        self.metrics.streams_migrated_out_total.inc()
+        self.refresh_track_gauge()
+        _logger.info("exported stream %s for migration (%d windows "
+                     "scored)", stream_id,
+                     state["counters"]["windows_scored"])
+        return state
+
+    def import_session(self, state: Dict[str, Any]) -> StreamSession:
+        """Rebuild a session from an exported snapshot (the restore half
+        of a migration).  Raises like :meth:`create` (KeyError if the id
+        is live here, OverflowError at the cap) or ValueError for a
+        snapshot this server can't resume; a half-restored session is
+        dropped, never served."""
+        sid = state.get("stream_id")
+        s = self.create(sid)
+        try:
+            s.load_state(state)
+        except Exception:
+            self.close(s.id)
+            raise
+        self.metrics.streams_migrated_in_total.inc()
+        self.refresh_track_gauge()
+        _logger.info("imported stream %s (verdict %r, %d windows "
+                     "scored)", s.id, s.current_verdict(),
+                     s.windows_scored)
+        return s
+
+    # ------------------------------------------------------------------
     def start_evictor(self) -> None:
         if self.cfg.stream_ttl_s <= 0 or self._evictor is not None:
             return
@@ -808,12 +898,15 @@ class StreamManager:
 # HTTP front end
 # ---------------------------------------------------------------------------
 
-_STREAM_PATH = re.compile(r"^/streams/([A-Za-z0-9_.-]{1,64})(/frames)?$")
+_STREAM_PATH = re.compile(
+    r"^/streams/([A-Za-z0-9_.-]{1,64})(/frames|/migrate)?$")
 
 
 class StreamServer(ThreadingHTTPServer):
     daemon_threads = True
     protocol_version = "HTTP/1.1"
+    request_queue_size = 256     # the serving front end's burst-connect
+    # discipline (router tier / many pushers connect at once)
 
     def __init__(self, addr: Tuple[str, int], manager: StreamManager,
                  engine, serving_metrics, metrics: StreamingMetrics):
@@ -826,6 +919,7 @@ class StreamServer(ThreadingHTTPServer):
 
 class _StreamHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True   # two-write responses vs delayed ACK
     server: StreamServer     # typing aid
 
     def log_message(self, fmt, *args):
@@ -865,10 +959,12 @@ class _StreamHandler(BaseHTTPRequestHandler):
         if path == "/healthz":
             self._respond(200, b"ok\n", "text/plain")
         elif path == "/readyz":
-            if srv.engine.ready:
-                self._respond(200, b"ready\n", "text/plain")
-            else:
-                self._respond(503, b"warming up\n", "text/plain")
+            # the serving front end's per-model JSON detail (ISSUE 15):
+            # a fleet router distinguishes "cold model warming" from
+            # "engine down" off this body
+            detail = srv.engine.readiness_detail()
+            body = (json.dumps(detail, sort_keys=True) + "\n").encode()
+            self._respond(200 if detail["ready"] else 503, body)
         elif path == "/metrics":
             text = srv.serving_metrics.render_prometheus() + \
                 srv.metrics.render_prometheus()
@@ -909,9 +1005,17 @@ class _StreamHandler(BaseHTTPRequestHandler):
         if path == "/streams":
             self._create_stream(body)
             return
+        if path == "/streams/restore":
+            # migration restore (ISSUE 15; shadows a stream literally
+            # named "restore" for this one verb — documented)
+            self._restore_stream(body)
+            return
         m = _STREAM_PATH.match(path)
         if not m or not m.group(2):
             self._json(404, {"error": f"no route {path!r}"})
+            return
+        if m.group(2) == "/migrate":
+            self._migrate_stream(m.group(1))
             return
         if body is None:
             self._json(400, {"error": "unreadable/oversize body"})
@@ -962,6 +1066,47 @@ class _StreamHandler(BaseHTTPRequestHandler):
             self._json(429, {"error": str(e)})
             return
         self._json(201, {"stream_id": s.id})
+
+    # -- live migration (ISSUE 15) -------------------------------------
+    def _migrate_stream(self, stream_id: str) -> None:
+        """Export + detach one session; the body IS the snapshot the
+        caller (the fleet router's drain) restores elsewhere.  The
+        session is gone from this server on 200 — a lost response means
+        a lost session, which is why the router's migrate path restores
+        back on failure and never drops the state on the floor."""
+        state = self.server.manager.export_session(stream_id)
+        if state is None:
+            self._json(404, {"error": f"no stream {stream_id!r}"})
+            return
+        self._json(200, state)
+
+    def _restore_stream(self, body: Optional[bytes]) -> None:
+        if not body:
+            self._json(400, {"error": "body must be a session snapshot "
+                                      "(dfd.streaming.session_state.v1)"})
+            return
+        try:
+            state = json.loads(body)
+            if not isinstance(state, dict):
+                raise ValueError("snapshot must be a JSON object")
+        except ValueError as e:
+            self._json(400, {"error": f"unparseable snapshot: {e}"})
+            return
+        try:
+            s = self.server.manager.import_session(state)
+        except KeyError as e:
+            self._json(409, {"error": str(e)})
+            return
+        except OverflowError as e:
+            self._json(429, {"error": str(e)})
+            return
+        except Exception as e:                     # noqa: BLE001
+            self.server.metrics.state_errors_total.inc()
+            self._json(400, {"error": f"cannot resume snapshot: {e!r}"})
+            return
+        self._json(201, {"stream_id": s.id,
+                         "verdict": s.current_verdict(),
+                         "windows_scored": s.windows_scored})
 
     # ------------------------------------------------------------------
     def _ingest_chunk(self, session: StreamSession,

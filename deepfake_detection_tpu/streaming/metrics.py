@@ -57,6 +57,10 @@ class StreamingMetrics:
         self.demux_failures_total = _Counter()     # ffmpeg died mid-stream
         self.streams_restored_total = _Counter()   # sessions resumed from
         # a state-dir snapshot after a server bounce
+        self.streams_migrated_out_total = _Counter()   # sessions exported
+        # to another replica (fleet drain; ISSUE 15)
+        self.streams_migrated_in_total = _Counter()    # sessions restored
+        # FROM another replica via POST /streams/restore
         self.state_errors_total = _Counter()       # snapshot save/restore
         # failures (corrupt/stale state files, unwritable dir)
         self.verdict_transitions_total: Dict[str, _Counter] = {}
@@ -108,6 +112,12 @@ class StreamingMetrics:
                 self.demux_failures_total.value)
         counter("streams_restored_total", "Stream sessions resumed from "
                 "a state-dir snapshot", self.streams_restored_total.value)
+        counter("streams_migrated_out_total", "Stream sessions exported "
+                "to another replica (fleet drain: quiesce -> snapshot "
+                "-> detach)", self.streams_migrated_out_total.value)
+        counter("streams_migrated_in_total", "Stream sessions restored "
+                "from another replica's snapshot (POST /streams/restore)",
+                self.streams_migrated_in_total.value)
         counter("state_errors_total", "Session snapshot save/restore "
                 "failures (corrupt or unwritable state files)",
                 self.state_errors_total.value)

@@ -1,6 +1,39 @@
-"""Training utilities (SURVEY.md §2.6): metrics, EMA, reporting, logging."""
+"""Training utilities (SURVEY.md §2.6): metrics, EMA, reporting, logging.
 
-from .ema import init_ema, update_ema
-from .log import FormatterNoInfo, setup_default_logging
-from .metrics import AverageMeter, accuracy, auc, masked_mean
-from .summary import get_outdir, natural_key, plot_csv, update_summary
+PEP-562 lazy exports (the ``data/``/``obs/``/``serving/`` idiom): the
+package itself imports nothing, so jax-free consumers — the fleet
+router's ``utils.prometheus`` use is the motivating one (dfdlint
+DFD001) — can reach the stdlib-pure submodules without paying for (or
+accidentally loading) the jax-importing ones (``metrics``, ``ema``).
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "AverageMeter": "metrics",
+    "LatencyHistogram": "metrics",
+    "accuracy": "metrics",
+    "auc": "metrics",
+    "masked_mean": "metrics",
+    "init_ema": "ema",
+    "update_ema": "ema",
+    "FormatterNoInfo": "log",
+    "setup_default_logging": "log",
+    "get_outdir": "summary",
+    "natural_key": "summary",
+    "plot_csv": "summary",
+    "update_summary": "summary",
+    "Counter": "prometheus",
+    "PromText": "prometheus",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

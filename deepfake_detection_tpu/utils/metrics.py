@@ -9,7 +9,6 @@ site, replacing ``reduce_tensor`` (:256-260).
 
 from __future__ import annotations
 
-import bisect
 import threading
 from typing import List, Optional, Sequence, Tuple, Union
 
@@ -38,75 +37,9 @@ class AverageMeter:
         self.avg = self.sum / self.count
 
 
-class LatencyHistogram:
-    """Thread-safe fixed-bucket latency histogram (host side, stdlib only).
-
-    The serving-path companion to :class:`AverageMeter`: where the meter
-    tracks a running average inside the train loop, the histogram tracks the
-    full latency distribution of a long-lived server (serving/metrics.py
-    renders it in Prometheus ``histogram`` text format, so the bucket
-    layout is cumulative-``le`` by construction).
-
-    Buckets are upper bounds in seconds; observations above the last bound
-    land in the implicit ``+Inf`` bucket.
-    """
-
-    #: default bounds: 1 ms .. 30 s, roughly log-spaced (Prometheus idiom)
-    DEFAULT_BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
-                      0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
-
-    def __init__(self, bounds: Sequence[float] = DEFAULT_BOUNDS):
-        self.bounds: Tuple[float, ...] = tuple(sorted(float(b)
-                                                      for b in bounds))
-        if not self.bounds:
-            raise ValueError("LatencyHistogram needs at least one bound")
-        self._counts = [0] * (len(self.bounds) + 1)   # [+Inf] is last
-        self.sum = 0.0
-        self.count = 0
-        self._lock = threading.Lock()
-
-    def observe(self, seconds: float) -> None:
-        i = bisect.bisect_left(self.bounds, seconds)
-        with self._lock:
-            self._counts[i] += 1
-            self.sum += seconds
-            self.count += 1
-
-    def snapshot(self) -> Tuple[List[int], float, int]:
-        """(per-bucket counts incl. +Inf, sum, count) — one consistent view."""
-        with self._lock:
-            return list(self._counts), self.sum, self.count
-
-    def cumulative(self) -> List[Tuple[float, int]]:
-        """[(le_bound, cumulative_count), ...] with +Inf last (le=inf)."""
-        counts, _, _ = self.snapshot()
-        out, acc = [], 0
-        for b, c in zip(self.bounds, counts):
-            acc += c
-            out.append((b, acc))
-        out.append((float("inf"), acc + counts[-1]))
-        return out
-
-    def quantile(self, q: float) -> float:
-        """Approximate quantile: upper bound of the bucket holding rank q
-        (the resolution any fixed-bucket histogram has; good enough for a
-        p50/p95/p99 serving report)."""
-        counts, _, total = self.snapshot()
-        if total == 0:
-            return float("nan")
-        rank = q * total
-        acc = 0
-        for b, c in zip(self.bounds, counts):
-            acc += c
-            if acc >= rank:
-                return b
-        return float("inf")
-
-    def reset(self) -> None:
-        with self._lock:
-            self._counts = [0] * (len(self.bounds) + 1)
-            self.sum = 0.0
-            self.count = 0
+#: moved to utils/prometheus.py (the jax-free observability floor the
+#: fleet router shares); re-exported here for existing callers
+from .prometheus import LatencyHistogram  # noqa: E402,F401
 
 
 def accuracy(output: jnp.ndarray, target: jnp.ndarray,

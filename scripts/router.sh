@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Fleet replica router (ISSUE 15): N shared-nothing serve/stream
+# processes behind one routing tier.  Flags pass through to
+# runners/router.py, e.g.:
+#
+#   # attach to replicas you launched yourself (scripts/serve.sh ×N)
+#   scripts/router.sh --replicas 127.0.0.1:8377,127.0.0.1:8379
+#
+#   # or spawn a local fleet of 4 serve children in one go
+#   scripts/router.sh --spawn 4 \
+#     --replica-args "--model-path ../models/model_best.ckpt \
+#                     --single-thread-xla"
+#
+#   curl -s http://127.0.0.1:8380/replicas           # fleet view
+#   curl -s -X POST http://127.0.0.1:8380/replicas/127.0.0.1:8377/drain
+python -m deepfake_detection_tpu.runners.router "$@"

@@ -53,3 +53,19 @@ def test_two_model_cascade_faults_recover_books_balance():
 
 def test_stream_server_bounce_resumes_verdicts_bit_identically():
     assert chaos_serve.main(["--scenario", "stream_resume"] + _BASE) == 0
+
+
+def test_fleet_replica_kill_fails_over_and_rejoins():
+    """ISSUE 15 acceptance: SIGKILL one of two serve replicas behind the
+    router under load — the router fails traffic over within the SLO,
+    router books stay exact (routed == forwarded + migrated + shed +
+    failed), and a relaunch on the same port rejoins the rotation."""
+    assert chaos_serve.main(["--scenario", "replica_kill"] + _BASE) == 0
+
+
+def test_fleet_drain_migrates_stream_bit_identically():
+    """ISSUE 15 acceptance: draining a stream's replica live-migrates
+    the session (PR 10 snapshot/restore) to the peer; the stream
+    finishes through the router with final status + event log
+    BIT-IDENTICAL to an undrained replay and exact migration books."""
+    assert chaos_serve.main(["--scenario", "replica_migrate"] + _BASE) == 0

@@ -1,0 +1,622 @@
+"""Fleet router tier (ISSUE 15): consistent-hash stability, registry
+routing, shed-aware failover, router books, jittered Retry-After, the
+aggregate metrics re-export, and live-migration plumbing — all against
+stdlib stub replicas, zero jax (the router's own DFD001 contract; the
+live-fleet drives are tools/chaos_serve.py's replica_* scenarios and
+tools/bench_serve.py --replicas)."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+pytestmark = pytest.mark.fleet
+
+import os  # noqa: E402
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+from deepfake_detection_tpu.config import RouterConfig  # noqa: E402
+from deepfake_detection_tpu.fleet.controller import (  # noqa: E402
+    HealthScraper, free_port, parse_exposition)
+from deepfake_detection_tpu.fleet.metrics import (  # noqa: E402
+    RouterMetrics, relabel_exposition)
+from deepfake_detection_tpu.fleet.registry import (  # noqa: E402
+    HashRing, Registry, normalize_netloc)
+from deepfake_detection_tpu.fleet.router import make_router_server  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# consistent hashing (satellite: stability + bounded churn over 1k ids)
+# ---------------------------------------------------------------------------
+
+def _ids(n=1000):
+    return [f"stream-{i:04d}" for i in range(n)]
+
+
+def test_ring_assignment_deterministic_across_restarts():
+    """The same replica set must produce the same stream→replica map in
+    a fresh ring (a rebooted router keeps routing every session home)."""
+    replicas = ["10.0.0.1:8377", "10.0.0.2:8377", "10.0.0.3:8377"]
+    a = HashRing(replicas)
+    b = HashRing(list(reversed(replicas)))   # insertion order irrelevant
+    for sid in _ids():
+        assert a.assign(sid) == b.assign(sid)
+
+
+def test_ring_removal_remaps_exactly_the_removed_replicas_keys():
+    replicas = ["r0:1", "r1:1", "r2:1", "r3:1"]
+    ring = HashRing(replicas)
+    before = {sid: ring.assign(sid) for sid in _ids()}
+    ring.remove("r2:1")
+    for sid, home in before.items():
+        got = ring.assign(sid)
+        if home == "r2:1":
+            assert got != "r2:1"
+        else:
+            assert got == home, f"{sid} moved {home} -> {got}"
+
+
+def test_ring_addition_bounded_churn():
+    """Adding one replica to N remaps ~1/(N+1) of the keys; assert a
+    generous 2×(1/(N+1)) bound over 1k synthetic stream ids."""
+    replicas = [f"r{i}:1" for i in range(4)]
+    ring = HashRing(replicas)
+    ids = _ids()
+    before = {sid: ring.assign(sid) for sid in ids}
+    ring.add("r9:1")
+    moved = sum(ring.assign(sid) != before[sid] for sid in ids)
+    assert moved > 0
+    assert moved / len(ids) <= 2.0 / 5.0, f"churn {moved}/{len(ids)}"
+    # and every moved key moved TO the new replica, never between
+    # survivors
+    for sid in ids:
+        got = ring.assign(sid)
+        assert got == before[sid] or got == "r9:1"
+
+
+def test_ring_eligible_walk_preserves_surviving_assignments():
+    replicas = [f"r{i}:1" for i in range(3)]
+    ring = HashRing(replicas)
+    ids = _ids(300)
+    before = {sid: ring.assign(sid) for sid in ids}
+    eligible = {"r0:1", "r2:1"}
+    for sid in ids:
+        got = ring.assign(sid, eligible=eligible)
+        if before[sid] in eligible:
+            assert got == before[sid]
+        else:
+            assert got in eligible
+
+
+def test_normalize_netloc():
+    assert normalize_netloc("http://127.0.0.1:8377/") == "127.0.0.1:8377"
+    assert normalize_netloc("127.0.0.1:8377") == "127.0.0.1:8377"
+    for bad in ("", "localhost", "http://hostonly/", "h:notaport"):
+        with pytest.raises(ValueError):
+            normalize_netloc(bad)
+
+
+# ---------------------------------------------------------------------------
+# registry routing
+# ---------------------------------------------------------------------------
+
+def _ready(r, depth=0):
+    r.healthy = True
+    r.ready = True
+    r.queue_depth = depth
+    return r
+
+
+def test_registry_pick_stateless_least_depth_and_eligibility():
+    reg = Registry(["a:1", "b:1", "c:1"])
+    ra, rb, rc = (reg.get(i) for i in ("a:1", "b:1", "c:1"))
+    assert reg.pick_stateless() is None          # nothing scraped yet
+    _ready(ra, depth=5)
+    _ready(rb, depth=1)
+    _ready(rc, depth=9)
+    assert reg.pick_stateless().id == "b:1"
+    rb.draining = True                           # drains take no traffic
+    assert reg.pick_stateless().id == "a:1"
+    reg.mark_shed("a:1", 30.0)                   # Retry-After honored
+    assert reg.pick_stateless().id == "c:1"
+    assert reg.pick_stateless(exclude={"c:1"}) is None
+    # router_inflight is live load: it outweighs a stale scrape
+    rb.draining = False
+    reg.note_dispatch("b:1", 20)
+    assert reg.pick_stateless().id == "c:1"
+    reg.note_done("b:1", 20)
+    assert reg.pick_stateless().id == "b:1"
+
+
+def test_registry_stream_affinity_overrides_beat_ring():
+    reg = Registry(["a:1", "b:1"])
+    for rid in ("a:1", "b:1"):
+        _ready(reg.get(rid))
+    home, migrated = reg.pick_stream("some-stream")
+    assert home is not None and not migrated
+    other = "b:1" if home.id == "a:1" else "a:1"
+    reg.set_override("some-stream", other)
+    got, migrated = reg.pick_stream("some-stream")
+    assert migrated and got.id == other
+    reg.clear_override("some-stream")
+    got, migrated = reg.pick_stream("some-stream")
+    assert not migrated and got.id == home.id
+    # removal drops the replica's overrides with it
+    reg.set_override("some-stream", other)
+    reg.remove(other)
+    got, migrated = reg.pick_stream("some-stream")
+    assert not migrated
+
+
+def test_registry_counts():
+    reg = Registry(["a:1", "b:1", "c:1"])
+    _ready(reg.get("a:1"))
+    _ready(reg.get("b:1")).draining = True
+    c = reg.counts()
+    assert c == {"replicas": 3, "healthy": 2, "ready": 2, "draining": 1,
+                 "eligible": 1}
+
+
+# ---------------------------------------------------------------------------
+# router metrics + re-export
+# ---------------------------------------------------------------------------
+
+def test_router_metrics_books_and_conformance():
+    m = RouterMetrics()
+    m.routed_total.inc(7)
+    m.forwarded_total.inc(4)
+    m.migrated_total.inc()
+    m.shed_total.inc()
+    m.failed_total.inc()
+    b = m.books()
+    assert b["routed"] == b["forwarded"] + b["migrated"] + b["shed"] + \
+        b["failed"]
+    m.count_request(200)
+    m.count_forward("127.0.0.1:1")
+    m.latency["upstream"].observe(0.01)
+    text = m.render_prometheus()
+    # every sample belongs to a declared family (the test_obs parser)
+    types, fams = {}, set()
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# TYPE "):
+            fams.add(line.split(" ", 3)[2])
+        elif not line.startswith("#"):
+            name = line.rsplit(" ", 1)[0].partition("{")[0]
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix):
+                    name = name[: -len(suffix)]
+            assert name in fams, name
+    assert 'dfd_router_replica_forwarded_total{replica="127.0.0.1:1"} 1' \
+        in text
+
+
+def test_relabel_exposition_injects_replica_and_dedupes_headers():
+    doc = ('# HELP dfd_serving_x help\n# TYPE dfd_serving_x counter\n'
+           'dfd_serving_x 5\n'
+           'dfd_serving_y{stage="queue"} 7\n')
+    seen = set()
+    a = relabel_exposition(doc, "r0:1", seen)
+    b = relabel_exposition(doc, "r1:1", seen)
+    assert 'dfd_serving_x{replica="r0:1"} 5' in a
+    assert 'dfd_serving_y{replica="r0:1",stage="queue"} 7' in a
+    # headers only once across the aggregate
+    assert sum(1 for line in a if line.startswith("# TYPE")) == 1
+    assert not any(line.startswith("#") for line in b)
+    assert 'dfd_serving_x{replica="r1:1"} 5' in b
+
+
+# ---------------------------------------------------------------------------
+# RouterConfig
+# ---------------------------------------------------------------------------
+
+def test_router_config_validation():
+    with pytest.raises(ValueError, match="fleet"):
+        RouterConfig().validate_required()
+    cfg = RouterConfig(replicas="127.0.0.1:1, 127.0.0.1:2").validate_required()
+    assert cfg.replica_urls() == ["127.0.0.1:1", "127.0.0.1:2"]
+    assert RouterConfig(spawn=2).validate_required().spawn == 2
+    for kw in ({"spawn_runner": "nope"}, {"spawn": -1},
+               {"virtual_nodes": 0}, {"route_retries": -1},
+               {"health_fail_after": 0}, {"scrape_interval_s": 0},
+               {"retry_jitter_s": -1}):
+        with pytest.raises(ValueError):
+            RouterConfig(**kw)
+
+
+def test_router_config_cli_two_stage_parse():
+    cfg = RouterConfig.from_args(
+        ["--replicas", "127.0.0.1:7", "--route-retries", "3",
+         "--retry-jitter-s", "0.5"])
+    assert cfg.replica_urls() == ["127.0.0.1:7"]
+    assert cfg.route_retries == 3 and cfg.retry_jitter_s == 0.5
+
+
+# ---------------------------------------------------------------------------
+# stub replicas (stdlib, instant, scriptable) + live router
+# ---------------------------------------------------------------------------
+
+class _StubState:
+    def __init__(self):
+        self.mode = "ok"          # ok | shed | error-mid | down-ish
+        self.retry_after = 7.0
+        self.requests = []
+        self.streams = {}         # sid -> state dict (migration stubs)
+
+
+class _StubHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True
+
+    def log_message(self, *a):
+        pass
+
+    def _r(self, code, obj, extra=None):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+
+    @property
+    def st(self) -> _StubState:
+        return self.server.state
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/readyz":
+            self._r(200, {"ready": True, "models": {"m": {"warmed": True}}})
+        elif path == "/metrics":
+            body = ("dfd_serving_queue_depth 2\n"
+                    "dfd_serving_inflight 1\n"
+                    "dfd_serving_breaker_state 0\n"
+                    "dfd_serving_scored_total 5\n")
+            raw = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+        elif path == "/streams":
+            self._r(200, {"streams": sorted(self.st.streams)})
+        elif path.startswith("/streams/"):
+            sid = path.split("/")[2]
+            if sid in self.st.streams:
+                self._r(200, self.st.streams[sid])
+            else:
+                self._r(404, {"error": "no stream"})
+        else:
+            self._r(200, {"ok": True})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        path = self.path.split("?", 1)[0]
+        self.st.requests.append((path, body))
+        if path.startswith("/streams"):
+            self._stream_post(path, body)
+            return
+        if self.st.mode == "shed":
+            self._r(503, {"error": "stub shedding"},
+                    {"Retry-After": self.st.retry_after})
+            return
+        self._r(200, {"fake_score": 0.5, "scores": [0.5, 0.5],
+                      "port": self.server.server_address[1]})
+
+    def _stream_post(self, path, body):
+        if path == "/streams":
+            payload = json.loads(body or b"{}")
+            sid = payload.get("stream_id", "anon")
+            self.st.streams[sid] = {"stream_id": sid, "windows": 0}
+            self._r(201, {"stream_id": sid})
+        elif path == "/streams/restore":
+            state = json.loads(body)
+            self.st.streams[state["stream_id"]] = state
+            self._r(201, {"stream_id": state["stream_id"]})
+        elif path.endswith("/migrate"):
+            sid = path.split("/")[2]
+            state = self.st.streams.pop(sid, None)
+            if state is None:
+                self._r(404, {"error": "no stream"})
+            else:
+                self._r(200, state)
+        elif path.endswith("/frames"):
+            sid = path.split("/")[2]
+            if sid not in self.st.streams:
+                self._r(404, {"error": "no stream"})
+                return
+            self.st.streams[sid]["windows"] += 1
+            self._r(200, {"stream_id": sid,
+                          "port": self.server.server_address[1]})
+        else:
+            self._r(404, {"error": "?"})
+
+
+def _stub_replica():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _StubHandler)
+    srv.daemon_threads = True
+    srv.state = _StubState()
+    threading.Thread(target=srv.serve_forever,
+                     kwargs={"poll_interval": 0.05}, daemon=True).start()
+    return srv
+
+
+@pytest.fixture()
+def fleet():
+    """Two stub replicas + a live router (scraper on a fast cadence)."""
+    stubs = [_stub_replica(), _stub_replica()]
+    urls = [f"127.0.0.1:{s.server_address[1]}" for s in stubs]
+    registry = Registry(urls)
+    metrics = RouterMetrics()
+    scraper = HealthScraper(registry, metrics, interval_s=0.1,
+                            fail_after=2, timeout_s=2.0)
+    server = make_router_server("127.0.0.1", 0, registry, metrics,
+                                scraper, route_retries=2,
+                                shed_retry_after_s=1.0,
+                                retry_jitter_s=2.0)
+    scraper.start()
+    threading.Thread(target=server.serve_forever,
+                     kwargs={"poll_interval": 0.05}, daemon=True).start()
+    deadline = time.monotonic() + 10.0
+    while registry.counts()["eligible"] < 2:
+        assert time.monotonic() < deadline, "stub fleet never ready"
+        time.sleep(0.05)
+    yield type("F", (), dict(stubs=stubs, urls=urls, registry=registry,
+                             metrics=metrics, scraper=scraper,
+                             server=server,
+                             port=server.server_address[1]))
+    server.shutdown()
+    scraper.stop()
+    server.server_close()
+    for s in stubs:
+        s.shutdown()
+        s.server_close()
+
+
+def _post(port, path, body=b"x", ctype="application/octet-stream",
+          timeout=10):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 data=body,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=timeout) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _assert_books(m: RouterMetrics):
+    b = m.books()
+    assert b["routed"] == b["forwarded"] + b["migrated"] + b["shed"] + \
+        b["failed"], b
+
+
+def test_stateless_forwarding_and_books(fleet):
+    for _ in range(8):
+        status, _, body = _post(fleet.port, "/score")
+        assert status == 200 and body["fake_score"] == 0.5
+    _assert_books(fleet.metrics)
+    assert fleet.metrics.forwarded_total.value == 8
+    # both stubs saw traffic (least-depth rotation spreads equal depths)
+    assert all(s.state.requests for s in fleet.stubs)
+
+
+def test_shed_aware_failover_honors_retry_after(fleet):
+    """An upstream 503+Retry-After backs the replica off and the request
+    fails over: the client still gets a 200, the shed replica takes no
+    more traffic until its window passes."""
+    shedder = fleet.stubs[0]
+    shedder.state.mode = "shed"
+    shedder.state.retry_after = 30.0
+    good_port = fleet.stubs[1].server_address[1]
+    seen_ports = set()
+    for _ in range(6):
+        status, _, body = _post(fleet.port, "/score")
+        assert status == 200
+        seen_ports.add(body["port"])
+    assert seen_ports == {good_port}
+    _assert_books(fleet.metrics)
+    assert fleet.metrics.retries_total.value >= 1
+    # the backoff is recorded on the registry
+    shed_id = f"127.0.0.1:{shedder.server_address[1]}"
+    assert fleet.registry.get(shed_id).backoff_until > time.monotonic()
+
+
+def test_router_shed_is_503_with_jittered_retry_after(fleet):
+    for s in fleet.stubs:
+        s.state.mode = "shed"
+        s.state.retry_after = 0.2   # short: the test fleet heals fast
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(fleet.port, "/score")
+    assert ei.value.code == 503
+    ra = float(ei.value.headers["Retry-After"])
+    # jittered base [1, 1+2): rounded to an int >= 1
+    assert 1 <= ra <= 3
+    m = fleet.metrics
+    assert m.shed_total.value >= 1
+    _assert_books(m)
+
+
+def test_stream_affinity_deterministic_and_restart_stable(fleet):
+    status, _, body = _post(fleet.port, "/streams",
+                            json.dumps({"stream_id": "pin-me"}).encode(),
+                            "application/json")
+    assert status == 201 and body["stream_id"] == "pin-me"
+    owner = [s for s in fleet.stubs if "pin-me" in s.state.streams]
+    assert len(owner) == 1
+    owner_port = owner[0].server_address[1]
+    for _ in range(4):
+        status, _, body = _post(fleet.port, "/streams/pin-me/frames")
+        assert status == 200 and body["port"] == owner_port
+    # deterministic across router restarts: a FRESH registry + ring over
+    # the same urls assigns the same home
+    fresh = Registry(fleet.urls)
+    r, migrated = fresh.pick_stream("pin-me")
+    assert not migrated and r.id == f"127.0.0.1:{owner_port}"
+    _assert_books(fleet.metrics)
+
+
+def test_stream_create_without_id_gets_router_assigned_id(fleet):
+    status, _, body = _post(fleet.port, "/streams", b"",
+                            "application/json")
+    assert status == 201
+    sid = body["stream_id"]
+    assert sid and any(sid in s.state.streams for s in fleet.stubs)
+
+
+def test_drain_migrates_streams_and_requests_count_migrated(fleet):
+    _post(fleet.port, "/streams",
+          json.dumps({"stream_id": "mover"}).encode(), "application/json")
+    source = next(s for s in fleet.stubs if "mover" in s.state.streams)
+    target = next(s for s in fleet.stubs if s is not source)
+    source_id = f"127.0.0.1:{source.server_address[1]}"
+    status, _, report = _post(fleet.port,
+                              f"/replicas/{source_id}/drain", b"")
+    assert status == 200
+    assert report["migrated"] == ["mover"] and not report["failed"]
+    assert "mover" in target.state.streams
+    assert fleet.metrics.streams_migrated_total.value == 1
+    assert fleet.metrics.migration_aborts_total.value == 0
+    # subsequent requests follow the override and book as migrated
+    status, _, body = _post(fleet.port, "/streams/mover/frames")
+    assert status == 200
+    assert body["port"] == target.server_address[1]
+    assert fleet.metrics.migrated_total.value >= 1
+    # a drained replica takes no NEW streams; undrain restores it
+    assert fleet.registry.get(source_id).draining
+    status, _, _ = _post(fleet.port, f"/replicas/{source_id}/undrain",
+                         b"")
+    assert status == 200
+    assert not fleet.registry.get(source_id).draining
+    _assert_books(fleet.metrics)
+
+
+def test_readyz_replicas_and_aggregate_metrics(fleet):
+    status, _, raw = _get(fleet.port, "/readyz")
+    detail = json.loads(raw)
+    assert status == 200 and detail["ready"]
+    assert detail["counts"]["ready"] == 2
+    status, _, raw = _get(fleet.port, "/replicas")
+    listing = json.loads(raw)
+    assert set(listing) == set(fleet.urls)
+    assert all(v["models"] for v in listing.values())
+    # aggregate /metrics: router catalog + per-replica re-export, and
+    # the scraped queue depth feeds routing state
+    time.sleep(0.3)
+    status, _, raw = _get(fleet.port, "/metrics")
+    text = raw.decode()
+    assert "dfd_router_routed_total" in text
+    for url in fleet.urls:
+        assert f'dfd_serving_scored_total{{replica="{url}"}} 5' in text
+    assert fleet.registry.get(fleet.urls[0]).queue_depth == 2
+
+
+def test_dead_fleet_fails_502_and_scraper_marks_down(fleet):
+    for s in fleet.stubs:
+        s.shutdown()
+        s.server_close()
+    deadline = time.monotonic() + 10.0
+    while fleet.registry.counts()["healthy"] > 0:
+        assert time.monotonic() < deadline, "scraper never marked down"
+        time.sleep(0.05)
+    assert fleet.metrics.replicas_down_total.value >= 2
+    # readyz goes 503; /score sheds (no eligible replica)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(fleet.port, "/readyz")
+    assert ei.value.code == 503
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(fleet.port, "/score")
+    assert ei.value.code == 503
+    _assert_books(fleet.metrics)
+
+
+def test_direct_migrate_via_proxy_is_rejected(fleet):
+    _post(fleet.port, "/streams",
+          json.dumps({"stream_id": "sneak"}).encode(), "application/json")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(fleet.port, "/streams/sneak/migrate", b"")
+    assert ei.value.code == 400
+    _assert_books(fleet.metrics)
+
+
+# ---------------------------------------------------------------------------
+# jittered Retry-After (satellite pin: seeded-rng spread)
+# ---------------------------------------------------------------------------
+
+def test_shed_retry_after_jitter_seeded_spread():
+    """Router-level sheds reuse the PR 10 jitter idiom: base + uniform
+    [0, jitter).  The rng is seeded, so the spread is deterministic —
+    pin bounds AND that the values actually spread (a constant would
+    herd every shed client into one resend wave)."""
+    registry = Registry(["127.0.0.1:1"])
+    server = make_router_server("127.0.0.1", 0, registry,
+                                shed_retry_after_s=1.0,
+                                retry_jitter_s=2.0)
+    try:
+        values = [server.shed_retry_after() for _ in range(200)]
+    finally:
+        server.server_close()
+    assert all(1.0 <= v < 3.0 for v in values)
+    assert max(values) - min(values) > 1.0       # real spread
+    assert len({round(v, 6) for v in values}) > 100
+    # deterministic: a fresh server with the same seed repeats the draws
+    server2 = make_router_server("127.0.0.1", 0, registry,
+                                 shed_retry_after_s=1.0,
+                                 retry_jitter_s=2.0)
+    try:
+        values2 = [server2.shed_retry_after() for _ in range(200)]
+    finally:
+        server2.server_close()
+    assert values == values2
+    # jitter 0 degrades to the constant base
+    server3 = make_router_server("127.0.0.1", 0, registry,
+                                 shed_retry_after_s=1.5,
+                                 retry_jitter_s=0.0)
+    try:
+        assert server3.shed_retry_after() == 1.5
+    finally:
+        server3.server_close()
+
+
+# ---------------------------------------------------------------------------
+# controller bits
+# ---------------------------------------------------------------------------
+
+def test_parse_exposition_skips_labels_and_comments():
+    out = parse_exposition("# HELP x y\nx 1\nx{a=\"b\"} 2\nbad\nz 3.5\n")
+    assert out == {"x": 1.0, "z": 3.5}
+
+
+def test_router_import_is_jax_free():
+    """DFD001's promise, proven against reality for the whole router
+    import chain (registry/metrics/controller/migrate/router + config +
+    runners.router)."""
+    code = ("import sys\n"
+            "import deepfake_detection_tpu.fleet.router\n"
+            "import deepfake_detection_tpu.fleet.controller\n"
+            "import deepfake_detection_tpu.fleet.migrate\n"
+            "import deepfake_detection_tpu.runners.router\n"
+            "from deepfake_detection_tpu.config import RouterConfig\n"
+            "assert 'jax' not in sys.modules, 'jax leaked'\n"
+            "print('ok')\n")
+    out = subprocess.run([sys.executable, "-c", code], cwd=_REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"
+
+
+def test_free_port_binds():
+    p = free_port()
+    assert 1 <= p <= 65535

@@ -266,6 +266,143 @@ class TestSharedRenderer:
             assert base in fams, name
 
 
+def _old_router_render(self) -> str:
+    """Hand-rolled mirror of fleet/metrics.py's ``dfd_router_*`` catalog
+    (ISSUE 15) — the same byte-layout lock the serving catalog carries:
+    the shared renderer must reproduce this exactly, so a scrape-side
+    dashboard can never notice a renderer refactor."""
+    from deepfake_detection_tpu.fleet.metrics import BOOK_KINDS, STAGES
+    del BOOK_KINDS      # documented grouping; the mirror spells names out
+    _PREFIX = "dfd_router"
+    lines = []
+
+    def counter(name, help_, value):
+        lines.append(f"# HELP {_PREFIX}_{name} {help_}")
+        lines.append(f"# TYPE {_PREFIX}_{name} counter")
+        lines.append(f"{_PREFIX}_{name} {value}")
+
+    def gauge(name, help_, value):
+        lines.append(f"# HELP {_PREFIX}_{name} {help_}")
+        lines.append(f"# TYPE {_PREFIX}_{name} gauge")
+        lines.append(f"{_PREFIX}_{name} {value}")
+
+    lines.append(f"# HELP {_PREFIX}_requests_total Router responses by "
+                 "HTTP status")
+    lines.append(f"# TYPE {_PREFIX}_requests_total counter")
+    with self._requests_lock:
+        items = sorted((k, c.value) for k, c in self.requests_total.items())
+    for status, value in items:
+        lines.append(
+            f'{_PREFIX}_requests_total{{status="{status}"}} {value}')
+    counter("routed_total", "Requests entering the routing path "
+            "(books: routed == forwarded + migrated + shed + failed)",
+            self.routed_total.value)
+    counter("forwarded_total", "Requests resolved by a replica "
+            "response relayed to the client", self.forwarded_total.value)
+    counter("migrated_total", "Requests resolved by a migration-"
+            "override target (the stream was moved off a drained "
+            "replica)", self.migrated_total.value)
+    counter("shed_total", "Requests shed at the router (no eligible "
+            "replica / every failover attempt shed): 503 + jittered "
+            "Retry-After", self.shed_total.value)
+    counter("failed_total", "Requests failed on transport errors "
+            "after the failover budget (502)", self.failed_total.value)
+    counter("retries_total", "Failover attempts past the first "
+            "replica (upstream shed, backoff or transport error)",
+            self.retries_total.value)
+    counter("scrape_errors_total", "Replica health-scrape failures",
+            self.scrape_errors_total.value)
+    counter("replicas_down_total", "Replica healthy->down "
+            "transitions observed by the scraper",
+            self.replicas_down_total.value)
+    counter("drains_total", "Replica drain operations run",
+            self.drains_total.value)
+    counter("streams_migrated_total", "Live stream sessions moved to "
+            "another replica (snapshot -> restore, books intact)",
+            self.streams_migrated_total.value)
+    counter("migration_aborts_total", "Stream migrations aborted "
+            "(target restore failed; the session was restored back "
+            "on its source or dumped to disk — never silently lost)",
+            self.migration_aborts_total.value)
+    lines.append(f"# HELP {_PREFIX}_replica_forwarded_total Requests "
+                 "forwarded per replica")
+    lines.append(f"# TYPE {_PREFIX}_replica_forwarded_total counter")
+    with self._replica_lock:
+        rep_items = sorted((k, c.value)
+                           for k, c in self.replica_forwarded.items())
+    for rid, value in rep_items:
+        lines.append(f'{_PREFIX}_replica_forwarded_total'
+                     f'{{replica="{rid}"}} {value}')
+    gauge("ready", "1 while at least one replica is eligible "
+          "(healthy + ready + not draining + not backing off)",
+          int(self.ready))
+    gauge("replicas", "Registered replicas", self.replicas)
+    gauge("healthy_replicas", "Replicas whose scrape succeeds",
+          self.healthy_replicas)
+    gauge("ready_replicas", "Replicas healthy AND /readyz-ready",
+          self.ready_replicas)
+    gauge("draining_replicas", "Replicas draining (no new traffic)",
+          self.draining_replicas)
+    for stage in STAGES:
+        h = self.latency[stage]
+        name = f"{_PREFIX}_latency_seconds"
+        lines.append(f"# HELP {name} Router request latency "
+                     "(upstream = replica round trip, total = "
+                     "socket in -> response out)")
+        lines.append(f"# TYPE {name} histogram")
+        counts, s, c = h.snapshot()
+        acc = 0
+        for bound, n in zip(h.bounds, counts):
+            acc += n
+            lines.append(f'{name}_bucket{{stage="{stage}",'
+                         f'le="{bound!r}"}} {acc}')
+        lines.append(f'{name}_bucket{{stage="{stage}",le="+Inf"}} {c}')
+        lines.append(f'{name}_sum{{stage="{stage}"}} {s}')
+        lines.append(f'{name}_count{{stage="{stage}"}} {c}')
+    return "\n".join(lines) + "\n"
+
+
+class TestRouterRenderer:
+    def _populated(self):
+        from deepfake_detection_tpu.fleet.metrics import RouterMetrics
+        m = RouterMetrics()
+        for status in (200, 200, 502, 503):
+            m.count_request(status)
+        m.routed_total.inc(9)
+        m.forwarded_total.inc(6)
+        m.migrated_total.inc()
+        m.shed_total.inc()
+        m.failed_total.inc()
+        m.retries_total.inc(2)
+        m.drains_total.inc()
+        m.streams_migrated_total.inc(3)
+        m.count_forward("127.0.0.1:8377")
+        m.count_forward("127.0.0.1:8379")
+        m.latency["upstream"].observe(0.004)
+        m.latency["total"].observe(0.006)
+        m.ready = True
+        m.set_fleet_gauges({"replicas": 2, "healthy": 2, "ready": 2,
+                            "draining": 1, "eligible": 1})
+        return m
+
+    def test_router_output_byte_identical_to_mirror(self):
+        m = self._populated()
+        assert m.render_prometheus() == _old_router_render(m)
+
+    def test_router_conformance(self):
+        m = self._populated()
+        types, samples = _parse_prom(m.render_prometheus())
+        assert types["dfd_router_routed_total"] == "counter"
+        assert types["dfd_router_latency_seconds"] == "histogram"
+        fams = set(types)
+        for name, _, _ in samples:
+            base = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix):
+                    base = name[: -len(suffix)]
+            assert base in fams, name
+
+
 class TestTrainTelemetryRenderer:
     def _telemetry(self, **kw):
         from deepfake_detection_tpu.obs import TrainTelemetry

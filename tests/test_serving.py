@@ -525,6 +525,36 @@ def test_reload_rejects_mismatched_tree(tmp_path):
 # end-to-end HTTP round trip
 # ---------------------------------------------------------------------------
 
+def test_readyz_carries_per_model_json_detail(stack):
+    """ISSUE 15 satellite: the /readyz body is the per-model readiness
+    JSON, so a fleet router can tell "cold model warming" (parseable
+    503) from "engine down" (no response) without scraping metrics
+    text."""
+    status, body = _get(stack.port, "/readyz")
+    assert status == 200
+    detail = json.loads(body)
+    assert detail["ready"] is True
+    assert detail["breaker"] == "closed"
+    primary = stack.engine.default_model_id
+    assert primary in detail["models"]
+    m = detail["models"][primary]
+    assert m["warmed"] is True and m["image_size"] == _SIZE
+    assert m["img_num"] == 1 and m["dtype"] == "f32"
+    assert detail["queue_depth"] == stack.metrics.queue_depth
+    # the not-ready body keeps the same shape (parseable 503): flip the
+    # gauge through the metrics seam the canary/recovery paths use
+    stack.metrics.ready = False
+    try:
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(stack.port, "/readyz")
+        assert ei.value.code == 503
+        cold = json.loads(ei.value.read())
+        assert cold["ready"] is False and primary in cold["models"]
+    finally:
+        stack.metrics.ready = True
+
+
 def test_e2e_localhost_roundtrip(stack):
     from deepfake_detection_tpu.runners.test import preprocess
 

@@ -856,3 +856,98 @@ def test_demuxer_kill_mid_stream_surfaces_error_not_hang(tmp_path):
         # close-flush must stay safe on an already-dead process
         assert d.close() == []
     assert not d.dead                        # deliberate close, not death
+
+
+# ---------------------------------------------------------------------------
+# live migration (ISSUE 15): export/import at manager granularity — the
+# replica-side halves the fleet router's drain path drives over HTTP
+# ---------------------------------------------------------------------------
+
+def _manager(metrics=None, jobs=None):
+    from deepfake_detection_tpu.config import StreamConfig
+    from deepfake_detection_tpu.streaming.ingest import StreamManager
+    cfg = StreamConfig(image_size=16, img_num=2, buckets=(1,),
+                       max_queue=1, stream_ttl_s=0.0,
+                       verdict_vector="0.1*2,0.95*8")
+    disp = types.SimpleNamespace(
+        push=(jobs.append if jobs is not None else (lambda j: None)),
+        drop_stream=lambda sid: 0)
+    return StreamManager(cfg, disp, metrics or StreamingMetrics(),
+                         16, "float32")
+
+
+def test_manager_export_import_resumes_bit_identically():
+    """Migration == restart for session state: export on one manager +
+    import on another (through-JSON, like the HTTP hop) + the remaining
+    frames == one uninterrupted session."""
+    ref_jobs, jobs = [], []
+    m_src = _manager(jobs=jobs)
+    m_dst = _manager(jobs=jobs)
+    ref = _session(jobs=ref_jobs, sid="mig")
+    _feed(ref, ref_jobs, 20)
+
+    s = m_src.create("mig")
+    frames = [np.full((16, 16, 3), i % 255, np.uint8) for i in range(8)]
+    for f in frames:
+        s.ingest_arrays([f])
+        while jobs:
+            s.on_window_result(jobs.pop(0), np.asarray([0.5, 0.5]), None)
+    state = m_src.export_session("mig")
+    assert m_src.get("mig") is None
+    assert m_src.metrics.streams_migrated_out_total.value == 1
+    # a late collector callback against the detached session is ignored
+    # (the snapshot already booked everything; folding it would skew the
+    # process-wide books)
+    scored_before = m_src.metrics.windows_scored_total.value
+    s.on_window_result(types.SimpleNamespace(frame_idxs=(9,), track_id=0,
+                                             enqueue_t=0.0),
+                       np.asarray([0.9, 0.1]), None)
+    assert m_src.metrics.windows_scored_total.value == scored_before
+
+    restored = m_dst.import_session(json.loads(json.dumps(state)))
+    assert m_dst.metrics.streams_migrated_in_total.value == 1
+    _feed(restored, jobs, 12, tag=8)
+
+    def comparable(st):
+        return {k: v for k, v in st.items()
+                if k not in ("created", "events")} | {
+                    "events": [{k: v for k, v in ev.items()
+                                if k != "wall_time"}
+                               for ev in st["events"]]}
+
+    assert comparable(restored.status()) == comparable(ref.status())
+    assert restored.stream_verdict.ema == ref.stream_verdict.ema
+
+
+def test_manager_export_unknown_stream_and_import_collision():
+    m = _manager()
+    assert m.export_session("ghost") is None
+    s = m.create("dup")
+    s.ingest_arrays([np.zeros((16, 16, 3), np.uint8)] * 2)
+    state = m.export_session("dup")
+    m2 = _manager()
+    m2.import_session(dict(state))
+    with pytest.raises(KeyError):
+        m2.import_session(dict(state))       # already live there
+    # a snapshot the server can't resume is dropped, never half-served
+    bad = dict(state, stream_id="other", schema="nope")
+    with pytest.raises(ValueError):
+        m2.import_session(bad)
+    assert m2.get("other") is None
+
+
+def test_export_books_inflight_windows_dropped():
+    """The restart quiesce discipline carries over: windows still in
+    flight at export time are booked dropped in the snapshot so the
+    per-stream books balance on the target."""
+    jobs = []
+    m = _manager(jobs=jobs)
+    s = m.create("busy")
+    for f in [np.zeros((16, 16, 3), np.uint8)] * 4:
+        s.ingest_arrays([f])
+    assert len(jobs) == 2                    # 2 windows in flight
+    state = m.export_session("busy", quiesce_s=0.2)
+    c = state["counters"]
+    assert c["windows_dropped"] == 2
+    assert c["windows_emitted"] == c["windows_scored"] + \
+        c["windows_dropped"] + c["windows_shed"] + c["windows_failed"]

@@ -631,6 +631,136 @@ def _terminate_proc(proc: subprocess.Popen) -> None:
         proc.kill()
 
 
+# ---------------------------------------------------------------------------
+# fleet matrix (--replicas): N serve replicas behind runners/router.py
+# ---------------------------------------------------------------------------
+
+def spawn_router(replica_netlocs: List[str]) -> Tuple[subprocess.Popen, str]:
+    """Spawn the fleet router attached to already-running replicas."""
+    port = free_port()
+    cmd = [sys.executable, "-m", "deepfake_detection_tpu.runners.router",
+           "--port", str(port),
+           "--replicas", ",".join(replica_netlocs),
+           "--scrape-interval-s", "0.2", "--health-fail-after", "2"]
+    _log("spawning router: " + " ".join(cmd))
+    proc = subprocess.Popen(cmd, cwd=_REPO, env=dict(os.environ),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    return proc, f"127.0.0.1:{port}"
+
+
+def wait_fleet_ready(router_netloc: str, n: int,
+                     timeout: float = 120.0) -> None:
+    """Poll the router's /readyz JSON until all ``n`` replicas are
+    healthy AND ready (the scraper has seen every /readyz go 200)."""
+    import json as _json
+    host, port = router_netloc.split(":")
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        try:
+            conn = http.client.HTTPConnection(host, int(port), timeout=2)
+            conn.request("GET", "/readyz")
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status == 200:
+                counts = _json.loads(body).get("counts", {})
+                if counts.get("ready", 0) >= n:
+                    _log(f"fleet ready ({n} replicas) after "
+                         f"{time.monotonic() - t0:.1f}s")
+                    return
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"fleet at {router_netloc} not ready ({n} "
+                       f"replicas) within {timeout}s")
+
+
+def assert_router_books(m: Dict[str, float]) -> None:
+    routed = m.get("dfd_router_routed_total", 0)
+    resolved = (m.get("dfd_router_forwarded_total", 0) +
+                m.get("dfd_router_migrated_total", 0) +
+                m.get("dfd_router_shed_total", 0) +
+                m.get("dfd_router_failed_total", 0))
+    if routed != resolved:
+        raise AssertionError(
+            f"router books do not balance: routed {routed:.0f} != "
+            f"forwarded {m.get('dfd_router_forwarded_total', 0):.0f} + "
+            f"migrated {m.get('dfd_router_migrated_total', 0):.0f} + "
+            f"shed {m.get('dfd_router_shed_total', 0):.0f} + "
+            f"failed {m.get('dfd_router_failed_total', 0):.0f}")
+    _log(f"router books balance: routed {routed:.0f} == resolved "
+         f"{resolved:.0f}")
+
+
+def run_fleet_phase(args, jpegs: List[bytes], n: int,
+                    concurrency: int) -> dict:
+    """One fleet size: N replicas + router, closed loop through the
+    router, books + zero-recompile asserts, per-replica spread."""
+    replicas = []
+    router_proc = None
+    try:
+        for _ in range(n):
+            replicas.append(spawn_server(args))
+        for _, netloc in replicas:
+            wait_ready(netloc)
+        router_proc, router_netloc = spawn_router(
+            [netloc for _, netloc in replicas])
+        wait_fleet_ready(router_netloc, n)
+        compiles0 = []
+        for _, netloc in replicas:
+            m = scrape_metrics(netloc)
+            compiles0.append(
+                m.get("dfd_serving_backend_compiles_total", 0))
+        _log(f"fleet closed loop: {n} replica(s), concurrency "
+             f"{concurrency}, {args.duration:.0f}s "
+             f"(+{args.warmup:.0f}s warmup)")
+        r = run_load(router_netloc, jpegs, concurrency, args.duration,
+                     args.warmup, retry_cap_s=args.retry_cap)
+        _log(f"  -> {r['rps']:.1f} req/s, p50 {r['p50']:.1f} ms, "
+             f"statuses {r['statuses']}")
+        # drain then assert the router books exactly
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            rm = scrape_metrics(router_netloc)
+            routed = rm.get("dfd_router_routed_total", 0)
+            resolved = (rm.get("dfd_router_forwarded_total", 0) +
+                        rm.get("dfd_router_migrated_total", 0) +
+                        rm.get("dfd_router_shed_total", 0) +
+                        rm.get("dfd_router_failed_total", 0))
+            if routed == resolved:
+                break
+            time.sleep(1.0)
+        assert_router_books(rm)
+        # the aggregate re-export must carry every replica's catalog
+        labeled = scrape_metrics_labeled(router_netloc)
+        fam = labeled_family(labeled, "dfd_serving_scored_total")
+        if len(fam) != n:
+            raise AssertionError(
+                f"aggregate /metrics re-exports {len(fam)} replica "
+                f"catalog(s), expected {n}: {sorted(fam)}")
+        spread = labeled_family(labeled, "dfd_router_replica_forwarded_total")
+        # zero recompiles on every replica across the load phase
+        for (_, netloc), c0 in zip(replicas, compiles0):
+            m = scrape_metrics(netloc)
+            c1 = m.get("dfd_serving_backend_compiles_total", 0)
+            if c1 != c0:
+                raise AssertionError(
+                    f"replica {netloc}: {c1 - c0:+.0f} backend "
+                    f"recompiles during the fleet phase")
+        r["replicas"] = n
+        r["books"] = {k.rsplit("_total", 1)[0].split("dfd_router_")[-1]: v
+                      for k, v in rm.items()
+                      if k.startswith("dfd_router_") and
+                      k.endswith("_total")}
+        r["spread"] = {k: v for k, v in sorted(spread.items())}
+        return r
+    finally:
+        if router_proc is not None:
+            _terminate_proc(router_proc)
+        for proc, _ in replicas:
+            _terminate_proc(proc)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--model", default="vit_tiny_patch16_224",
@@ -679,6 +809,13 @@ def main(argv=None) -> int:
                          "id triages student-first in a SECOND server "
                          "phase, compared against the flagship-only "
                          "phase at the same concurrency")
+    ap.add_argument("--replicas", default="",
+                    help="fleet matrix (ISSUE 15): comma list of fleet "
+                         "sizes (e.g. 1,2,4) — each size spawns that "
+                         "many serve replicas behind runners/router.py "
+                         "and drives the SAME closed loop through the "
+                         "router at the max --concurrency, compared "
+                         "against the single-process row")
     ap.add_argument("--traffic-mix", type=float, default=0.8,
                     help="fraction of bench traffic the calibrated "
                          "suspect band lets the student clear (the rest "
@@ -746,6 +883,12 @@ def main(argv=None) -> int:
         c = max(int(x) for x in args.concurrency.split(","))
         cas, cas_labeled = run_cascade_phase(args, jpegs, c)
 
+    fleet_rows = []
+    if args.replicas:
+        c = max(int(x) for x in args.concurrency.split(","))
+        for n in [int(x) for x in args.replicas.split(",") if x]:
+            fleet_rows.append(run_fleet_phase(args, jpegs, n, c))
+
     seq = None
     if not args.no_baseline:
         _log("warm sequential baseline (runners/test.py loop) ...")
@@ -812,6 +955,34 @@ def main(argv=None) -> int:
             f"{books.get('flagship_scored', 0):.0f} flagship-scored + "
             f"{books.get('escalation_failed', 0):.0f} failed — books "
             f"exact, zero recompiles).")
+    if fleet_rows:
+        c = max(int(x) for x in args.concurrency.split(","))
+        flag_row = next((r for cc, r in rows if cc == c), None)
+        base_rps = flag_row["rps"] if flag_row else None
+        lines.append("")
+        lines.append(f"**Fleet matrix (ISSUE 15)** — N serve replicas "
+                     f"behind `runners/router.py`, same closed loop at "
+                     f"concurrency {c}; scaling is vs the single-process "
+                     f"HTTP row above (the measured per-process host "
+                     f"ceiling).  Router books exact and zero replica "
+                     f"recompiles asserted every phase.")
+        lines.append("")
+        lines.append("| replicas | throughput (req/s) | vs 1 process | "
+                     "p50 (ms) | p95 (ms) | router books "
+                     "(routed=fwd+mig+shed+fail) | per-replica spread |")
+        lines.append("|---|---|---|---|---|---|---|")
+        for r in fleet_rows:
+            ratio = (f"{r['rps'] / base_rps:.2f}×" if base_rps else "–")
+            b = r["books"]
+            books = (f"{b.get('routed', 0):.0f}="
+                     f"{b.get('forwarded', 0):.0f}+"
+                     f"{b.get('migrated', 0):.0f}+"
+                     f"{b.get('shed', 0):.0f}+{b.get('failed', 0):.0f}")
+            spread = "/".join(f"{v:.0f}"
+                              for _, v in sorted(r["spread"].items()))
+            lines.append(f"| {r['replicas']} (router in front) | "
+                         f"{r['rps']:.1f} | {ratio} | {r['p50']:.1f} | "
+                         f"{r['p95']:.1f} | {books} | {spread} |")
     lines.append("")
     lines.append(f"Compile probe: {compiles_at_ready:.0f} bucket "
                  f"executables at ready, **{recompiles:+.0f} after "

@@ -36,6 +36,15 @@ Scenarios (``--scenario``, comma list or ``all``):
 * ``stream_resume``— stream server SIGTERM + restart with
   ``--state-dir``: verdict streams RESUME (compared against an
   unkilled replay of the same frames).
+* ``replica_kill`` — fleet scenario (ISSUE 15): 2 serve replicas behind
+  ``runners/router.py``, one SIGKILLed under load — the router fails
+  over within ``--slo-s``, books stay exact (routed == forwarded +
+  migrated + shed + failed), and a relaunch on the same port rejoins
+  the rotation;
+* ``replica_migrate`` — fleet scenario: a live stream's replica is
+  DRAINED — the session snapshot/restores onto the peer via the PR 10
+  state machinery, the stream finishes through the router, and the
+  final status + event log are BIT-IDENTICAL to an undrained replay.
 
 Example (the CI slow tier runs exactly this, small model)::
 
@@ -61,10 +70,11 @@ from typing import Dict, List, Optional, Tuple
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-from tools.bench_serve import free_port, make_jpegs, scrape_metrics, \
-    wait_ready  # noqa: E402
+from tools.bench_serve import assert_router_books, free_port, make_jpegs, \
+    scrape_metrics, spawn_router, wait_fleet_ready, wait_ready  # noqa: E402
 
-SCENARIOS = ("exc", "nan", "hang", "kill", "torn_reload", "stream_resume")
+SCENARIOS = ("exc", "nan", "hang", "kill", "torn_reload", "stream_resume",
+             "replica_kill", "replica_migrate")
 
 
 def _log(msg: str) -> None:
@@ -591,6 +601,229 @@ def run_stream_resume(args) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# fleet scenarios (ISSUE 15): replicas behind runners/router.py
+# ---------------------------------------------------------------------------
+
+def _spawn_fleet_serve(args, n: int) -> Tuple[list, subprocess.Popen, str]:
+    """n serve replicas + router; returns ([(proc, port)...], router_proc,
+    router_netloc) with the whole fleet scraped ready."""
+    replicas = []
+    for _ in range(n):
+        port = free_port()
+        replicas.append((_spawn_serve(args, port, ""), port))
+    for _, port in replicas:
+        wait_ready(f"127.0.0.1:{port}", timeout=args.ready_timeout_s)
+    router_proc, router_netloc = spawn_router(
+        [f"127.0.0.1:{port}" for _, port in replicas])
+    wait_fleet_ready(router_netloc, n, timeout=args.ready_timeout_s)
+    return replicas, router_proc, router_netloc
+
+
+def run_replica_kill(args) -> dict:
+    """SIGKILL one replica of a 2-replica fleet under load: the router
+    must fail traffic over to the survivor within --slo-s, books stay
+    exact (routed == forwarded + migrated + shed + failed), and a
+    relaunched replica on the same port rejoins the rotation."""
+    jpegs = make_jpegs(8, args.src_size)
+    replicas, router_proc, netloc = _spawn_fleet_serve(args, 2)
+    victim_proc, victim_port = replicas[0]
+    try:
+        # fault probe: the scraper marks the victim down (ready_replicas
+        # gauge drops below 2)
+        def fault_seen() -> bool:
+            try:
+                m = scrape_metrics(netloc)
+                return m.get("dfd_router_ready_replicas", 2) < 2
+            except OSError:
+                return False
+
+        killed = threading.Event()
+
+        def killer() -> None:
+            time.sleep(1.5)           # let load flow through both first
+            _log(f"SIGKILL replica on port {victim_port}")
+            victim_proc.kill()
+            killed.set()
+
+        threading.Thread(target=killer, daemon=True).start()
+        r = _drive_until_recovered(netloc, jpegs, fault_seen, args.slo_s)
+        if not killed.is_set():
+            raise AssertionError("victim was never killed (probe fired "
+                                 "early?)")
+        m = scrape_metrics(netloc)
+        assert_router_books(m)
+        down = m.get("dfd_router_replicas_down_total", 0)
+        if down < 1:
+            raise AssertionError("router never counted the replica down")
+        # relaunch on the SAME port: the scraper must return it to
+        # rotation (healthy+ready count back to 2)
+        replicas[0] = (_spawn_serve(args, victim_port, ""), victim_port)
+        wait_fleet_ready(netloc, 2, timeout=args.ready_timeout_s)
+        _log("relaunched replica rejoined the rotation")
+        # one more loaded pass over the healed fleet, books still exact
+        stop = threading.Event()
+        posters = [_Poster(netloc, jpegs, stop) for _ in range(3)]
+        for p in posters:
+            p.start()
+        time.sleep(2.0)
+        stop.set()
+        for p in posters:
+            p.join(timeout=10)
+        ok_after = sum(1 for p in posters for (_, s) in p.samples
+                       if s == 200)
+        if ok_after == 0:
+            raise AssertionError("no 200s after the replica rejoined")
+        m = scrape_metrics(netloc)
+        assert_router_books(m)
+        return {"scenario": "replica_kill",
+                "recovery_s": r["recovery_s"],
+                "statuses": r["statuses"],
+                "replicas_down": down,
+                "books": {k: v for k, v in m.items()
+                          if k.startswith("dfd_router_") and
+                          k.endswith("_total")}}
+    finally:
+        _terminate(router_proc)
+        for proc, _ in replicas:
+            _terminate(proc)
+
+
+def _stream_replica_cmd(args, port: int, state_dir: str,
+                        event_dir: str) -> list:
+    # the stream_resume topology, one replica's worth (shared event dir:
+    # a migrated session appends to the SAME per-stream JSONL, so the
+    # coherence check covers the migration seam exactly like the
+    # restart seam)
+    return _stream_cmd(args, port, state_dir, event_dir)
+
+
+def run_replica_migrate(args) -> dict:
+    """Live migration: drive a stream through the router onto its home
+    replica, drain that replica (sessions snapshot + restore onto the
+    peer via the PR 10 state machinery), finish the stream through the
+    router, and require the final status to be BIT-IDENTICAL to an
+    undrained replay — plus exact router books and a connected
+    per-stream event log across the migration seam."""
+    import numpy as np
+    rng = np.random.default_rng(11)
+    s = args.image_size
+    frames = [rng.integers(0, 255, (s, s, 3), dtype=np.uint8)
+              for _ in range(20)]
+    phase1, phase2 = frames[:8], frames[8:]
+    n1, n_total = len(phase1) // 2, len(frames) // 2
+    sid = "migrate-me"
+
+    def run_topology(drain: bool) -> Tuple[dict, str, dict]:
+        event_dir = tempfile.mkdtemp(prefix="chaos-fleet-events-")
+        replicas = []
+        router_proc = None
+        try:
+            for _ in range(2):
+                port = free_port()
+                state_dir = tempfile.mkdtemp(prefix="chaos-fleet-state-")
+                proc = subprocess.Popen(
+                    _stream_replica_cmd(args, port, state_dir, event_dir),
+                    cwd=_REPO, env=_child_env(),
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+                replicas.append((proc, port))
+            for _, port in replicas:
+                wait_ready(f"127.0.0.1:{port}",
+                           timeout=args.ready_timeout_s)
+            router_proc, netloc = spawn_router(
+                [f"127.0.0.1:{port}" for _, port in replicas])
+            wait_fleet_ready(netloc, 2, timeout=args.ready_timeout_s)
+            rport = int(netloc.split(":")[1])
+            client = _StreamClient(rport)
+            client.open(sid)
+            client.push_raw(sid, phase1)
+            client.wait_scored(sid, n1)      # quiesce before any drain
+            # who holds the session? ask the replicas directly
+            owner = None
+            for _, port in replicas:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=10)
+                conn.request("GET", "/streams")
+                listing = json.loads(conn.getresponse().read())
+                conn.close()
+                if sid in listing.get("streams", []):
+                    owner = port
+            if owner is None:
+                raise AssertionError(f"no replica holds stream {sid!r}")
+            if drain:
+                _log(f"draining replica 127.0.0.1:{owner} (owns {sid})")
+                conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                                  timeout=60)
+                conn.request("POST", f"/replicas/127.0.0.1:{owner}/drain")
+                resp = conn.getresponse()
+                report = json.loads(resp.read())
+                conn.close()
+                if resp.status != 200 or report.get("failed") or \
+                        sid not in report.get("migrated", []):
+                    raise AssertionError(f"drain did not migrate {sid}: "
+                                         f"{report}")
+                m = scrape_metrics(netloc)
+                if m.get("dfd_router_streams_migrated_total", 0) != 1:
+                    raise AssertionError("streams_migrated_total != 1")
+                if m.get("dfd_router_migration_aborts_total", 0):
+                    raise AssertionError("migration aborted")
+                # the session must now live on the OTHER replica
+                other = next(p for _, p in replicas if p != owner)
+                conn = http.client.HTTPConnection("127.0.0.1", other,
+                                                  timeout=10)
+                conn.request("GET", "/streams")
+                listing = json.loads(conn.getresponse().read())
+                conn.close()
+                if sid not in listing.get("streams", []):
+                    raise AssertionError("migrated session not on the "
+                                         "target replica")
+            client.push_raw(sid, phase2)     # routed via the override
+            final = client.wait_scored(sid, n_total)
+            m = scrape_metrics(netloc)
+            assert_router_books(m)
+            if drain and m.get("dfd_router_migrated_total", 0) < 1:
+                raise AssertionError("no request resolved via the "
+                                     "migration override")
+            return final, event_dir, m
+        finally:
+            if router_proc is not None:
+                _terminate(router_proc)
+            for proc, _ in replicas:
+                _terminate(proc)
+
+    final_migrated, event_dir, m = run_topology(drain=True)
+    final_replay, _, _ = run_topology(drain=False)
+    got, want = _comparable(final_migrated), _comparable(final_replay)
+    if got != want:
+        raise AssertionError(
+            "migrated stream diverged from the undrained replay:\n"
+            f"migrated: {json.dumps(got, sort_keys=True)}\n"
+            f"replay:   {json.dumps(want, sort_keys=True)}")
+    _log(f"migrated stream bit-identical to undrained replay (verdict "
+         f"{got['verdict']!r}, {got['counters']['windows_scored']} "
+         f"windows)")
+    # per-stream event log: ONE coherent connected stream across the
+    # migration seam (both replicas appended to the same JSONL)
+    log_path = os.path.join(event_dir, f"{sid}.events.jsonl")
+    with open(log_path) as f:
+        events = [json.loads(line) for line in f]
+    by_machine: Dict[tuple, list] = {}
+    for ev in events:
+        by_machine.setdefault(
+            (ev.get("scope"), ev.get("track_id")), []).append(ev)
+    for key, evs in by_machine.items():
+        if not all(a["to"] == b["from"] for a, b in zip(evs, evs[1:])):
+            raise AssertionError(f"event log transition path for {key} "
+                                 f"broken across the migration: {evs}")
+    _log(f"event log coherent across the migration ({len(events)} "
+         f"transition(s))")
+    return {"scenario": "replica_migrate",
+            "windows_scored": got["counters"]["windows_scored"],
+            "verdict": got["verdict"],
+            "migrated": m.get("dfd_router_streams_migrated_total", 0),
+            "events": len(events)}
+
+
+# ---------------------------------------------------------------------------
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
@@ -631,6 +864,10 @@ def main(argv=None) -> int:
                 results.append(run_torn_reload(args))
             elif n == "stream_resume":
                 results.append(run_stream_resume(args))
+            elif n == "replica_kill":
+                results.append(run_replica_kill(args))
+            elif n == "replica_migrate":
+                results.append(run_replica_migrate(args))
             else:
                 results.append(run_serve_fault(args, n))
             _log(f"=== {n} PASS ===")
