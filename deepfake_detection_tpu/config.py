@@ -887,6 +887,21 @@ class RouterConfig:
     shed_retry_after_s: float = 1.0
     retry_jitter_s: float = 2.0
 
+    # --- data plane (fleet/dataplane.py) ---
+    data_plane: str = "evloop"           # evloop | threads — the relay
+    # hot path: a selectors-based event loop (the ~5x relays/s plane) or
+    # the original thread-per-connection fallback
+    relay_workers: int = 1               # evloop shards accepting on the
+    # same port via SO_REUSEPORT (>1 needs kernel support; threads
+    # plane ignores it)
+    idle_timeout_s: float = 60.0         # close keep-alive connections
+    # silent this long (counted dfd_router_idle_closed_total)
+    header_timeout_s: float = 10.0       # slowloris bound: a request
+    # head must arrive whole within this window (408 + close)
+    max_buffer_bytes: int = 1 << 20      # per-connection relay buffer
+    # bound: larger responses stream with backpressure (evloop); a
+    # stalled reader whose buffer stays full between requests is shed
+
     # --- migration (fleet/migrate.py) ---
     migrate_timeout_s: float = 30.0      # per-stream export/restore bound
     drain_on_exit: bool = False          # drain spawned replicas' streams
@@ -908,9 +923,19 @@ class RouterConfig:
         if int(self.health_fail_after) < 1:
             raise ValueError(f"--health-fail-after must be >= 1, got "
                              f"{self.health_fail_after}")
+        if self.data_plane not in ("evloop", "threads"):
+            raise ValueError(f"--data-plane must be evloop|threads, got "
+                             f"{self.data_plane!r}")
+        if int(self.relay_workers) < 1:
+            raise ValueError(f"--relay-workers must be >= 1, got "
+                             f"{self.relay_workers}")
+        if int(self.max_buffer_bytes) < 4096:
+            raise ValueError(f"--max-buffer-bytes must be >= 4096, got "
+                             f"{self.max_buffer_bytes}")
         for name in ("scrape_interval_s", "scrape_timeout_s",
                      "upstream_timeout_s", "migrate_timeout_s",
-                     "shed_retry_after_s"):
+                     "shed_retry_after_s", "idle_timeout_s",
+                     "header_timeout_s"):
             if float(getattr(self, name)) <= 0:
                 raise ValueError(f"--{name.replace('_', '-')} must be "
                                  f"> 0, got {getattr(self, name)}")

@@ -124,6 +124,10 @@ class HealthScraper:
                 r.healthy = False
                 r.ready = False
                 r.exposition = None
+                # pool owners prune on generation change: a down
+                # replica's pooled upstream sockets close instead of
+                # leaking until the pool owner's own lifetime ends
+                self.registry.bump_generation()
             return
         text = mtext.decode("utf-8", "replace")
         samples = parse_exposition(text)

@@ -64,6 +64,14 @@ class RouterMetrics:
         self.failed_total = _Counter()
         self.retries_total = _Counter()          # failover attempts past
         # the first replica (shed/backoff/transport)
+        # connection hygiene (ISSUE 16): slowloris/idle hardening + the
+        # bounded-relay-buffer guard, shared by both data planes
+        self.idle_closed_total = _Counter()      # connections closed on
+        # a header-read or idle deadline (408/close)
+        self.overflow_closed_total = _Counter()  # connections closed
+        # because a stalled peer let the bounded relay buffer fill
+        self.upstream_pool_closed_total = _Counter()   # pooled upstream
+        # sockets closed because their replica retired or went down
         self.scrape_errors_total = _Counter()    # health-scrape failures
         self.replicas_down_total = _Counter()    # healthy -> down edges
         self.drains_total = _Counter()           # drain operations run
@@ -140,6 +148,15 @@ class RouterMetrics:
         counter("retries_total", "Failover attempts past the first "
                 "replica (upstream shed, backoff or transport error)",
                 self.retries_total.value)
+        counter("idle_closed_total", "Connections closed on a header-"
+                "read or idle deadline (slowloris/idle hardening, both "
+                "data planes)", self.idle_closed_total.value)
+        counter("overflow_closed_total", "Connections closed because a "
+                "stalled peer let the bounded relay buffer fill",
+                self.overflow_closed_total.value)
+        counter("upstream_pool_closed_total", "Pooled upstream sockets "
+                "closed because their replica retired or went down",
+                self.upstream_pool_closed_total.value)
         counter("scrape_errors_total", "Replica health-scrape failures",
                 self.scrape_errors_total.value)
         counter("replicas_down_total", "Replica healthy->down "

@@ -174,8 +174,32 @@ class Registry:
         #: replica's streams re-pin here); consulted before the ring
         self.overrides: Dict[str, str] = {}
         self._rr = 0                 # least-depth tiebreak rotation
+        #: immutable membership snapshot for the lock-free hot path
+        #: (the event-loop data plane routes off this tuple: a plain
+        #: attribute read is atomic under the GIL, so the loop thread
+        #: never takes the registry lock per request)
+        self._view: Tuple[Replica, ...] = ()
+        #: bumped on every membership/health edge (add/remove/down);
+        #: upstream connection pools key their prune passes off it, so
+        #: a retired or down-marked replica's pooled sockets are closed
+        #: instead of leaking for the pool owner's lifetime
+        self.generation = 0
         for url in urls:
             self.add(url)
+
+    def _rebuild_view_locked(self) -> None:
+        self._view = tuple(self.replicas[k] for k in sorted(self.replicas))
+        self.generation += 1
+
+    def bump_generation(self) -> None:
+        """Signal pool owners that membership/health changed (the health
+        scraper calls this on a healthy→down edge)."""
+        with self._lock:
+            self.generation += 1
+
+    def view(self) -> Tuple[Replica, ...]:
+        """Immutable membership snapshot (lock-free read)."""
+        return self._view
 
     # ------------------------------------------------------------------
     def add(self, url: str, process=None) -> Replica:
@@ -185,6 +209,7 @@ class Registry:
                 raise ValueError(f"replica {r.id!r} already registered")
             self.replicas[r.id] = r
             self.ring.add(r.id)
+            self._rebuild_view_locked()
         return r
 
     def remove(self, replica_id: str) -> Optional[Replica]:
@@ -195,6 +220,7 @@ class Registry:
                 self.overrides = {sid: rid for sid, rid
                                   in self.overrides.items()
                                   if rid != replica_id}
+                self._rebuild_view_locked()
         return r
 
     def get(self, replica_id: str) -> Optional[Replica]:
@@ -231,6 +257,47 @@ class Registry:
         with self._lock:
             self._rr += 1
             return tied[self._rr % len(tied)]
+
+    def pick_stateless_fast(self,
+                            exclude: Set[str] = frozenset()
+                            ) -> Optional[Replica]:
+        """Lock-free :meth:`pick_stateless` for the event-loop data
+        plane's hot path.  Iterates the immutable membership snapshot
+        (``view()``); eligibility/depth are plain attribute reads (each
+        atomic under the GIL).  Membership changes land as a whole new
+        tuple, so the worst concurrent-mutation outcome is routing one
+        request on a one-snapshot-stale view — never a torn read."""
+        now = time.monotonic()
+        best: Optional[Replica] = None
+        best_depth = -1
+        tied: List[Replica] = []
+        for r in self._view:
+            if r.id in exclude or not r.eligible(now):
+                continue
+            d = r.depth()
+            if best is None or d < best_depth:
+                best, best_depth, tied = r, d, [r]
+            elif d == best_depth:
+                tied.append(r)
+        if best is None:
+            return None
+        # unlocked rotation: a lost update costs one repeated tiebreak
+        # pick, not correctness
+        self._rr += 1
+        return tied[self._rr % len(tied)]
+
+    def pick_stream_fast(self, stream_id: str
+                         ) -> Tuple[Optional[Replica], bool]:
+        """Lock-free :meth:`pick_stream` (overrides dict get + ring walk
+        are individually atomic; membership churn mid-read can at worst
+        route one request on a stale assignment, matching what a
+        one-scrape-stale threads-plane pick already allows)."""
+        rid = self.overrides.get(stream_id)
+        migrated = rid is not None
+        if rid is None:
+            rid = self.ring.assign(stream_id)
+        r = self.replicas.get(rid) if rid is not None else None
+        return r, migrated
 
     def pick_stream(self, stream_id: str
                     ) -> Tuple[Optional[Replica], bool]:

@@ -62,7 +62,9 @@ from .registry import Registry, Replica
 _logger = logging.getLogger(__name__)
 
 __all__ = ["RouterServer", "make_router_server",
-           "FORWARD_HEADER_EXCLUDES"]
+           "FORWARD_HEADER_EXCLUDES", "readyz_document",
+           "aggregate_metrics_text", "merged_streams",
+           "replica_operation", "ensure_stream_id"]
 
 _MAX_BODY = 64 * 1024 * 1024          # one frame chunk, not one image
 _STREAM_PATH = re.compile(
@@ -73,6 +75,99 @@ _REPLICA_PATH = re.compile(r"^/replicas/([^/]+)(/drain|/undrain)?$")
 FORWARD_HEADER_EXCLUDES = frozenset(
     {"host", "connection", "content-length", "transfer-encoding",
      "keep-alive"})
+
+# ---------------------------------------------------------------------------
+# control-plane documents, shared verbatim by BOTH data planes (threads
+# here, the ISSUE 16 event loop in fleet/dataplane.py) — extracting them
+# is what makes the aggregate /metrics re-export and the /readyz JSON
+# byte-identical across planes by construction
+# ---------------------------------------------------------------------------
+
+def readyz_document(registry: Registry,
+                    metrics: RouterMetrics) -> Tuple[int, bytes]:
+    """(status, body) of ``GET /readyz``: ready while ≥1 replica is
+    eligible, with the per-replica JSON detail."""
+    counts = registry.counts()
+    metrics.set_fleet_gauges(counts)
+    body = (json.dumps({
+        "ready": counts["eligible"] > 0,
+        "counts": counts,
+        "replicas": {r.id: r.summary() for r in registry.all()},
+    }, sort_keys=True) + "\n").encode()
+    return (200 if counts["eligible"] > 0 else 503), body
+
+
+def aggregate_metrics_text(registry: Registry,
+                           metrics: RouterMetrics) -> str:
+    """Router catalog + every replica's last exposition re-labeled
+    ``replica="<id>"`` (one scrape sees the whole fleet)."""
+    metrics.set_fleet_gauges(registry.counts())
+    lines = [metrics.render_prometheus().rstrip("\n")]
+    seen: Set[str] = set()
+    for r in registry.all():
+        if r.exposition:
+            lines.extend(relabel_exposition(r.exposition, r.id, seen))
+    return "\n".join(lines) + "\n"
+
+
+def merged_streams(registry: Registry, timeout_s: float) -> dict:
+    """Fleet-wide stream listing (one blocking round trip per healthy
+    replica — control plane, never the hot path)."""
+    streams: Dict[str, str] = {}
+    for r in registry.all():
+        if not r.healthy:
+            continue
+        try:
+            _, _, body = http_request(r.netloc, "GET", "/streams",
+                                      timeout=timeout_s)
+            for sid in json.loads(body).get("streams", []):
+                streams[sid] = r.id
+        except (OSError, ValueError):
+            continue
+    return {"streams": sorted(streams),
+            "active": len(streams),
+            "by_replica": streams}
+
+
+def replica_operation(registry: Registry, metrics: RouterMetrics,
+                      drain_lock: threading.Lock, replica_id: str,
+                      op: str, migrate_timeout_s: float
+                      ) -> Tuple[int, dict]:
+    """(status, JSON body) of ``POST /replicas/<id>[/drain|/undrain]``.
+    Blocking (migrations run inside) — control plane only."""
+    if registry.get(replica_id) is None:
+        return 404, {"error": f"unknown replica {replica_id!r}",
+                     "replicas": registry.ids()}
+    if op == "/drain":
+        with drain_lock:
+            return 200, drain_replica(registry, metrics, replica_id,
+                                      timeout_s=migrate_timeout_s)
+    if op == "/undrain":
+        with drain_lock:
+            return 200, undrain_replica(registry, metrics, replica_id)
+    return 404, {"error": "POST /replicas/<id>/drain or /undrain"}
+
+
+def ensure_stream_id(body: bytes) -> Tuple[Optional[str], bytes]:
+    """(stream id, possibly-rewritten body) for POST /streams; id is
+    None when the body is unparseable (400 path).  Creation must pass
+    through the router so it can hash the id — a client that did not
+    name one gets a router-assigned id injected into the body."""
+    payload: dict = {}
+    if body:
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            return None, body
+        if not isinstance(payload, dict):
+            return None, body
+    sid = payload.get("stream_id")
+    if not sid:
+        sid = uuid.uuid4().hex[:12]
+        payload["stream_id"] = sid
+        body = json.dumps(payload).encode()
+    return str(sid), body
+
 
 #: per-thread upstream connection pool ({replica_id: _UpstreamConn}).
 #: ThreadingHTTPServer runs one thread per client connection and clients
@@ -159,7 +254,10 @@ class RouterServer(ThreadingHTTPServer):
                  route_retries: int = 2, upstream_timeout_s: float = 30.0,
                  shed_retry_after_s: float = 1.0,
                  retry_jitter_s: float = 2.0,
-                 migrate_timeout_s: float = 30.0):
+                 migrate_timeout_s: float = 30.0,
+                 idle_timeout_s: float = 60.0,
+                 header_timeout_s: float = 10.0,
+                 max_buffer_bytes: int = 1 << 20):
         super().__init__(addr, _RouterHandler)
         self.registry = registry
         self.metrics = metrics
@@ -169,6 +267,15 @@ class RouterServer(ThreadingHTTPServer):
         self.shed_retry_after_s = float(shed_retry_after_s)
         self.retry_jitter_s = float(retry_jitter_s)
         self.migrate_timeout_s = float(migrate_timeout_s)
+        # slowloris/idle hardening (ISSUE 16), matched by the evloop
+        # plane: idle keep-alive connections and stalled header reads
+        # are closed on deadline instead of pinning a thread forever
+        self.idle_timeout_s = float(idle_timeout_s)
+        self.header_timeout_s = float(header_timeout_s)
+        # per-connection relay buffer bound — only the evloop plane
+        # buffers, but both planes accept the knob so RouterConfig can
+        # drive either through one kwargs dict
+        self.max_buffer_bytes = int(max_buffer_bytes)
         # seeded: deterministic under test, de-correlated in production
         # (per-process stream; DFD003 discipline)
         self._shed_rng = random.Random(0x0F1EE7)
@@ -205,6 +312,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         _logger.debug("%s " + fmt, self.address_string(), *args)
 
+    def setup(self) -> None:
+        # arm the idle deadline as the socket timeout: a keep-alive
+        # connection that goes quiet stops costing a thread at
+        # idle_timeout_s instead of forever
+        self.timeout = self.server.idle_timeout_s
+        super().setup()
+
     # Date-header cache: BaseHTTP's send_response runs strftime per
     # response; at fleet rates that is real GIL time.  Worst case of the
     # benign class-attr race is one redundant strftime.
@@ -235,8 +349,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
         serving stack's clients never send it."""
         self.command = self.requestline = ""
         self.request_version = self.protocol_version
+        srv = self.server
         try:
-            self.raw_requestline = self.rfile.readline(65537)
+            try:
+                self.raw_requestline = self.rfile.readline(65537)
+            except TimeoutError:
+                # idle deadline between requests: quiet keep-alive
+                # connection, close without a response (same as evloop)
+                srv.metrics.idle_closed_total.inc()
+                self.close_connection = True
+                return
             if len(self.raw_requestline) > 65536:
                 self.send_error(414)
                 return
@@ -252,14 +374,31 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 return
             self.command, self.path, self.request_version = parts
             self.requestline = line
+            # header-read deadline (slowloris guard): a client that has
+            # opened a request line owes the complete head within
+            # header_timeout_s — trickling headers gets 408 + close
+            self.connection.settimeout(srv.header_timeout_s)
+            head_deadline = time.monotonic() + srv.header_timeout_s
             headers = _Headers()
-            while True:
-                h = self.rfile.readline(65537)
-                if h in (b"\r\n", b"\n", b""):
-                    break
-                k, sep, v = h.decode("latin-1").partition(":")
-                if sep:
-                    headers[k.strip().lower()] = v.strip()
+            try:
+                while True:
+                    h = self.rfile.readline(65537)
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    if time.monotonic() > head_deadline:
+                        raise TimeoutError("header deadline")
+                    k, sep, v = h.decode("latin-1").partition(":")
+                    if sep:
+                        headers[k.strip().lower()] = v.strip()
+            except TimeoutError:
+                srv.metrics.idle_closed_total.inc()
+                self.close_connection = True
+                self.wfile.write(b"HTTP/1.1 408 Request Timeout\r\n"
+                                 b"Content-Length: 0\r\n"
+                                 b"Connection: close\r\n\r\n")
+                srv.metrics.count_request(408)
+                return
+            self.connection.settimeout(srv.idle_timeout_s)
             self.headers = headers
             conn_tok = headers.get("connection", "").lower()
             if self.request_version == "HTTP/1.0":
@@ -274,6 +413,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
             method()
             self.wfile.flush()
         except TimeoutError:
+            # body-read (or response-write) stall past the idle
+            # deadline: poison the connection, count the close
+            srv.metrics.idle_closed_total.inc()
             self.close_connection = True
 
     # -- plumbing (the serving handler's keep-alive discipline) --------
@@ -318,23 +460,18 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if path == "/healthz":
             self._respond(200, b"ok\n", "text/plain")
         elif path == "/readyz":
-            counts = srv.registry.counts()
-            srv.metrics.set_fleet_gauges(counts)
-            body = (json.dumps({
-                "ready": counts["eligible"] > 0,
-                "counts": counts,
-                "replicas": {r.id: r.summary()
-                             for r in srv.registry.all()},
-            }, sort_keys=True) + "\n").encode()
-            self._respond(200 if counts["eligible"] > 0 else 503, body)
+            status, body = readyz_document(srv.registry, srv.metrics)
+            self._respond(status, body)
         elif path == "/metrics":
-            self._respond(200, self._aggregate_metrics().encode(),
-                          "text/plain; version=0.0.4; charset=utf-8")
+            self._respond(200, aggregate_metrics_text(
+                srv.registry, srv.metrics).encode(),
+                "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/replicas":
             self._json(200, {r.id: r.summary()
                              for r in srv.registry.all()})
         elif path == "/streams":
-            self._json(200, self._merged_streams())
+            self._json(200, merged_streams(srv.registry,
+                                           srv.upstream_timeout_s))
         else:
             self._proxy("GET", None)
 
@@ -353,51 +490,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------------
     def _replica_op(self, replica_id: str, op: str) -> None:
         srv = self.server
-        if srv.registry.get(replica_id) is None:
-            self._json(404, {"error": f"unknown replica {replica_id!r}",
-                             "replicas": srv.registry.ids()})
-            return
-        if op == "/drain":
-            with srv._drain_lock:
-                report = drain_replica(srv.registry, srv.metrics,
-                                       replica_id,
-                                       timeout_s=srv.migrate_timeout_s)
-            self._json(200, report)
-        elif op == "/undrain":
-            with srv._drain_lock:
-                report = undrain_replica(srv.registry, srv.metrics,
-                                         replica_id)
-            self._json(200, report)
-        else:
-            self._json(404, {"error": "POST /replicas/<id>/drain or "
-                                      "/undrain"})
-
-    def _aggregate_metrics(self) -> str:
-        srv = self.server
-        srv.metrics.set_fleet_gauges(srv.registry.counts())
-        lines = [srv.metrics.render_prometheus().rstrip("\n")]
-        seen: Set[str] = set()
-        for r in srv.registry.all():
-            if r.exposition:
-                lines.extend(relabel_exposition(r.exposition, r.id, seen))
-        return "\n".join(lines) + "\n"
-
-    def _merged_streams(self) -> dict:
-        srv = self.server
-        streams: Dict[str, str] = {}
-        for r in srv.registry.all():
-            if not r.healthy:
-                continue
-            try:
-                _, _, body = http_request(r.netloc, "GET", "/streams",
-                                          timeout=srv.upstream_timeout_s)
-                for sid in json.loads(body).get("streams", []):
-                    streams[sid] = r.id
-            except (OSError, ValueError):
-                continue
-        return {"streams": sorted(streams),
-                "active": len(streams),
-                "by_replica": streams}
+        status, doc = replica_operation(srv.registry, srv.metrics,
+                                        srv._drain_lock, replica_id, op,
+                                        srv.migrate_timeout_s)
+        self._json(status, doc)
 
     # ------------------------------------------------------------------
     # proxy path — every resolution increments EXACTLY one book
@@ -436,7 +532,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if method == "POST" and path == "/streams":
             # creation: the router must know the id to hash it — inject
             # one when the client didn't name it
-            sid, body = self._ensure_stream_id(body)
+            sid, body = ensure_stream_id(body)
             if sid is None:
                 self._json(400, {"error": "body must be empty or a JSON "
                                           "object"})
@@ -463,14 +559,29 @@ class _RouterHandler(BaseHTTPRequestHandler):
         self._json(502, {"error": note})
 
     def _pooled_conn(self, r: Replica) -> Tuple["_UpstreamConn", bool]:
-        """(connection, was_reused) from this thread's upstream pool."""
+        """(connection, was_reused) from this thread's upstream pool.
+
+        The pool is pruned whenever the registry generation moved
+        (replica removed or down-marked): sockets to retired replicas
+        close instead of leaking one FD per pool owner until the thread
+        dies."""
+        srv = self.server
         pool = getattr(_tls, "pool", None)
         if pool is None:
             pool = _tls.pool = {}
+            _tls.generation = -1
+        gen = srv.registry.generation
+        if _tls.generation != gen:
+            _tls.generation = gen
+            for rid in list(pool):
+                rep = srv.registry.get(rid)
+                if rep is None or not rep.healthy:
+                    pool.pop(rid).close()
+                    srv.metrics.upstream_pool_closed_total.inc()
         conn = pool.get(r.id)
         if conn is not None:
             return conn, True
-        conn = _UpstreamConn(r.netloc, self.server.upstream_timeout_s)
+        conn = _UpstreamConn(r.netloc, srv.upstream_timeout_s)
         pool[r.id] = conn
         return conn, False
 
@@ -625,32 +736,31 @@ class _RouterHandler(BaseHTTPRequestHandler):
         srv.metrics.count_forward(r.id)
         self._relay(status, hdrs, rbody)
 
-    @staticmethod
-    def _ensure_stream_id(body: bytes
-                          ) -> Tuple[Optional[str], bytes]:
-        """(stream id, possibly-rewritten body) for POST /streams; id is
-        None when the body is unparseable (400 path)."""
-        payload: dict = {}
-        if body:
-            try:
-                payload = json.loads(body)
-            except ValueError:
-                return None, body
-            if not isinstance(payload, dict):
-                return None, body
-        sid = payload.get("stream_id")
-        if not sid:
-            sid = uuid.uuid4().hex[:12]
-            payload["stream_id"] = sid
-            body = json.dumps(payload).encode()
-        return str(sid), body
-
 
 def make_router_server(host: str, port: int, registry: Registry,
                        metrics: Optional[RouterMetrics] = None,
-                       scraper: Optional[HealthScraper] = None,
-                       **kw) -> RouterServer:
+                       scraper: Optional[HealthScraper] = None, *,
+                       data_plane: str = "threads",
+                       relay_workers: int = 1, **kw):
+    """Build a router server on the chosen data plane.
+
+    ``threads`` (default): :class:`RouterServer`, one thread per client
+    connection.  ``evloop``: the ISSUE 16 non-blocking event loop
+    (``fleet/dataplane.py``), same control plane and books, one loop
+    thread (``relay_workers`` shards accept across N loops via
+    SO_REUSEPORT).  Both return objects with the same serve_forever /
+    shutdown / server_close / server_address surface.
+    """
     metrics = metrics if metrics is not None else RouterMetrics()
     scraper = scraper if scraper is not None else HealthScraper(
         registry, metrics)
+    if data_plane == "evloop":
+        # lazy import: dataplane imports this module's shared helpers
+        from .dataplane import EvLoopRouterServer
+        return EvLoopRouterServer((host, port), registry, metrics,
+                                  scraper, relay_workers=relay_workers,
+                                  **kw)
+    if data_plane != "threads":
+        raise ValueError(f"data_plane must be 'threads' or 'evloop', "
+                         f"got {data_plane!r}")
     return RouterServer((host, port), registry, metrics, scraper, **kw)

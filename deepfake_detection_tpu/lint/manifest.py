@@ -70,6 +70,7 @@ JAX_FREE_MODULES = (
     "deepfake_detection_tpu.fleet.controller",
     "deepfake_detection_tpu.fleet.migrate",
     "deepfake_detection_tpu.fleet.router",
+    "deepfake_detection_tpu.fleet.dataplane",
     "deepfake_detection_tpu.runners.router",
     "tools.pack_dataset",
     "tools.obs_report",

@@ -67,11 +67,16 @@ def build_router(cfg):
                             timeout_s=cfg.scrape_timeout_s)
     server = make_router_server(
         cfg.host, cfg.port, registry, metrics, scraper,
+        data_plane=cfg.data_plane,
+        relay_workers=cfg.relay_workers,
         route_retries=cfg.route_retries,
         upstream_timeout_s=cfg.upstream_timeout_s,
         shed_retry_after_s=cfg.shed_retry_after_s,
         retry_jitter_s=cfg.retry_jitter_s,
-        migrate_timeout_s=cfg.migrate_timeout_s)
+        migrate_timeout_s=cfg.migrate_timeout_s,
+        idle_timeout_s=cfg.idle_timeout_s,
+        header_timeout_s=cfg.header_timeout_s,
+        max_buffer_bytes=cfg.max_buffer_bytes)
     return server, spawned
 
 
@@ -97,9 +102,13 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     signal.signal(signal.SIGINT, _sig)
     host, port = server.server_address[:2]
     _logger.info(
-        "routing on http://%s:%d over %d replica(s): %s (POST /score, "
-        "/streams/*, GET /healthz /readyz /metrics /replicas, POST "
-        "/replicas/<id>/drain)", host, port,
+        "routing on http://%s:%d [%s data plane%s] over %d replica(s): "
+        "%s (POST /score, /streams/*, GET /healthz /readyz /metrics "
+        "/replicas, POST /replicas/<id>/drain)", host, port,
+        cfg.data_plane,
+        (f", {cfg.relay_workers} shards"
+         if cfg.data_plane == "evloop" and int(cfg.relay_workers) > 1
+         else ""),
         len(server.registry.ids()), ", ".join(server.registry.ids()))
     t = threading.Thread(target=server.serve_forever,
                          kwargs={"poll_interval": 0.1}, daemon=True)

@@ -6,6 +6,7 @@ live-fleet drives are tools/chaos_serve.py's replica_* scenarios and
 tools/bench_serve.py --replicas)."""
 
 import json
+import socket
 import subprocess
 import sys
 import threading
@@ -309,6 +310,18 @@ class _StubHandler(BaseHTTPRequestHandler):
             self._r(503, {"error": "stub shedding"},
                     {"Retry-After": self.st.retry_after})
             return
+        if self.st.mode == "tear-mid":
+            # promise 1000 body bytes, deliver 7, die: the router must
+            # treat this as a transport error and fail over cleanly
+            self.wfile.write(b"HTTP/1.1 200 OK\r\n"
+                             b"Content-Length: 1000\r\n\r\npartial")
+            self.wfile.flush()
+            self.close_connection = True
+            try:
+                self.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return
         self._r(200, {"fake_score": 0.5, "scores": [0.5, 0.5],
                       "port": self.server.server_address[1]})
 
@@ -350,9 +363,11 @@ def _stub_replica():
     return srv
 
 
-@pytest.fixture()
-def fleet():
-    """Two stub replicas + a live router (scraper on a fast cadence)."""
+@pytest.fixture(params=["threads", "evloop"])
+def fleet(request):
+    """Two stub replicas + a live router (scraper on a fast cadence),
+    parametrized over BOTH data planes — the routing/books contract is
+    identical by construction and this fixture is what pins it."""
     stubs = [_stub_replica(), _stub_replica()]
     urls = [f"127.0.0.1:{s.server_address[1]}" for s in stubs]
     registry = Registry(urls)
@@ -362,7 +377,8 @@ def fleet():
     server = make_router_server("127.0.0.1", 0, registry, metrics,
                                 scraper, route_retries=2,
                                 shed_retry_after_s=1.0,
-                                retry_jitter_s=2.0)
+                                retry_jitter_s=2.0,
+                                data_plane=request.param)
     scraper.start()
     threading.Thread(target=server.serve_forever,
                      kwargs={"poll_interval": 0.05}, daemon=True).start()
@@ -372,7 +388,7 @@ def fleet():
         time.sleep(0.05)
     yield type("F", (), dict(stubs=stubs, urls=urls, registry=registry,
                              metrics=metrics, scraper=scraper,
-                             server=server,
+                             server=server, data_plane=request.param,
                              port=server.server_address[1]))
     server.shutdown()
     scraper.stop()
@@ -605,6 +621,7 @@ def test_router_import_is_jax_free():
     runners.router)."""
     code = ("import sys\n"
             "import deepfake_detection_tpu.fleet.router\n"
+            "import deepfake_detection_tpu.fleet.dataplane\n"
             "import deepfake_detection_tpu.fleet.controller\n"
             "import deepfake_detection_tpu.fleet.migrate\n"
             "import deepfake_detection_tpu.runners.router\n"
@@ -620,3 +637,214 @@ def test_router_import_is_jax_free():
 def test_free_port_binds():
     p = free_port()
     assert 1 <= p <= 65535
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: splice-FSM framing edge cases, hardening, pool lifecycle —
+# all run against BOTH data planes via the parametrized fleet fixture
+# ---------------------------------------------------------------------------
+
+class _RawClient:
+    """Keep-alive raw-socket client with a minimal Content-Length
+    response reader (what the relay-ceiling bench clients do)."""
+
+    def __init__(self, port, timeout=10.0):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.rfile = self.sock.makefile("rb")
+
+    def request(self, method, path, body=b""):
+        self.sock.sendall(
+            (f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+             f"Content-Length: {len(body)}\r\n\r\n").encode() + body)
+        return self.read_response()
+
+    def read_response(self):
+        line = self.rfile.readline()
+        status = int(line.split()[1])
+        hdrs = {}
+        while True:
+            h = self.rfile.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.partition(b":")
+            hdrs[k.strip().lower().decode()] = v.strip().decode()
+        n = int(hdrs.get("content-length", 0))
+        return status, hdrs, self.rfile.read(n)
+
+    def close(self):
+        for x in (self.rfile, self.sock):
+            try:
+                x.close()
+            except OSError:
+                pass
+
+
+def test_pipelined_keepalive_requests(fleet):
+    """Three requests in ONE write: the FSM must consume the burst
+    request-by-request and answer all three, in order, books exact."""
+    before = fleet.metrics.books()["routed"]
+    c = _RawClient(fleet.port)
+    try:
+        one = (b"POST /score HTTP/1.1\r\nHost: t\r\n"
+               b"Content-Length: 1\r\n\r\nx")
+        c.sock.sendall(one * 3)
+        for _ in range(3):
+            status, _, body = c.read_response()
+            assert status == 200
+            assert json.loads(body)["fake_score"] == 0.5
+    finally:
+        c.close()
+    _assert_books(fleet.metrics)
+    assert fleet.metrics.books()["routed"] == before + 3
+
+
+def test_request_body_split_across_writes(fleet):
+    """Head and body arriving in three separate writes must reassemble
+    into one upstream request."""
+    c = _RawClient(fleet.port)
+    try:
+        body = b'{"stream_id": "split-body"}'
+        c.sock.sendall((f"POST /streams HTTP/1.1\r\nHost: t\r\n"
+                        f"Content-Length: {len(body)}\r\n\r\n").encode())
+        time.sleep(0.05)
+        c.sock.sendall(body[:9])
+        time.sleep(0.05)
+        c.sock.sendall(body[9:])
+        status, _, rbody = c.read_response()
+        assert status == 201
+        assert json.loads(rbody)["stream_id"] == "split-body"
+    finally:
+        c.close()
+    _assert_books(fleet.metrics)
+
+
+def test_chunked_and_oversize_poison(fleet):
+    """The serving handler's drain-or-poison discipline at the router:
+    chunked framing and unparseable/oversize Content-Length get 400 and
+    the connection is poisoned — and neither touches the books."""
+    before = fleet.metrics.books()
+    c = _RawClient(fleet.port)
+    try:
+        c.sock.sendall(b"POST /score HTTP/1.1\r\nHost: t\r\n"
+                       b"Transfer-Encoding: chunked\r\n\r\n")
+        status, _, _ = c.read_response()
+        assert status == 400
+        assert c.rfile.read(1) == b""        # poisoned: EOF follows
+    finally:
+        c.close()
+    c = _RawClient(fleet.port)
+    try:
+        c.sock.sendall(b"POST /score HTTP/1.1\r\nHost: t\r\n"
+                       b"Content-Length: 999999999999\r\n\r\n")
+        status, _, _ = c.read_response()
+        assert status == 400
+        assert c.rfile.read(1) == b""
+    finally:
+        c.close()
+    assert fleet.metrics.books() == before   # rejected BEFORE routed
+
+
+def test_mid_response_upstream_death_fails_over(fleet):
+    """A replica that tears mid-response (promises 1000 bytes, sends 7,
+    dies) is a transport error: the request fails over and the client
+    sees a clean 200 from the survivor, books exact."""
+    fleet.stubs[0].state.mode = "tear-mid"
+    good_port = fleet.stubs[1].server_address[1]
+    for _ in range(4):
+        status, _, body = _post(fleet.port, "/score")
+        assert status == 200 and body["port"] == good_port
+    _assert_books(fleet.metrics)
+    assert fleet.metrics.books()["failed"] == 0
+    assert fleet.metrics.retries_total.value >= 1
+
+
+def test_upstream_pool_prunes_on_replica_retire(fleet):
+    """Retiring a replica closes its pooled upstream sockets (counted)
+    instead of leaking them for the pool owner's lifetime."""
+    c = _RawClient(fleet.port)
+    try:
+        ports = set()
+        for _ in range(8):
+            status, _, body = c.request("POST", "/score", b"x")
+            assert status == 200
+            ports.add(json.loads(body)["port"])
+        assert len(ports) == 2       # pooled sockets to both replicas
+        gone = fleet.urls[0]
+        fleet.registry.remove(gone)
+        deadline = time.monotonic() + 5.0
+        while (fleet.metrics.upstream_pool_closed_total.value < 1
+               and time.monotonic() < deadline):
+            status, _, _ = c.request("POST", "/score", b"x")
+            assert status == 200
+            time.sleep(0.05)
+        assert fleet.metrics.upstream_pool_closed_total.value >= 1
+        if fleet.data_plane == "evloop":
+            for lo in fleet.server._loops:
+                assert gone not in lo.pools
+    finally:
+        c.close()
+    _assert_books(fleet.metrics)
+
+
+@pytest.mark.parametrize("plane", ["threads", "evloop"])
+def test_idle_and_header_deadlines(plane):
+    """Slowloris/idle hardening on both planes: a quiet connection is
+    closed at the idle deadline (no response); a stalled header read
+    gets 408 + close.  Both count dfd_router_idle_closed_total."""
+    registry = Registry([])
+    metrics = RouterMetrics()
+    server = make_router_server("127.0.0.1", 0, registry, metrics,
+                                data_plane=plane, idle_timeout_s=0.6,
+                                header_timeout_s=0.5)
+    threading.Thread(target=server.serve_forever,
+                     kwargs={"poll_interval": 0.05}, daemon=True).start()
+    port = server.server_address[1]
+    try:
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s.settimeout(5)
+        assert s.recv(64) == b""             # idle: closed, silently
+        s.close()
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s.settimeout(5)
+        s.sendall(b"POST /score HTTP/1.1\r\nX-Slow: 1\r\n")   # stalls
+        data = s.recv(4096)
+        assert b"408" in data.split(b"\r\n", 1)[0]
+        assert s.recv(64) == b""             # ...and poisoned
+        s.close()
+        deadline = time.monotonic() + 5.0
+        while (metrics.idle_closed_total.value < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert metrics.idle_closed_total.value >= 2
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_evloop_overflow_guard_sheds_stalled_reader():
+    """The bounded-buffer guard: a reader stalled past a full relay
+    buffer is shed (closed + counted), never buffered without limit."""
+    from deepfake_detection_tpu.fleet import dataplane as dp
+    registry = Registry([])
+    metrics = RouterMetrics()
+    server = make_router_server("127.0.0.1", 0, registry, metrics,
+                                data_plane="evloop",
+                                max_buffer_bytes=4096)
+    lo = server._loops[0]
+    a, b = socket.socketpair()
+    a.setblocking(False)
+    a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 2048)
+    try:
+        c = dp._Conn(a)
+        lo.conns.add(c)
+        lo._enqueue(c, b"x" * 65536)         # peer never reads
+        assert c.out_len > 4096              # buffer past the bound
+        c.state = dp._Conn.RELAY
+        lo._finish_response(c)               # between-requests guard
+        assert c.closed
+        assert metrics.overflow_closed_total.value == 1
+    finally:
+        b.close()
+        server.server_close()

@@ -614,7 +614,8 @@ def _spawn_fleet_serve(args, n: int) -> Tuple[list, subprocess.Popen, str]:
     for _, port in replicas:
         wait_ready(f"127.0.0.1:{port}", timeout=args.ready_timeout_s)
     router_proc, router_netloc = spawn_router(
-        [f"127.0.0.1:{port}" for _, port in replicas])
+        [f"127.0.0.1:{port}" for _, port in replicas],
+        data_plane=args.data_plane)
     wait_fleet_ready(router_netloc, n, timeout=args.ready_timeout_s)
     return replicas, router_proc, router_netloc
 
@@ -730,7 +731,8 @@ def run_replica_migrate(args) -> dict:
                 wait_ready(f"127.0.0.1:{port}",
                            timeout=args.ready_timeout_s)
             router_proc, netloc = spawn_router(
-                [f"127.0.0.1:{port}" for _, port in replicas])
+                [f"127.0.0.1:{port}" for _, port in replicas],
+                data_plane=args.data_plane)
             wait_fleet_ready(netloc, 2, timeout=args.ready_timeout_s)
             rport = int(netloc.split(":")[1])
             client = _StreamClient(rport)
@@ -847,6 +849,10 @@ def main(argv=None) -> int:
     ap.add_argument("--watchdog-timeout-s", type=float, default=2.0)
     ap.add_argument("--breaker-threshold", type=int, default=5)
     ap.add_argument("--ready-timeout-s", type=float, default=900.0)
+    ap.add_argument("--data-plane", default="evloop",
+                    choices=["evloop", "threads"],
+                    help="router data plane for the fleet scenarios "
+                         "(ISSUE 16: chaos must hold on both)")
     ap.add_argument("--out", default="", help="write a JSON report here")
     args = ap.parse_args(argv)
 
