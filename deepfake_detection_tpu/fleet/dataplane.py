@@ -84,16 +84,22 @@ _DL_IDLE = 0        # quiet between requests: close, count idle_closed
 _DL_HEAD = 1        # mid-head slowloris: 408 + close, count idle_closed
 _DL_BODY = 2        # stalled body sender: close, count idle_closed
 _DL_UPSTREAM = 3    # upstream round trip too slow: transport error
+_DL_DRAIN = 4       # full relay buffer not draining: shed (overflow)
 
 
 class _TimerWheel:
     """Hashed timer wheel with lazy re-file.
 
     ``conn.deadline`` is the truth; wheel entries are hints.  ``arm``
-    files a connection at its deadline's tick (at most one live entry
-    per connection); when a slot fires, entries whose deadline moved
-    into the future are re-filed instead of expired.  O(1) arm, O(slot)
-    advance — per-request deadline updates are two attribute writes.
+    files a connection at its deadline's tick.  A deadline that moves
+    LATER is handled lazily: the early entry fires, sees the deadline
+    in the future, and re-files.  A deadline that moves EARLIER files
+    an additional entry immediately (otherwise a short deadline — the
+    10s header or 30s upstream one — would only fire at the stale 60s
+    idle tick); ``conn.wheel_tick`` names the live entry so the stale
+    later one is skipped when it fires.  O(1) arm, O(slot) advance —
+    per-request deadline updates are two attribute writes on the
+    steady path.
     """
 
     __slots__ = ("granularity", "nslots", "slots", "tick")
@@ -106,6 +112,7 @@ class _TimerWheel:
 
     def _file(self, conn, deadline: float) -> None:
         t = max(int(deadline / self.granularity) + 1, self.tick)
+        conn.wheel_tick = t
         self.slots[t % self.nslots].append((t, conn))
 
     def arm(self, conn, deadline: float, kind: int) -> None:
@@ -113,6 +120,10 @@ class _TimerWheel:
         conn.deadline_kind = kind
         if not conn.wheel_filed:
             conn.wheel_filed = True
+            self._file(conn, deadline)
+        elif int(deadline / self.granularity) + 1 < conn.wheel_tick:
+            # moved earlier than the filed entry: file a fresh one (the
+            # stale later entry no longer matches wheel_tick)
             self._file(conn, deadline)
 
     def disarm(self, conn) -> None:
@@ -131,6 +142,8 @@ class _TimerWheel:
                     if t != self.tick:
                         keep.append((t, conn))   # a later wrap's entry
                         continue
+                    if conn.wheel_tick != t:
+                        continue     # superseded by an earlier re-file
                     conn.wheel_filed = False
                     if conn.closed or conn.deadline <= 0.0:
                         continue
@@ -138,7 +151,11 @@ class _TimerWheel:
                         conn.wheel_filed = True
                         self._file(conn, conn.deadline)
                     else:
-                        expire(conn, conn.deadline_kind)
+                        kind = conn.deadline_kind
+                        # clear BEFORE firing so a duplicate entry at
+                        # this tick can never expire the conn twice
+                        conn.deadline = 0.0
+                        expire(conn, kind)
                 self.slots[self.tick % self.nslots] = keep
             self.tick += 1
 
@@ -154,7 +171,7 @@ class _Upstream:
     __slots__ = ("sock", "rid", "netloc", "rbuf", "reused", "conn",
                  "closed", "outbuf", "out_off", "t0", "mask",
                  "deadline", "deadline_kind", "wheel_filed",
-                 "last_head", "last_parsed")
+                 "wheel_tick", "last_head", "last_parsed")
 
     def __init__(self, netloc: str, rid: str):
         host, port = netloc.rsplit(":", 1)
@@ -180,6 +197,7 @@ class _Upstream:
         self.deadline = 0.0
         self.deadline_kind = _DL_UPSTREAM
         self.wheel_filed = False
+        self.wheel_tick = 0
         # steady-state response-head cache: a replica answering the
         # same request shape emits byte-identical heads (modulo a
         # once-per-second Date tick) — skip the re-parse on a hit
@@ -202,7 +220,7 @@ class _Conn:
 
     __slots__ = ("sock", "inbuf", "outbuf", "out_off", "out_len",
                  "state", "closed", "closing", "keep_alive", "mask",
-                 "client_gone", "processing",
+                 "client_gone", "processing", "drain_wait",
                  # request under assembly / in flight
                  "method", "target", "path", "head_lines", "body",
                  "body_need", "t0",
@@ -218,7 +236,8 @@ class _Conn:
                  # keep-alive connection skip the parse + rebuild)
                  "head_cache", "hc_body_need", "fwd_cache",
                  # timer wheel
-                 "deadline", "deadline_kind", "wheel_filed")
+                 "deadline", "deadline_kind", "wheel_filed",
+                 "wheel_tick")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
@@ -232,10 +251,12 @@ class _Conn:
         self.keep_alive = True
         self.client_gone = False      # EOF seen mid-request
         self.processing = False       # _on_client_bytes reentrancy guard
+        self.drain_wait = False       # paused until outbuf drains
         self.mask = 0
         self.deadline = 0.0
         self.deadline_kind = _DL_IDLE
         self.wheel_filed = False
+        self.wheel_tick = 0
         # parse products survive _reset_request: on a head-cache hit the
         # previous request's method/target/path/head_lines are reused
         self.method = ""
@@ -387,6 +408,7 @@ class _Loop:
     def _flush(self, c: _Conn) -> None:
         """Optimistic writes until EAGAIN; gate WRITE interest on a
         non-empty buffer (writability-gated backpressure)."""
+        before = c.out_len
         try:
             while c.outbuf:
                 chunk = c.outbuf[0]
@@ -402,9 +424,14 @@ class _Loop:
         except OSError:
             self._close_conn(c)
             return
-        want = selectors.EVENT_WRITE if c.outbuf else 0
+        if c.drain_wait and c.out_len < before:
+            # the reader is draining: keep the overflow-shed deadline
+            # rolling — only a reader that stops making progress with
+            # the buffer past its bound is ever shed
+            c.deadline = time.monotonic() + self.server.idle_timeout_s
         if c.outbuf:
-            self._set_mask(c, c.sock, c.mask | want, "conn")
+            self._set_mask(c, c.sock, c.mask | selectors.EVENT_WRITE,
+                           "conn")
         else:
             if c.closing:
                 self._close_conn(c)
@@ -412,6 +439,20 @@ class _Loop:
             if c.mask & selectors.EVENT_WRITE:
                 self._set_mask(c, c.sock,
                                c.mask & ~selectors.EVENT_WRITE, "conn")
+            if c.drain_wait:
+                # overflow pause over: the relay buffer drained — go
+                # back to serving (possibly pipelined) requests
+                c.drain_wait = False
+                self.wheel.arm(c, time.monotonic() +
+                               self.server.idle_timeout_s, _DL_IDLE)
+                if c.inbuf:
+                    self._on_client_bytes(c)
+                if c.closed or c.drain_wait:
+                    return
+                if not (c.mask & selectors.EVENT_READ):
+                    self._set_mask(c, c.sock,
+                                   c.mask | selectors.EVENT_READ,
+                                   "conn")
             # a paused streaming upstream resumes once we drain below
             # the low-water mark
             u = c.u
@@ -451,9 +492,13 @@ class _Loop:
         u = c.u
         if u is not None:
             # mid-request upstream: response state unknown, not
-            # poolable.  Books: if a route was in flight the request
-            # still resolves below (client_gone path) — never here.
+            # poolable.  The attempt is still live here (every resolved
+            # attempt clears c.u first), so settle its accounting —
+            # Replica.router_inflight must not stay inflated because
+            # the client died mid-relay.
             c.u = None
+            u.conn = None
+            self._attempt_done(c, u)
             self._kill_upstream(u)
 
     def _finish_response(self, c: _Conn) -> None:
@@ -466,11 +511,19 @@ class _Loop:
             return
         c.state = _Conn.HEAD
         c._reset_request()
-        # bounded-buffer guard: a reader stalled past a full relay
-        # buffer sheds (close + count) instead of growing without limit
+        # bounded-buffer guard: past a full relay buffer, PAUSE — stop
+        # reading the next pipelined request until the buffer drains
+        # (_flush resumes us).  Closing here would discard unsent
+        # response bytes an actively-draining reader is still owed
+        # (silent truncation booked as success); only a reader that
+        # stops making progress is shed, on the _DL_DRAIN deadline.
         if c.out_len > self.server.max_buffer_bytes:
-            self.metrics.overflow_closed_total.inc()
-            self._close_conn(c)
+            c.drain_wait = True
+            if c.mask & selectors.EVENT_READ:
+                self._set_mask(c, c.sock,
+                               c.mask & ~selectors.EVENT_READ, "conn")
+            self.wheel.arm(c, time.monotonic() +
+                           self.server.idle_timeout_s, _DL_DRAIN)
             return
         self.wheel.arm(c, time.monotonic() + self.server.idle_timeout_s,
                        _DL_IDLE)
@@ -552,7 +605,7 @@ class _Loop:
             c.processing = False
 
     def _client_fsm(self, c: _Conn) -> None:
-        while not c.closed:
+        while not c.closed and not c.drain_wait:
             if c.state == _Conn.HEAD:
                 idx = c.inbuf.find(b"\r\n\r\n")
                 if idx < 0:
@@ -1018,6 +1071,13 @@ class _Loop:
         # plane's per-recv socket timeout semantics)
         c.deadline = time.monotonic() + self.server.upstream_timeout_s
         if c.resp_streaming:
+            if len(data) > c.resp_need:
+                # overrun: bytes past Content-Length (e.g. a pipelined
+                # next response on the keep-alive socket) must never be
+                # spliced into the client's stream — and the socket's
+                # framing is no longer trustworthy, so don't pool it
+                data = data[:c.resp_need]
+                c.resp_close = True
             c.resp_need -= len(data)
             self._enqueue(c, data)
             if c.closed:
@@ -1069,9 +1129,15 @@ class _Loop:
                 # streaming splice: forward verbatim, book at the end
                 c.resp_streaming = True
                 c.resp_sent_any = True
-                got = len(u.rbuf)
-                c.resp_need = (idx + 4 + length) - got
-                self._enqueue(c, bytes(u.rbuf))
+                full = idx + 4 + length
+                if len(u.rbuf) > full:
+                    # overrun past Content-Length: clamp, don't pool
+                    chunk = bytes(u.rbuf[:full])
+                    c.resp_close = True
+                else:
+                    chunk = bytes(u.rbuf)
+                c.resp_need = full - len(chunk)
+                self._enqueue(c, chunk)
                 u.rbuf.clear()
                 if c.closed:
                     return
@@ -1080,6 +1146,10 @@ class _Loop:
                 return
         total = c.resp_head_len + c.resp_need
         if len(u.rbuf) >= total:
+            if len(u.rbuf) > total:
+                # trailing bytes past the framed response: the socket
+                # can't be trusted for reuse (clamped by the slice)
+                c.resp_close = True
             self._buffered_response(c, u, total)
 
     def _buffered_response(self, c: _Conn, u: _Upstream,
@@ -1147,6 +1217,12 @@ class _Loop:
         if kind == _DL_UPSTREAM:
             if c.u is not None:
                 self._upstream_error(c, timeout=True)
+            return
+        if kind == _DL_DRAIN:
+            # overflow shed: a full relay buffer made zero progress for
+            # an entire idle window — the reader is genuinely stalled
+            self.metrics.overflow_closed_total.inc()
+            self._close_conn(c)
             return
         self.metrics.idle_closed_total.inc()
         if kind == _DL_HEAD:

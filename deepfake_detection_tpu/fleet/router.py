@@ -317,6 +317,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
         # connection that goes quiet stops costing a thread at
         # idle_timeout_s instead of forever
         self.timeout = self.server.idle_timeout_s
+        # bytes read past the head terminator (the head read pulls one
+        # raw recv at a time, which can over-read into the body or the
+        # next pipelined request); consumed before rfile everywhere
+        self._head_excess = b""
         super().setup()
 
     # Date-header cache: BaseHTTP's send_response runs strftime per
@@ -345,51 +349,60 @@ class _RouterHandler(BaseHTTPRequestHandler):
         the fleet's aggregate near 1×.  This override keeps the stdlib
         server's connection/dispatch semantics (keep-alive, 501 on
         unknown verbs, timeouts poison the connection) with a plain
-        readline/split parse.  No Expect: 100-continue handling — the
-        serving stack's clients never send it."""
+        split parse over the raw head.  No Expect: 100-continue
+        handling — the serving stack's clients never send it.
+
+        The whole head (request line + headers) is read via
+        ``rfile.read1`` — at most ONE raw recv per call — under a
+        socket timeout that shrinks toward a hard deadline: the first
+        byte may wait out the idle timeout (quiet keep-alive), but once
+        any byte has arrived the complete head is owed within
+        header_timeout_s.  A per-recv timeout alone (readline) would
+        let a client trickling bytes — even within a single header
+        line — reset it forever while pinning this thread."""
         self.command = self.requestline = ""
         self.request_version = self.protocol_version
         srv = self.server
         try:
-            try:
-                self.raw_requestline = self.rfile.readline(65537)
-            except TimeoutError:
-                # idle deadline between requests: quiet keep-alive
-                # connection, close without a response (same as evloop)
-                srv.metrics.idle_closed_total.inc()
-                self.close_connection = True
-                return
-            if len(self.raw_requestline) > 65536:
-                self.send_error(414)
-                return
-            if not self.raw_requestline:
-                self.close_connection = True
-                return
-            line = self.raw_requestline.decode("latin-1").rstrip("\r\n")
-            parts = line.split()
-            if len(parts) != 3:
-                self.close_connection = True
-                if line:
-                    self.send_error(400, "malformed request line")
-                return
-            self.command, self.path, self.request_version = parts
-            self.requestline = line
-            # header-read deadline (slowloris guard): a client that has
-            # opened a request line owes the complete head within
-            # header_timeout_s — trickling headers gets 408 + close
-            self.connection.settimeout(srv.header_timeout_s)
-            head_deadline = time.monotonic() + srv.header_timeout_s
-            headers = _Headers()
+            buf, self._head_excess = self._head_excess, b""
+            deadline = 0.0            # armed at the first head byte
             try:
                 while True:
-                    h = self.rfile.readline(65537)
-                    if h in (b"\r\n", b"\n", b""):
+                    i = buf.find(b"\r\n\r\n")
+                    sep = 4
+                    if i < 0:
+                        i = buf.find(b"\n\n")
+                        sep = 2
+                    if i >= 0:
                         break
-                    if time.monotonic() > head_deadline:
-                        raise TimeoutError("header deadline")
-                    k, sep, v = h.decode("latin-1").partition(":")
-                    if sep:
-                        headers[k.strip().lower()] = v.strip()
+                    if len(buf) > 65536:
+                        self.send_error(414)
+                        return
+                    now = time.monotonic()
+                    if buf and deadline == 0.0:
+                        deadline = now + srv.header_timeout_s
+                    if deadline:
+                        remaining = deadline - now
+                        if remaining <= 0:
+                            raise TimeoutError("header deadline")
+                        self.connection.settimeout(remaining)
+                    else:
+                        self.connection.settimeout(srv.idle_timeout_s)
+                    try:
+                        chunk = self.rfile.read1(65536)
+                    except TimeoutError:
+                        if deadline == 0.0:
+                            # idle deadline between requests: quiet
+                            # keep-alive connection, close without a
+                            # response (same as evloop)
+                            srv.metrics.idle_closed_total.inc()
+                            self.close_connection = True
+                            return
+                        raise
+                    if not chunk:
+                        self.close_connection = True
+                        return
+                    buf += chunk
             except TimeoutError:
                 srv.metrics.idle_closed_total.inc()
                 self.close_connection = True
@@ -398,6 +411,22 @@ class _RouterHandler(BaseHTTPRequestHandler):
                                  b"Connection: close\r\n\r\n")
                 srv.metrics.count_request(408)
                 return
+            self._head_excess = buf[i + sep:]
+            lines = buf[:i].split(b"\n")
+            line = lines[0].decode("latin-1").rstrip("\r")
+            parts = line.split()
+            if len(parts) != 3:
+                self.close_connection = True
+                if line:
+                    self.send_error(400, "malformed request line")
+                return
+            self.command, self.path, self.request_version = parts
+            self.requestline = line
+            headers = _Headers()
+            for hl in lines[1:]:
+                k, hsep, v = hl.decode("latin-1").partition(":")
+                if hsep:
+                    headers[k.strip().lower()] = v.strip()
             self.connection.settimeout(srv.idle_timeout_s)
             self.headers = headers
             conn_tok = headers.get("connection", "").lower()
@@ -416,6 +445,11 @@ class _RouterHandler(BaseHTTPRequestHandler):
             # body-read (or response-write) stall past the idle
             # deadline: poison the connection, count the close
             srv.metrics.idle_closed_total.inc()
+            self.close_connection = True
+        except OSError:
+            # client vanished mid-request (reset/EPIPE on a write):
+            # every route path settles its book before writing to the
+            # client, so just poison the connection quietly
             self.close_connection = True
 
     # -- plumbing (the serving handler's keep-alive discipline) --------
@@ -449,6 +483,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if not 0 <= length <= _MAX_BODY:
             self.close_connection = True
             return None
+        excess = self._head_excess
+        if excess:
+            # the head read over-ran into the body: consume that first
+            head, self._head_excess = excess[:length], excess[length:]
+            need = length - len(head)
+            if need:
+                return head + self.rfile.read(need)
+            return head
         return self.rfile.read(length)
 
     # ------------------------------------------------------------------

@@ -380,6 +380,11 @@ def main(cfg: TrainConfig) -> Dict[str, float]:
         the _bak best-copy, then model_best), skipping torn/corrupt files
         instead of crashing on them.  Returns (state, meta, path) or
         None."""
+        # an in-flight async recovery write hasn't renamed into place yet
+        # — join it BEFORE listing, or a guard rewind a step or two after
+        # the snapshot finds an empty ladder (loads already join; the
+        # listing must too)
+        wait_pending_saves()
         cands = find_resume_candidates(
             output_dir, bak_dir=os.path.join(output_dir, "_bak"),
             sharded=cfg.ckpt_sharded)

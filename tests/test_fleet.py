@@ -6,7 +6,9 @@ live-fleet drives are tools/chaos_serve.py's replica_* scenarios and
 tools/bench_serve.py --replicas)."""
 
 import json
+import selectors
 import socket
+import struct
 import subprocess
 import sys
 import threading
@@ -310,6 +312,15 @@ class _StubHandler(BaseHTTPRequestHandler):
             self._r(503, {"error": "stub shedding"},
                     {"Retry-After": self.st.retry_after})
             return
+        if self.st.mode == "slow":
+            # slow enough that a client can die while the router's
+            # upstream attempt is still in flight
+            time.sleep(0.5)
+        elif self.st.mode == "big":
+            # a response larger than a small max_buffer_bytes: the
+            # evloop plane streams it instead of buffering
+            self._r(200, {"pad": "x" * 65536})
+            return
         if self.st.mode == "tear-mid":
             # promise 1000 body bytes, deliver 7, die: the router must
             # treat this as a transport error and fail over cleanly
@@ -358,6 +369,8 @@ def _stub_replica():
     srv = ThreadingHTTPServer(("127.0.0.1", 0), _StubHandler)
     srv.daemon_threads = True
     srv.state = _StubState()
+    # client-death tests tear sockets mid-write; keep stderr clean
+    srv.handle_error = lambda *a: None
     threading.Thread(target=srv.serve_forever,
                      kwargs={"poll_interval": 0.05}, daemon=True).start()
     return srv
@@ -792,26 +805,35 @@ def test_upstream_pool_prunes_on_replica_retire(fleet):
 def test_idle_and_header_deadlines(plane):
     """Slowloris/idle hardening on both planes: a quiet connection is
     closed at the idle deadline (no response); a stalled header read
-    gets 408 + close.  Both count dfd_router_idle_closed_total."""
+    gets 408 + close.  Both count dfd_router_idle_closed_total.
+
+    The timeouts are deliberately FAR apart (REVIEW regression): with
+    near-equal values the evloop's stale idle wheel entry could mask a
+    header deadline that never re-files — the 408 must land well
+    before the idle deadline would fire."""
     registry = Registry([])
     metrics = RouterMetrics()
     server = make_router_server("127.0.0.1", 0, registry, metrics,
-                                data_plane=plane, idle_timeout_s=0.6,
-                                header_timeout_s=0.5)
+                                data_plane=plane, idle_timeout_s=1.5,
+                                header_timeout_s=0.25)
     threading.Thread(target=server.serve_forever,
                      kwargs={"poll_interval": 0.05}, daemon=True).start()
     port = server.server_address[1]
     try:
         s = socket.create_connection(("127.0.0.1", port), timeout=5)
         s.settimeout(5)
-        assert s.recv(64) == b""             # idle: closed, silently
+        s.sendall(b"POST /score HTTP/1.1\r\nX-Slow: 1\r\n")   # stalls
+        t0 = time.monotonic()
+        data = s.recv(4096)
+        elapsed = time.monotonic() - t0
+        assert b"408" in data.split(b"\r\n", 1)[0]
+        assert elapsed < 1.2, f"408 took {elapsed:.2f}s — header " \
+            "deadline fired at the idle tick, not at header_timeout_s"
+        assert s.recv(64) == b""             # ...and poisoned
         s.close()
         s = socket.create_connection(("127.0.0.1", port), timeout=5)
         s.settimeout(5)
-        s.sendall(b"POST /score HTTP/1.1\r\nX-Slow: 1\r\n")   # stalls
-        data = s.recv(4096)
-        assert b"408" in data.split(b"\r\n", 1)[0]
-        assert s.recv(64) == b""             # ...and poisoned
+        assert s.recv(64) == b""             # idle: closed, silently
         s.close()
         deadline = time.monotonic() + 5.0
         while (metrics.idle_closed_total.value < 2
@@ -823,28 +845,242 @@ def test_idle_and_header_deadlines(plane):
         server.server_close()
 
 
-def test_evloop_overflow_guard_sheds_stalled_reader():
-    """The bounded-buffer guard: a reader stalled past a full relay
-    buffer is shed (closed + counted), never buffered without limit."""
+@pytest.mark.parametrize("plane", ["threads", "evloop"])
+def test_header_trickle_within_one_line_still_bounded(plane):
+    """REVIEW regression: a client trickling bytes WITHIN a single
+    header line must still hit the header deadline.  The threads plane
+    used per-recv socket timeouts that every byte reset (a one-line
+    trickler could pin a thread for hours); the head read is now bound
+    to a hard deadline on both planes."""
+    registry = Registry([])
+    metrics = RouterMetrics()
+    server = make_router_server("127.0.0.1", 0, registry, metrics,
+                                data_plane=plane, idle_timeout_s=5.0,
+                                header_timeout_s=0.5)
+    threading.Thread(target=server.serve_forever,
+                     kwargs={"poll_interval": 0.05}, daemon=True).start()
+    port = server.server_address[1]
+    try:
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s.settimeout(0.05)
+        s.sendall(b"POST /score HTTP/1.1\r\nX-Trickle: ")
+        t0 = time.monotonic()
+        data = b""
+        while time.monotonic() - t0 < 3.0:
+            try:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+                continue
+            except TimeoutError:
+                pass
+            try:
+                s.sendall(b"a")              # one byte per ~50 ms
+            except OSError:
+                break
+            time.sleep(0.05)
+        elapsed = time.monotonic() - t0
+        assert b"408" in data.split(b"\r\n", 1)[0], data
+        assert elapsed < 2.0, f"trickler held the head read " \
+            f"{elapsed:.2f}s past a 0.5s deadline"
+        s.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def _evloop_conn_with_full_buffer(max_buffer=4096, payload=65536):
+    """(server, loop, conn, peer): an evloop _Conn whose outbuf sits
+    past max_buffer_bytes because the peer hasn't read yet."""
     from deepfake_detection_tpu.fleet import dataplane as dp
     registry = Registry([])
     metrics = RouterMetrics()
     server = make_router_server("127.0.0.1", 0, registry, metrics,
                                 data_plane="evloop",
-                                max_buffer_bytes=4096)
+                                max_buffer_bytes=max_buffer)
     lo = server._loops[0]
+    # align the wheel with the clock (run() normally does this)
+    lo.wheel.tick = int(time.monotonic() / lo.wheel.granularity)
     a, b = socket.socketpair()
     a.setblocking(False)
     a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 2048)
+    b.settimeout(5.0)
+    c = dp._Conn(a)
+    lo.conns.add(c)
+    lo._enqueue(c, b"x" * payload)           # peer hasn't read yet
+    assert c.out_len > max_buffer            # buffer past the bound
+    c.state = dp._Conn.RELAY
+    return server, lo, c, b
+
+
+def test_evloop_overflow_guard_sheds_stalled_reader():
+    """The bounded-buffer guard: a reader that makes NO progress with
+    the relay buffer past its bound is shed (closed + counted) when the
+    drain deadline fires — never buffered without limit."""
+    server, lo, c, b = _evloop_conn_with_full_buffer()
+    metrics = server.metrics
     try:
-        c = dp._Conn(a)
-        lo.conns.add(c)
-        lo._enqueue(c, b"x" * 65536)         # peer never reads
-        assert c.out_len > 4096              # buffer past the bound
-        c.state = dp._Conn.RELAY
         lo._finish_response(c)               # between-requests guard
+        # NOT closed on the spot: the buffer is still flushing and the
+        # peer may be draining — the guard pauses the next request
+        assert not c.closed
+        assert c.drain_wait
+        assert not (c.mask & selectors.EVENT_READ)
+        # ...but a reader with zero progress for a full idle window is
+        # genuinely stalled: the _DL_DRAIN deadline sheds it
+        lo.wheel.advance(time.monotonic() + server.idle_timeout_s + 1.0,
+                         lo._expire)
         assert c.closed
         assert metrics.overflow_closed_total.value == 1
     finally:
         b.close()
         server.server_close()
+
+
+def test_evloop_overflow_guard_spares_draining_reader():
+    """REVIEW regression: a reader that IS draining a streamed/burst
+    response past max_buffer_bytes must receive every byte — the old
+    guard closed at request completion with unsent outbuf bytes
+    discarded (silent truncation booked as success)."""
+    server, lo, c, b = _evloop_conn_with_full_buffer()
+    metrics = server.metrics
+    try:
+        lo._finish_response(c)
+        assert not c.closed and c.drain_wait
+        got = 0
+        deadline = time.monotonic() + 10.0
+        while got < 65536 and time.monotonic() < deadline:
+            got += len(b.recv(65536))        # the reader drains...
+            lo._flush(c)                     # ...and the loop flushes
+        assert got == 65536                  # every byte arrived
+        assert not c.closed
+        assert not c.drain_wait              # pause lifted on drain
+        assert metrics.overflow_closed_total.value == 0
+    finally:
+        b.close()
+        server.server_close()
+
+
+def test_timer_wheel_rearms_when_deadline_moves_earlier():
+    """REVIEW regression: after a long deadline files the wheel entry,
+    a shorter re-arm (idle 60s -> header 10s) must fire at the SHORT
+    deadline, not the stale long tick — and never fire twice."""
+    import types
+
+    from deepfake_detection_tpu.fleet import dataplane as dp
+
+    wheel = dp._TimerWheel(granularity=0.25)
+    c = types.SimpleNamespace(deadline=0.0, deadline_kind=0,
+                              wheel_filed=False, wheel_tick=0,
+                              closed=False)
+    fired = []
+    wheel.arm(c, 60.0, dp._DL_IDLE)          # long deadline files
+    wheel.arm(c, 10.0, dp._DL_HEAD)          # then moves EARLIER
+    wheel.advance(11.0, lambda conn, kind: fired.append(kind))
+    assert fired == [dp._DL_HEAD]            # fired at ~10s, not ~60s
+    wheel.advance(61.0, lambda conn, kind: fired.append(kind))
+    assert fired == [dp._DL_HEAD]            # stale entry never re-fires
+    # the conn is re-armable after the stale entry is consumed
+    wheel.arm(c, 120.0, dp._DL_IDLE)
+    wheel.advance(121.0, lambda conn, kind: fired.append(kind))
+    assert fired == [dp._DL_HEAD, dp._DL_IDLE]
+
+
+def test_inflight_not_leaked_when_client_dies_mid_relay(fleet):
+    """REVIEW regression: a client that resets its connection while the
+    upstream attempt is in flight must not leave Replica.router_inflight
+    inflated — a leak there skews least-depth stateless routing away
+    from the replica for the router's lifetime."""
+    for s in fleet.stubs:
+        s.state.mode = "slow"
+    before = fleet.metrics.books()["routed"]
+    c = _RawClient(fleet.port)
+    c.sock.sendall(b"POST /score HTTP/1.1\r\nHost: t\r\n"
+                   b"Content-Length: 1\r\n\r\nx")
+    time.sleep(0.15)               # let the router attach the upstream
+    # RST, not FIN: the router must see a hard error mid-relay (a FIN
+    # takes the orderly client_gone path instead)
+    c.sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                      struct.pack("ii", 1, 0))
+    c.close()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        b = fleet.metrics.books()
+        if (b["routed"] == before + 1
+                and b["routed"] == b["forwarded"] + b["migrated"]
+                + b["shed"] + b["failed"]
+                and all(r.router_inflight == 0
+                        for r in fleet.registry.view())):
+            break
+        time.sleep(0.05)
+    assert all(r.router_inflight == 0 for r in fleet.registry.view()), \
+        [(r.id, r.router_inflight) for r in fleet.registry.view()]
+    _assert_books(fleet.metrics)
+    for s in fleet.stubs:
+        s.state.mode = "ok"
+
+
+def test_evloop_streamed_response_complete_to_slow_reader():
+    """REVIEW regression: a streamed (> max_buffer_bytes) response to a
+    reader that drains slowly must arrive COMPLETE, and the keep-alive
+    connection must survive — the old overflow guard closed at request
+    completion with unsent outbuf bytes discarded (silent truncation
+    booked as forwarded/200)."""
+    stub = _stub_replica()
+    stub.state.mode = "big"
+    netloc = f"127.0.0.1:{stub.server_address[1]}"
+    registry = Registry([netloc])
+    r = registry.get(netloc)
+    r.healthy = r.ready = True               # no scraper needed
+    metrics = RouterMetrics()
+    server = make_router_server("127.0.0.1", 0, registry, metrics,
+                                data_plane="evloop",
+                                max_buffer_bytes=4096)
+    threading.Thread(target=server.serve_forever,
+                     kwargs={"poll_interval": 0.05}, daemon=True).start()
+    port = server.server_address[1]
+    s = socket.socket()
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        s.settimeout(10)
+        s.connect(("127.0.0.1", port))
+        rf = s.makefile("rb")
+        s.sendall(b"POST /score HTTP/1.1\r\nHost: t\r\n"
+                  b"Content-Length: 1\r\n\r\nx")
+        status = int(rf.readline().split()[1])
+        assert status == 200
+        hdrs = {}
+        while True:
+            h = rf.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.partition(b":")
+            hdrs[k.strip().lower()] = v.strip()
+        need = int(hdrs[b"content-length"])
+        assert need > 4096                   # actually streamed
+        body = b""
+        while len(body) < need:
+            chunk = rf.read(min(8192, need - len(body)))
+            if not chunk:
+                break
+            body += chunk
+            time.sleep(0.02)                 # slow, but draining
+        assert len(body) == need, \
+            f"truncated: {len(body)}/{need} bytes delivered"
+        assert json.loads(body)["pad"] == "x" * 65536
+        # the connection survived the overflow pause: next request OK
+        # (small response this time, so its book resolves on enqueue
+        # and the final books assertion can't race the relay)
+        stub.state.mode = "ok"
+        s.sendall(b"POST /score HTTP/1.1\r\nHost: t\r\n"
+                  b"Content-Length: 1\r\n\r\nx")
+        assert int(rf.readline().split()[1]) == 200
+        assert metrics.overflow_closed_total.value == 0
+        _assert_books(metrics)
+    finally:
+        s.close()
+        server.shutdown()
+        server.server_close()
+        stub.shutdown()
+        stub.server_close()

@@ -310,6 +310,15 @@ def _old_router_render(self) -> str:
     counter("retries_total", "Failover attempts past the first "
             "replica (upstream shed, backoff or transport error)",
             self.retries_total.value)
+    counter("idle_closed_total", "Connections closed on a header-read "
+            "or idle deadline (slowloris/idle hardening, both data "
+            "planes)", self.idle_closed_total.value)
+    counter("overflow_closed_total", "Connections closed because a "
+            "stalled peer let the bounded relay buffer fill",
+            self.overflow_closed_total.value)
+    counter("upstream_pool_closed_total", "Pooled upstream sockets "
+            "closed because their replica retired or went down",
+            self.upstream_pool_closed_total.value)
     counter("scrape_errors_total", "Replica health-scrape failures",
             self.scrape_errors_total.value)
     counter("replicas_down_total", "Replica healthy->down "
