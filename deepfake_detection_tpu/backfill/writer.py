@@ -20,8 +20,12 @@ re-leased *shard* is the unit of recovery; the surviving records within
 it are kept, not re-scored.
 
 :func:`collect_books` is the auditor both the runner's exit path and
-the chaos harness call: ``manifest clips == scored + failed``, with
-duplicates and missing clips named, never summarized away.
+the chaos harness call: ``manifest clips == scored + failed +
+skipped_dup``, with duplicates and missing clips named, never
+summarized away.  ``skipped_dup`` records (the ``--dedup`` pass:
+clips whose canonical pixel content already occurs earlier in the
+manifest) carry ``dup_of`` naming the canonical clip — a skip is a
+booked decision, never a silently absent row.
 
 jax-free (DFD001): the chaos harness audits books with no accelerator
 stack.
@@ -70,6 +74,7 @@ class ShardVerdictWriter:
         self.scored_keys: Set[Key] = set()
         self.records = 0
         self.failed = 0
+        self.skipped = 0          # skipped_dup records (--dedup pass)
         # ONE pass over the surviving bytes indexes the records AND
         # seeds the incremental content hash, so finalize() never
         # re-reads the stream — shard opens are a measurable cost under
@@ -90,7 +95,9 @@ class ShardVerdictWriter:
                         continue
                     self.scored_keys.add(clip_key(rec))
                     self.records += 1
-                    if not rec.get("ok"):
+                    if rec.get("skipped_dup"):
+                        self.skipped += 1
+                    elif not rec.get("ok"):
                         self.failed += 1
         except OSError:
             pass
@@ -110,7 +117,9 @@ class ShardVerdictWriter:
     def _book(self, rec: Dict[str, Any]) -> None:
         self.scored_keys.add(clip_key(rec))
         self.records += 1
-        if not rec["ok"]:
+        if rec.get("skipped_dup"):
+            self.skipped += 1
+        elif not rec["ok"]:
             self.failed += 1
 
     def append(self, kind: str, root: int, clip: str, label: int,
@@ -143,14 +152,40 @@ class ShardVerdictWriter:
         for rec in recs:
             self._book(rec)
 
+    def append_dups(self, rows) -> None:
+        """Book a batch of duplicate clips without scoring them.
+        ``rows``: ``(kind, root, clip, label, dup_of)`` tuples, where
+        ``dup_of`` names the canonical clip (``kind/root/clip``) whose
+        identical pixel content occurs earlier in the manifest.  The
+        record carries ``skipped_dup: true`` + ``dup_of`` so the books
+        auditor can bucket it apart from scored AND from failed —
+        a dedup skip is a decision, not damage."""
+        recs = []
+        for kind, root, clip, label, dup_of in rows:
+            rec = self._record(kind, root, clip, label, None, "")
+            rec["skipped_dup"] = True
+            rec["dup_of"] = dup_of
+            recs.append(rec)
+        if not recs:
+            return
+        text = "".join(
+            json.dumps(r, separators=(",", ":"), allow_nan=False) + "\n"
+            for r in recs)
+        self._f.write(text)
+        self._f.flush()
+        self._sha.update(text.encode())
+        for rec in recs:
+            self._book(rec)
+
     def finalize(self) -> Dict[str, Any]:
         """fsync the stream and return the shard's book entry (what the
         done marker records): counts + content hash of the JSONL."""
         self._f.flush()
         os.fsync(self._f.fileno())
         return {"clips": self.records,
-                "scored": self.records - self.failed,
-                "failed": self.failed, "sha256": self._sha.hexdigest()}
+                "scored": self.records - self.failed - self.skipped,
+                "failed": self.failed, "skipped_dup": self.skipped,
+                "sha256": self._sha.hexdigest()}
 
     def tear(self) -> None:
         """Chaos seam (``backfill_torn_shard``): leave exactly the damage
@@ -203,7 +238,12 @@ def collect_books(run_dir: str, manifest: Dict[str, Any]
     Walks every manifest shard's JSONL and checks the one identity the
     whole subsystem exists to uphold::
 
-        manifest clips == scored + failed,  each clip exactly once
+        manifest clips == scored + failed + skipped_dup,
+        each clip exactly once
+
+    (``skipped_dup`` is zero unless the run used ``--dedup``: a clip
+    whose canonical pixel content duplicates an earlier manifest clip
+    books a skip record instead of a score — still exactly one row.)
 
     Returns counts plus the *named* discrepancies (missing /
     duplicated / alien clips) and ``balanced`` — True iff every shard
@@ -215,7 +255,7 @@ def collect_books(run_dir: str, manifest: Dict[str, Any]
         for kind, ri, name, _num in s["clips"]:
             expected.add((kind, int(ri), name))
     seen: Dict[Key, int] = {}
-    scored = failed = 0
+    scored = failed = skipped = 0
     shards_done = 0
     for s in manifest["shards"]:
         if os.path.isfile(os.path.join(run_dir, _DONE,
@@ -224,7 +264,9 @@ def collect_books(run_dir: str, manifest: Dict[str, Any]
         for rec in read_verdicts(verdict_path(run_dir, s["id"])):
             key = clip_key(rec)
             seen[key] = seen.get(key, 0) + 1
-            if rec.get("ok"):
+            if rec.get("skipped_dup"):
+                skipped += 1
+            elif rec.get("ok"):
                 scored += 1
             else:
                 failed += 1
@@ -233,9 +275,11 @@ def collect_books(run_dir: str, manifest: Dict[str, Any]
     dup = sorted("/".join(map(str, k)) for k, n in seen.items() if n > 1)
     complete = shards_done == len(manifest["shards"])
     balanced = (complete and not missing and not alien and not dup
-                and scored + failed == int(manifest["num_clips"]))
+                and scored + failed + skipped ==
+                int(manifest["num_clips"]))
     return {"manifest_clips": int(manifest["num_clips"]),
             "scored": scored, "failed": failed,
+            "skipped_dup": skipped,
             "shards_done": shards_done,
             "shards_total": len(manifest["shards"]),
             "missing": missing, "duplicated": dup, "alien": alien,

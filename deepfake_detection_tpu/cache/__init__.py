@@ -1,0 +1,38 @@
+"""Content-addressed verdict cache (ISSUE 17): the dedup tier.
+
+At production scale the request distribution is Zipfian — the same
+re-shared clip arrives over and over, and without this tier every copy
+pays a full forward pass.  This package is the jax-free core shared by
+all three consumers:
+
+* the serving batcher's pre-dispatch probe (hit resolves a request
+  without it ever entering a bucket; miss populates on score),
+* the fleet router's optional edge probe (both data planes), and
+* the backfill dedup pass over pack shards.
+
+Keying is ``(content_hash, model_id, checkpoint_fingerprint)`` — the
+content hash is taken over the *canonical uint8 canvas* (after
+``params.prepare_canvas``) so byte-identical re-uploads at any
+container/encoding collide, and the fingerprint is the engine's weight
+identity so a hot reload or quantized swap can never serve a stale
+verdict: the reload commit bumps the fingerprint atomically and old
+entries are orphaned by construction.
+
+jax-free by decree (``lint/manifest.py:JAX_FREE_MODULES``): the router
+process and backfill book audits import this with no accelerator stack.
+"""
+
+from .content import (ahash64, clip_phash, content_hash, dhash64,
+                      hamming64, tree_fingerprint)
+from .store import SingleFlight, VerdictCache
+
+__all__ = [
+    "VerdictCache",
+    "SingleFlight",
+    "content_hash",
+    "clip_phash",
+    "dhash64",
+    "ahash64",
+    "hamming64",
+    "tree_fingerprint",
+]

@@ -630,6 +630,20 @@ class ServeConfig:
     # synchronizes every shed client into one thundering-herd resend)
     retry_jitter_s: float = 2.0
 
+    # --- verdict cache (cache/, ISSUE 17) ---
+    # bounded LRU+TTL dedup tier keyed (content_hash, model_id,
+    # checkpoint_fingerprint): a repeat of an already-scored clip resolves
+    # without entering a bucket, concurrent copies of one clip coalesce
+    # into ONE dispatch.  0 entries disables the tier entirely
+    cache_entries: int = 0
+    cache_ttl_s: float = 300.0
+    # opt-in near-dup perceptual index (dHash/aHash over the downsampled
+    # canvas, Hamming-radius probe): a near hit serves a DIFFERENT clip's
+    # verdict by construction — its own knob, its own hit counter, never
+    # conflated with exact hits
+    cache_near_dup: bool = False
+    cache_near_radius: int = 3
+
     # --- observability ---
     throughput_window_s: float = 30.0
 
@@ -667,6 +681,15 @@ class ServeConfig:
             raise ValueError("--breaker-threshold must be >= 0 (0 = off)")
         if self.breaker_open_s <= 0:
             raise ValueError("--breaker-open-s must be > 0")
+        if int(self.cache_entries) < 0:
+            raise ValueError(f"--cache-entries must be >= 0 (0 = off), "
+                             f"got {self.cache_entries}")
+        if float(self.cache_ttl_s) <= 0:
+            raise ValueError(f"--cache-ttl-s must be > 0, got "
+                             f"{self.cache_ttl_s}")
+        if not 0 <= int(self.cache_near_radius) <= 8:
+            raise ValueError(f"--cache-near-radius must be in [0, 8], "
+                             f"got {self.cache_near_radius}")
         self.dtype = _canon_quant_dtype(self.dtype, "--dtype")
         specs = self.model_specs()          # validates the grammar
         ids = [s["id"] for s in specs]
@@ -786,6 +809,14 @@ class BackfillConfig:
     max_shards: int = 0                  # stop this worker after N
     # shards (0 = run to corpus completion; smoke/test hook)
 
+    # --- dedup (cache/, ISSUE 17) ---
+    # content-hash dedup pass over pack shards: clips whose canonical
+    # pixel bytes already occur earlier in the manifest skip the device
+    # and book a skipped_dup verdict row pointing at the canonical clip
+    # (books: manifest == scored + failed + skipped_dup).  Packed source
+    # only — the hash reads the mmap slabs without decoding
+    dedup: bool = False
+
     # ------------------------------------------------------------------
     def __post_init__(self):
         # required-field checks live in validate_required(): the two-stage
@@ -815,6 +846,9 @@ class BackfillConfig:
         if bool(self.data_packed) == bool(self.data):
             raise ValueError("exactly one of --data-packed / --data "
                              "must be given (the clip source)")
+        if self.dedup and not self.data_packed:
+            raise ValueError("--dedup needs --data-packed (the dedup "
+                             "index hashes pack slabs without decoding)")
         return self
 
     # ------------------------------------------------------------------
@@ -907,6 +941,15 @@ class RouterConfig:
     drain_on_exit: bool = False          # drain spawned replicas' streams
     # before terminating them on shutdown
 
+    # --- edge verdict cache (cache/, ISSUE 17) ---
+    # optional response cache for POST /score at the routing tier, keyed
+    # by raw body digest + the fleet weights-epoch (the set of per-model
+    # checkpoint fingerprints scraped off every replica's /readyz): a
+    # mixed-fingerprint rollout changes the epoch and bypasses the cache
+    # until the fleet converges.  0 entries disables the edge probe
+    edge_cache_entries: int = 0
+    edge_cache_ttl_s: float = 2.0
+
     # ------------------------------------------------------------------
     def __post_init__(self):
         if self.spawn_runner not in ("serve", "stream"):
@@ -932,6 +975,12 @@ class RouterConfig:
         if int(self.max_buffer_bytes) < 4096:
             raise ValueError(f"--max-buffer-bytes must be >= 4096, got "
                              f"{self.max_buffer_bytes}")
+        if int(self.edge_cache_entries) < 0:
+            raise ValueError(f"--edge-cache-entries must be >= 0 "
+                             f"(0 = off), got {self.edge_cache_entries}")
+        if float(self.edge_cache_ttl_s) <= 0:
+            raise ValueError(f"--edge-cache-ttl-s must be > 0, got "
+                             f"{self.edge_cache_ttl_s}")
         for name in ("scrape_interval_s", "scrape_timeout_s",
                      "upstream_timeout_s", "migrate_timeout_s",
                      "shed_retry_after_s", "idle_timeout_s",

@@ -29,8 +29,10 @@ SO_REUSEPORT):
 Behavior contract: identical to the threads plane.  Same RouterConfig,
 same consistent-hash affinity, same shed-aware failover honoring
 upstream Retry-After, same drain/migration overrides, same books
-(``routed == forwarded + migrated + shed + failed``, exactly one
-resolution per routed request), and the same control-plane documents —
+(``routed == cache_hit + forwarded + migrated + shed + failed``,
+exactly one resolution per routed request), the same optional edge
+verdict cache (``EdgeCache``, shared class), and the same
+control-plane documents —
 shared verbatim via ``fleet/router.py``'s module-level helpers, so the
 aggregate ``/metrics`` re-export and ``/readyz`` JSON are byte-identical
 across planes by construction.  tests/test_fleet.py runs parametrized
@@ -64,8 +66,9 @@ from .controller import HealthScraper
 from .metrics import RouterMetrics
 from .registry import Registry, Replica
 from .router import (FORWARD_HEADER_EXCLUDES, _MAX_BODY, _REPLICA_PATH,
-                     _STREAM_PATH, aggregate_metrics_text, ensure_stream_id,
-                     merged_streams, readyz_document, replica_operation)
+                     _STREAM_PATH, EdgeCache, aggregate_metrics_text,
+                     ensure_stream_id, merged_streams, readyz_document,
+                     replica_operation)
 
 _logger = logging.getLogger(__name__)
 
@@ -227,7 +230,7 @@ class _Conn:
                  # routing state
                  "kind", "sid", "creating", "tried", "attempts",
                  "saw_transport", "saw_shed", "resent", "replica",
-                 "via_override", "u",
+                 "via_override", "u", "cache_key",
                  # response splice state
                  "resp_status", "resp_need", "resp_head_len",
                  "resp_streaming", "resp_sent_any", "resp_close",
@@ -283,6 +286,8 @@ class _Conn:
         self.replica: Optional[Replica] = None
         self.via_override = False
         self.u: Optional[_Upstream] = None
+        self.cache_key: Optional[str] = None   # edge-cache probe digest
+        # (set only on a /score miss: the 200 relay populates under it)
         self.resp_status = 0
         self.resp_need = 0            # response body bytes still owed
         self.resp_head_len = 0        # head+CRLFCRLF bytes of the resp
@@ -799,6 +804,25 @@ class _Loop:
         c.state = _Conn.RELAY
         if path == "/score":
             c.kind = "score"
+            cache = self.server.edge_cache
+            if cache is not None:
+                ct = b""
+                for hl in c.head_lines:
+                    if hl[:13].lower() == b"content-type:":
+                        ct = hl[13:].strip()
+                        break
+                c.cache_key = EdgeCache.request_key(
+                    method, c.target, ct.decode("latin-1"), body)
+                hit = cache.get(c.cache_key)
+                if hit is not None:
+                    # edge verdict-cache resolution: one book, no
+                    # replica touched (parity with the threads plane)
+                    self.metrics.cache_hit_total.inc()
+                    c.book_resolved = True
+                    self._respond(c, hit[0], hit[2], hit[1])
+                    if c.closed:
+                        return
+                    return self._resolve(c)
             self._next_attempt(c)
         else:
             c.kind = "stream"
@@ -1184,6 +1208,15 @@ class _Loop:
         c.book_resolved = True
         self.metrics.count_forward(u.rid)
         self.metrics.count_request(status)
+        if c.kind == "score" and c.cache_key is not None and status == 200:
+            # populate the edge cache with the buffered body (streamed
+            # responses never reach here — _relay_complete skips)
+            head = raw[:c.resp_head_len]
+            ct = _hval(head.lower(), head, b"content-type")
+            self.server.edge_cache.put(
+                c.cache_key, status,
+                (ct or b"application/json").decode("latin-1"),
+                raw[c.resp_head_len:])
         self._pool_release(c, u)
         self._enqueue(c, raw)
         if c.closed:
@@ -1309,7 +1342,9 @@ class EvLoopRouterServer:
                  migrate_timeout_s: float = 30.0,
                  idle_timeout_s: float = 60.0,
                  header_timeout_s: float = 10.0,
-                 max_buffer_bytes: int = 1 << 20):
+                 max_buffer_bytes: int = 1 << 20,
+                 edge_cache_entries: int = 0,
+                 edge_cache_ttl_s: float = 2.0):
         self.registry = registry
         self.metrics = metrics
         self.scraper = scraper
@@ -1321,6 +1356,12 @@ class EvLoopRouterServer:
         self.idle_timeout_s = float(idle_timeout_s)
         self.header_timeout_s = float(header_timeout_s)
         self.max_buffer_bytes = int(max_buffer_bytes)
+        # optional edge verdict cache (ISSUE 17), shared across loops
+        # (VerdictCache is internally locked): 0 entries = off
+        self.edge_cache = (
+            EdgeCache(registry, edge_cache_entries, edge_cache_ttl_s,
+                      max_value_bytes=self.max_buffer_bytes)
+            if int(edge_cache_entries) > 0 else None)
         self.relay_workers = max(1, int(relay_workers))
         # same seeded-rng shed jitter as the threads plane (DFD003;
         # pinned by the seeded-spread test run against both planes)

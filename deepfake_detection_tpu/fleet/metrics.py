@@ -13,14 +13,16 @@ router books on top, each replica's ``dfd_serving_*`` /
 Router request books — the fleet-level mirror of the serving ledger,
 asserted exactly by tools/bench_serve.py and tools/chaos_serve.py::
 
-    routed == forwarded + migrated + shed + failed
+    routed == cache_hit + forwarded + migrated + shed + failed
 
-Every proxied request resolves exactly once: ``forwarded`` (a replica
-answered and its response was relayed), ``migrated`` (answered by a
-migration-override target — the stream was moved off a drained
-replica), ``shed`` (no eligible replica, or every failover attempt shed:
-router-level 503 with a jittered ``Retry-After``), or ``failed``
-(transport errors exhausted the failover budget: 502).
+Every proxied request resolves exactly once: ``cache_hit`` (the edge
+verdict cache answered without touching a replica, ISSUE 17),
+``forwarded`` (a replica answered and its response was relayed),
+``migrated`` (answered by a migration-override target — the stream was
+moved off a drained replica), ``shed`` (no eligible replica, or every
+failover attempt shed: router-level 503 with a jittered
+``Retry-After``), or ``failed`` (transport errors exhausted the
+failover budget: 502).
 """
 
 from __future__ import annotations
@@ -43,7 +45,7 @@ _BOUNDS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
 STAGES = ("upstream", "total")
 
 #: request-book resolution kinds (routed == sum of these, exactly)
-BOOK_KINDS = ("forwarded", "migrated", "shed", "failed")
+BOOK_KINDS = ("cache_hit", "forwarded", "migrated", "shed", "failed")
 
 
 class RouterMetrics:
@@ -54,10 +56,13 @@ class RouterMetrics:
             s: LatencyHistogram(_BOUNDS) for s in STAGES}
         self.requests_total: Dict[str, _Counter] = {}   # by HTTP status
         self._requests_lock = threading.Lock()
-        # fleet request books: routed == forwarded + migrated + shed +
-        # failed holds EXACTLY (chaos_serve asserts it after every
-        # replica-kill scenario; bench_serve after every load phase)
+        # fleet request books: routed == cache_hit + forwarded +
+        # migrated + shed + failed holds EXACTLY (chaos_serve asserts it
+        # after every replica-kill scenario; bench_serve after every
+        # load phase)
         self.routed_total = _Counter()
+        self.cache_hit_total = _Counter()        # edge verdict-cache
+        # answers (ISSUE 17): resolved at the router, no replica touched
         self.forwarded_total = _Counter()
         self.migrated_total = _Counter()
         self.shed_total = _Counter()
@@ -113,6 +118,7 @@ class RouterMetrics:
 
     def books(self) -> Dict[str, int]:
         return {"routed": self.routed_total.value,
+                "cache_hit": self.cache_hit_total.value,
                 "forwarded": self.forwarded_total.value,
                 "migrated": self.migrated_total.value,
                 "shed": self.shed_total.value,
@@ -131,8 +137,11 @@ class RouterMetrics:
         for status, value in items:
             doc.sample("requests_total", f'{{status="{status}"}}', value)
         counter("routed_total", "Requests entering the routing path "
-                "(books: routed == forwarded + migrated + shed + failed)",
-                self.routed_total.value)
+                "(books: routed == cache_hit + forwarded + migrated "
+                "+ shed + failed)", self.routed_total.value)
+        counter("cache_hit_total", "Requests resolved by the edge "
+                "verdict cache (keyed on the fleet weights-epoch; no "
+                "replica touched)", self.cache_hit_total.value)
         counter("forwarded_total", "Requests resolved by a replica "
                 "response relayed to the client",
                 self.forwarded_total.value)

@@ -37,11 +37,17 @@ Routing:
 
 Books (asserted exactly by bench_serve + chaos_serve)::
 
-    routed == forwarded + migrated + shed + failed
+    routed == cache_hit + forwarded + migrated + shed + failed
+
+``cache_hit`` is the optional **edge verdict cache** (ISSUE 17): whole
+``POST /score`` responses keyed on the exact request bytes under the
+fleet *weights-epoch* (:class:`EdgeCache`), resolved at the router
+without touching a replica.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import random
@@ -53,6 +59,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Set, Tuple
 
+from ..cache import VerdictCache
 from ..serving.resilience import jittered_retry_after
 from .controller import HealthScraper, http_request
 from .metrics import RouterMetrics, relabel_exposition
@@ -61,7 +68,7 @@ from .registry import Registry, Replica
 
 _logger = logging.getLogger(__name__)
 
-__all__ = ["RouterServer", "make_router_server",
+__all__ = ["RouterServer", "make_router_server", "EdgeCache",
            "FORWARD_HEADER_EXCLUDES", "readyz_document",
            "aggregate_metrics_text", "merged_streams",
            "replica_operation", "ensure_stream_id"]
@@ -169,6 +176,112 @@ def ensure_stream_id(body: bytes) -> Tuple[Optional[str], bytes]:
     return str(sid), body
 
 
+class EdgeCache:
+    """Router-edge verdict cache (ISSUE 17): whole ``POST /score``
+    responses keyed on the exact request bytes, shared by BOTH data
+    planes.
+
+    The store is the jax-free :class:`~..cache.VerdictCache` under a
+    synthetic model id ``"edge"`` whose *fingerprint* is the **fleet
+    weights-epoch**: a digest of every ready replica's
+    ``{model: checkpoint-fingerprint}`` map from its scraped ``/readyz``
+    detail.  Any hot reload or quantized swap anywhere in the fleet
+    moves a replica fingerprint, therefore the epoch, therefore the
+    addressable key space — old entries are orphaned (and eagerly
+    cleared) rather than invalidated one by one, the same story as the
+    in-replica cache.
+
+    Two honesty rules:
+
+    * while ANY ready replica's readiness detail lacks model
+      fingerprints (scrape not landed yet, mixed versions mid-rollout)
+      the epoch is ``None`` and the cache **bypasses** — correctness
+      never leans on scrape freshness;
+    * the epoch only moves when a scrape lands, so an edge hit can be
+      stale by at most ``min(ttl, scrape interval)`` after a reload —
+      which is why the edge TTL defaults to seconds where the
+      in-replica cache (exact by construction) defaults to minutes.
+    """
+
+    __slots__ = ("store", "registry", "max_value_bytes", "_epoch",
+                 "_epoch_sig")
+
+    def __init__(self, registry: Registry, entries: int, ttl_s: float,
+                 *, max_value_bytes: int = 1 << 20):
+        self.store = VerdictCache(int(entries), float(ttl_s))
+        self.registry = registry
+        # streamed / oversize responses are relayed, never buffered for
+        # the cache: the router's memory bound stays the relay bound
+        self.max_value_bytes = int(max_value_bytes)
+        # epoch memo keyed on the identity of every replica's last
+        # readiness doc (the scraper replaces the dict wholesale, so
+        # ``id()`` moves iff a new scrape landed).  Unsynchronized by
+        # design: the worst data race costs one redundant recompute.
+        self._epoch: Optional[str] = None
+        self._epoch_sig: Optional[tuple] = None
+
+    @staticmethod
+    def request_key(method: str, target: str, content_type: str,
+                    body: bytes) -> str:
+        """Exact byte identity of one request: method + target
+        (query included) + content type + raw body."""
+        h = hashlib.sha256()
+        h.update(method.encode("latin-1", "replace"))
+        h.update(b"\0")
+        h.update(target.encode("latin-1", "replace"))
+        h.update(b"\0")
+        h.update((content_type or "").encode("latin-1", "replace"))
+        h.update(b"\0")
+        h.update(body)
+        return h.hexdigest()
+
+    def epoch(self) -> Optional[str]:
+        view = self.registry.view()
+        sig = tuple((r.id, id(r.readiness)) for r in view)
+        if sig == self._epoch_sig:
+            return self._epoch
+        pairs: Optional[Set[str]] = set()
+        for r in view:
+            if not (r.healthy and r.ready):
+                continue
+            models = (r.readiness or {}).get("models")
+            if not isinstance(models, dict) or not models:
+                pairs = None
+                break
+            for mid, det in models.items():
+                fp = det.get("fingerprint") \
+                    if isinstance(det, dict) else None
+                if not fp:
+                    pairs = None
+                    break
+                pairs.add(f"{mid}={fp}")
+            if pairs is None:
+                break
+        epoch = (hashlib.sha256("\n".join(sorted(pairs)).encode())
+                 .hexdigest() if pairs else None)
+        if self._epoch is not None and epoch != self._epoch:
+            # the epoch moved (reload / membership change): every held
+            # entry is unaddressable — reclaim eagerly
+            self.store.clear()
+        self._epoch_sig, self._epoch = sig, epoch
+        return epoch
+
+    def get(self, key: str):
+        """(status, content_type, body) | None."""
+        ep = self.epoch()
+        if ep is None:
+            return None
+        return self.store.get(key, "edge", ep)
+
+    def put(self, key: str, status: int, content_type: str,
+            body: bytes) -> None:
+        ep = self.epoch()
+        if ep is None or len(body) > self.max_value_bytes:
+            return
+        self.store.put(key, "edge", ep,
+                       (int(status), content_type, body))
+
+
 #: per-thread upstream connection pool ({replica_id: _UpstreamConn}).
 #: ThreadingHTTPServer runs one thread per client connection and clients
 #: keep-alive, so the pool amortizes the upstream TCP handshake to zero
@@ -257,7 +370,9 @@ class RouterServer(ThreadingHTTPServer):
                  migrate_timeout_s: float = 30.0,
                  idle_timeout_s: float = 60.0,
                  header_timeout_s: float = 10.0,
-                 max_buffer_bytes: int = 1 << 20):
+                 max_buffer_bytes: int = 1 << 20,
+                 edge_cache_entries: int = 0,
+                 edge_cache_ttl_s: float = 2.0):
         super().__init__(addr, _RouterHandler)
         self.registry = registry
         self.metrics = metrics
@@ -276,6 +391,11 @@ class RouterServer(ThreadingHTTPServer):
         # buffers, but both planes accept the knob so RouterConfig can
         # drive either through one kwargs dict
         self.max_buffer_bytes = int(max_buffer_bytes)
+        # optional edge verdict cache (ISSUE 17): 0 entries = off
+        self.edge_cache = (
+            EdgeCache(registry, edge_cache_entries, edge_cache_ttl_s,
+                      max_value_bytes=self.max_buffer_bytes)
+            if int(edge_cache_entries) > 0 else None)
         # seeded: deterministic under test, de-correlated in production
         # (per-process stream; DFD003 discipline)
         self._shed_rng = random.Random(0x0F1EE7)
@@ -582,7 +702,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
         srv.metrics.routed_total.inc()
         try:
             if path == "/score":
-                self._route_stateless(method, target, body)
+                cache, cache_key = srv.edge_cache, None
+                if cache is not None:
+                    cache_key = EdgeCache.request_key(
+                        method, target,
+                        self.headers.get("content-type", ""), body)
+                    hit = cache.get(cache_key)
+                    if hit is not None:
+                        # edge verdict-cache resolution: one book, no
+                        # replica touched
+                        srv.metrics.cache_hit_total.inc()
+                        self._relay(hit[0], {"content-type": hit[1]},
+                                    hit[2])
+                        return
+                self._route_stateless(method, target, body,
+                                      cache_key=cache_key)
             else:
                 self._route_stream(method, path, target, body,
                                    create_sid=sid)
@@ -693,12 +827,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
         except (TypeError, ValueError):
             return default
 
-    def _route_stateless(self, method: str, target: str,
-                         body: bytes) -> None:
+    def _route_stateless(self, method: str, target: str, body: bytes,
+                         cache_key: Optional[str] = None) -> None:
         """Least-depth routing with shed-aware failover: an upstream
         429/503 backs the replica off for its Retry-After and the
         request moves on; transport errors likewise.  Exactly one book
-        resolution on every path out."""
+        resolution on every path out.  A 200 relay populates the edge
+        cache when the probe missed (``cache_key`` carries the probe's
+        request digest)."""
         srv = self.server
         tried: Set[str] = set()
         saw_transport_error = False
@@ -725,6 +861,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 continue
             srv.metrics.forwarded_total.inc()
             srv.metrics.count_forward(r.id)
+            if cache_key is not None and status == 200:
+                srv.edge_cache.put(
+                    cache_key, status,
+                    hdrs.get("content-type", "application/json"), rbody)
             self._relay(status, hdrs, rbody)
             return
         if saw_transport_error and not saw_shed:

@@ -64,6 +64,12 @@ JAX_FREE_MODULES = (
     # pay — or wait on — an accelerator import; replicas are separate
     # processes that do.  utils.prometheus is the jax-free observability
     # floor these share (utils/__init__ is PEP-562 lazy for exactly this)
+    # the verdict-cache core (ISSUE 17): numpy+hashlib only, shared by
+    # the router edge probe and the backfill dedup pass — both run in
+    # processes that never import jax
+    "deepfake_detection_tpu.cache",
+    "deepfake_detection_tpu.cache.content",
+    "deepfake_detection_tpu.cache.store",
     "deepfake_detection_tpu.fleet",
     "deepfake_detection_tpu.fleet.registry",
     "deepfake_detection_tpu.fleet.metrics",

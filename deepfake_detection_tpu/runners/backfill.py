@@ -25,9 +25,17 @@ SIGTERM exits 75 at a batch boundary (the train/resilience.py restart
 contract) and a relaunch resumes at shard granularity; a dead host's
 lease expires by mtime and its partially written shard is re-leased,
 torn tail repaired, surviving records kept.  At corpus completion the
-books must balance EXACTLY: ``manifest clips == scored + failed``, no
-clip twice, none missing — imbalance is exit 1 with the discrepancies
-named, never a summary that rounds them away.
+books must balance EXACTLY: ``manifest clips == scored + failed +
+skipped_dup``, no clip twice, none missing — imbalance is exit 1 with
+the discrepancies named, never a summary that rounds them away.
+
+``--dedup`` (packed source only) runs a content-hash pass over the pack
+slabs before scoring: a clip whose canonical pixel bytes already occur
+earlier in the manifest never enters a device batch — it books a
+``skipped_dup`` verdict row pointing at the canonical clip (the same
+content addressing the serving verdict cache uses, ``cache/content``).
+Archival corpora are full of re-encoded reposts; paying inference per
+COPY instead of per CONTENT is the whole point of the cache tier.
 
 Usage::
 
@@ -164,6 +172,40 @@ class _Pipeline:
             self._mean, self._std)
 
 
+def _build_dup_map(source, manifest) -> Dict[Tuple[str, int, str], str]:
+    """Content-hash pass over the pack: manifest-order duplicate index.
+
+    Hashes every clip's canonical uint8 bytes (``cache/content``'s exact
+    addressing — the serving verdict cache's key) straight off the mmap
+    slabs, no decode.  The FIRST manifest occurrence of each content
+    hash is canonical; every later occurrence maps to its
+    ``kind/root/clip`` string.  Manifest order is deterministic, so N
+    workers build the identical map independently — no coordination
+    file, no races, and a killed+resumed run books the same skips.
+
+    A clip that fails to load is simply absent from the index (it will
+    be booked ``ok=false`` by the score path like any damaged clip).
+    """
+    from ..backfill import manifest_entries
+    from ..cache.content import content_hash
+
+    first: Dict[str, Tuple[str, int, str]] = {}
+    dup_of: Dict[Tuple[str, int, str], str] = {}
+    for entry in manifest_entries(manifest):
+        kind, ri, name, _num = entry
+        try:
+            h = content_hash([source.load(entry)])
+        except Exception:                          # noqa: BLE001
+            continue
+        key = (kind, int(ri), name)
+        canon = first.get(h)
+        if canon is None:
+            first[h] = key
+        else:
+            dup_of[key] = "/".join(map(str, canon))
+    return dup_of
+
+
 def run_backfill(cfg, stop: Optional[threading.Event] = None
                  ) -> Dict[str, Any]:
     """One worker's pass over the manifest; returns the run summary
@@ -201,6 +243,14 @@ def run_backfill(cfg, stop: Optional[threading.Event] = None
         source = TreeSource(cfg.data, frames_per_clip=cfg.frames,
                             image_size=cfg.image_size)
         frames = source.frames_per_clip
+    dup_of: Dict[Tuple[str, int, str], str] = {}
+    if cfg.dedup:
+        t_h = time.monotonic()
+        dup_of = _build_dup_map(source, manifest)
+        _logger.info(
+            "dedup index: hashed %d clips in %.1fs — %d duplicate(s) "
+            "will skip the device and book skipped_dup",
+            manifest["num_clips"], time.monotonic() - t_h, len(dup_of))
     run_dir = cfg.out
     os.makedirs(run_dir, exist_ok=True)
     owner = cfg.worker_name or f"{socket.gethostname()}-{os.getpid()}"
@@ -214,7 +264,8 @@ def run_backfill(cfg, stop: Optional[threading.Event] = None
     pending = lease.pending_shards(manifest)
     summary: Dict[str, Any] = {
         "worker": owner, "shards_this_proc": 0, "clips_this_proc": 0,
-        "failed_this_proc": 0, "lease_lost": 0, "lease_steals": 0,
+        "failed_this_proc": 0, "skipped_dup_this_proc": 0,
+        "lease_lost": 0, "lease_steals": 0,
         "steady_recompiles": 0, "clips_per_s": 0.0, "elapsed_s": 0.0,
     }
     pipe: Optional[_Pipeline] = None
@@ -278,6 +329,19 @@ def run_backfill(cfg, stop: Optional[threading.Event] = None
         resumed = len(entries) - len(todo)
         failed0 = writer.failed       # inherited from a predecessor's
         # surviving records — not this process's doing
+        skipped0 = writer.skipped
+        if dup_of:
+            # book the shard's duplicates up front, before any batch
+            # dispatches: the skip rows land in one write, and a kill
+            # right after still resumes exactly (scored_keys covers them)
+            dups = [e for e in todo if (e[0], e[1], e[2]) in dup_of]
+            if dups:
+                writer.append_dups(
+                    [(kind, ri, name, 0 if kind == "fake" else 1,
+                      dup_of[(kind, ri, name)])
+                     for kind, ri, name, _num in dups])
+                todo = [e for e in todo
+                        if (e[0], e[1], e[2]) not in dup_of]
         if resumed:
             _logger.info("%s: resuming a partial shard — %d/%d verdicts "
                          "survive (%d torn bytes dropped)", sid, resumed,
@@ -474,7 +538,8 @@ def run_backfill(cfg, stop: Optional[threading.Event] = None
         done_clips = writer.records - resumed
         log.metrics(
             shard=sid, clips=len(entries), scored=writer.records -
-            writer.failed, failed=writer.failed, resumed=resumed,
+            writer.failed - writer.skipped, failed=writer.failed,
+            skipped_dup=writer.skipped, resumed=resumed,
             committed=committed, wall_s=round(wall, 3),
             clips_per_s=round(done_clips / wall, 2) if wall else None,
             data_wait_s=round(data_wait, 3),
@@ -485,6 +550,7 @@ def run_backfill(cfg, stop: Optional[threading.Event] = None
             worker=owner)
         summary["clips_this_proc"] += done_clips
         summary["failed_this_proc"] += writer.failed - failed0
+        summary["skipped_dup_this_proc"] += writer.skipped - skipped0
         return committed
 
     rival: Optional[LeaseDir] = None
@@ -574,11 +640,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     _logger.info(
         "worker %s: %d shard(s), %d clip(s) this process at %.1f "
         "clips/s; corpus %d/%d shards done — books: %d manifest == %d "
-        "scored + %d failed (%s)", summary["worker"],
+        "scored + %d failed + %d skipped_dup (%s)", summary["worker"],
         summary["shards_this_proc"], summary["clips_this_proc"],
         summary["clips_per_s"], books["shards_done"],
         books["shards_total"], books["manifest_clips"], books["scored"],
-        books["failed"], "BALANCED" if books["balanced"] else
+        books["failed"], books["skipped_dup"],
+        "BALANCED" if books["balanced"] else
         ("incomplete" if not books["complete"] else "IMBALANCED"))
     if summary["preempted"]:
         return EXIT_PREEMPTED

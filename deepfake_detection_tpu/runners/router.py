@@ -76,7 +76,13 @@ def build_router(cfg):
         migrate_timeout_s=cfg.migrate_timeout_s,
         idle_timeout_s=cfg.idle_timeout_s,
         header_timeout_s=cfg.header_timeout_s,
-        max_buffer_bytes=cfg.max_buffer_bytes)
+        max_buffer_bytes=cfg.max_buffer_bytes,
+        edge_cache_entries=cfg.edge_cache_entries,
+        edge_cache_ttl_s=cfg.edge_cache_ttl_s)
+    if int(cfg.edge_cache_entries) > 0:
+        _logger.info("edge verdict cache: %d entries, ttl %.1fs "
+                     "(keyed on the fleet weights-epoch)",
+                     cfg.edge_cache_entries, cfg.edge_cache_ttl_s)
     return server, spawned
 
 

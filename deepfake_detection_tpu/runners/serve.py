@@ -107,10 +107,27 @@ def build_engine(cfg):
     engine.warmup()
     if engine.chaos.active:
         _logger.warning("DFD_CHAOS active: %s", sorted(engine.chaos.points))
+    cache = None
+    if int(cfg.cache_entries) > 0:
+        from ..cache import VerdictCache
+        cache = VerdictCache(cfg.cache_entries, cfg.cache_ttl_s,
+                             near_dup=cfg.cache_near_dup,
+                             near_radius=cfg.cache_near_radius,
+                             on_expired=metrics.cache_expired_total.inc,
+                             on_evicted=metrics.cache_evicted_total.inc)
+        # engine.start() hands the cache + fingerprint resolver to the
+        # batcher; holding it on the engine also lets a reload commit
+        # purge (and count) the entries its fingerprint bump orphaned
+        engine.verdict_cache = cache
+        _logger.info("verdict cache: %d entries, ttl %.0fs%s",
+                     cfg.cache_entries, cfg.cache_ttl_s,
+                     (f", near-dup radius {cfg.cache_near_radius}"
+                      if cfg.cache_near_dup else ""))
     batcher = MicroBatcher(max_batch=cfg.max_batch_size,
                            deadline_ms=cfg.batch_deadline_ms,
                            max_queue=cfg.max_queue, metrics=metrics,
-                           retry_jitter_s=cfg.retry_jitter_s)
+                           retry_jitter_s=cfg.retry_jitter_s,
+                           cache=cache)
     if cfg.reload_dir:
         engine.start_reload_watcher(cfg.reload_dir,
                                     interval_s=cfg.reload_interval_s,

@@ -97,13 +97,19 @@ class CascadeRouter:
         return self.low <= p_fake <= self.high
 
     def score(self, student_payload: Any,
-              flagship_payload: Callable[[], Any]) -> CascadeResult:
+              flagship_payload: Callable[[], Any],
+              content_key: Optional[Any] = None) -> CascadeResult:
         """Triage one clip.
 
         ``flagship_payload`` is a thunk so the (possibly larger) flagship
         canvas is only prepared for the escalated fraction.  Student-
         phase exceptions propagate; flagship-phase exceptions degrade to
         the student verdict (counted).
+
+        ``content_key`` is the clip's verdict-cache identity (ISSUE 17),
+        forwarded to BOTH tier submits — the cache key carries the model
+        id, so student and flagship verdicts never mix, and the tiers
+        compose multiplicatively: cache → student → flagship.
 
         The two tiers share ONE ``timeout_s`` budget: the flagship leg
         gets whatever the student left (an exhausted budget at escalation
@@ -112,9 +118,10 @@ class CascadeRouter:
         deadline behind a 200."""
         m = self.metrics
         t0 = time.monotonic()
+        kw = {} if content_key is None else {"content_key": content_key}
         req = self.batcher.submit(student_payload,
                                   timeout_s=self.timeout_s,
-                                  model_id=self.student_id)
+                                  model_id=self.student_id, **kw)
         # raises on shed/deadline/fault: the clip was never triaged, and
         # the per-model books already account the failed student request
         s_scores = req.result(timeout=self.timeout_s + 5.0)
@@ -137,7 +144,7 @@ class CascadeRouter:
                     f"student phase")
             freq = self.batcher.submit(flagship_payload(),
                                        timeout_s=remaining,
-                                       model_id=self.flagship_id)
+                                       model_id=self.flagship_id, **kw)
             f_scores = freq.result(timeout=remaining + 5.0)
         except Exception as e:                     # noqa: BLE001
             # the student verdict is still a verdict: serve it, count the

@@ -94,6 +94,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..cache.content import tree_fingerprint
 from ..chaos import chaos_from_env
 from ..params import image_max_height, img_mean, img_num as _default_img_num, \
     img_std
@@ -114,6 +115,20 @@ DEFAULT_BUCKETS = (1, 4, 16, 64)
 _CKPT_SUFFIXES = (".msgpack", ".ckpt", ".flax", ".pkt")
 
 
+def _params_fingerprint(host_tree: Any, dtype: str) -> str:
+    """Stable hex digest of a host-side params tree: the weight identity
+    the verdict cache keys on (ISSUE 17) and ``/readyz`` exposes.
+
+    Digests every leaf's key-path, shape, dtype and bytes, plus the
+    serving dtype — an f32→bf16/int8 swap of the SAME checkpoint scores
+    differently and must never share cached verdicts."""
+    leaves = jax.tree_util.tree_flatten_with_path(host_tree)[0]
+    return tree_fingerprint(
+        ((jax.tree_util.keystr(path), np.asarray(leaf))
+         for path, leaf in leaves),
+        extra=(canonical_mode(dtype),))
+
+
 class _ModelEntry:
     """One served model: params, geometry, compiled programs, reload and
     canary state.  The engine's model table maps ``model_id`` → entry."""
@@ -121,8 +136,8 @@ class _ModelEntry:
     __slots__ = ("model_id", "model", "image_size", "img_num", "dtype",
                  "multi_frame", "host_template", "var_shapes", "variables",
                  "mean", "std", "mean_multi", "std_multi", "compiled",
-                 "golden", "golden_ref", "reload_count", "last_reload_key",
-                 "reload_attempts", "watcher", "warmed")
+                 "golden", "golden_ref", "fingerprint", "reload_count",
+                 "last_reload_key", "reload_attempts", "watcher", "warmed")
 
     def __init__(self, model_id: str, model, variables, *,
                  image_size: int, img_num: int, dtype: str,
@@ -158,6 +173,11 @@ class _ModelEntry:
         self.compiled: Dict[Tuple[int, int], Any] = {}  # (bucket, chans)
         self.golden: Optional[np.ndarray] = None
         self.golden_ref: Optional[np.ndarray] = None
+        # weight identity: part of every verdict-cache key, so a reload
+        # (which re-assigns this atomically under the commit lock) orphans
+        # all cached verdicts of the old weights by construction
+        self.fingerprint = _params_fingerprint(self.host_template,
+                                               self.dtype)
         self.reload_count = 0
         self.last_reload_key: Optional[Tuple[str, float, int]] = None
         self.reload_attempts = 0           # torn_reload chaos step counter
@@ -244,6 +264,10 @@ class InferenceEngine:
         self._rewarm_timeout_s = max(30.0, 4.0 * float(watchdog_timeout_s))
         self._rewarm_thread: Optional[threading.Thread] = None
         self._canary_hook = None           # test seam: runs mid-canary
+        #: verdict cache (cache/store.py VerdictCache), attached by the
+        #: runner; start() hands it (plus the fingerprint resolver) to
+        #: the batcher, and a reload commit purges the orphaned entries
+        self.verdict_cache = None
 
         self.add_model(self.default_model_id, model, variables,
                        image_size=image_size, img_num=img_num, dtype=dtype)
@@ -299,6 +323,14 @@ class InferenceEngine:
 
     def has_model(self, model_id: str) -> bool:
         return model_id in self._models
+
+    def model_fingerprint(self, model_id: Optional[str] = None) -> str:
+        """The checkpoint fingerprint of one model (None = primary): a
+        stable hex digest of its host params tree + serving dtype.  This
+        is the weight identity the verdict cache keys on and ``/readyz``
+        publishes per model — a hot reload or quantized swap changes it
+        atomically with the weights."""
+        return self.entry(model_id).fingerprint
 
     def model_ids(self) -> Tuple[str, ...]:
         return tuple(self._models)
@@ -417,6 +449,7 @@ class InferenceEngine:
                       "image_size": e.image_size,
                       "img_num": e.img_num,
                       "dtype": e.dtype,
+                      "fingerprint": e.fingerprint,
                       "reloads": e.reload_count}
                 for mid, e in list(self._models.items())},
             "breaker": self.breaker.state,
@@ -821,6 +854,13 @@ class InferenceEngine:
         self._batcher = batcher
         # unrouted submits land on the primary model's books
         batcher.default_model_id = self.default_model_id
+        # verdict cache: the batcher's probe keys on the engine's weight
+        # identity — a submit races a reload only in the safe direction
+        # (new scores stored under the orphaned old fingerprint, never
+        # old scores under the new one)
+        batcher.fingerprint_of = self.model_fingerprint
+        if self.verdict_cache is not None and batcher.cache is None:
+            batcher.cache = self.verdict_cache
         self._spawn_worker()
         self.watchdog.start()
 
@@ -973,6 +1013,10 @@ class InferenceEngine:
                 new_vars = jax.device_put(
                     quantize_tree(host_tree, entry.dtype))
                 canary = self._canary_scores(entry, new_vars)
+                # weight identity of the candidate, hashed OUTSIDE the
+                # commit lock (bytes-proportional work) and assigned
+                # inside it — one atom with the variables swap
+                new_fp = _params_fingerprint(host_tree, entry.dtype)
             except Exception:                      # noqa: BLE001
                 _logger.exception("hot reload of model %r from %s "
                                   "rejected; previous weights keep "
@@ -987,7 +1031,17 @@ class InferenceEngine:
                 entry.variables = new_vars
                 if canary is not None:
                     entry.golden_ref = canary      # new drift baseline
+                # the fingerprint bump orphans every cached verdict of
+                # the old weights: a stale hit is impossible from this
+                # point on, no sweep required
+                entry.fingerprint = new_fp
                 entry.reload_count += 1
+            if self.verdict_cache is not None:
+                purged = self.verdict_cache.purge_model(
+                    entry.model_id, keep_fingerprint=new_fp)
+                if purged:
+                    self.metrics.cache_invalidated_total.inc(purged)
+                    self.metrics.cache_entries = self.verdict_cache.size()
             self.metrics.reloads_total.inc()
             self.metrics.count_model("reloads", entry.model_id)
             _logger.info("hot-reloaded model %r weights from %s "

@@ -54,6 +54,7 @@ from urllib.parse import parse_qs
 import numpy as np
 from PIL import Image
 
+from ..cache import clip_phash, content_hash
 from ..params import normalize_concat, normalize_replicate, prepare_canvas
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
 from .engine import InferenceEngine
@@ -264,6 +265,10 @@ class _Handler(BaseHTTPRequestHandler):
         concatenate into one temporal clip.  Raises ValueError for a
         clip this entry can't take (the 400 path)."""
         canvases = [prepare_canvas(f, entry.image_size) for f in frames]
+        return _Handler._payload_from(srv, entry, canvases)
+
+    @staticmethod
+    def _payload_from(srv, entry, canvases: list):
         if srv.engine.wire == "float32":
             if len(canvases) == 1:
                 return normalize_replicate(canvases[0], entry.img_num)
@@ -327,10 +332,21 @@ class _Handler(BaseHTTPRequestHandler):
                                f"frames, got {len(frames)}"})
             return
         try:
-            payload = self._payload_for(srv, entry, frames)
+            canvases = [prepare_canvas(f, entry.image_size)
+                        for f in frames]
+            payload = self._payload_from(srv, entry, canvases)
         except ValueError as e:
             self._respond_json(400, {"error": str(e)})
             return
+        # verdict-cache identity: hash the CANONICAL canvases (not the
+        # wire bytes), so byte-identical re-uploads at any container or
+        # encoding collide once decode+resize has normalized them; billed
+        # to the preprocess stage like the canvas work it extends
+        content_key = None
+        if srv.batcher.cache is not None:
+            content_key = (content_hash(canvases),
+                           clip_phash(canvases)
+                           if srv.batcher.cache.near_dup else None)
         t_pre = time.monotonic() - t_body     # decode+canvas only
         srv.metrics.latency["preprocess"].observe(t_pre)
         cas_result = None
@@ -343,12 +359,14 @@ class _Handler(BaseHTTPRequestHandler):
                 cas_result = cascade.score(
                     payload,
                     lambda: self._payload_for(srv, flagship_entry,
-                                              frames))
+                                              frames),
+                    content_key=content_key)
                 scores = cas_result.scores
             else:
                 req = srv.batcher.submit(payload,
                                          timeout_s=srv.request_timeout_s,
-                                         model_id=entry.model_id)
+                                         model_id=entry.model_id,
+                                         content_key=content_key)
                 # the batcher/engine enforce the queue-side deadline; the
                 # extra 5s here only catches a wedged engine so the HTTP
                 # thread can never hang forever

@@ -77,10 +77,10 @@ _BOUNDS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
 STAGES = ("queue", "preprocess", "device", "total")
 
 #: per-model request-book resolutions (the ``model=`` labeled mirror of
-#: the global books: per model, accepted == scored + shed + deadline +
-#: failed holds exactly, plus reloads for A/B observability)
+#: the global books: per model, accepted == cache_hit + scored + shed +
+#: deadline + failed holds exactly, plus reloads for A/B observability)
 MODEL_BOOK_KINDS = ("accepted", "scored", "failed", "shed", "deadline",
-                    "reloads")
+                    "cache_hit", "reloads")
 
 #: cascade tiers (serving/cascade.py latency histograms)
 CASCADE_TIERS = ("student", "flagship")
@@ -95,10 +95,10 @@ class ServingMetrics:
         self.requests_total: Dict[str, _Counter] = {}   # keyed by status
         self._requests_lock = threading.Lock()
         # request-books ledger: every submit attempt lands in accepted,
-        # and every accepted request resolves EXACTLY once as scored,
-        # shed, deadline or failed — tools/chaos_serve.py asserts the
-        # identity accepted == scored + shed + deadline + failed from a
-        # /metrics scrape after every fault scenario
+        # and every accepted request resolves EXACTLY once as cache_hit,
+        # scored, shed, deadline or failed — tools/chaos_serve.py asserts
+        # the identity accepted == cache_hit + scored + shed + deadline +
+        # failed from a /metrics scrape after every fault scenario
         self.accepted_total = _Counter()
         self.scored_total = _Counter()
         self.failed_total = _Counter()
@@ -118,6 +118,17 @@ class ServingMetrics:
         self.breaker_opens_total = _Counter()
         self.breaker_probes_total = _Counter()
         self.breaker_rejected_total = _Counter()
+        # verdict-cache books (ISSUE 17 dedup tier): cache_hit is the new
+        # resolution term (exact + near + coalesced); near/coalesced are
+        # sub-counters, the rest is store lifecycle (never silent)
+        self.cache_hit_total = _Counter()
+        self.cache_near_hit_total = _Counter()
+        self.cache_coalesced_total = _Counter()
+        self.cache_miss_total = _Counter()
+        self.cache_insert_total = _Counter()
+        self.cache_expired_total = _Counter()
+        self.cache_evicted_total = _Counter()
+        self.cache_invalidated_total = _Counter()
         self.chaos_injections_total: Dict[str, _Counter] = {}
         self._chaos_lock = threading.Lock()
         # per-model request books (ISSUE 14 multi-model engine): the
@@ -141,6 +152,7 @@ class ServingMetrics:
         self.cascade_latency: Dict[str, LatencyHistogram] = {
             t: LatencyHistogram(_BOUNDS) for t in CASCADE_TIERS}
         self.queue_depth = 0            # gauge, written by the batcher
+        self.cache_entries = 0          # gauge, written on cache inserts
         self.inflight = 0               # gauge, written by the engine
         self.ready = False              # gauge, flipped after warmup and
         # DROPPED during watchdog recovery / bucket re-warm / reload canary
@@ -235,8 +247,8 @@ class ServingMetrics:
         for status, value in items:
             doc.sample("requests_total", f'{{status="{status}"}}', value)
         counter("accepted_total", "Requests offered to the micro-batcher "
-                "(books: accepted == scored + shed + deadline + failed)",
-                self.accepted_total.value)
+                "(books: accepted == cache_hit + scored + shed + deadline "
+                "+ failed)", self.accepted_total.value)
         counter("scored_total", "Requests resolved with a score",
                 self.scored_total.value)
         counter("failed_total", "Requests resolved with an error (engine "
@@ -282,6 +294,29 @@ class ServingMetrics:
                 self.breaker_probes_total.value)
         counter("breaker_rejected_total", "Requests shed 503 by the open "
                 "breaker", self.breaker_rejected_total.value)
+        counter("cache_hit_total", "Requests resolved by the verdict "
+                "cache — exact + near-dup + coalesced (books: accepted "
+                "== cache_hit + scored + shed + deadline + failed)",
+                self.cache_hit_total.value)
+        counter("cache_near_hit_total", "Verdict-cache hits via the "
+                "near-dup perceptual index (subset of cache_hit_total; "
+                "never conflated with exact hits)",
+                self.cache_near_hit_total.value)
+        counter("cache_coalesced_total", "Requests that rode an "
+                "in-flight twin's single dispatch (subset of "
+                "cache_hit_total)", self.cache_coalesced_total.value)
+        counter("cache_miss_total", "Keyed submits that found no cached "
+                "verdict and dispatched", self.cache_miss_total.value)
+        counter("cache_insert_total", "Verdicts stored after a scored "
+                "miss", self.cache_insert_total.value)
+        counter("cache_expired_total", "Verdict-cache entries dropped at "
+                "TTL expiry", self.cache_expired_total.value)
+        counter("cache_evicted_total", "Verdict-cache entries evicted by "
+                "LRU capacity", self.cache_evicted_total.value)
+        counter("cache_invalidated_total", "Verdict-cache entries purged "
+                "by a reload's fingerprint bump (stale hits are "
+                "impossible by construction; this reclaims the memory)",
+                self.cache_invalidated_total.value)
         # per-model request books (multi-model engine): one labeled
         # family per resolution kind, mirroring the global ledger
         with self._model_lock:
@@ -331,6 +366,8 @@ class ServingMetrics:
                        value)
         gauge("queue_depth", "Requests waiting in the micro-batch queue",
               self.queue_depth)
+        gauge("cache_entries", "Verdicts currently stored in the cache",
+              self.cache_entries)
         gauge("inflight", "Requests staged on device", self.inflight)
         gauge("ready", "1 once all buckets are warmed (drops during "
               "recovery re-warm and the reload canary)", int(self.ready))

@@ -259,7 +259,7 @@ class TestWriter:
         book = w2.finalize()
         w2.close()
         assert book == {"clips": 3, "scored": 2, "failed": 1,
-                        "sha256": book["sha256"]}
+                        "skipped_dup": 0, "sha256": book["sha256"]}
         # the incremental sha IS the file's content hash
         import hashlib
         with open(verdict_path(run, "s0"), "rb") as f:
@@ -318,6 +318,51 @@ class TestRunner:
         assert {r["shard"] for r in shard_recs} == \
             {sh["id"] for sh in corpus["manifest"]["shards"]}
         assert all(r["backend_compiles"] == 0 for r in shard_recs)
+
+    def test_dedup_books_skipped_dup_against_manifest(self, tmp_path):
+        """--dedup (ISSUE 17): byte-identical clips skip the device and
+        book skipped_dup rows naming the canonical clip — books balance
+        with the third term, no clip silently absent."""
+        import shutil
+        from deepfake_detection_tpu.data.packed import write_pack
+        from deepfake_detection_tpu.runners.backfill import run_backfill
+        root = str(tmp_path / "root")
+        _write_tree(root, fake=5, real=4, frames=2, size=32, seed=3)
+        # byte-copy three clips: identical JPEG bytes decode to
+        # identical pixels, so the pack slabs collide on content hash
+        for src, dst in (("fake/c0", "fake/c3"), ("fake/c0", "fake/c4"),
+                         ("real/c1", "real/c2")):
+            shutil.rmtree(os.path.join(root, dst))
+            shutil.copytree(os.path.join(root, src),
+                            os.path.join(root, dst))
+        pack = str(tmp_path / "pack")
+        write_pack(root, pack, image_size=0, frames_per_clip=2,
+                   shard_size=8, workers=2)
+        manifest = build_manifest_from_pack(pack, shard_clips=4)
+        mpath = str(tmp_path / "manifest.json")
+        save_manifest(mpath, manifest)
+        dup_corpus = {"pack": pack, "manifest_path": mpath,
+                      "manifest": manifest}
+        run = tmp_path / "run"
+        s = run_backfill(_cfg(dup_corpus, run, dedup=True))
+        b = s["books"]
+        assert b["balanced"], b
+        assert b["skipped_dup"] == 3
+        assert b["scored"] + b["failed"] + b["skipped_dup"] == \
+            b["manifest_clips"] == 9
+        assert s["skipped_dup_this_proc"] == 3
+        assert s["steady_recompiles"] == 0
+        recs = []
+        for sh in manifest["shards"]:
+            recs += read_verdicts(verdict_path(str(run), sh["id"]))
+        skips = [r for r in recs if r.get("skipped_dup")]
+        assert len(skips) == 3
+        # every skip names a canonical clip that was actually SCORED
+        # (never a chain of skips, never a failed clip)
+        scored = {f"{r['kind']}/{r['root']}/{r['clip']}"
+                  for r in recs if r.get("ok")}
+        assert all(r["dup_of"] in scored for r in skips)
+        assert all(r["score"] is None and not r["ok"] for r in skips)
 
     @pytest.mark.slow   # tier-1 budget: a second full corpus run (~3 s)
     # re-proving determinism the slow-tier kill/resume identity drive

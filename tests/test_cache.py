@@ -1,0 +1,521 @@
+"""Verdict-cache subsystem tests (ISSUE 17): content addressing, the
+bounded LRU+TTL store, in-flight coalescing, and the weight-identity
+contract that makes a stale hit impossible across hot reloads and
+quantized swaps.
+
+Fast tier (``cache`` marker, not ``slow``): the store/content units are
+jax-free and instant; the engine-level tests reuse the small conv model
+at a 32² canvas with one bucket so compiles hit the persistent cache.
+The live-subprocess e2e rides the slow tier (see tests/README.md).
+"""
+
+import io
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepfake_detection_tpu.cache import (SingleFlight, VerdictCache,
+                                          ahash64, clip_phash,
+                                          content_hash, dhash64)
+from deepfake_detection_tpu.cache.content import (hamming64,
+                                                  tree_fingerprint)
+
+pytestmark = pytest.mark.cache
+
+_MODEL = "mobilenetv3_small_100"
+_SIZE = 32
+
+
+# ---------------------------------------------------------------------------
+# content addressing (jax-free)
+# ---------------------------------------------------------------------------
+
+def _canvas(seed=0, h=96, w=80):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+
+
+def test_content_hash_identity_and_sensitivity():
+    """dtype, shape, bytes and FRAME ORDER are all part of the exact
+    identity; none may collide."""
+    a, b = _canvas(0), _canvas(1)
+    assert content_hash([a, b]) == content_hash([a.copy(), b.copy()])
+    assert content_hash([a, b]) != content_hash([b, a])      # order
+    assert content_hash([a]) != content_hash([a.astype(np.uint16)])
+    assert content_hash([a]) != content_hash([a[:-1]])        # shape
+    flipped = a.copy()
+    flipped[0, 0, 0] ^= 1
+    assert content_hash([a]) != content_hash([flipped])       # bytes
+
+
+def test_dhash_brightness_invariant_ahash_is_not():
+    """The gradient hash must survive a global brightness shift (the
+    classic re-encode artifact); pairing it with aHash in the probe is
+    what cuts the false positives it alone lets through."""
+    base = _canvas(3).astype(np.float64)
+    assert dhash64(base) == dhash64(base + 9.0)
+    assert hamming64(dhash64(base), dhash64(_canvas(4))) > 8
+
+
+def test_clip_phash_stable_under_tiny_perturbation():
+    frames = [_canvas(s) for s in (10, 11)]
+    d0, a0 = clip_phash(frames)
+    bumped = [f.astype(np.int16) for f in frames]
+    bumped[0][0, 0, :] += 3            # one pixel of one frame
+    d1, a1 = clip_phash([np.clip(b, 0, 255).astype(np.uint8)
+                         for b in bumped])
+    assert hamming64(d0, d1) <= 3 and hamming64(a0, a1) <= 3
+    assert 0 <= hamming64(0, 2**64 - 1) == 64
+
+
+def test_tree_fingerprint_extra_tags_split_identity():
+    """Same leaves + different serving dtype must be different keys —
+    an f32→bf16/int8 swap of one checkpoint scores differently and can
+    never share verdicts."""
+    leaves = [("w", np.arange(6, dtype=np.float32).reshape(2, 3))]
+    assert tree_fingerprint(leaves) == tree_fingerprint(leaves)
+    assert (tree_fingerprint(leaves, extra=("f32",))
+            != tree_fingerprint(leaves, extra=("bf16",)))
+    assert tree_fingerprint(leaves) != tree_fingerprint(
+        [("w2", leaves[0][1])])
+
+
+# ---------------------------------------------------------------------------
+# VerdictCache store (injected clock, jax-free)
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_store_exact_key_is_hash_model_fingerprint():
+    c = VerdictCache(capacity=4, ttl_s=60)
+    c.put("h1", "m", "fp0", [0.25, 0.75])
+    assert c.get("h1", "m", "fp0") == [0.25, 0.75]
+    assert c.get("h1", "m", "fp1") is None       # other weights
+    assert c.get("h1", "m2", "fp0") is None      # other model
+    assert c.get("h2", "m", "fp0") is None       # other content
+    assert len(c) == 1
+
+
+def test_store_ttl_expiry_is_lazy_and_counted():
+    clk, expired = _Clock(), []
+    c = VerdictCache(capacity=4, ttl_s=30, clock=clk,
+                     on_expired=expired.append)
+    c.put("h1", "m", "fp", "v")
+    clk.t += 29.9
+    assert c.get("h1", "m", "fp") == "v"
+    clk.t += 30.1                    # now past the ttl of the put above
+    assert c.get("h1", "m", "fp") is None
+    assert expired == [1] and c.size() == 0
+
+
+def test_store_lru_eviction_counted_and_recency_protects():
+    evicted = []
+    c = VerdictCache(capacity=2, ttl_s=60, on_evicted=evicted.append)
+    c.put("a", "m", "fp", 1)
+    c.put("b", "m", "fp", 2)
+    assert c.get("a", "m", "fp") == 1            # refresh: b is now LRU
+    c.put("c", "m", "fp", 3)
+    assert evicted == [1]
+    assert c.get("b", "m", "fp") is None         # the victim
+    assert c.get("a", "m", "fp") == 1 and c.get("c", "m", "fp") == 3
+
+
+def test_store_near_probe_radius_and_fingerprint_scoping():
+    c = VerdictCache(capacity=8, ttl_s=60, near_dup=True, near_radius=3)
+    c.put("h1", "m", "fp", "verdict", phash=(0b0, 0b0))
+    # within radius on BOTH hashes -> near hit with the distance
+    assert c.get_near((0b111, 0b1), "m", "fp") == ("verdict", 3)
+    # dhash in radius but ahash out -> the false-positive guard fires
+    assert c.get_near((0b111, 0b11111), "m", "fp") is None
+    assert c.get_near((0b11111, 0b0), "m", "fp") is None   # out of radius
+    assert c.get_near((0b1, 0b0), "m", "other_fp") is None  # other weights
+    # near never answers an exact probe: different content hash misses
+    assert c.get("h2", "m", "fp") is None
+
+
+def test_store_near_disabled_never_answers():
+    c = VerdictCache(capacity=8, ttl_s=60, near_dup=False)
+    c.put("h1", "m", "fp", "v", phash=(0, 0))
+    assert c.get_near((0, 0), "m", "fp") is None
+
+
+def test_store_purge_model_keeps_current_fingerprint():
+    c = VerdictCache(capacity=8, ttl_s=60)
+    c.put("h1", "m", "old", 1)
+    c.put("h2", "m", "old", 2)
+    c.put("h3", "m", "new", 3)
+    c.put("h4", "other", "old", 4)
+    assert c.purge_model("m", keep_fingerprint="new") == 2
+    assert c.get("h3", "m", "new") == 3
+    assert c.get("h4", "other", "old") == 4
+    assert c.get("h1", "m", "old") is None
+
+
+def test_store_rejects_nonsense_bounds():
+    with pytest.raises(ValueError):
+        VerdictCache(capacity=0, ttl_s=60)
+    with pytest.raises(ValueError):
+        VerdictCache(capacity=4, ttl_s=0)
+    with pytest.raises(ValueError):
+        VerdictCache(capacity=4, ttl_s=60, near_radius=9)
+
+
+def test_single_flight_leader_follower_contract():
+    sf = SingleFlight()
+    assert sf.lead_or_follow("k", "r0") is True     # leader
+    assert sf.lead_or_follow("k", "r1") is False
+    assert sf.lead_or_follow("k", "r2") is False
+    assert sf.depth() == 2
+    assert sf.pop("k") == ["r1", "r2"]
+    assert sf.pop("k") == []                        # exactly once
+    assert sf.lead_or_follow("k", "r3") is True     # fresh election
+
+
+# ---------------------------------------------------------------------------
+# engine-level: the cache in front of the batcher (small conv model)
+# ---------------------------------------------------------------------------
+
+def _build_stack(cache, buckets=(1, 4), max_batch=4, deadline_ms=5.0):
+    import jax
+
+    from deepfake_detection_tpu.models import create_model
+    from deepfake_detection_tpu.serving.batcher import MicroBatcher
+    from deepfake_detection_tpu.serving.engine import InferenceEngine
+    from deepfake_detection_tpu.serving.metrics import ServingMetrics
+    from tests.test_serving import _perturbed_variables
+
+    model = create_model(_MODEL, num_classes=2, in_chans=3)
+    variables = _perturbed_variables(model, _SIZE, 3, seed=1)
+    metrics = ServingMetrics()
+    if cache is not None:
+        cache._on_expired = metrics.cache_expired_total.inc
+        cache._on_evicted = metrics.cache_evicted_total.inc
+    engine = InferenceEngine(model, variables, image_size=_SIZE, img_num=1,
+                             buckets=buckets, metrics=metrics)
+    engine.verdict_cache = cache
+    batcher = MicroBatcher(max_batch=max_batch, deadline_ms=deadline_ms,
+                           max_queue=64, metrics=metrics, cache=cache)
+    return model, variables, metrics, engine, batcher
+
+
+def _payload(seed=0):
+    from tests.test_serving import _payloads
+    return _payloads(1, seed=seed)[0]
+
+
+def _key(seed=0):
+    return (content_hash([_payload(seed)]), None)
+
+
+def _books_balance(metrics):
+    acc = metrics.accepted_total.value
+    resolved = (metrics.cache_hit_total.value + metrics.scored_total.value
+                + metrics.shed_total.value + metrics.deadline_total.value
+                + metrics.failed_total.value)
+    assert acc == resolved, f"books broken: {acc} accepted != {resolved}"
+
+
+def test_exact_hit_skips_device_bit_identical():
+    """Second submit of the same content resolves pre-dispatch: booked
+    cache_hit (never scored), bit-identical verdict, zero extra device
+    batches."""
+    cache = VerdictCache(capacity=8, ttl_s=600)
+    _, _, metrics, engine, batcher = _build_stack(cache)
+    engine.start(batcher)
+    try:
+        p, ck = _payload(7), _key(7)
+        r1 = batcher.submit(p, timeout_s=10, content_key=ck).result(10)
+        batches1 = metrics.batches_total.value
+        r2 = batcher.submit(p, timeout_s=10, content_key=ck).result(10)
+        np.testing.assert_array_equal(r1, r2)
+        assert metrics.batches_total.value == batches1
+        assert metrics.cache_hit_total.value == 1
+        assert metrics.cache_insert_total.value == 1
+        assert metrics.cache_miss_total.value == 1
+        assert metrics.scored_total.value == 1
+        assert metrics.cache_entries == 1
+        # a submit WITHOUT a content key must bypass the cache entirely
+        r3 = batcher.submit(p, timeout_s=10).result(10)
+        np.testing.assert_array_equal(r1, r3)
+        assert metrics.cache_hit_total.value == 1
+        assert metrics.scored_total.value == 2
+        _books_balance(metrics)
+    finally:
+        engine.stop()
+        batcher.close()
+
+
+def test_concurrent_coalescing_one_device_row():
+    """N concurrent submits of ONE clip dispatch exactly one device row:
+    the first becomes the single-flight leader, the rest ride its
+    resolution as counted coalesced cache hits, all bit-identical.
+
+    The submits land before the engine thread starts draining, so every
+    follower provably attaches while the leader is in flight — the
+    N-concurrent window is pinned, not raced."""
+    n = 6
+    cache = VerdictCache(capacity=8, ttl_s=600)
+    _, _, metrics, engine, batcher = _build_stack(cache, buckets=(1,),
+                                                  max_batch=1)
+    # attach the identity resolver without starting the drain loop
+    batcher.fingerprint_of = engine.model_fingerprint
+    p, ck = _payload(9), _key(9)
+    reqs = []
+    errs = []
+
+    def _submit():
+        try:
+            reqs.append(batcher.submit(p, timeout_s=30, content_key=ck))
+        except Exception as e:                         # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=_submit) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs and len(reqs) == n
+    engine.start(batcher)
+    try:
+        rows = [r.result(30) for r in reqs]
+        for row in rows[1:]:
+            np.testing.assert_array_equal(rows[0], row)
+        assert metrics.batches_total.value == 1
+        assert metrics.batch_rows_total.value == 1      # THE contract
+        assert metrics.scored_total.value == 1
+        assert metrics.cache_hit_total.value == n - 1
+        assert metrics.cache_coalesced_total.value == n - 1
+        assert metrics.accepted_total.value == n
+        _books_balance(metrics)
+        # and the verdict is now stored: a late N+1th is an exact hit
+        batcher.submit(p, timeout_s=10, content_key=ck).result(10)
+        assert metrics.cache_hit_total.value == n
+        assert metrics.batches_total.value == 1
+    finally:
+        engine.stop()
+        batcher.close()
+
+
+def test_near_hit_counted_separately_from_exact():
+    """A near-dup hit is a DIFFERENT clip's verdict: it books cache_hit
+    like any hit but also bumps the near counter — the two kinds are
+    never conflated."""
+    cache = VerdictCache(capacity=8, ttl_s=600, near_dup=True,
+                         near_radius=3)
+    _, _, metrics, engine, batcher = _build_stack(cache)
+    engine.start(batcher)
+    try:
+        p = _payload(11)
+        r1 = batcher.submit(p, timeout_s=10,
+                            content_key=("hA", (0b0, 0b0))).result(10)
+        r2 = batcher.submit(p, timeout_s=10,
+                            content_key=("hB", (0b11, 0b1))).result(10)
+        np.testing.assert_array_equal(r1, r2)
+        assert metrics.cache_hit_total.value == 1
+        assert metrics.cache_near_hit_total.value == 1
+        # exact re-probe of the stored clip is NOT a near hit
+        batcher.submit(p, timeout_s=10,
+                       content_key=("hA", (0b0, 0b0))).result(10)
+        assert metrics.cache_hit_total.value == 2
+        assert metrics.cache_near_hit_total.value == 1
+        _books_balance(metrics)
+    finally:
+        engine.stop()
+        batcher.close()
+
+
+def test_ttl_and_lru_counted_through_serving_metrics():
+    """Expiry and eviction are never silent: the store's callbacks are
+    wired to dfd_serving_cache_{expired,evicted}_total exactly as the
+    serve runner wires them."""
+    clk = _Clock()
+    cache = VerdictCache(capacity=2, ttl_s=30, clock=clk)
+    _, _, metrics, engine, batcher = _build_stack(cache)
+    engine.start(batcher)
+    try:
+        for seed in (20, 21, 22):       # capacity 2 -> third insert evicts
+            batcher.submit(_payload(seed), timeout_s=10,
+                           content_key=_key(seed)).result(10)
+        assert metrics.cache_evicted_total.value == 1
+        clk.t += 31.0                   # everything left is now stale
+        batcher.submit(_payload(22), timeout_s=10,
+                       content_key=_key(22)).result(10)
+        assert metrics.cache_expired_total.value >= 1
+        assert metrics.cache_hit_total.value == 0   # stale never serves
+        assert metrics.scored_total.value == 4
+        _books_balance(metrics)
+    finally:
+        engine.stop()
+        batcher.close()
+
+
+def test_reload_flips_fingerprint_and_invalidates():
+    """The ISSUE 17 staleness contract end to end: a hot reload bumps
+    ``engine.model_fingerprint``, purges the old weights' verdicts
+    (counted as invalidated), and the post-reload re-score is bit-level
+    identical to the new weights' reference — never the cached old
+    verdict."""
+    import jax
+    import jax.numpy as jnp
+
+    from tests.test_serving import _perturbed_variables
+
+    cache = VerdictCache(capacity=8, ttl_s=600)
+    model, _, metrics, engine, batcher = _build_stack(cache)
+    engine.start(batcher)
+    try:
+        p, ck = _payload(30), _key(30)
+        before = batcher.submit(p, timeout_s=10, content_key=ck).result(10)
+        batcher.submit(p, timeout_s=10, content_key=ck).result(10)
+        assert metrics.cache_hit_total.value == 1
+        fp0 = engine.model_fingerprint()
+        detail = engine.readiness_detail()["models"][engine.default_model_id]
+        assert detail["fingerprint"] == fp0 and len(fp0) == 64
+
+        new_vars = _perturbed_variables(model, _SIZE, 3, seed=2)
+        engine.submit_reload(jax.tree.map(np.asarray, new_vars),
+                             source="<test>")
+        deadline = time.monotonic() + 20.0
+        while engine.reload_count == 0 and time.monotonic() < deadline:
+            # the swap lands between batches — keep uncached traffic
+            # flowing (no content key: these must not touch the cache)
+            batcher.submit(p, timeout_s=5).result(5)
+        assert engine.reload_count == 1, "reload never applied"
+
+        fp1 = engine.model_fingerprint()
+        assert fp1 != fp0
+        assert (engine.readiness_detail()["models"]
+                [engine.default_model_id]["fingerprint"] == fp1)
+        assert metrics.cache_invalidated_total.value == 1
+        assert cache.size() == 0
+
+        hits0 = metrics.cache_hit_total.value
+        after = batcher.submit(p, timeout_s=10, content_key=ck).result(10)
+        assert metrics.cache_hit_total.value == hits0   # miss, re-scored
+        assert not np.array_equal(before, after)
+        want = np.asarray(jax.jit(
+            lambda v, x: jax.nn.softmax(
+                model.apply(v, x, training=False), -1)
+        )(jax.device_put(new_vars), jnp.asarray(p[None])))[0]
+        np.testing.assert_array_equal(after, want)
+        _books_balance(metrics)
+    finally:
+        engine.stop()
+        batcher.close()
+
+
+def test_quantized_swap_is_a_different_cache_key():
+    """The serving dtype is folded into the fingerprint: bf16/int8 of
+    the SAME checkpoint can never address f32's cached verdicts."""
+    import jax
+
+    from deepfake_detection_tpu.models import create_model
+    from deepfake_detection_tpu.serving.engine import _params_fingerprint
+    from tests.test_serving import _perturbed_variables
+
+    model = create_model(_MODEL, num_classes=2, in_chans=3)
+    host = jax.tree.map(np.asarray,
+                        _perturbed_variables(model, _SIZE, 3, seed=1))
+    fps = {d: _params_fingerprint(host, d) for d in ("f32", "bf16", "int8")}
+    assert len(set(fps.values())) == 3
+    assert fps["f32"] == _params_fingerprint(host, "f32")   # stable
+
+
+# ---------------------------------------------------------------------------
+# live-server e2e (slow tier; rationale in tests/README.md)
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _jpeg(seed=0):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(_canvas(seed, 64, 64)).save(buf, "JPEG", quality=90)
+    return buf.getvalue()
+
+
+def _scrape(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("dfd_serving_"):
+            name, _, val = line.partition(" ")
+            out[name[len("dfd_serving_"):]] = float(val)
+    return out
+
+
+@pytest.mark.slow
+def test_live_server_cache_e2e():
+    """Real ``runners/serve.py`` subprocess with ``--cache-entries``:
+    repeat POSTs of one jpeg resolve as cache hits over the wire with
+    identical bodies, /readyz publishes the per-model fingerprint, and
+    the scraped books identity holds with a non-zero cache_hit term."""
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deepfake_detection_tpu.runners.serve",
+         "--model", _MODEL, "--image-size", str(_SIZE), "--port",
+         str(port), "--buckets", "1,4", "--cache-entries", "16"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 120.0
+        ready = None
+        while time.monotonic() < deadline:
+            assert proc.poll() is None, "server died during warmup"
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/readyz", timeout=2) as r:
+                    ready = json.loads(r.read())
+                break
+            except Exception:                          # noqa: BLE001
+                time.sleep(0.25)
+        assert ready is not None, "server never became ready"
+        fp = ready["models"][_MODEL]["fingerprint"]
+        assert len(fp) == 64
+
+        body = _jpeg(5)
+        verdicts = []
+        for _ in range(5):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/score", data=body,
+                headers={"Content-Type": "image/jpeg"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                verdicts.append(json.loads(r.read()))
+        # the verdict fields are bit-identical across hits (timings_ms
+        # naturally differ: a hit books queue=device=0)
+        for v in verdicts[1:]:
+            assert v["scores"] == verdicts[0]["scores"]
+            assert v["fake_score"] == verdicts[0]["fake_score"]
+
+        m = _scrape(port)
+        assert m["cache_hit_total"] == 4
+        assert m["scored_total"] == 1
+        assert m["cache_entries"] == 1
+        assert m["accepted_total"] == (
+            m["cache_hit_total"] + m["scored_total"] + m["shed_total"]
+            + m["deadline_total"] + m["failed_total"])
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()

@@ -29,13 +29,17 @@ _BASE = ["--model", "mobilenetv3_small_100", "--image-size", "32",
 def test_serve_faults_recover_books_balance_zero_recompiles():
     """exc / nan / hang / kill: each injected fault fires under live
     load, the engine self-heals within the SLO, the request books
-    balance exactly, and no backend recompile happens across recovery."""
-    assert chaos_serve.main(["--scenario", "exc,nan,hang,kill"] +
-                            _BASE) == 0
+    balance exactly, and no backend recompile happens across recovery.
+    The verdict cache runs live (ISSUE 17): the posters cycle 8 distinct
+    jpegs, so the books identity is asserted with a non-zero cache_hit
+    term through every fault window."""
+    assert chaos_serve.main(["--scenario", "exc,nan,hang,kill",
+                             "--cache-entries", "32"] + _BASE) == 0
 
 
 def test_torn_reload_rejected_then_clean_reload_lands():
-    assert chaos_serve.main(["--scenario", "torn_reload"] + _BASE) == 0
+    assert chaos_serve.main(["--scenario", "torn_reload",
+                             "--cache-entries", "32"] + _BASE) == 0
 
 
 def test_two_model_cascade_faults_recover_books_balance():

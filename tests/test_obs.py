@@ -55,8 +55,8 @@ def _old_serving_render(self) -> str:
         lines.append(
             f'{_PREFIX}_requests_total{{status="{status}"}} {value}')
     counter("accepted_total", "Requests offered to the micro-batcher "
-            "(books: accepted == scored + shed + deadline + failed)",
-            self.accepted_total.value)
+            "(books: accepted == cache_hit + scored + shed + deadline "
+            "+ failed)", self.accepted_total.value)
     counter("scored_total", "Requests resolved with a score",
             self.scored_total.value)
     counter("failed_total", "Requests resolved with an error (engine "
@@ -102,6 +102,30 @@ def _old_serving_render(self) -> str:
             self.breaker_probes_total.value)
     counter("breaker_rejected_total", "Requests shed 503 by the open "
             "breaker", self.breaker_rejected_total.value)
+    # the ISSUE 17 verdict-cache counters, same hand-rolled style
+    counter("cache_hit_total", "Requests resolved by the verdict "
+            "cache — exact + near-dup + coalesced (books: accepted "
+            "== cache_hit + scored + shed + deadline + failed)",
+            self.cache_hit_total.value)
+    counter("cache_near_hit_total", "Verdict-cache hits via the "
+            "near-dup perceptual index (subset of cache_hit_total; "
+            "never conflated with exact hits)",
+            self.cache_near_hit_total.value)
+    counter("cache_coalesced_total", "Requests that rode an "
+            "in-flight twin's single dispatch (subset of "
+            "cache_hit_total)", self.cache_coalesced_total.value)
+    counter("cache_miss_total", "Keyed submits that found no cached "
+            "verdict and dispatched", self.cache_miss_total.value)
+    counter("cache_insert_total", "Verdicts stored after a scored "
+            "miss", self.cache_insert_total.value)
+    counter("cache_expired_total", "Verdict-cache entries dropped at "
+            "TTL expiry", self.cache_expired_total.value)
+    counter("cache_evicted_total", "Verdict-cache entries evicted by "
+            "LRU capacity", self.cache_evicted_total.value)
+    counter("cache_invalidated_total", "Verdict-cache entries purged "
+            "by a reload's fingerprint bump (stale hits are "
+            "impossible by construction; this reclaims the memory)",
+            self.cache_invalidated_total.value)
     # per-model request books (ISSUE 14 multi-model engine)
     from deepfake_detection_tpu.serving.metrics import MODEL_BOOK_KINDS
     with self._model_lock:
@@ -153,6 +177,8 @@ def _old_serving_render(self) -> str:
                      f'{{point="{point}"}} {value}')
     gauge("queue_depth", "Requests waiting in the micro-batch queue",
           self.queue_depth)
+    gauge("cache_entries", "Verdicts currently stored in the cache",
+          self.cache_entries)
     gauge("inflight", "Requests staged on device", self.inflight)
     gauge("ready", "1 once all buckets are warmed (drops during "
           "recovery re-warm and the reload canary)", int(self.ready))
@@ -239,6 +265,17 @@ class TestSharedRenderer:
         m.cascade_flagship_scored_total.inc()
         m.cascade_latency["student"].observe(0.003)
         m.cascade_latency["flagship"].observe(0.4)
+        # the ISSUE 17 verdict-cache counters + gauge
+        m.count_model("cache_hit", "flagship", 2)
+        m.cache_hit_total.inc(2)
+        m.cache_near_hit_total.inc()
+        m.cache_coalesced_total.inc()
+        m.cache_miss_total.inc(4)
+        m.cache_insert_total.inc(3)
+        m.cache_expired_total.inc()
+        m.cache_evicted_total.inc()
+        m.cache_invalidated_total.inc(2)
+        m.cache_entries = 3
         m.queue_depth = 5
         m.inflight = 2
         m.ready = True
@@ -295,8 +332,11 @@ def _old_router_render(self) -> str:
         lines.append(
             f'{_PREFIX}_requests_total{{status="{status}"}} {value}')
     counter("routed_total", "Requests entering the routing path "
-            "(books: routed == forwarded + migrated + shed + failed)",
-            self.routed_total.value)
+            "(books: routed == cache_hit + forwarded + migrated "
+            "+ shed + failed)", self.routed_total.value)
+    counter("cache_hit_total", "Requests resolved by the edge "
+            "verdict cache (keyed on the fleet weights-epoch; no "
+            "replica touched)", self.cache_hit_total.value)
     counter("forwarded_total", "Requests resolved by a replica "
             "response relayed to the client", self.forwarded_total.value)
     counter("migrated_total", "Requests resolved by a migration-"
@@ -377,7 +417,8 @@ class TestRouterRenderer:
         m = RouterMetrics()
         for status in (200, 200, 502, 503):
             m.count_request(status)
-        m.routed_total.inc(9)
+        m.routed_total.inc(11)    # == 2 + 6 + 1 + 1 + 1 (books exact)
+        m.cache_hit_total.inc(2)
         m.forwarded_total.inc(6)
         m.migrated_total.inc()
         m.shed_total.inc()

@@ -182,7 +182,7 @@ def bench_engine(args, corpus: Dict[str, str], duration: float,
             np.full((args.batch, 2), 0.5, np.float32))
         # _stage's jax.device_put(buf) still runs before this — only the
         # XLA execution is removed, matching the backfill null exactly
-        engine._run = lambda bucket, variables, x, multi=False: scores_j
+        engine._run = lambda entry, bucket, chans, variables, x: scores_j
     engine.start(batcher)
     compiles0 = backend_compile_count()
     stop = threading.Event()

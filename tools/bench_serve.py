@@ -183,7 +183,8 @@ def per_bucket_padding_rows(labeled: Dict[str, float]) -> List[str]:
 
 def per_model_rows(labeled: Dict[str, float]) -> List[str]:
     """Markdown rows: per-model request books from the labeled ledger."""
-    kinds = ("accepted", "scored", "failed", "shed", "deadline")
+    kinds = ("accepted", "cache_hit", "scored", "failed", "shed",
+             "deadline")
     models = set()
     for kind in kinds:
         fam = labeled_family(labeled,
@@ -191,8 +192,9 @@ def per_model_rows(labeled: Dict[str, float]) -> List[str]:
         models.update(_label_get(l, "model") for l in fam)
     if not models:
         return []
-    rows = ["| model | accepted | scored | failed | shed | deadline |",
-            "|---|---|---|---|---|---|"]
+    rows = ["| model | accepted | cache_hit | scored | failed | shed | "
+            "deadline |",
+            "|---|---|---|---|---|---|---|"]
     for model in sorted(models):
         vals = []
         for kind in kinds:
@@ -233,7 +235,8 @@ class _Client(threading.Thread):
 
     def __init__(self, netloc: str, jpegs: List[bytes], stop: threading.Event,
                  measure_from: float, seed: int,
-                 retry_cap_s: float = 2.0):
+                 retry_cap_s: float = 2.0,
+                 popularity: Optional[np.ndarray] = None):
         super().__init__(daemon=True)
         host, port = netloc.split(":")
         self.addr = (host, int(port))
@@ -249,8 +252,15 @@ class _Client(threading.Thread):
                     f"Content-Type: image/jpeg\r\n"
                     f"Content-Length: {len(body)}\r\n\r\n").encode()
             self.requests.append(head + body)
-        self.offset = int(np.random.default_rng(seed).integers(
-            0, len(self.requests)))
+        rng = np.random.default_rng(seed)
+        self.offset = int(rng.integers(0, len(self.requests)))
+        # popularity-weighted traffic (the --zipf phase): a seeded
+        # pre-drawn schedule per client, cycled — sampling in the hot
+        # loop would bill rng time to the server under test
+        self.order: Optional[np.ndarray] = None
+        if popularity is not None:
+            self.order = rng.choice(len(self.requests), size=8192,
+                                    p=popularity)
 
     def _recv_response(self, sock_file) -> Tuple[int, float]:
         """Minimal HTTP/1.1 response read: status + headers +
@@ -290,7 +300,10 @@ class _Client(threading.Thread):
                     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
                                     1)
                     f = sock.makefile("rb")
-                sock.sendall(self.requests[i % len(self.requests)])
+                idx = (int(self.order[i % len(self.order)])
+                       if self.order is not None
+                       else i % len(self.requests))
+                sock.sendall(self.requests[idx])
                 i += 1
                 status, retry_after = self._recv_response(f)
             except OSError:
@@ -320,12 +333,13 @@ class _Client(threading.Thread):
 
 def run_load(netloc: str, jpegs: List[bytes], concurrency: int,
              duration: float, warmup: float,
-             retry_cap_s: float = 2.0) -> Dict[str, float]:
+             retry_cap_s: float = 2.0,
+             popularity: Optional[np.ndarray] = None) -> Dict[str, float]:
     stop = threading.Event()
     t_start = time.monotonic()
     measure_from = t_start + warmup
     clients = [_Client(netloc, jpegs, stop, measure_from, seed=c,
-                       retry_cap_s=retry_cap_s)
+                       retry_cap_s=retry_cap_s, popularity=popularity)
                for c in range(concurrency)]
     for c in clients:
         c.start()
@@ -597,7 +611,8 @@ def run_cascade_phase(args, jpegs: List[bytes],
         while time.monotonic() < deadline:
             m1 = scrape_metrics(netloc)
             acc = m1.get("dfd_serving_accepted_total", 0)
-            resolved = (m1.get("dfd_serving_scored_total", 0) +
+            resolved = (m1.get("dfd_serving_cache_hit_total", 0) +
+                        m1.get("dfd_serving_scored_total", 0) +
                         m1.get("dfd_serving_shed_total", 0) +
                         m1.get("dfd_serving_deadline_total", 0) +
                         m1.get("dfd_serving_failed_total", 0))
@@ -629,6 +644,176 @@ def _terminate_proc(proc: subprocess.Popen) -> None:
         proc.wait(timeout=10)
     except subprocess.TimeoutExpired:
         proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# verdict-cache Zipf phase (ISSUE 17): viral traffic, cache on vs off
+# ---------------------------------------------------------------------------
+
+def zipf_popularity(n: int, s: float) -> np.ndarray:
+    """Zipf(s) rank-popularity over ``n`` items (rank 1 = most viral)."""
+    ranks = np.arange(1, n + 1, dtype=np.float64)
+    w = ranks ** -s
+    return w / w.sum()
+
+
+def _drain_serving_books(netloc: str) -> Dict[str, float]:
+    """Wait for the serving ledger to settle, then assert it EXACTLY:
+    accepted == cache_hit + scored + shed + deadline + failed."""
+    deadline = time.monotonic() + 30.0
+    while True:
+        m = scrape_metrics(netloc)
+        acc = m.get("dfd_serving_accepted_total", 0)
+        resolved = (m.get("dfd_serving_cache_hit_total", 0) +
+                    m.get("dfd_serving_scored_total", 0) +
+                    m.get("dfd_serving_shed_total", 0) +
+                    m.get("dfd_serving_deadline_total", 0) +
+                    m.get("dfd_serving_failed_total", 0))
+        if acc == resolved or time.monotonic() > deadline:
+            break
+        time.sleep(0.5)
+    if acc != resolved:
+        raise AssertionError(
+            f"serving books do not balance after drain: accepted "
+            f"{acc:.0f} != cache_hit "
+            f"{m.get('dfd_serving_cache_hit_total', 0):.0f} + scored "
+            f"{m.get('dfd_serving_scored_total', 0):.0f} + shed "
+            f"{m.get('dfd_serving_shed_total', 0):.0f} + deadline "
+            f"{m.get('dfd_serving_deadline_total', 0):.0f} + failed "
+            f"{m.get('dfd_serving_failed_total', 0):.0f}")
+    return m
+
+
+def _sequential_p50_ms(netloc: str, body: bytes, n: int = 40) -> float:
+    """Median latency of ``n`` sequential uncontended /score requests of
+    ONE image (2 warm requests discarded) — the direct hit-latency probe:
+    after the load phase the most-popular clip is certainly cached."""
+    host, port = netloc.split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    lats = []
+    for i in range(n + 2):
+        t0 = time.monotonic()
+        conn.request("POST", "/score", body,
+                     {"Content-Type": "image/jpeg"})
+        resp = conn.getresponse()
+        resp.read()
+        if i >= 2 and resp.status == 200:
+            lats.append(time.monotonic() - t0)
+    conn.close()
+    lats.sort()
+    return lats[len(lats) // 2] * 1000.0 if lats else float("nan")
+
+
+def run_zipf_phase(args) -> List[str]:
+    """ISSUE 17: closed-loop Zipf(s) viral traffic, cache-off vs
+    cache-on, SAME seeded schedule both phases.
+
+    The cache capacity is deliberately smaller than the distinct-clip
+    count, so the hit rate is the LRU keeping the popular head resident
+    — not a degenerate everything-fits cache.  Asserted per phase: exact
+    serving books (accepted == cache_hit + scored + shed + deadline +
+    failed) and zero post-warmup recompiles (a hit never enters a
+    bucket).  The pre-registered heavy-flagship bar is >= 3x effective
+    req/s at s=1.1; auto (<=0) asserts strict ordering on shared-core
+    boxes where the colocated load generator caps the ratio."""
+    s = args.zipf
+    n = args.zipf_clips
+    cap = args.zipf_cache_entries
+    if cap >= n:
+        raise SystemExit(f"--zipf-cache-entries {cap} must be < "
+                         f"--zipf-clips {n} (an everything-fits cache "
+                         f"measures nothing)")
+    bar = args.zipf_bar if args.zipf_bar > 0 else 1.05
+    concurrency = max(int(x) for x in args.concurrency.split(","))
+    jpegs = make_jpegs(n, args.src_size, seed=17)
+    pop = zipf_popularity(n, s)
+    _log(f"zipf phase: s={s}, {n} distinct clips, cache capacity {cap} "
+         f"(top-{cap} popularity mass {pop[:cap].sum():.0%}), "
+         f"concurrency {concurrency}")
+    results: Dict[str, dict] = {}
+    for mode in ("off", "on"):
+        extra = [] if mode == "off" else \
+            ["--cache-entries", str(cap)]
+        proc, netloc = spawn_server(args, extra=extra)
+        try:
+            wait_ready(netloc)
+            m0 = scrape_metrics(netloc)
+            compiles0 = m0.get("dfd_serving_compiles_total", 0)
+            backend0 = m0.get("dfd_serving_backend_compiles_total", 0)
+            _log(f"zipf closed loop [cache {mode}]: {args.duration:.0f}s "
+                 f"(+{args.warmup:.0f}s warmup)")
+            r = run_load(netloc, jpegs, concurrency, args.duration,
+                         args.warmup, retry_cap_s=args.retry_cap,
+                         popularity=pop)
+            m1 = _drain_serving_books(netloc)
+            recompiles = ((m1.get("dfd_serving_compiles_total", 0) -
+                           compiles0) +
+                          (m1.get("dfd_serving_backend_compiles_total",
+                                  0) - backend0))
+            if recompiles:
+                raise AssertionError(
+                    f"[cache {mode}] {recompiles:+.0f} recompiles during "
+                    f"the zipf phase (must be zero)")
+            r["books"] = {k: m1.get(f"dfd_serving_{k}_total", 0)
+                          for k in ("accepted", "cache_hit", "scored",
+                                    "shed", "deadline", "failed")}
+            acc = max(1.0, r["books"]["accepted"])
+            r["hit_rate"] = r["books"]["cache_hit"] / acc
+            # uncontended sequential probe of the most-popular clip:
+            # a guaranteed hit on the cache-on server, a fresh score on
+            # the cache-off one (the direct hit-vs-miss latency read)
+            r["probe_p50"] = _sequential_p50_ms(netloc, jpegs[0])
+            _log(f"  -> {r['rps']:.1f} req/s, p50 {r['p50']:.1f} ms, "
+                 f"hit rate {r['hit_rate']:.0%}, sequential probe "
+                 f"{r['probe_p50']:.2f} ms, statuses {r['statuses']}, "
+                 f"books {r['books']}")
+            results[mode] = r
+        finally:
+            _terminate_proc(proc)
+    ratio = results["on"]["rps"] / max(1e-9, results["off"]["rps"])
+    _log(f"zipf s={s}: cache-on {results['on']['rps']:.1f} vs cache-off "
+         f"{results['off']['rps']:.1f} req/s = {ratio:.2f}x (bar "
+         f"{bar:.2f}x); hit probe {results['on']['probe_p50']:.2f} ms "
+         f"vs miss probe {results['off']['probe_p50']:.2f} ms")
+    if ratio < bar:
+        raise AssertionError(
+            f"zipf bar missed: cache-on is {ratio:.2f}x cache-off "
+            f"effective req/s, bar is {bar:.2f}x")
+
+    lines = []
+    lines.append(
+        f"**Verdict cache (ISSUE 17)** — closed-loop Zipf(s={s}) viral "
+        f"traffic over {n} distinct clips, {concurrency} keep-alive "
+        f"clients, {args.duration:.0f}s measured per phase, cache "
+        f"capacity {cap} entries (top-{cap} popularity mass "
+        f"{pop[:cap].sum():.0%} — the LRU must keep the viral head "
+        f"resident, nothing fits whole).  Exact serving books and zero "
+        f"post-warmup recompiles asserted both phases; same seeded "
+        f"request schedule both phases.")
+    lines.append("")
+    lines.append("| verdict cache | effective req/s | vs off | p50 (ms) "
+                 "| p95 (ms) | hit rate | sequential probe (ms) | books "
+                 "(acc=hit+scored+shed+ddl+fail) |")
+    lines.append("|---|---|---|---|---|---|---|---|")
+    for mode in ("off", "on"):
+        r = results[mode]
+        b = r["books"]
+        bk = (f"{b['accepted']:.0f}={b['cache_hit']:.0f}+"
+              f"{b['scored']:.0f}+{b['shed']:.0f}+{b['deadline']:.0f}+"
+              f"{b['failed']:.0f}")
+        rel = f"{r['rps'] / max(1e-9, results['off']['rps']):.2f}×"
+        lines.append(f"| {mode} | {r['rps']:.1f} | {rel} | "
+                     f"{r['p50']:.1f} | {r['p95']:.1f} | "
+                     f"{r['hit_rate']:.0%} | {r['probe_p50']:.2f} | "
+                     f"{bk} |")
+    lines.append("")
+    lines.append(
+        f"The sequential probe re-scores the single most-viral clip "
+        f"uncontended: {results['on']['probe_p50']:.2f} ms served from "
+        f"the cache vs {results['off']['probe_p50']:.2f} ms through the "
+        f"model — a hit costs decode+canonicalize+hash only, never a "
+        f"bucket slot.")
+    return lines
 
 
 # ---------------------------------------------------------------------------
@@ -679,13 +864,15 @@ def wait_fleet_ready(router_netloc: str, n: int,
 
 def assert_router_books(m: Dict[str, float]) -> None:
     routed = m.get("dfd_router_routed_total", 0)
-    resolved = (m.get("dfd_router_forwarded_total", 0) +
+    resolved = (m.get("dfd_router_cache_hit_total", 0) +
+                m.get("dfd_router_forwarded_total", 0) +
                 m.get("dfd_router_migrated_total", 0) +
                 m.get("dfd_router_shed_total", 0) +
                 m.get("dfd_router_failed_total", 0))
     if routed != resolved:
         raise AssertionError(
             f"router books do not balance: routed {routed:.0f} != "
+            f"cache_hit {m.get('dfd_router_cache_hit_total', 0):.0f} + "
             f"forwarded {m.get('dfd_router_forwarded_total', 0):.0f} + "
             f"migrated {m.get('dfd_router_migrated_total', 0):.0f} + "
             f"shed {m.get('dfd_router_shed_total', 0):.0f} + "
@@ -726,7 +913,8 @@ def run_fleet_phase(args, jpegs: List[bytes], n: int,
         while time.monotonic() < deadline:
             rm = scrape_metrics(router_netloc)
             routed = rm.get("dfd_router_routed_total", 0)
-            resolved = (rm.get("dfd_router_forwarded_total", 0) +
+            resolved = (rm.get("dfd_router_cache_hit_total", 0) +
+                        rm.get("dfd_router_forwarded_total", 0) +
                         rm.get("dfd_router_migrated_total", 0) +
                         rm.get("dfd_router_shed_total", 0) +
                         rm.get("dfd_router_failed_total", 0))
@@ -904,6 +1092,7 @@ def run_relay_ceiling(args) -> List[str]:
                 while time.monotonic() < deadline:
                     rm = scrape_metrics(router_netloc)
                     if rm.get("dfd_router_routed_total", 0) == (
+                            rm.get("dfd_router_cache_hit_total", 0) +
                             rm.get("dfd_router_forwarded_total", 0) +
                             rm.get("dfd_router_migrated_total", 0) +
                             rm.get("dfd_router_shed_total", 0) +
@@ -1075,6 +1264,24 @@ def main(argv=None) -> int:
                          "3s per plane (concurrency stays >=8 — below "
                          "the epoll batching regime the comparison "
                          "measures latency, not relay cost)")
+    ap.add_argument("--zipf", type=float, default=0.0,
+                    help="run ONLY the verdict-cache phase (ISSUE 17): "
+                         "closed-loop Zipf(s) popularity over "
+                         "--zipf-clips distinct clips, cache-off vs "
+                         "cache-on at the max --concurrency, exact "
+                         "books + zero-recompile asserts (e.g. "
+                         "--zipf 1.1)")
+    ap.add_argument("--zipf-clips", type=int, default=256,
+                    help="distinct synthetic clips in the zipf phase "
+                         "(must exceed the cache capacity)")
+    ap.add_argument("--zipf-cache-entries", type=int, default=64,
+                    help="verdict-cache capacity for the cache-on zipf "
+                         "phase (deliberately < --zipf-clips)")
+    ap.add_argument("--zipf-bar", type=float, default=-1.0,
+                    help="minimum cache-on/cache-off effective req/s "
+                         "ratio; <=0 = auto ordering tripwire (1.05; "
+                         "the pre-registered heavy-flagship bar at "
+                         "s=1.1 is 3.0)")
     ap.add_argument("--traffic-mix", type=float, default=0.8,
                     help="fraction of bench traffic the calibrated "
                          "suspect band lets the student clear (the rest "
@@ -1090,6 +1297,18 @@ def main(argv=None) -> int:
         if args.smoke:
             args.relay_duration = min(args.relay_duration, 3.0)
         table = "\n".join(run_relay_ceiling(args))
+        print(table)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(table + "\n")
+            _log(f"wrote {args.out}")
+        return 0
+
+    if args.zipf > 0:
+        if args.smoke:
+            args.duration = min(args.duration, 4.0)
+            args.warmup = min(args.warmup, 1.0)
+        table = "\n".join(run_zipf_phase(args))
         print(table)
         if args.out:
             with open(args.out, "w") as f:

@@ -173,8 +173,8 @@ def run_backfill_scenario(fault: str, cmd: list, expect: int,
               f"{books}")
         return 1
     print(f"[chaos] books balanced: {books['manifest_clips']} manifest "
-          f"== {books['scored']} scored + {books['failed']} failed",
-          flush=True)
+          f"== {books['scored']} scored + {books['failed']} failed "
+          f"+ {books['skipped_dup']} skipped_dup", flush=True)
     # the unkilled reference: same command, pristine out dir (handle
     # both `--out DIR` and `--out=DIR` — a missed rewrite would compare
     # the killed run's verdicts against THEMSELVES and pass vacuously)

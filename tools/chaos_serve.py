@@ -8,9 +8,11 @@ this harness proves the SERVING one: it spawns a live
 armed, drives it with real HTTP load, watches the fault fire in
 /metrics, and asserts the recovery invariants:
 
-* **books balance** — ``accepted == scored + shed + deadline + failed``
-  from a post-drain /metrics scrape, exactly: no request is ever lost
-  or double-counted through a fault;
+* **books balance** — ``accepted == cache_hit + scored + shed +
+  deadline + failed`` from a post-drain /metrics scrape, exactly: no
+  request is ever lost or double-counted through a fault (with
+  ``--cache-entries`` the serve scenarios run the verdict cache live,
+  so hits flow through the fault window too);
 * **zero post-recovery recompiles** — ``backend_compiles_total`` (jax's
   own monitoring hook) does not move across fault + recovery: re-warms
   execute existing bucket executables;
@@ -115,6 +117,11 @@ def _spawn_serve(args, port: int, chaos: str,
            "--batch-deadline-ms", "5", "--max-queue", "64",
            "--watchdog-timeout-s", str(args.watchdog_timeout_s),
            "--breaker-threshold", str(args.breaker_threshold)]
+    if getattr(args, "cache_entries", 0):
+        # verdict cache live through the fault (ISSUE 17): the poster
+        # cycles few distinct jpegs, so hits flow during the fault
+        # window and the books identity is asserted WITH its cache term
+        cmd += ["--cache-entries", str(args.cache_entries)]
     if args.models:
         # two-model mode (ISSUE 14): every serve scenario runs with the
         # extra model(s) loaded — recovery re-warms BOTH models' buckets,
@@ -221,13 +228,14 @@ def _drive_until_recovered(netloc: str, jpegs: List[bytes],
 
 
 def _assert_books_balance(netloc: str, settle_s: float = 2.0) -> dict:
-    """Post-drain scrape: accepted == scored + shed + deadline + failed,
-    exactly."""
+    """Post-drain scrape: accepted == cache_hit + scored + shed +
+    deadline + failed, exactly."""
     deadline = time.monotonic() + 30.0
     while True:
         m = scrape_metrics(netloc)
         acc = m.get("dfd_serving_accepted_total", 0)
-        resolved = (m.get("dfd_serving_scored_total", 0) +
+        resolved = (m.get("dfd_serving_cache_hit_total", 0) +
+                    m.get("dfd_serving_scored_total", 0) +
                     m.get("dfd_serving_shed_total", 0) +
                     m.get("dfd_serving_deadline_total", 0) +
                     m.get("dfd_serving_failed_total", 0))
@@ -236,12 +244,16 @@ def _assert_books_balance(netloc: str, settle_s: float = 2.0) -> dict:
         time.sleep(settle_s)   # something still in flight: let it drain
     if acc != resolved:
         raise AssertionError(
-            f"books do not balance: accepted {acc:.0f} != scored "
+            f"books do not balance: accepted {acc:.0f} != cache_hit "
+            f"{m.get('dfd_serving_cache_hit_total', 0):.0f} + scored "
             f"{m.get('dfd_serving_scored_total', 0):.0f} + shed "
             f"{m.get('dfd_serving_shed_total', 0):.0f} + deadline "
             f"{m.get('dfd_serving_deadline_total', 0):.0f} + failed "
             f"{m.get('dfd_serving_failed_total', 0):.0f}")
-    _log(f"books balance: accepted {acc:.0f} == resolved {resolved:.0f}")
+    _log(f"books balance: accepted {acc:.0f} == cache_hit "
+         f"{m.get('dfd_serving_cache_hit_total', 0):.0f} + "
+         f"{resolved - m.get('dfd_serving_cache_hit_total', 0):.0f} "
+         f"scored/shed/deadline/failed")
     tri = m.get("dfd_serving_cascade_triaged_total", 0)
     clr = m.get("dfd_serving_cascade_cleared_total", 0)
     esc = m.get("dfd_serving_cascade_escalated_total", 0)
@@ -848,6 +860,11 @@ def main(argv=None) -> int:
                     help="max seconds from fault to next 200")
     ap.add_argument("--watchdog-timeout-s", type=float, default=2.0)
     ap.add_argument("--breaker-threshold", type=int, default=5)
+    ap.add_argument("--cache-entries", type=int, default=0,
+                    help="run the serve scenarios with the verdict "
+                         "cache enabled at this capacity (ISSUE 17): "
+                         "the books identity is then asserted with a "
+                         "live cache_hit term through every fault")
     ap.add_argument("--ready-timeout-s", type=float, default=900.0)
     ap.add_argument("--data-plane", default="evloop",
                     choices=["evloop", "threads"],

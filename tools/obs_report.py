@@ -121,7 +121,8 @@ def summarize_backfill(path, metrics, events) -> None:
         verdict = "BALANCED" if b.get("balanced") else (
             "incomplete" if not b.get("complete") else "IMBALANCED")
         print(f"\nbooks: {b.get('manifest_clips')} manifest == "
-              f"{b.get('scored')} scored + {b.get('failed')} failed — "
+              f"{b.get('scored')} scored + {b.get('failed')} failed "
+              f"+ {b.get('skipped_dup', 0)} skipped_dup — "
               f"{verdict} ({b.get('shards_done')}/"
               f"{b.get('shards_total')} shards done); this worker "
               f"{end.get('clips_this_proc')} clips @ "
