@@ -950,6 +950,46 @@ class RouterConfig:
     edge_cache_entries: int = 0
     edge_cache_ttl_s: float = 2.0
 
+    # --- autoscaling (fleet/autoscaler.py, ISSUE 18) ---
+    # the SLO-driven control loop: sample the fleet every
+    # --autoscale-interval-s, scale up when the router p99 / shed rate /
+    # per-replica depth breach for --autoscale-up-samples consecutive
+    # ticks, scale in (drain-first, lossless) after
+    # --autoscale-down-samples idle ticks; decisions are deterministic
+    # from the recorded sample trace (--autoscale-trace + the golden
+    # replay test pin it)
+    autoscale: bool = False
+    slo_p99_ms: float = 250.0            # the breach line
+    min_replicas: int = 1                # hard floor (dead children
+    # re-spawn to it even with no load)
+    max_replicas: int = 4                # capacity slots shared with
+    # the backfill tenant
+    autoscale_interval_s: float = 1.0
+    autoscale_up_samples: int = 2
+    autoscale_down_samples: int = 5
+    autoscale_up_cooldown_s: float = 5.0
+    autoscale_down_cooldown_s: float = 15.0
+    autoscale_shed_high: float = 0.01    # shed fraction breach line
+    autoscale_depth_high: float = 8.0    # per-replica depth breach line
+    autoscale_depth_low: float = 1.0     # per-replica depth idle line
+    autoscale_trace: str = ""            # JSONL decision trace path
+    # (sample + decision per tick; replayable via
+    # fleet.autoscaler.replay_trace)
+    spawn_grace_s: float = 900.0         # a spawned child is *warming*,
+    # not down, until it binds its port or this window expires
+    settle_timeout_s: float = 20.0       # scale-in: bounded wait for a
+    # drained replica's inflight to reach zero before terminate
+
+    # --- backfill tenant (ISSUE 18): idle capacity runs backfill ---
+    backfill_tenant: str = ""            # manifest path (enables the
+    # tenant: idle capacity slots run runners/backfill.py workers that
+    # yield on a traffic spike via SIGTERM -> exit-75 lease release)
+    backfill_out: str = ""               # the tenant's shared run dir
+    backfill_args: str = ""              # extra CLI for every tenant
+    # worker (shlex-split), e.g. "--data-packed ... --model ..."
+    backfill_max_workers: int = 0        # cap (0 = all idle slots)
+    backfill_yield_timeout_s: float = 30.0
+
     # ------------------------------------------------------------------
     def __post_init__(self):
         if self.spawn_runner not in ("serve", "stream"):
@@ -991,6 +1031,37 @@ class RouterConfig:
         if float(self.retry_jitter_s) < 0:
             raise ValueError(f"--retry-jitter-s must be >= 0, got "
                              f"{self.retry_jitter_s}")
+        if int(self.min_replicas) < 1:
+            raise ValueError(f"--min-replicas must be >= 1, got "
+                             f"{self.min_replicas}")
+        if int(self.max_replicas) < int(self.min_replicas):
+            raise ValueError(
+                f"--max-replicas ({self.max_replicas}) must be >= "
+                f"--min-replicas ({self.min_replicas})")
+        if int(self.autoscale_up_samples) < 1 or \
+                int(self.autoscale_down_samples) < 1:
+            raise ValueError("--autoscale-up-samples / "
+                             "--autoscale-down-samples must be >= 1")
+        if float(self.autoscale_depth_low) > \
+                float(self.autoscale_depth_high):
+            raise ValueError("--autoscale-depth-low must be <= "
+                             "--autoscale-depth-high (the hysteresis "
+                             "dead band)")
+        if int(self.backfill_max_workers) < 0:
+            raise ValueError(f"--backfill-max-workers must be >= 0, "
+                             f"got {self.backfill_max_workers}")
+        for name in ("slo_p99_ms", "autoscale_interval_s",
+                     "spawn_grace_s", "settle_timeout_s",
+                     "backfill_yield_timeout_s"):
+            if float(getattr(self, name)) <= 0:
+                raise ValueError(f"--{name.replace('_', '-')} must be "
+                                 f"> 0, got {getattr(self, name)}")
+        for name in ("autoscale_up_cooldown_s",
+                     "autoscale_down_cooldown_s",
+                     "autoscale_shed_high", "autoscale_depth_low"):
+            if float(getattr(self, name)) < 0:
+                raise ValueError(f"--{name.replace('_', '-')} must be "
+                                 f">= 0, got {getattr(self, name)}")
 
     def replica_urls(self) -> List[str]:
         return [u.strip() for u in str(self.replicas).split(",")
@@ -1002,6 +1073,12 @@ class RouterConfig:
         if not self.replica_urls() and int(self.spawn) < 1:
             raise ValueError("give the router a fleet: --replicas "
                              "url[,url...] and/or --spawn N")
+        if self.backfill_tenant and not self.autoscale:
+            raise ValueError("--backfill-tenant needs --autoscale (the "
+                             "control loop is the tenant's scheduler)")
+        if self.backfill_tenant and not self.backfill_out:
+            raise ValueError("--backfill-tenant needs --backfill-out "
+                             "(the tenant's shared run dir)")
         return self
 
     # ------------------------------------------------------------------

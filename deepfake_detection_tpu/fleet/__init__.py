@@ -30,12 +30,20 @@ _LAZY = {
     "free_port": "controller",
     "http_request": "controller",
     "parse_exposition": "controller",
+    "retire_replica": "controller",
     "RouterServer": "router",
     "make_router_server": "router",
     "drain_replica": "migrate",
     "undrain_replica": "migrate",
     "migrate_stream": "migrate",
     "list_streams": "migrate",
+    "Autoscaler": "autoscaler",
+    "BackfillTenant": "autoscaler",
+    "FleetSample": "autoscaler",
+    "FleetSampler": "autoscaler",
+    "PolicyKnobs": "autoscaler",
+    "ScalePolicy": "autoscaler",
+    "replay_trace": "autoscaler",
 }
 
 __all__ = sorted(_LAZY)

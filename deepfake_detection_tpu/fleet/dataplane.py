@@ -67,8 +67,8 @@ from .metrics import RouterMetrics
 from .registry import Registry, Replica
 from .router import (FORWARD_HEADER_EXCLUDES, _MAX_BODY, _REPLICA_PATH,
                      _STREAM_PATH, EdgeCache, aggregate_metrics_text,
-                     ensure_stream_id, merged_streams, readyz_document,
-                     replica_operation)
+                     autoscaler_document, ensure_stream_id,
+                     merged_streams, readyz_document, replica_operation)
 
 _logger = logging.getLogger(__name__)
 
@@ -748,6 +748,11 @@ class _Loop:
                 self._json(c, 200, {r.id: r.summary()
                                     for r in self.registry.all()})
                 return self._finish_response(c)
+            if path == "/autoscaler":
+                status, body = autoscaler_document(
+                    getattr(self.server, "autoscaler", None))
+                self._respond(c, status, body)
+                return self._finish_response(c)
             if path == "/streams":
                 srv = self.server
                 self._control(c, lambda: (200, merged_streams(
@@ -1368,6 +1373,9 @@ class EvLoopRouterServer:
         self._shed_rng = random.Random(0x0F1EE7)
         self._shed_rng_lock = threading.Lock()
         self._drain_lock = threading.Lock()
+        #: the control loop (ISSUE 18), attached by the runner when
+        #: --autoscale is set; serves GET /autoscaler on both planes
+        self.autoscaler = None
         self._stop = threading.Event()
         self._started = threading.Event()
         self._threads: List[threading.Thread] = []

@@ -84,6 +84,19 @@ class RouterMetrics:
         self.migration_aborts_total = _Counter()   # restore-on-target
         # failed; the stream was restored back on its source (or, if even
         # that failed, dumped to disk — never silently lost)
+        # replica lifecycle books (ISSUE 18): every spawned child resolves
+        # exactly once as retired (drain-first, clean terminate) or killed
+        # (the escalation fired / the child died under us)
+        self.replicas_spawned_total = _Counter()
+        self.replicas_retired_total = _Counter()
+        self.replicas_killed_total = _Counter()
+        # autoscaler decision books (ISSUE 18): acted scale decisions
+        self.autoscale_up_total = _Counter()
+        self.autoscale_down_total = _Counter()
+        # backfill tenant books (ISSUE 18): idle-capacity workers
+        self.backfill_workers_spawned_total = _Counter()
+        self.backfill_yields_total = _Counter()    # workers yielded at a
+        # traffic spike (SIGTERM -> exit-75 lease release)
         # per-replica forward counts: (replica,) -> Counter
         self.replica_forwarded: Dict[str, _Counter] = {}
         self._replica_lock = threading.Lock()
@@ -91,7 +104,11 @@ class RouterMetrics:
         self.replicas = 0            # gauges, written by the scraper
         self.healthy_replicas = 0
         self.ready_replicas = 0
+        self.warming_replicas = 0
         self.draining_replicas = 0
+        self.autoscale_target_replicas = 0   # gauge, written by the
+        # autoscaler (its current desired fleet size)
+        self.backfill_workers = 0    # gauge, written by the tenant
 
     # ------------------------------------------------------------------
     def count_request(self, status: int) -> None:
@@ -113,6 +130,7 @@ class RouterMetrics:
         self.replicas = counts["replicas"]
         self.healthy_replicas = counts["healthy"]
         self.ready_replicas = counts["ready"]
+        self.warming_replicas = counts.get("warming", 0)
         self.draining_replicas = counts["draining"]
         self.ready = counts["eligible"] > 0
 
@@ -180,6 +198,27 @@ class RouterMetrics:
                 "(target restore failed; the session was restored back "
                 "on its source or dumped to disk — never silently lost)",
                 self.migration_aborts_total.value)
+        counter("replicas_spawned_total", "Replica children spawned "
+                "(launch + autoscaler scale-up)",
+                self.replicas_spawned_total.value)
+        counter("replicas_retired_total", "Replicas retired cleanly "
+                "(drain-first: migrate -> settle -> terminate)",
+                self.replicas_retired_total.value)
+        counter("replicas_killed_total", "Replica stops that escalated "
+                "to SIGKILL (or children that died under the "
+                "controller)", self.replicas_killed_total.value)
+        counter("autoscale_up_total", "Acted scale-up decisions "
+                "(SLO breach held through the hysteresis window)",
+                self.autoscale_up_total.value)
+        counter("autoscale_down_total", "Acted scale-in decisions "
+                "(idle held through the hysteresis window; drain-first)",
+                self.autoscale_down_total.value)
+        counter("backfill_workers_spawned_total", "Backfill tenant "
+                "workers launched onto idle capacity",
+                self.backfill_workers_spawned_total.value)
+        counter("backfill_yields_total", "Backfill tenant workers "
+                "yielded at a traffic spike (SIGTERM -> exit-75 lease "
+                "release)", self.backfill_yields_total.value)
         doc.header("replica_forwarded_total",
                    "Requests forwarded per replica", "counter")
         with self._replica_lock:
@@ -196,8 +235,17 @@ class RouterMetrics:
               self.healthy_replicas)
         gauge("ready_replicas", "Replicas healthy AND /readyz-ready",
               self.ready_replicas)
+        gauge("warming_replicas", "Replicas warming a cold model "
+              "(parseable 503 /readyz, or a spawned child inside its "
+              "startup grace) — capacity in flight, NOT down",
+              self.warming_replicas)
         gauge("draining_replicas", "Replicas draining (no new traffic)",
               self.draining_replicas)
+        gauge("autoscale_target_replicas", "The autoscaler's current "
+              "desired fleet size (0 while autoscaling is off)",
+              self.autoscale_target_replicas)
+        gauge("backfill_workers", "Live backfill tenant workers on "
+              "idle capacity", self.backfill_workers)
         for stage in STAGES:
             doc.histogram("latency_seconds", "Router request latency "
                           "(upstream = replica round trip, total = "

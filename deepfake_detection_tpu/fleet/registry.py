@@ -112,17 +112,21 @@ class Replica:
     """One backend's routing state (mutated by the health scraper and
     the router under the registry lock)."""
 
-    __slots__ = ("id", "netloc", "healthy", "ready", "draining",
-                 "breaker_state", "queue_depth", "inflight",
+    __slots__ = ("id", "netloc", "healthy", "ready", "warming",
+                 "draining", "breaker_state", "queue_depth", "inflight",
                  "router_inflight", "backoff_until",
                  "consecutive_failures", "exposition", "readiness",
-                 "last_scrape_t", "process")
+                 "last_scrape_t", "process", "born_t", "ever_up")
 
     def __init__(self, url: str, process=None):
         self.netloc = normalize_netloc(url)
         self.id = self.netloc
         self.healthy = False         # scrape reaches the process
         self.ready = False           # /readyz said 200
+        self.warming = False         # cold model warming (parseable 503
+        # /readyz, or a just-spawned child whose port is not bound yet):
+        # NOT down — the autoscaler must never retire a replica it just
+        # spawned, and must count it toward capacity in flight
         self.draining = False        # operator drain: no new traffic
         self.breaker_state = 0       # scraped dfd_serving_breaker_state
         self.queue_depth = 0         # scraped dfd_serving_queue_depth
@@ -134,6 +138,11 @@ class Replica:
         self.readiness: Optional[dict] = None   # last /readyz JSON detail
         self.last_scrape_t = 0.0
         self.process = process       # controller-spawned child (or None)
+        self.born_t = time.monotonic()          # registration time: the
+        # scraper's spawn-grace window is measured from here
+        self.ever_up = False         # a scrape has succeeded at least
+        # once (a replica that WAS up and stops answering is down, not
+        # warming — the grace window only shields cold starts)
 
     def depth(self) -> int:
         """Load signal for least-depth routing: the replica's own queue
@@ -151,6 +160,7 @@ class Replica:
             "id": self.id,
             "healthy": self.healthy,
             "ready": self.ready,
+            "warming": self.warming,
             "draining": self.draining,
             "eligible": self.eligible(),
             "breaker_state": self.breaker_state,
@@ -356,6 +366,7 @@ class Registry:
             "replicas": len(reps),
             "healthy": sum(r.healthy for r in reps),
             "ready": sum(r.healthy and r.ready for r in reps),
+            "warming": sum(r.warming for r in reps),
             "draining": sum(r.draining for r in reps),
             "eligible": sum(r.eligible(now) for r in reps),
         }

@@ -71,7 +71,8 @@ _logger = logging.getLogger(__name__)
 __all__ = ["RouterServer", "make_router_server", "EdgeCache",
            "FORWARD_HEADER_EXCLUDES", "readyz_document",
            "aggregate_metrics_text", "merged_streams",
-           "replica_operation", "ensure_stream_id"]
+           "replica_operation", "ensure_stream_id",
+           "autoscaler_document"]
 
 _MAX_BODY = 64 * 1024 * 1024          # one frame chunk, not one image
 _STREAM_PATH = re.compile(
@@ -153,6 +154,19 @@ def replica_operation(registry: Registry, metrics: RouterMetrics,
         with drain_lock:
             return 200, undrain_replica(registry, metrics, replica_id)
     return 404, {"error": "POST /replicas/<id>/drain or /undrain"}
+
+
+def autoscaler_document(autoscaler) -> Tuple[int, bytes]:
+    """(status, body) of ``GET /autoscaler`` — shared by both data
+    planes.  404 while autoscaling is off (the runner attaches the
+    autoscaler to the server object when ``--autoscale`` is set)."""
+    if autoscaler is None:
+        return 404, (json.dumps({"enabled": False,
+                                 "error": "autoscaler disabled "
+                                          "(--autoscale)"},
+                                sort_keys=True) + "\n").encode()
+    return 200, (json.dumps(autoscaler.status(), sort_keys=True)
+                 + "\n").encode()
 
 
 def ensure_stream_id(body: bytes) -> Tuple[Optional[str], bytes]:
@@ -402,6 +416,9 @@ class RouterServer(ThreadingHTTPServer):
         self._shed_rng_lock = threading.Lock()
         #: serializes drain/undrain (a drain mid-drain would double-move)
         self._drain_lock = threading.Lock()
+        #: the control loop (ISSUE 18), attached by the runner when
+        #: --autoscale is set; serves GET /autoscaler on both planes
+        self.autoscaler = None
 
     def shed_retry_after(self) -> float:
         """Router-level shed Retry-After: base + bounded uniform jitter
@@ -631,6 +648,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
         elif path == "/replicas":
             self._json(200, {r.id: r.summary()
                              for r in srv.registry.all()})
+        elif path == "/autoscaler":
+            status, body = autoscaler_document(
+                getattr(srv, "autoscaler", None))
+            self._respond(status, body)
         elif path == "/streams":
             self._json(200, merged_streams(srv.registry,
                                            srv.upstream_timeout_s))

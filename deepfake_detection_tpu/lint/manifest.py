@@ -77,6 +77,9 @@ JAX_FREE_MODULES = (
     "deepfake_detection_tpu.fleet.migrate",
     "deepfake_detection_tpu.fleet.router",
     "deepfake_detection_tpu.fleet.dataplane",
+    # the ISSUE 18 control loop: SLO autoscaler + backfill tenant glue
+    # run in the router process (decisions must never wait on jax)
+    "deepfake_detection_tpu.fleet.autoscaler",
     "deepfake_detection_tpu.runners.router",
     "tools.pack_dataset",
     "tools.obs_report",

@@ -57,14 +57,17 @@ def build_router(cfg):
         child = ReplicaProcess(cfg.spawn_runner, free_port(),
                                cfg.replica_args)
         spawned.append(child)
-        registry.add(child.netloc, process=child)
+        r = registry.add(child.netloc, process=child)
+        r.warming = True              # cold start, not down
     for url in cfg.replica_urls():
         registry.add(url)
     metrics = RouterMetrics()
+    metrics.replicas_spawned_total.inc(len(spawned))
     scraper = HealthScraper(registry, metrics,
                             interval_s=cfg.scrape_interval_s,
                             fail_after=cfg.health_fail_after,
-                            timeout_s=cfg.scrape_timeout_s)
+                            timeout_s=cfg.scrape_timeout_s,
+                            spawn_grace_s=cfg.spawn_grace_s)
     server = make_router_server(
         cfg.host, cfg.port, registry, metrics, scraper,
         data_plane=cfg.data_plane,
@@ -83,6 +86,42 @@ def build_router(cfg):
         _logger.info("edge verdict cache: %d entries, ttl %.1fs "
                      "(keyed on the fleet weights-epoch)",
                      cfg.edge_cache_entries, cfg.edge_cache_ttl_s)
+    if cfg.autoscale:
+        from ..fleet.autoscaler import (Autoscaler, BackfillTenant,
+                                        PolicyKnobs)
+        tenant = None
+        if cfg.backfill_tenant:
+            tenant = BackfillTenant(
+                manifest=cfg.backfill_tenant, out=cfg.backfill_out,
+                extra_args=cfg.backfill_args,
+                max_workers=cfg.backfill_max_workers, metrics=metrics,
+                yield_timeout_s=cfg.backfill_yield_timeout_s)
+        server.autoscaler = Autoscaler(
+            registry, metrics, scraper,
+            knobs=PolicyKnobs(
+                slo_p99_ms=cfg.slo_p99_ms,
+                min_replicas=cfg.min_replicas,
+                max_replicas=cfg.max_replicas,
+                up_samples=cfg.autoscale_up_samples,
+                down_samples=cfg.autoscale_down_samples,
+                up_cooldown_s=cfg.autoscale_up_cooldown_s,
+                down_cooldown_s=cfg.autoscale_down_cooldown_s,
+                shed_high=cfg.autoscale_shed_high,
+                depth_high=cfg.autoscale_depth_high,
+                depth_low=cfg.autoscale_depth_low),
+            spawn_runner=cfg.spawn_runner,
+            replica_args=cfg.replica_args,
+            interval_s=cfg.autoscale_interval_s,
+            tenant=tenant, trace_path=cfg.autoscale_trace,
+            migrate_timeout_s=cfg.migrate_timeout_s,
+            settle_timeout_s=cfg.settle_timeout_s)
+        _logger.info(
+            "autoscaler: slo p99 %.0fms, %d..%d replicas%s%s",
+            cfg.slo_p99_ms, cfg.min_replicas, cfg.max_replicas,
+            f", backfill tenant on {cfg.backfill_tenant}"
+            if tenant is not None else "",
+            f", trace -> {cfg.autoscale_trace}"
+            if cfg.autoscale_trace else "")
     return server, spawned
 
 
@@ -97,6 +136,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     cfg = RouterConfig.from_args(argv)
     server, spawned = build_router(cfg)
     server.scraper.start()
+    if server.autoscaler is not None:
+        server.autoscaler.start()
 
     stop = threading.Event()
 
@@ -124,10 +165,20 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             stop.wait(0.5)
     finally:
         server.shutdown()
+        if server.autoscaler is not None:
+            # stops the control loop AND yields the backfill tenant's
+            # workers (SIGTERM -> exit-75 lease release)
+            server.autoscaler.stop()
         server.scraper.stop()
-        if cfg.drain_on_exit and spawned:
+        # the autoscaler may have spawned children past the launch set —
+        # the registry's process-attached replicas are the whole truth
+        children = {id(c): c for c in spawned}
+        for r in server.registry.all():
+            if r.process is not None:
+                children.setdefault(id(r.process), r.process)
+        if cfg.drain_on_exit and children:
             from ..fleet.migrate import drain_replica
-            for child in spawned:
+            for child in children.values():
                 try:
                     drain_replica(server.registry, server.metrics,
                                   child.netloc,
@@ -135,7 +186,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                 except Exception:                  # noqa: BLE001
                     _logger.exception("drain of %s on exit failed",
                                       child.netloc)
-        for child in spawned:
+        for child in children.values():
             child.stop()
         server.server_close()
         _logger.info("bye")

@@ -73,3 +73,16 @@ def test_fleet_drain_migrates_stream_bit_identically():
     finishes through the router with final status + event log
     BIT-IDENTICAL to an undrained replay and exact migration books."""
     assert chaos_serve.main(["--scenario", "replica_migrate"] + _BASE) == 0
+
+
+def test_fleet_elastic_two_tenant_books_exact_through_transitions():
+    """ISSUE 18 acceptance: the SLO autoscaler + backfill tenant driven
+    through a spike-triggered tenant yield (SIGTERM → exit-75 lease
+    release), a SIGKILL of the new warming replica (booked + respawned
+    under live load) and a drain-first scale-in, after which the tenant
+    reclaims the idle slot and runs the corpus dry.  Books stay exact
+    on BOTH tenants (routed == cache_hit + forwarded + migrated + shed
+    + failed; manifest clips == scored + failed + skipped_dup), no
+    client ever sees a failure, surviving replicas never recompile, and
+    the recorded decision trace replays bit-exactly."""
+    assert chaos_serve.main(["--scenario", "fleet_elastic"] + _BASE) == 0

@@ -26,8 +26,11 @@ import os  # noqa: E402
 _REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 
 from deepfake_detection_tpu.config import RouterConfig  # noqa: E402
+from deepfake_detection_tpu.fleet.autoscaler import (  # noqa: E402
+    EXIT_PREEMPTED, BackfillTenant, Autoscaler, Decision, FleetSample,
+    FleetSampler, PolicyKnobs, ScalePolicy, _p99_ms, replay_trace)
 from deepfake_detection_tpu.fleet.controller import (  # noqa: E402
-    HealthScraper, free_port, parse_exposition)
+    HealthScraper, free_port, parse_exposition, retire_replica)
 from deepfake_detection_tpu.fleet.metrics import (  # noqa: E402
     RouterMetrics, relabel_exposition)
 from deepfake_detection_tpu.fleet.registry import (  # noqa: E402
@@ -163,8 +166,8 @@ def test_registry_counts():
     _ready(reg.get("a:1"))
     _ready(reg.get("b:1")).draining = True
     c = reg.counts()
-    assert c == {"replicas": 3, "healthy": 2, "ready": 2, "draining": 1,
-                 "eligible": 1}
+    assert c == {"replicas": 3, "healthy": 2, "ready": 2, "warming": 0,
+                 "draining": 1, "eligible": 1}
 
 
 # ---------------------------------------------------------------------------
@@ -248,6 +251,7 @@ def test_router_config_cli_two_stage_parse():
 class _StubState:
     def __init__(self):
         self.mode = "ok"          # ok | shed | error-mid | down-ish
+        self.ready = True         # False -> parseable 503 (warming)
         self.retry_after = 7.0
         self.requests = []
         self.streams = {}         # sid -> state dict (migration stubs)
@@ -277,7 +281,12 @@ class _StubHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         path = self.path.split("?", 1)[0]
         if path == "/readyz":
-            self._r(200, {"ready": True, "models": {"m": {"warmed": True}}})
+            if self.st.ready:
+                self._r(200, {"ready": True,
+                              "models": {"m": {"warmed": True}}})
+            else:                 # a live engine warming a cold model
+                self._r(503, {"ready": False,
+                              "models": {"m": {"warmed": False}}})
         elif path == "/metrics":
             body = ("dfd_serving_queue_depth 2\n"
                     "dfd_serving_inflight 1\n"
@@ -1084,3 +1093,400 @@ def test_evloop_streamed_response_complete_to_slow_reader():
         server.server_close()
         stub.shutdown()
         stub.server_close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: the SLO autoscaler — deterministic policy, golden trace,
+# warming-vs-down scraping, drain-first retirement, the backfill tenant
+# ---------------------------------------------------------------------------
+
+import random  # noqa: E402
+from types import SimpleNamespace  # noqa: E402
+
+_FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "autoscale_trace.jsonl")
+
+#: the golden decisions: warm-up breach -> scale 1->3 (warming +
+#: cooldown holds between the ups) -> idle -> scale 3->1 (down-cooldown
+#: + a dead-band reset in the middle) -> hold at min.  Regenerating the
+#: fixture must reproduce EXACTLY this sequence or the policy changed.
+_GOLDEN_ACTIONS = (
+    ["hold", "hold", "up", "hold", "hold", "hold", "hold", "up",
+     "hold", "hold", "hold", "down", "hold", "hold", "hold", "hold",
+     "hold", "hold", "hold", "hold", "hold", "down", "hold", "hold",
+     "hold"])
+
+
+def _sample(t, ready=1, warming=0, p99=60.0, shed=0.0, depth=2.0,
+            routed=50, draining=0, breakers=0):
+    return FleetSample(t=float(t), ready=ready, warming=warming,
+                       draining=draining, routed=routed, shed_rate=shed,
+                       p99_ms=p99, depth=depth, breakers=breakers)
+
+
+_KNOBS = dict(slo_p99_ms=100.0, min_replicas=1, max_replicas=3,
+              up_samples=2, down_samples=3, up_cooldown_s=5.0,
+              down_cooldown_s=10.0, shed_high=0.01, depth_high=8.0,
+              depth_low=1.0, p99_low_frac=0.5)
+
+
+def test_autoscale_golden_trace_replay():
+    """The checked-in trace replays bit-identically AND pins the exact
+    decision sequence — any behavior drift in ScalePolicy fails here."""
+    rep = replay_trace(_FIXTURE)
+    assert rep["match"], rep["mismatches"]
+    assert rep["n"] == len(_GOLDEN_ACTIONS)
+    assert rep["recorded"] == _GOLDEN_ACTIONS
+    assert rep["replayed"] == _GOLDEN_ACTIONS
+
+
+def test_autoscale_policy_no_flap_across_thresholds():
+    """Noise straddling a band edge can never accumulate a run: the
+    dead band resets BOTH counters, so an alternating breach/neutral
+    (or idle/neutral) stream holds forever."""
+    p = ScalePolicy(PolicyKnobs(**_KNOBS))
+    for i in range(60):      # p99 bounces 150 <-> 99 around the SLO
+        d = p.decide(_sample(i, ready=2, p99=150.0 if i % 2 else 99.0))
+        assert d.action == "hold", (i, d)
+    p = ScalePolicy(PolicyKnobs(**_KNOBS))
+    for i in range(60):      # idle <-> dead band around p99_low
+        d = p.decide(_sample(i, ready=2, p99=20.0 if i % 2 else 99.0,
+                             depth=0.2))
+        assert d.action == "hold", (i, d)
+
+
+def test_autoscale_cooldown_paces_sustained_breach():
+    """Under a sustained breach the ups land exactly up_cooldown_s
+    apart (sample time, not wall clock) — never a burst."""
+    p = ScalePolicy(PolicyKnobs(**{**_KNOBS, "max_replicas": 10}))
+    ups = [t for t in range(20)
+           if p.decide(_sample(t, ready=1 + t // 5,
+                               p99=300.0)).action == "up"]
+    assert ups == [1, 6, 11, 16], ups
+
+
+def test_autoscale_warming_holds_the_next_spawn():
+    p = ScalePolicy(PolicyKnobs(**_KNOBS))
+    p.decide(_sample(0, p99=300.0))
+    assert p.decide(_sample(1, p99=300.0)).action == "up"
+    p.decide(_sample(2, p99=300.0, warming=1))   # run 1 (reset by up)
+    d = p.decide(_sample(3, p99=300.0, warming=1))   # run 2: would up,
+    assert d.action == "hold" and "warming" in d.reason   # but warming
+
+
+def test_autoscale_below_min_floor_respawns_regardless_of_load():
+    """A fleet below min (a child died) re-spawns even when the load
+    signals scream idle — one at a time, warming-aware."""
+    p = ScalePolicy(PolicyKnobs(**_KNOBS))
+    d = p.decide(_sample(0, ready=0, p99=0.0, depth=0.0, routed=0))
+    assert d.action == "up" and "below min" in d.reason
+    # warming counts toward capacity: min=2 with one warming is still
+    # below the floor, but the spawn in flight holds the next one
+    p2 = ScalePolicy(PolicyKnobs(**{**_KNOBS, "min_replicas": 2}))
+    d = p2.decide(_sample(0, ready=0, warming=1, p99=0.0, depth=0.0))
+    assert d.action == "hold" and "warming" in d.reason
+    d = p2.decide(_sample(1, ready=1, warming=0, p99=0.0, depth=0.0))
+    assert d.action == "up" and "below min" in d.reason
+    # and at-min idle never goes below the floor
+    p = ScalePolicy(PolicyKnobs(**_KNOBS))
+    for t in range(10):
+        d = p.decide(_sample(t, ready=1, p99=10.0, depth=0.1))
+        assert d.action == "hold", d
+    assert "at min" in d.reason
+
+
+def test_autoscale_breach_bands_shed_depth_breakers():
+    """Every breach signal — shed rate, queue depth, open breakers —
+    drives the same hysteresis path p99 does."""
+    for kw in ({"shed": 0.05}, {"depth": 9.0}, {"breakers": 1}):
+        p = ScalePolicy(PolicyKnobs(**_KNOBS))
+        p.decide(_sample(0, **kw))
+        d = p.decide(_sample(1, **kw))
+        assert d.action == "up", (kw, d)
+
+
+def test_autoscale_replay_equals_live_on_random_stream():
+    """decide() is a pure function of the sample sequence: a seeded
+    random walk replayed through a fresh policy is bit-identical."""
+    rng = random.Random(0xD1CE)
+    samples = [_sample(t,
+                       ready=rng.randint(1, 3),
+                       warming=rng.randint(0, 1),
+                       p99=rng.choice([10.0, 60.0, 150.0, 400.0]),
+                       shed=rng.choice([0.0, 0.0, 0.02]),
+                       depth=rng.choice([0.1, 2.0, 9.5]))
+               for t in range(300)]
+    knobs = PolicyKnobs(**_KNOBS)
+    live = ScalePolicy.replay(samples, knobs)
+    again = ScalePolicy.replay(samples, knobs)
+    assert live == again
+    assert any(d.action != "hold" for d in live)   # walk actually moves
+
+
+def test_policy_knobs_validation():
+    with pytest.raises(ValueError):
+        PolicyKnobs(min_replicas=0)
+    with pytest.raises(ValueError):
+        PolicyKnobs(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        PolicyKnobs(up_samples=0)
+    with pytest.raises(ValueError):
+        PolicyKnobs(depth_low=5.0, depth_high=2.0)
+    with pytest.raises(ValueError):
+        PolicyKnobs(p99_low_frac=1.5)
+
+
+def test_p99_from_bucket_deltas():
+    assert _p99_ms([0.1, 0.5], [0, 0, 0]) == 0.0          # no traffic
+    assert _p99_ms([0.1, 0.5], [10, 0, 0]) == 100.0       # first bucket
+    assert _p99_ms([0.1, 0.5], [0, 10, 0]) == 500.0
+    # +Inf bucket -> finite, monotone sentinel (2x last bound)
+    assert _p99_ms([0.1, 0.5], [0, 0, 10]) == 1000.0
+    # the p99 rank, not the max: 99 fast + 1 slow stays in the fast
+    # bucket; 97 fast + 3 slow does not
+    assert _p99_ms([0.1, 0.5], [99, 1, 0]) == 100.0
+    assert _p99_ms([0.1, 0.5], [97, 3, 0]) == 500.0
+
+
+def test_fleet_sampler_windows_counters_and_roundtrips():
+    reg = Registry(["a:1", "b:1", "c:1"])
+    _ready(reg.get("a:1"), depth=2)
+    _ready(reg.get("b:1"), depth=4)
+    reg.get("c:1").warming = True
+    m = RouterMetrics()
+    sampler = FleetSampler(m)
+    first = sampler.sample(reg, now=10.0)
+    assert first.routed == 0 and first.p99_ms == 0.0   # no window yet
+    assert first.ready == 2 and first.warming == 1
+    m.routed_total.inc(100)
+    m.shed_total.inc(3)
+    for _ in range(50):
+        m.latency["total"].observe(0.004)
+    s = sampler.sample(reg, now=11.0)
+    assert s.routed == 100 and s.shed_rate == 0.03
+    bound = min(b for b in m.latency["total"].bounds if b >= 0.004)
+    assert s.p99_ms == round(bound * 1000.0, 6)
+    assert s.depth == 3.0          # mean over READY replicas only
+    # trace round-trip: the JSONL record reproduces the sample exactly
+    assert FleetSample.from_record(
+        json.loads(json.dumps(s.to_record()))) == s
+    # next window is a fresh delta, not cumulative
+    s2 = sampler.sample(reg, now=12.0)
+    assert s2.routed == 0 and s2.p99_ms == 0.0
+
+
+def test_scraper_parseable_503_is_warming_not_down():
+    stub = _stub_replica()
+    stub.state.ready = False
+    try:
+        reg = Registry([f"127.0.0.1:{stub.server_address[1]}"])
+        m = RouterMetrics()
+        sc = HealthScraper(reg, m, fail_after=2)
+        r = reg.all()[0]
+        for _ in range(5):             # fail_after must not bite
+            sc.scrape_once(r)
+        assert r.healthy and not r.ready and r.warming
+        assert reg.counts()["warming"] == 1
+        assert m.replicas_down_total.value == 0
+        stub.state.ready = True        # model warmed
+        sc.scrape_once(r)
+        assert r.ready and not r.warming
+    finally:
+        stub.shutdown()
+        stub.server_close()
+
+
+def test_scraper_spawn_grace_vs_down():
+    """An unbound port is *warming* while a live child is inside its
+    spawn grace — and *down* the moment the child dies, the grace
+    expires, or a replica that WAS up stops answering."""
+    m = RouterMetrics()
+    reg = Registry([f"127.0.0.1:{free_port()}"])   # nothing listening
+    r = reg.all()[0]
+    r.process = SimpleNamespace(alive=True)
+    sc = HealthScraper(reg, m, fail_after=2, timeout_s=0.2,
+                       spawn_grace_s=900.0)
+    for _ in range(5):
+        sc.scrape_once(r)
+    assert r.warming and not r.healthy
+    assert m.replicas_down_total.value == 0
+    # child dies -> down IMMEDIATELY (no fail_after wait)
+    r.process = SimpleNamespace(alive=False)
+    sc.scrape_once(r)
+    assert not r.warming and not r.healthy
+    assert m.replicas_down_total.value == 1
+    # grace expiry: a live child that never binds eventually counts down
+    reg2 = Registry([f"127.0.0.1:{free_port()}"])
+    r2 = reg2.all()[0]
+    r2.process = SimpleNamespace(alive=True)
+    sc2 = HealthScraper(reg2, m, fail_after=2, timeout_s=0.2)
+    sc2.scrape_once(r2)                # inside grace: warming
+    assert r2.warming
+    r2.born_t -= 1000.0               # grace long since over
+    sc2.scrape_once(r2)               # fail_after bites now
+    assert not r2.warming and not r2.healthy
+    assert m.replicas_down_total.value == 2
+    # ever_up: a replica that was up gets NO grace when it goes dark
+    reg3 = Registry([f"127.0.0.1:{free_port()}"])
+    r3 = reg3.all()[0]
+    r3.process = SimpleNamespace(alive=True)
+    r3.ever_up = True
+    r3.healthy = r3.ready = True
+    sc3 = HealthScraper(reg3, m, fail_after=2, timeout_s=0.2)
+    sc3.scrape_once(r3)
+    assert not r3.warming
+    sc3.scrape_once(r3)
+    assert not r3.healthy
+
+
+def test_scrape_cadence_jitter_is_seeded_and_bounded():
+    reg = Registry()
+    sc = HealthScraper(reg, RouterMetrics(), interval_s=0.5)
+    draws = [sc._rng.uniform(0.0, sc.interval_s * 0.2)
+             for _ in range(200)]
+    assert all(0.0 <= d < 0.1 for d in draws)
+    assert len({round(d, 9) for d in draws}) > 100   # actually jittered
+
+
+def test_retire_replica_drain_first_books():
+    stub = _stub_replica()
+    netloc = f"127.0.0.1:{stub.server_address[1]}"
+    try:
+        reg = Registry([netloc])
+        m = RouterMetrics()
+        sc = HealthScraper(reg, m)
+        r = reg.all()[0]
+        sc.scrape_once(r)
+        assert r.ready
+        # the stub's canned /metrics claims queue 2 / inflight 1; this
+        # replica has genuinely nothing in flight, so clear the scraped
+        # load and let settle see it (no scraper -> no re-scrape)
+        r.inflight = r.queue_depth = 0
+        report = retire_replica(reg, m, netloc, settle_timeout_s=2.0)
+        assert report["settled"] and not report["killed"]
+        assert m.replicas_retired_total.value == 1
+        assert m.replicas_killed_total.value == 0
+        assert reg.ids() == []
+    finally:
+        stub.shutdown()
+        stub.server_close()
+    # unknown replica: an error report, no counter movement
+    out = retire_replica(reg, m, "nope:1")
+    assert "error" in out
+    assert m.replicas_retired_total.value == 1
+
+
+# a stub tenant worker: parks until SIGTERM, then honors the backfill
+# preemption contract (finish-batch -> release leases -> exit 75)
+_YIELDING_WORKER = ("import signal, sys, time\n"
+                    "signal.signal(signal.SIGTERM,"
+                    " lambda *a: sys.exit(75))\n"
+                    "time.sleep(120)\n")
+
+
+def test_backfill_tenant_leases_launches_and_yields(tmp_path):
+    m = RouterMetrics()
+    t = BackfillTenant(manifest="unused.jsonl", out=str(tmp_path),
+                       metrics=m, yield_timeout_s=10.0,
+                       worker_cmd=[sys.executable, "-u", "-c",
+                                   _YIELDING_WORKER])
+    try:
+        t.reconcile(idle_slots=2, total_slots=3)
+        assert sorted(t.workers) == ["slot-00", "slot-01"]
+        assert m.backfill_workers_spawned_total.value == 2
+        assert m.backfill_workers == 2
+        # a second tenant on the same run dir cannot double-fill slots
+        t2 = BackfillTenant(manifest="unused.jsonl", out=str(tmp_path),
+                            worker_cmd=[sys.executable, "-c", "pass"])
+        t2.reconcile(idle_slots=2, total_slots=2)
+        assert t2.workers == {}
+        # spike: serving wants one slot back -> SIGTERM -> clean 75
+        t.ensure_room(idle_slots=1)
+        assert sorted(t.workers) == ["slot-00"]
+        assert m.backfill_yields_total.value == 1
+        # slot freed for real: the other tenant can take it now
+        t2.reconcile(idle_slots=1, total_slots=2)
+        assert sorted(t2.workers) == ["slot-01"]
+        t2.stop()
+        # load drop: idle capacity returns -> the tenant grows back
+        t.reconcile(idle_slots=2, total_slots=3)
+        assert len(t.workers) == 2
+    finally:
+        t.stop()
+        assert t.workers == {}
+
+
+def test_backfill_tenant_corpus_done_stops_relaunching(tmp_path):
+    t = BackfillTenant(manifest="unused.jsonl", out=str(tmp_path),
+                       worker_cmd=[sys.executable, "-c", "pass"])
+    t.reconcile(idle_slots=1, total_slots=2)
+    assert len(t.workers) == 1
+    t.workers["slot-00"].wait(timeout=10)
+    t.reconcile(idle_slots=1, total_slots=2)   # reaps the exit-0
+    assert t.corpus_done and t.workers == {}
+    t.reconcile(idle_slots=2, total_slots=2)   # and never relaunches
+    assert t.workers == {}
+
+
+def test_autoscaler_tick_traces_and_reaps_lost_children(tmp_path):
+    trace = str(tmp_path / "trace.jsonl")
+    reg = Registry(["a:1"])
+    _ready(reg.get("a:1"))
+    m = RouterMetrics()
+    sc = HealthScraper(reg, m)
+    a = Autoscaler(reg, m, sc, knobs=PolicyKnobs(**_KNOBS),
+                   trace_path=trace)
+    for t in range(4):                  # idle at min: all holds
+        assert a.tick(now=float(t)).action == "hold"
+    assert a.ticks == 4
+    assert m.autoscale_target_replicas == 1
+    st = a.status()
+    assert st["enabled"] and st["last_action"] == "hold"
+    assert st["books"]["spawned"] == 0
+    # a corpse under the controller: deregistered + booked killed
+    dead = reg.add("b:1", process=SimpleNamespace(
+        alive=False, proc=SimpleNamespace(returncode=-9)))
+    assert dead is not None
+    a.tick(now=4.0)
+    assert reg.ids() == ["a:1"]
+    assert m.replicas_killed_total.value == 1
+    a.stop()                            # closes the trace cleanly
+    rep = replay_trace(trace)
+    assert rep["match"] and rep["n"] == 5
+
+
+def test_autoscaler_endpoint_on_both_planes(fleet):
+    status, _, body = _get_allow_error(fleet.port, "/autoscaler")
+    assert status == 404
+    assert json.loads(body)["enabled"] is False
+    fleet.server.autoscaler = SimpleNamespace(
+        status=lambda: {"enabled": True, "ticks": 7})
+    try:
+        status, _, body = _get_allow_error(fleet.port, "/autoscaler")
+        assert status == 200
+        assert json.loads(body) == {"enabled": True, "ticks": 7}
+    finally:
+        fleet.server.autoscaler = None
+
+
+def _get_allow_error(port, path):
+    try:
+        return _get(port, path)
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_router_config_autoscale_validation():
+    with pytest.raises(ValueError):
+        RouterConfig(replicas="a:1", autoscale=True, min_replicas=0)
+    with pytest.raises(ValueError):
+        RouterConfig(replicas="a:1", autoscale=True, min_replicas=3,
+                     max_replicas=2)
+    with pytest.raises(ValueError):     # tenant needs the autoscaler
+        cfg = RouterConfig(replicas="a:1", backfill_tenant="m.jsonl",
+                           backfill_out="out")
+        cfg.validate_required()
+    with pytest.raises(ValueError):     # tenant needs an out dir
+        cfg = RouterConfig(replicas="a:1", autoscale=True,
+                           backfill_tenant="m.jsonl")
+        cfg.validate_required()

@@ -373,6 +373,27 @@ def _old_router_render(self) -> str:
             "(target restore failed; the session was restored back "
             "on its source or dumped to disk — never silently lost)",
             self.migration_aborts_total.value)
+    counter("replicas_spawned_total", "Replica children spawned "
+            "(launch + autoscaler scale-up)",
+            self.replicas_spawned_total.value)
+    counter("replicas_retired_total", "Replicas retired cleanly "
+            "(drain-first: migrate -> settle -> terminate)",
+            self.replicas_retired_total.value)
+    counter("replicas_killed_total", "Replica stops that escalated "
+            "to SIGKILL (or children that died under the "
+            "controller)", self.replicas_killed_total.value)
+    counter("autoscale_up_total", "Acted scale-up decisions "
+            "(SLO breach held through the hysteresis window)",
+            self.autoscale_up_total.value)
+    counter("autoscale_down_total", "Acted scale-in decisions "
+            "(idle held through the hysteresis window; drain-first)",
+            self.autoscale_down_total.value)
+    counter("backfill_workers_spawned_total", "Backfill tenant "
+            "workers launched onto idle capacity",
+            self.backfill_workers_spawned_total.value)
+    counter("backfill_yields_total", "Backfill tenant workers "
+            "yielded at a traffic spike (SIGTERM -> exit-75 lease "
+            "release)", self.backfill_yields_total.value)
     lines.append(f"# HELP {_PREFIX}_replica_forwarded_total Requests "
                  "forwarded per replica")
     lines.append(f"# TYPE {_PREFIX}_replica_forwarded_total counter")
@@ -390,8 +411,17 @@ def _old_router_render(self) -> str:
           self.healthy_replicas)
     gauge("ready_replicas", "Replicas healthy AND /readyz-ready",
           self.ready_replicas)
+    gauge("warming_replicas", "Replicas warming a cold model "
+          "(parseable 503 /readyz, or a spawned child inside its "
+          "startup grace) — capacity in flight, NOT down",
+          self.warming_replicas)
     gauge("draining_replicas", "Replicas draining (no new traffic)",
           self.draining_replicas)
+    gauge("autoscale_target_replicas", "The autoscaler's current "
+          "desired fleet size (0 while autoscaling is off)",
+          self.autoscale_target_replicas)
+    gauge("backfill_workers", "Live backfill tenant workers on "
+          "idle capacity", self.backfill_workers)
     for stage in STAGES:
         h = self.latency[stage]
         name = f"{_PREFIX}_latency_seconds"
@@ -426,13 +456,24 @@ class TestRouterRenderer:
         m.retries_total.inc(2)
         m.drains_total.inc()
         m.streams_migrated_total.inc(3)
+        # replica lifecycle books (ISSUE 18): spawned == retired +
+        # killed + still-running (here 3 == 1 + 1 + 1)
+        m.replicas_spawned_total.inc(3)
+        m.replicas_retired_total.inc()
+        m.replicas_killed_total.inc()
+        m.autoscale_up_total.inc(2)
+        m.autoscale_down_total.inc()
+        m.backfill_workers_spawned_total.inc(2)
+        m.backfill_yields_total.inc()
+        m.backfill_workers = 1
+        m.autoscale_target_replicas = 2
         m.count_forward("127.0.0.1:8377")
         m.count_forward("127.0.0.1:8379")
         m.latency["upstream"].observe(0.004)
         m.latency["total"].observe(0.006)
         m.ready = True
         m.set_fleet_gauges({"replicas": 2, "healthy": 2, "ready": 2,
-                            "draining": 1, "eligible": 1})
+                            "warming": 1, "draining": 1, "eligible": 1})
         return m
 
     def test_router_output_byte_identical_to_mirror(self):

@@ -33,6 +33,7 @@ import socket
 import statistics
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -1182,6 +1183,239 @@ def run_relay_ceiling(args) -> List[str]:
     return lines
 
 
+# ---------------------------------------------------------------------------
+# elastic autoscale phase (ISSUE 18): spiky load, measured time-to-scale
+# ---------------------------------------------------------------------------
+
+class _ElasticPoster(threading.Thread):
+    """Closed-loop /score poster for the elastic phase: keeps posting
+    until told to stop (the spike has no fixed duration — it ends when
+    the fleet has scaled), records every status for the
+    zero-client-visible-failures assert."""
+
+    def __init__(self, netloc: str, jpegs: List[bytes],
+                 stop: threading.Event, seed: int):
+        super().__init__(daemon=True)
+        host, port = netloc.split(":")
+        self.host, self.port = host, int(port)
+        self.jpegs = jpegs
+        self.stop_ev = stop
+        self.seed = seed
+        self.statuses: Dict[int, int] = {}
+
+    def run(self) -> None:
+        conn = None
+        i = self.seed
+        while not self.stop_ev.is_set():
+            try:
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        self.host, self.port, timeout=60)
+                body = self.jpegs[i % len(self.jpegs)]
+                i += 1
+                conn.request("POST", "/score", body,
+                             {"Content-Type": "image/jpeg"})
+                resp = conn.getresponse()
+                resp.read()
+                status = resp.status
+            except OSError:
+                if conn is not None:
+                    conn.close()
+                conn = None
+                status = -1
+            self.statuses[status] = self.statuses.get(status, 0) + 1
+            if status in (429, 503):
+                self.stop_ev.wait(0.05)
+        if conn is not None:
+            conn.close()
+
+
+def _spawn_elastic_router(args, trace_path: str
+                          ) -> Tuple[subprocess.Popen, str]:
+    """Router that OWNS its fleet: --spawn 1 cold replica plus the SLO
+    autoscaler armed to grow to 2.  The breach line is per-replica
+    queue depth (deterministic under a closed-loop CPU spike, unlike a
+    wall-clock p99 line); the p99 line is parked out of reach."""
+    port = free_port()
+    replica_args = (f"--model {args.model} --image-size "
+                    f"{args.image_size} --img-num {args.img_num} "
+                    f"--buckets 1,4 --batch-deadline-ms 5 "
+                    f"--max-queue 64")
+    if args.single_thread_xla:
+        replica_args += " --single-thread-xla"
+    cmd = [sys.executable, "-m", "deepfake_detection_tpu.runners.router",
+           "--port", str(port),
+           "--spawn", "1", "--replica-args", replica_args,
+           "--data-plane", args.data_plane,
+           "--scrape-interval-s", "0.2", "--health-fail-after", "2",
+           "--autoscale", "--min-replicas", "1", "--max-replicas", "2",
+           "--autoscale-interval-s", "0.5",
+           "--slo-p99-ms", "100000",
+           "--autoscale-depth-high", "2", "--autoscale-depth-low", "1",
+           "--autoscale-up-samples", "2", "--autoscale-down-samples", "4",
+           "--autoscale-up-cooldown-s", "2",
+           "--autoscale-down-cooldown-s", "2",
+           "--autoscale-trace", trace_path]
+    env = dict(os.environ)
+    if not args.keep_env:
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+    _log("spawning elastic router: " + " ".join(cmd))
+    proc = subprocess.Popen(cmd, cwd=_REPO, env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    return proc, f"127.0.0.1:{port}"
+
+
+def _wait_metric(netloc: str, probe, what: str,
+                 timeout: float = 120.0) -> float:
+    """Poll /metrics until ``probe(m)`` is true; returns seconds waited."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        try:
+            if probe(scrape_metrics(netloc)):
+                return time.monotonic() - t0
+        except OSError:
+            pass
+        time.sleep(0.1)
+    raise TimeoutError(f"{what} not observed within {timeout}s")
+
+
+def run_elastic_phase(args) -> List[str]:
+    """ISSUE 18: the spiky load curve.  One cold replica behind the
+    autoscaling router; a closed-loop spike breaches the depth line and
+    the phase MEASURES the three transitions that define elasticity:
+
+    * spike → acted scale-up decision (``autoscale_up_total``),
+    * spike → second replica actually serving (router /readyz count —
+      includes the child's full cold start: spawn + import + compile),
+    * load off → drain-first retirement (``replicas_retired_total``).
+
+    Exact router books and a bit-exact decision-trace replay
+    (``fleet.autoscaler.replay_trace``) are asserted in the same run."""
+    jpegs = make_jpegs(16, args.src_size)
+    trace_path = os.path.join(
+        tempfile.mkdtemp(prefix="bench-elastic-"), "autoscale.jsonl")
+    proc, netloc = _spawn_elastic_router(args, trace_path)
+    stop = threading.Event()
+    posters: List[_ElasticPoster] = []
+    try:
+        t_cold0 = time.monotonic()
+        wait_fleet_ready(netloc, 1, timeout=900.0)
+        warm_s = time.monotonic() - t_cold0
+        # settle a few idle control ticks first: the scale-up timing
+        # below must start from a quiescent policy, not mid-startup
+        time.sleep(2.0)
+        m0 = scrape_metrics(netloc)
+        if m0.get("dfd_router_autoscale_up_total", 0):
+            raise AssertionError("scale-up before any load was offered")
+
+        _log(f"spike: {args.elastic_posters} closed-loop posters")
+        t_spike = time.monotonic()
+        posters = [_ElasticPoster(netloc, jpegs, stop, seed=i)
+                   for i in range(args.elastic_posters)]
+        for p in posters:
+            p.start()
+        decision_s = _wait_metric(
+            netloc,
+            lambda m: m.get("dfd_router_autoscale_up_total", 0) >= 1,
+            "scale-up decision", timeout=60.0)
+        _log(f"scale-up decided {decision_s:.2f}s after the spike")
+        wait_fleet_ready(netloc, 2, timeout=900.0)
+        capacity_s = time.monotonic() - t_spike
+        _log(f"second replica serving {capacity_s:.2f}s after the spike")
+        # hold the spike briefly over the grown fleet, then drop it
+        time.sleep(args.elastic_hold)
+        stop.set()
+        for p in posters:
+            p.join(timeout=30)
+        scale_in_s = _wait_metric(
+            netloc,
+            lambda m: m.get("dfd_router_replicas_retired_total", 0) >= 1,
+            "drain-first retirement", timeout=120.0)
+        _log(f"scale-in retired a replica {scale_in_s:.2f}s after "
+             f"load off")
+        wait_fleet_ready(netloc, 1, timeout=60.0)
+
+        # exact books after everything drains, and no client ever saw a
+        # connection error or 5xx other than a shed 503
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            m = scrape_metrics(netloc)
+            if m.get("dfd_router_routed_total", 0) == (
+                    m.get("dfd_router_cache_hit_total", 0) +
+                    m.get("dfd_router_forwarded_total", 0) +
+                    m.get("dfd_router_migrated_total", 0) +
+                    m.get("dfd_router_shed_total", 0) +
+                    m.get("dfd_router_failed_total", 0)):
+                break
+            time.sleep(0.5)
+        assert_router_books(m)
+        statuses: Dict[int, int] = {}
+        for p in posters:
+            for s, c in p.statuses.items():
+                statuses[s] = statuses.get(s, 0) + c
+        bad = {s: c for s, c in statuses.items()
+               if s not in (200, 429, 503)}
+        if bad:
+            raise AssertionError(
+                f"client-visible failures through the transitions: "
+                f"{bad} (statuses {statuses})")
+        spawned = m.get("dfd_router_replicas_spawned_total", 0)
+        retired = m.get("dfd_router_replicas_retired_total", 0)
+        killed = m.get("dfd_router_replicas_killed_total", 0)
+        alive = m.get("dfd_router_ready_replicas", 0) + \
+            m.get("dfd_router_warming_replicas", 0)
+        if spawned != retired + killed + alive:
+            raise AssertionError(
+                f"replica books do not balance: spawned {spawned:.0f} "
+                f"!= retired {retired:.0f} + killed {killed:.0f} + "
+                f"alive {alive:.0f}")
+        _log(f"replica books balance: spawned {spawned:.0f} == retired "
+             f"{retired:.0f} + killed {killed:.0f} + alive {alive:.0f}")
+    finally:
+        stop.set()
+        _terminate_proc(proc)
+
+    # the decision trace must replay bit-exactly through a fresh policy
+    from deepfake_detection_tpu.fleet.autoscaler import replay_trace
+    rep = replay_trace(trace_path)
+    if not rep["match"]:
+        raise AssertionError(
+            f"decision-trace replay diverged: {rep['mismatches'][:3]}")
+    _log(f"decision trace replays bit-exactly ({rep['n']} ticks)")
+
+    lines = []
+    lines.append(f"**Elastic autoscale (ISSUE 18)** — 1 cold replica "
+                 f"behind the autoscaling router "
+                 f"(`--min-replicas 1 --max-replicas 2`, depth line 2, "
+                 f"0.5s control ticks), {args.elastic_posters} "
+                 f"closed-loop posters spiking `{args.model}` @ "
+                 f"{args.image_size}px on {os.cpu_count()} CPU "
+                 f"core(s).  Exact router books, zero client-visible "
+                 f"failures and a bit-exact decision-trace replay "
+                 f"asserted in the same run.")
+    lines.append("")
+    lines.append("| transition | time |")
+    lines.append("|---|---|")
+    lines.append(f"| cold start → first replica serving | "
+                 f"{warm_s:.1f}s |")
+    lines.append(f"| spike → acted scale-up decision | "
+                 f"{decision_s:.1f}s |")
+    lines.append(f"| spike → second replica serving (incl. child cold "
+                 f"start) | {capacity_s:.1f}s |")
+    lines.append(f"| load off → drain-first retirement | "
+                 f"{scale_in_s:.1f}s |")
+    lines.append(f"| decision-trace replay | bit-exact, {rep['n']} "
+                 f"ticks |")
+    lines.append("")
+    lines.append(f"Statuses through every transition: "
+                 f"{dict(sorted(statuses.items()))} — sheds (503/429) "
+                 f"are the breach signal doing its job; no connection "
+                 f"error or unexpected 5xx ever reached a client.")
+    return lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--model", default="vit_tiny_patch16_224",
@@ -1282,6 +1516,20 @@ def main(argv=None) -> int:
                          "ratio; <=0 = auto ordering tripwire (1.05; "
                          "the pre-registered heavy-flagship bar at "
                          "s=1.1 is 3.0)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run ONLY the elastic autoscale phase "
+                         "(ISSUE 18): 1 cold replica behind the "
+                         "autoscaling router, a closed-loop spike, "
+                         "measured spike->decision, spike->capacity "
+                         "and load-off->retirement times, exact books "
+                         "+ bit-exact decision-trace replay")
+    ap.add_argument("--elastic-posters", type=int, default=8,
+                    help="closed-loop posters in the elastic spike "
+                         "(must drive per-replica depth past the "
+                         "breach line of 2)")
+    ap.add_argument("--elastic-hold", type=float, default=4.0,
+                    help="seconds the spike keeps running after the "
+                         "second replica is serving")
     ap.add_argument("--traffic-mix", type=float, default=0.8,
                     help="fraction of bench traffic the calibrated "
                          "suspect band lets the student clear (the rest "
@@ -1297,6 +1545,17 @@ def main(argv=None) -> int:
         if args.smoke:
             args.relay_duration = min(args.relay_duration, 3.0)
         table = "\n".join(run_relay_ceiling(args))
+        print(table)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(table + "\n")
+            _log(f"wrote {args.out}")
+        return 0
+
+    if args.elastic:
+        if args.smoke:
+            args.elastic_hold = min(args.elastic_hold, 2.0)
+        table = "\n".join(run_elastic_phase(args))
         print(table)
         if args.out:
             with open(args.out, "w") as f:

@@ -47,6 +47,14 @@ Scenarios (``--scenario``, comma list or ``all``):
   DRAINED — the session snapshot/restores onto the peer via the PR 10
   state machinery, the stream finishes through the router, and the
   final status + event log are BIT-IDENTICAL to an undrained replay.
+* ``fleet_elastic`` — autoscaler scenario (ISSUE 18): 1 replica + the
+  SLO autoscaler + the backfill tenant on the idle slot; a spike makes
+  the tenant YIELD (SIGTERM → exit-75 lease release) and scale-up
+  spawn into its slot, the new warming replica is SIGKILLed and
+  respawned under load, then scale-in drains back to the floor and the
+  tenant runs the corpus dry — exact books on BOTH tenants, zero
+  client-visible failures, zero post-transition recompiles, bit-exact
+  decision-trace replay.
 
 Example (the CI slow tier runs exactly this, small model)::
 
@@ -72,11 +80,12 @@ from typing import Dict, List, Optional, Tuple
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-from tools.bench_serve import assert_router_books, free_port, make_jpegs, \
-    scrape_metrics, spawn_router, wait_fleet_ready, wait_ready  # noqa: E402
+from tools.bench_serve import assert_router_books, free_port, \
+    labeled_family, make_jpegs, scrape_metrics, scrape_metrics_labeled, \
+    spawn_router, wait_fleet_ready, wait_ready  # noqa: E402
 
 SCENARIOS = ("exc", "nan", "hang", "kill", "torn_reload", "stream_resume",
-             "replica_kill", "replica_migrate")
+             "replica_kill", "replica_migrate", "fleet_elastic")
 
 
 def _log(msg: str) -> None:
@@ -838,6 +847,295 @@ def run_replica_migrate(args) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# fleet_elastic (ISSUE 18): autoscaler + backfill tenant through a spike,
+# a replica SIGKILL and a scale-in — exact books on BOTH tenants
+# ---------------------------------------------------------------------------
+
+def _await(probe, what: str, timeout_s: float,
+           poll_s: float = 0.2) -> float:
+    """Poll ``probe()`` until true; returns seconds waited."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        try:
+            if probe():
+                return time.monotonic() - t0
+        except OSError:
+            pass
+        time.sleep(poll_s)
+    raise AssertionError(f"{what} not observed within {timeout_s:.0f}s")
+
+
+def _router_json(netloc: str, path: str) -> dict:
+    host, port = netloc.split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=10)
+    try:
+        conn.request("GET", path)
+        return json.loads(conn.getresponse().read())
+    finally:
+        conn.close()
+
+
+def _find_pid_by_cmdline(*needles: str) -> Optional[int]:
+    """Linux /proc scan: the pid whose cmdline contains every needle
+    (the autoscaler's children are the ROUTER's subprocesses, so the
+    harness has no Popen handle to SIGKILL — the pid is the handle)."""
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                joined = f.read().decode(errors="replace").replace(
+                    "\0", " ")
+        except OSError:
+            continue
+        if all(n in joined for n in needles):
+            return int(pid)
+    return None
+
+
+def _write_backfill_corpus(work: str, image_size: int,
+                           fake: int = 7, real: int = 6,
+                           frames: int = 2) -> Tuple[str, str, dict]:
+    """A small packed corpus + manifest for the tenant (the
+    tests/test_backfill.py idiom): returns (pack, manifest_path,
+    manifest).  All imports here are jax-free (DFD001)."""
+    import numpy as np
+    from PIL import Image
+
+    from deepfake_detection_tpu.backfill.manifest import (
+        build_manifest_from_pack, save_manifest)
+    from deepfake_detection_tpu.data.packed import write_pack
+
+    root = os.path.join(work, "corpus")
+    rng = np.random.default_rng(0)
+    for kind, n in (("fake", fake), ("real", real)):
+        for c in range(n):
+            d = os.path.join(root, kind, f"c{c}")
+            os.makedirs(d, exist_ok=True)
+            for i in range(frames):
+                Image.fromarray(rng.integers(
+                    0, 255, (image_size, image_size, 3),
+                    dtype=np.uint8)).save(
+                    os.path.join(d, f"{i}.jpg"), quality=92)
+        with open(os.path.join(root, f"{kind}_list.txt"), "w") as f:
+            f.write("".join(f"c{c}:{frames}\n" for c in range(n)))
+    pack = os.path.join(work, "pack")
+    write_pack(root, pack, image_size=0, frames_per_clip=frames,
+               shard_size=8, workers=2)
+    manifest = build_manifest_from_pack(pack, shard_clips=4)
+    mpath = os.path.join(work, "manifest.json")
+    save_manifest(mpath, manifest)
+    return pack, mpath, manifest
+
+
+def run_fleet_elastic(args) -> dict:
+    """ISSUE 18: the self-operating fleet through every transition at
+    once.  One cold replica + the SLO autoscaler (max 2) + the backfill
+    tenant on the idle slot; then, under live traffic:
+
+    * a closed-loop spike breaches the depth line → the tenant YIELDS
+      its worker (SIGTERM → exit-75 lease release) and the autoscaler
+      spawns into the freed slot;
+    * the NEW (still warming) replica is SIGKILLed → the control loop
+      books it killed and respawns under the persisting breach;
+    * load drops → drain-first scale-in back to 1 replica, the tenant
+      relaunches onto the re-idled slot and runs the corpus dry.
+
+    Asserts: exact router books AND exact backfill books (manifest
+    clips == scored + failed + skipped_dup), zero client-visible
+    failures, zero post-transition recompiles on surviving replicas,
+    replica books (spawned == retired + killed + alive) and a bit-exact
+    replay of the recorded decision trace."""
+    jpegs = make_jpegs(8, args.src_size)
+    work = tempfile.mkdtemp(prefix="chaos-elastic-")
+    # the tenant scores the PAPER flagship at 160² (~0.8 clips/s on this
+    # class of box): the corpus must outlive replica warmup + the spike
+    # gate, or the worker runs it dry before there is anything to yield
+    pack, mpath, manifest = _write_backfill_corpus(
+        work, 160, fake=15, real=15, frames=2)
+    out = os.path.join(work, "run")
+    trace = os.path.join(work, "autoscale.jsonl")
+    port = free_port()
+    netloc = f"127.0.0.1:{port}"
+    replica_args = (f"--model {args.model} --image-size "
+                    f"{args.image_size} --img-num 1 --buckets 1,4 "
+                    f"--batch-deadline-ms 5 --max-queue 64")
+    backfill_args = (f"--data-packed {pack} "
+                     f"--model efficientnet_deepfake_v4 "
+                     f"--batch-size 2 --workers 1 --lease-ttl-s 60")
+    cmd = [sys.executable, "-m", "deepfake_detection_tpu.runners.router",
+           "--port", str(port),
+           "--spawn", "1", "--replica-args", replica_args,
+           "--data-plane", args.data_plane,
+           "--scrape-interval-s", "0.2", "--health-fail-after", "2",
+           "--autoscale", "--min-replicas", "1", "--max-replicas", "2",
+           "--autoscale-interval-s", "0.5",
+           "--slo-p99-ms", "100000",          # breach via depth only:
+           # a wall-clock p99 line is nondeterministic on a shared box
+           "--autoscale-depth-high", "2", "--autoscale-depth-low", "1",
+           "--autoscale-up-samples", "2", "--autoscale-down-samples", "6",
+           "--autoscale-up-cooldown-s", "3",
+           "--autoscale-down-cooldown-s", "5",
+           "--autoscale-trace", trace,
+           "--backfill-tenant", mpath, "--backfill-out", out,
+           "--backfill-max-workers", "1",
+           "--backfill-yield-timeout-s", "60",
+           "--backfill-args", backfill_args]
+    _log("spawn elastic router: " + " ".join(cmd))
+    router_proc = subprocess.Popen(cmd, cwd=_REPO, env=_child_env(),
+                                   stdout=subprocess.DEVNULL,
+                                   stderr=subprocess.DEVNULL)
+    stop = threading.Event()
+    posters: List[_Poster] = []
+    try:
+        wait_fleet_ready(netloc, 1, timeout=args.ready_timeout_s)
+        # the tenant must be ON the idle slot and its worker past
+        # startup before the spike: a shard lease in <out>/leases/
+        # proves the worker's SIGTERM→75 handler is installed (the
+        # runner arms it in main(), before any shard is leased)
+        lease_dir = os.path.join(out, "leases")
+        _await(lambda: scrape_metrics(netloc).get(
+                   "dfd_router_backfill_workers", 0) >= 1,
+               "backfill tenant worker on the idle slot", 120.0)
+        _await(lambda: os.path.isdir(lease_dir) and
+                   any(f.endswith(".lease")
+                       for f in os.listdir(lease_dir)),
+               "tenant worker's first shard lease", 300.0)
+        baseline_ids = set(_router_json(netloc, "/replicas"))
+        _log(f"tenant worker leased a shard; spiking over "
+             f"{sorted(baseline_ids)}")
+
+        posters = [_Poster(netloc, jpegs, stop) for _ in range(6)]
+        for p in posters:
+            p.start()
+        # spike → tenant yield (exit-75) → spawn into the freed slot
+        t_yield = _await(lambda: scrape_metrics(netloc).get(
+                             "dfd_router_backfill_yields_total", 0) >= 1,
+                         "backfill yield at the spike", 120.0)
+        _await(lambda: scrape_metrics(netloc).get(
+                   "dfd_router_replicas_spawned_total", 0) >= 2,
+               "scale-up spawn after the yield", 120.0)
+        _log(f"tenant yielded {t_yield:.1f}s into the spike; "
+             f"scale-up spawned")
+
+        # SIGKILL the NEW replica while it warms: the harness holds no
+        # Popen for it (it is the router's child), so find it via /proc
+        def new_replica() -> Optional[str]:
+            fresh = set(_router_json(netloc, "/replicas")) - baseline_ids
+            return sorted(fresh)[0] if fresh else None
+
+        _await(lambda: new_replica() is not None,
+               "the new replica registering", 60.0)
+        victim_id = new_replica()
+        victim_port = victim_id.split(":")[1]
+        # the trailing space rides on argv's NUL terminator: it stops
+        # "--port 5872" from matching a port that merely extends it
+        victim_pid = _find_pid_by_cmdline(
+            "deepfake_detection_tpu.runners.serve",
+            f"--port {victim_port} ")
+        if victim_pid is None:
+            raise AssertionError(
+                f"no serve process found for {victim_id}")
+        _log(f"SIGKILL warming replica {victim_id} (pid {victim_pid})")
+        os.kill(victim_pid, signal.SIGKILL)
+        _await(lambda: scrape_metrics(netloc).get(
+                   "dfd_router_replicas_killed_total", 0) >= 1,
+               "the kill being booked", 60.0)
+        # the breach persists under the posters: the loop must respawn
+        # and warm a replacement INTO the live spike
+        wait_fleet_ready(netloc, 2, timeout=args.ready_timeout_s)
+        _log("replacement replica warmed under load (2 ready)")
+        time.sleep(2.0)          # loaded pass over the grown fleet
+        compiles0 = labeled_family(
+            scrape_metrics_labeled(netloc),
+            "dfd_serving_backend_compiles_total")
+
+        stop.set()
+        for p in posters:
+            p.join(timeout=30)
+        # idle → drain-first scale-in back to the floor
+        _await(lambda: scrape_metrics(netloc).get(
+                   "dfd_router_replicas_retired_total", 0) >= 1,
+               "drain-first retirement after load off", 120.0)
+        wait_fleet_ready(netloc, 1, timeout=60.0)
+        compiles1 = labeled_family(
+            scrape_metrics_labeled(netloc),
+            "dfd_serving_backend_compiles_total")
+        for labels, c1 in compiles1.items():
+            c0 = compiles0.get(labels)
+            if c0 is not None and c1 != c0:
+                raise AssertionError(
+                    f"surviving replica recompiled through the "
+                    f"transitions: {labels} {c0:.0f} -> {c1:.0f}")
+        _log(f"zero post-transition recompiles on "
+             f"{len(compiles1)} surviving replica(s)")
+
+        # the tenant takes the re-idled slot back and runs the corpus
+        # dry (shard leases + done markers make every yield resumable)
+        _await(lambda: (_router_json(netloc, "/autoscaler")
+                        .get("tenant") or {}).get("corpus_done", False),
+               "the tenant finishing the corpus", 600.0, poll_s=1.0)
+        _log("backfill corpus complete")
+
+        m = scrape_metrics(netloc)
+        assert_router_books(m)
+        spawned = m.get("dfd_router_replicas_spawned_total", 0)
+        retired = m.get("dfd_router_replicas_retired_total", 0)
+        killed = m.get("dfd_router_replicas_killed_total", 0)
+        alive = m.get("dfd_router_ready_replicas", 0) + \
+            m.get("dfd_router_warming_replicas", 0)
+        if spawned != retired + killed + alive:
+            raise AssertionError(
+                f"replica books do not balance: spawned {spawned:.0f} "
+                f"!= retired {retired:.0f} + killed {killed:.0f} + "
+                f"alive {alive:.0f}")
+        statuses: Dict[int, int] = {}
+        for p in posters:
+            for _, s in p.samples:
+                statuses[s] = statuses.get(s, 0) + 1
+        bad = {s: c for s, c in statuses.items()
+               if s not in (200, 429, 503)}
+        if bad:
+            raise AssertionError(
+                f"client-visible failures through the transitions: "
+                f"{bad} (statuses {statuses})")
+        yields = m.get("dfd_router_backfill_yields_total", 0)
+        _log(f"replica books balance ({spawned:.0f} == {retired:.0f} + "
+             f"{killed:.0f} + {alive:.0f}); statuses {statuses}")
+    finally:
+        stop.set()
+        _terminate(router_proc, timeout=60.0)
+
+    # both tenants' books, audited AFTER the graceful shutdown:
+    # the backfill identity is read from the run dir itself
+    from deepfake_detection_tpu.backfill.writer import collect_books
+    books = collect_books(out, manifest)
+    if not books["balanced"]:
+        raise AssertionError(f"backfill books do not balance: {books}")
+    if books["scored"] + books["failed"] + books["skipped_dup"] != \
+            books["manifest_clips"]:
+        raise AssertionError(f"backfill identity broken: {books}")
+    _log(f"backfill books balance: {books['manifest_clips']} manifest "
+         f"clips == {books['scored']} scored + {books['failed']} "
+         f"failed + {books['skipped_dup']} skipped_dup")
+    from deepfake_detection_tpu.fleet.autoscaler import replay_trace
+    rep = replay_trace(trace)
+    if not rep["match"]:
+        raise AssertionError(
+            f"decision-trace replay diverged: {rep['mismatches'][:3]}")
+    _log(f"decision trace replays bit-exactly ({rep['n']} ticks)")
+    return {"scenario": "fleet_elastic",
+            "yield_s": t_yield,
+            "statuses": statuses,
+            "replica_books": {"spawned": spawned, "retired": retired,
+                              "killed": killed, "alive": alive},
+            "backfill_books": {k: books[k] for k in
+                               ("manifest_clips", "scored", "failed",
+                                "skipped_dup")},
+            "trace_ticks": rep["n"]}
+
+
+# ---------------------------------------------------------------------------
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
@@ -891,6 +1189,8 @@ def main(argv=None) -> int:
                 results.append(run_replica_kill(args))
             elif n == "replica_migrate":
                 results.append(run_replica_migrate(args))
+            elif n == "fleet_elastic":
+                results.append(run_fleet_elastic(args))
             else:
                 results.append(run_serve_fault(args, n))
             _log(f"=== {n} PASS ===")
