@@ -321,6 +321,11 @@ class TrainConfig:
     profile_capture: int = 20
 
     # --- misc / infra ---
+    # jax persistent compilation cache dir ("" = off): repeat runs of an
+    # unchanged (program, jax/jaxlib, backend, topology) skip XLA
+    # backend compilation — re-tracing/lowering still happens, which is
+    # why serving layers an AOT executable store on top (PERF.md §9)
+    compile_cache_dir: str = ""
     seed: int = 42
     log_interval: int = 50
     profile: int = 0      # trace N train steps with jax.profiler (SURVEY §5)
@@ -655,7 +660,31 @@ class ServeConfig:
     # Leave off for large models, where intra-op threads do pay.
     single_thread_xla: bool = False
 
+    # --- warm start (ISSUE 19) ---
+    # persistent AOT executable store: a replica spawn deserializes its
+    # bucket executables from this dir instead of re-paying XLA
+    # compilation (serving/warmstart.py; "" disables).  Safe by
+    # construction: key mismatch / corrupt entry = counted fallback to a
+    # fresh compile, and a golden-batch canary gates every store hit.
+    warmstart_dir: str = ""
+    # fallback tier underneath the AOT store: jax's own persistent
+    # compilation cache (caches HLO→binary, still re-traces; PERF.md §9)
+    compile_cache_dir: str = ""
+    # staged readiness: warm the first priority bucket, report /readyz
+    # 200 in phase "degraded" serving the warm subset, finish the rest
+    # in background (the scraper routes degraded capacity as ready)
+    warm_staged: bool = False
+    # comma-separated bucket warm order ("" = smallest-first); must be a
+    # subset of --buckets
+    warm_priority: str = ""
+    # concurrent bucket compiles during warmup (0 = auto, 1 = serial)
+    warm_parallel: int = 0
+
     # ------------------------------------------------------------------
+    def warm_priority_buckets(self) -> Tuple[int, ...]:
+        s = str(self.warm_priority).strip()
+        return _tuple_of_ints(s) if s else ()
+
     def __post_init__(self):
         if isinstance(self.buckets, str):
             self.buckets = _tuple_of_ints(self.buckets)
@@ -690,6 +719,13 @@ class ServeConfig:
         if not 0 <= int(self.cache_near_radius) <= 8:
             raise ValueError(f"--cache-near-radius must be in [0, 8], "
                              f"got {self.cache_near_radius}")
+        if int(self.warm_parallel) < 0:
+            raise ValueError("--warm-parallel must be >= 0 (0 = auto)")
+        bad = [b for b in self.warm_priority_buckets()
+               if b not in self.buckets]
+        if bad:
+            raise ValueError(f"--warm-priority buckets {bad} not in "
+                             f"--buckets {self.buckets}")
         self.dtype = _canon_quant_dtype(self.dtype, "--dtype")
         specs = self.model_specs()          # validates the grammar
         ids = [s["id"] for s in specs]
@@ -816,6 +852,13 @@ class BackfillConfig:
     # (books: manifest == scored + failed + skipped_dup).  Packed source
     # only — the hash reads the mmap slabs without decoding
     dedup: bool = False
+
+    # --- warm start (ISSUE 19; semantics as on ServeConfig) ---
+    # every backfill worker re-pays THE bucket compile at launch without
+    # this; the store key folds in the mesh/sharding signature, so a
+    # topology change is a miss, never a wrong executable
+    warmstart_dir: str = ""
+    compile_cache_dir: str = ""
 
     # ------------------------------------------------------------------
     def __post_init__(self):
@@ -979,6 +1022,12 @@ class RouterConfig:
     # not down, until it binds its port or this window expires
     settle_timeout_s: float = 20.0       # scale-in: bounded wait for a
     # drained replica's inflight to reach zero before terminate
+    # standby pool (ISSUE 19): keep N fully-warmed but UNREGISTERED
+    # replicas parked (counted as neither ready nor warming) so a
+    # scale-up is a registry promotion in milliseconds instead of a
+    # cold spawn; standbys occupy capacity slots (max_replicas) and the
+    # backfill tenant's slot math counts them
+    standby_replicas: int = 0
 
     # --- backfill tenant (ISSUE 18): idle capacity runs backfill ---
     backfill_tenant: str = ""            # manifest path (enables the
@@ -1050,6 +1099,12 @@ class RouterConfig:
         if int(self.backfill_max_workers) < 0:
             raise ValueError(f"--backfill-max-workers must be >= 0, "
                              f"got {self.backfill_max_workers}")
+        if int(self.standby_replicas) < 0:
+            raise ValueError(f"--standby-replicas must be >= 0, got "
+                             f"{self.standby_replicas}")
+        if int(self.standby_replicas) > 0 and not self.autoscale:
+            raise ValueError("--standby-replicas needs --autoscale "
+                             "(the autoscaler owns the standby pool)")
         for name in ("slo_p99_ms", "autoscale_interval_s",
                      "spawn_grace_s", "settle_timeout_s",
                      "backfill_yield_timeout_s"):

@@ -53,6 +53,7 @@ process, which must never pay — or wait on — an accelerator import.
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import shlex
@@ -65,7 +66,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..backfill.lease import LeaseDir
 from ..obs.events import EventLog, iter_records
-from .controller import ReplicaProcess, free_port, retire_replica
+from .controller import ReplicaProcess, free_port, http_request, \
+    retire_replica
 from .metrics import RouterMetrics
 from .registry import Registry
 
@@ -525,6 +527,21 @@ class BackfillTenant:
 # the actuator
 # ---------------------------------------------------------------------------
 
+class _Standby:
+    """One fully-warmed but UNREGISTERED replica parked for promotion:
+    it holds a capacity slot but is invisible to the ring, the scraper
+    and the fleet gauges (neither ready nor warming) until a scale-up
+    promotes it into the registry — a millisecond operation against the
+    51.8 s cold spawn it replaces."""
+
+    __slots__ = ("proc", "warmed", "born_t")
+
+    def __init__(self, proc: ReplicaProcess, born_t: float):
+        self.proc = proc
+        self.warmed = False
+        self.born_t = born_t
+
+
 class Autoscaler:
     """The control loop: sample → decide → act, one tick at a time.
 
@@ -540,6 +557,7 @@ class Autoscaler:
                  tenant: Optional[BackfillTenant] = None,
                  trace_path: str = "", migrate_timeout_s: float = 30.0,
                  settle_timeout_s: float = 20.0,
+                 standby_replicas: int = 0,
                  child_env: Optional[dict] = None):
         self.registry = registry
         self.metrics = metrics
@@ -551,6 +569,8 @@ class Autoscaler:
         self.tenant = tenant
         self.migrate_timeout_s = float(migrate_timeout_s)
         self.settle_timeout_s = float(settle_timeout_s)
+        self.standby_replicas = int(standby_replicas)
+        self.standbys: List[_Standby] = []
         self.child_env = child_env
         self.policy = ScalePolicy(knobs)
         self.sampler = FleetSampler(metrics)
@@ -568,6 +588,7 @@ class Autoscaler:
     def tick(self, now: Optional[float] = None) -> Decision:
         now = time.monotonic() if now is None else now
         self._reap_lost()
+        self._tend_standbys()
         sample = self.sampler.sample(self.registry, now)
         d = self.policy.decide(sample)
         self.last_decision = d
@@ -580,7 +601,9 @@ class Autoscaler:
         elif d.action == "down":
             self._scale_down()
         if self.tenant is not None:
-            used = len(self.registry.ids())
+            # a parked standby HOLDS its capacity slot — the backfill
+            # tenant must not fill it, or promotion would have to evict
+            used = len(self.registry.ids()) + len(self.standbys)
             self.tenant.reconcile(self.knobs.max_replicas - used,
                                   self.knobs.max_replicas)
         self.metrics.autoscale_target_replicas = min(
@@ -605,8 +628,75 @@ class Autoscaler:
             self.metrics.replicas_killed_total.inc()
             self.registry.remove(r.id)
 
+    def _tend_standbys(self) -> None:
+        """Keep the parked pool at ``standby_replicas``: reap dead
+        children (booked killed, same as registry corpses), poll the
+        unwarmed ones until their /readyz reports phase ``ready`` (fully
+        warmed — a degraded standby would demote promotion back into a
+        compile wait), and replenish while capacity slots remain."""
+        if self.standby_replicas <= 0 and not self.standbys:
+            return
+        for s in list(self.standbys):
+            if not s.proc.alive:
+                _logger.warning("standby %s: child exited %s — reaping",
+                                s.proc.netloc, s.proc.proc.returncode)
+                self.metrics.replicas_killed_total.inc()
+                self.standbys.remove(s)
+                continue
+            if not s.warmed:
+                try:
+                    status, _hdrs, body = http_request(
+                        s.proc.netloc, "GET", "/readyz", timeout=2.0)
+                    detail = json.loads(body.decode("utf-8"))
+                except (OSError, ValueError):
+                    continue          # still importing/compiling
+                if status == 200 and detail.get("ready") and \
+                        detail.get("phase", "ready") == "ready":
+                    s.warmed = True
+                    _logger.info("standby %s: fully warmed in %.1fs — "
+                                 "parked for promotion", s.proc.netloc,
+                                 time.monotonic() - s.born_t)
+        while (len(self.standbys) < self.standby_replicas
+               and len(self.registry.ids()) + len(self.standbys)
+               < self.knobs.max_replicas):
+            if self.tenant is not None:
+                used = len(self.registry.ids()) + len(self.standbys)
+                self.tenant.ensure_room(
+                    self.knobs.max_replicas - (used + 1))
+            child = ReplicaProcess(self.spawn_runner, free_port(),
+                                   self.replica_args, env=self.child_env)
+            self.standbys.append(_Standby(child, time.monotonic()))
+            self.metrics.replicas_spawned_total.inc()
+            _logger.info("autoscaler: warming standby %s (%d/%d)",
+                         child.netloc, len(self.standbys),
+                         self.standby_replicas)
+        self.metrics.standby_replicas = len(self.standbys)
+
+    def _promote_standby(self) -> bool:
+        """Registry-promote the oldest warmed standby: the ms-scale
+        scale-up path.  Booked as a scale-up but NOT a spawn (the spawn
+        was booked when the standby was parked, keeping
+        spawned == retired + killed + live + standby exact)."""
+        for s in list(self.standbys):
+            if not (s.warmed and s.proc.alive):
+                continue
+            self.standbys.remove(s)
+            r = self.registry.add(s.proc.netloc, process=s.proc)
+            r.warming = True          # first scrape flips it ready
+            self.metrics.standby_replicas = len(self.standbys)
+            self.metrics.standby_promotions_total.inc()
+            self.metrics.autoscale_up_total.inc()
+            _logger.info("autoscaler: scale-up -> promoted standby %s",
+                         r.id)
+            if self.trace is not None:
+                self.trace.event("standby_promoted", replica=r.id)
+            return True
+        return False
+
     def _scale_up(self) -> None:
-        used = len(self.registry.ids())
+        if self._promote_standby():
+            return                    # warm path: no spawn, no compile
+        used = len(self.registry.ids()) + len(self.standbys)
         if used >= self.knobs.max_replicas and self.tenant is None:
             return                     # registry still holds a corpse
         if self.tenant is not None:
@@ -658,6 +748,13 @@ class Autoscaler:
                 "killed": self.metrics.replicas_killed_total.value,
                 "up": self.metrics.autoscale_up_total.value,
                 "down": self.metrics.autoscale_down_total.value,
+                "standby_promotions":
+                    self.metrics.standby_promotions_total.value,
+            },
+            "standbys": {
+                "target": self.standby_replicas,
+                "parked": len(self.standbys),
+                "warmed": sum(1 for s in self.standbys if s.warmed),
             },
             "tenant": (self.tenant.status()
                        if self.tenant is not None else None),
@@ -677,6 +774,11 @@ class Autoscaler:
         if self._thread is not None:
             self._thread.join(timeout=30.0)
             self._thread = None
+        for s in self.standbys:
+            s.proc.stop()
+            self.metrics.replicas_killed_total.inc()
+        self.standbys.clear()
+        self.metrics.standby_replicas = 0
         if stop_tenant and self.tenant is not None:
             self.tenant.stop()
         if self.trace is not None:

@@ -29,6 +29,7 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import os
 import random
 import shlex
 import socket
@@ -239,7 +240,12 @@ class ReplicaProcess:
                     f"deepfake_detection_tpu.runners.{runner}",
                     "--port", str(self.port)] + shlex.split(extra_args)
         _logger.info("spawning replica: %s", " ".join(self.cmd))
-        self.proc = subprocess.Popen(self.cmd, env=env)
+        # spawn timestamp for the child's cold-start stage breakdown
+        # (dfd_serving_warmup_seconds{stage="spawn"}): wall-clock, since
+        # monotonic clocks don't compare across processes
+        child_env = dict(os.environ if env is None else env)
+        child_env.setdefault("DFD_SPAWN_T", repr(time.time()))
+        self.proc = subprocess.Popen(self.cmd, env=child_env)
 
     @property
     def netloc(self) -> str:

@@ -93,6 +93,9 @@ class RouterMetrics:
         # autoscaler decision books (ISSUE 18): acted scale decisions
         self.autoscale_up_total = _Counter()
         self.autoscale_down_total = _Counter()
+        # standby pool (ISSUE 19): scale-ups served by promoting a
+        # parked fully-warmed replica instead of a cold spawn
+        self.standby_promotions_total = _Counter()
         # backfill tenant books (ISSUE 18): idle-capacity workers
         self.backfill_workers_spawned_total = _Counter()
         self.backfill_yields_total = _Counter()    # workers yielded at a
@@ -108,6 +111,8 @@ class RouterMetrics:
         self.draining_replicas = 0
         self.autoscale_target_replicas = 0   # gauge, written by the
         # autoscaler (its current desired fleet size)
+        self.standby_replicas = 0    # gauge: parked warm standbys
+        # (unregistered — NOT counted in replicas/ready/warming above)
         self.backfill_workers = 0    # gauge, written by the tenant
 
     # ------------------------------------------------------------------
@@ -213,6 +218,10 @@ class RouterMetrics:
         counter("autoscale_down_total", "Acted scale-in decisions "
                 "(idle held through the hysteresis window; drain-first)",
                 self.autoscale_down_total.value)
+        counter("standby_promotions_total", "Scale-ups served by "
+                "promoting a parked warm standby into the registry "
+                "(ms-scale, no spawn, no compile)",
+                self.standby_promotions_total.value)
         counter("backfill_workers_spawned_total", "Backfill tenant "
                 "workers launched onto idle capacity",
                 self.backfill_workers_spawned_total.value)
@@ -244,6 +253,9 @@ class RouterMetrics:
         gauge("autoscale_target_replicas", "The autoscaler's current "
               "desired fleet size (0 while autoscaling is off)",
               self.autoscale_target_replicas)
+        gauge("standby_replicas", "Parked fully-warmed standby replicas "
+              "(unregistered: hold a capacity slot, invisible to the "
+              "ring until promoted)", self.standby_replicas)
         gauge("backfill_workers", "Live backfill tenant workers on "
               "idle capacity", self.backfill_workers)
         for stage in STAGES:

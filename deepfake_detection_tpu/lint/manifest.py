@@ -70,6 +70,10 @@ JAX_FREE_MODULES = (
     "deepfake_detection_tpu.cache",
     "deepfake_detection_tpu.cache.content",
     "deepfake_detection_tpu.cache.store",
+    # warm-start key/manifest schema (ISSUE 19): the store KEY must be
+    # computable by jax-free tooling (bench reporters, fleet ops); only
+    # serving.warmstart (serialize/deserialize) touches jax
+    "deepfake_detection_tpu.serving.warmkey",
     "deepfake_detection_tpu.fleet",
     "deepfake_detection_tpu.fleet.registry",
     "deepfake_detection_tpu.fleet.metrics",

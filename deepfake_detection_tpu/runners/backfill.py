@@ -149,11 +149,43 @@ class _Pipeline:
         t0 = time.monotonic()
         x_spec = jax.ShapeDtypeStruct(
             (self.batch, hw[0], hw[1], self.chans), jnp.dtype(np.uint8))
-        self._compiled = jax.jit(
-            _score,
-            in_shardings=(self._rep, self._bsh, self._rep, self._rep),
-            out_shardings=self._rep).lower(
-                self.variables, x_spec, self._mean, self._std).compile()
+        # ISSUE 19: the AOT executable store — a hit replaces the whole
+        # lower+compile with a deserialize, gated by the golden-batch
+        # canary below; ANY unusable entry is a counted loud fallback to
+        # the fresh compile, never a crash, never silently wrong
+        self.warm_source = "compile"
+        self.warm_fallback = ""
+        store = fields = manifest = None
+        if getattr(cfg, "warmstart_dir", ""):
+            from ..serving.warmstart import ExecutableStore, WarmstartMiss
+            store = ExecutableStore(cfg.warmstart_dir)
+            fields = self._store_fields(cfg, model)
+            try:
+                compiled, manifest = store.load(fields)
+                self.warm_source = "store"
+            except WarmstartMiss as miss:
+                compiled = None
+                if miss.reason != "absent":
+                    self.warm_fallback = miss.reason
+                    _logger.warning(
+                        "warm store entry unusable (%s) — falling back "
+                        "to fresh compile: %s", miss.reason, miss)
+        else:
+            compiled = None
+        if compiled is not None and \
+                not self._canary_ok(compiled, store, fields, manifest):
+            compiled = None
+            self.warm_source = "compile"
+            self.warm_fallback = "canary-reject"
+        if compiled is None:
+            compiled = jax.jit(
+                _score,
+                in_shardings=(self._rep, self._bsh, self._rep,
+                              self._rep),
+                out_shardings=self._rep).lower(
+                    self.variables, x_spec, self._mean,
+                    self._std).compile()
+        self._compiled = compiled
         # warm once: first-run allocation paths + the persistent-cache
         # hit land before the steady-state recompile probe arms
         jax.block_until_ready(self._compiled(
@@ -162,6 +194,102 @@ class _Pipeline:
                                     np.uint8), self._bsh),
             self._mean, self._std))
         self.compile_s = time.monotonic() - t0
+        if store is not None and self.warm_source == "compile":
+            # re-serialize after every miss AND every fallback so the
+            # next worker (or the next corrupted-entry recovery) hits
+            scores = np.asarray(jax.block_until_ready(self._compiled(
+                self.variables, jax.device_put(
+                    self._golden_input(), self._bsh),
+                self._mean, self._std)))
+            if store.save(fields, self._compiled, golden_scores=scores,
+                          params_fingerprint=self._fingerprint()):
+                _logger.info("warm store: serialized %s", fields["bucket"])
+
+    # ------------------------------------------------------------------
+    def _store_fields(self, cfg, model):
+        """The complete executable identity (serving-engine idiom):
+        program structure + geometry + sharding signature — params
+        VALUES stay out (they ride the call as arguments)."""
+        import hashlib
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..serving import warmkey
+
+        h = hashlib.sha256()
+        h.update(repr(model).encode())
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.variables)[0]:
+            h.update(jax.tree_util.keystr(path).encode())
+            h.update(str(jnp.shape(leaf)).encode())
+            h.update(str(jnp.result_type(leaf)).encode())
+        import numpy as np
+        h.update(np.asarray(self._mean).tobytes())
+        h.update(np.asarray(self._std).tobytes())
+        return warmkey.key_fields(
+            backend=jax.default_backend(),
+            device_kind=jax.devices()[0].device_kind,
+            program=h.hexdigest(),
+            geometry={"hw": list(self.hw), "frames": self.frames,
+                      "stem_s2d": bool(cfg.stem_s2d),
+                      "model_class": type(model).__name__,
+                      "pipeline": "backfill"},
+            bucket=self.batch, chans=self.chans, wire="uint8",
+            quant="none",
+            sharding=repr(sorted(dict(self.mesh.shape).items())))
+
+    def _fingerprint(self) -> str:
+        import jax
+        import numpy as np
+
+        from ..cache.content import tree_fingerprint
+        leaves = jax.tree_util.tree_flatten_with_path(self.variables)[0]
+        return tree_fingerprint(
+            ((jax.tree_util.keystr(path), np.asarray(leaf))
+             for path, leaf in leaves))
+
+    def _golden_input(self):
+        import numpy as np
+        rng = np.random.default_rng(0xCA9A87)
+        return rng.integers(0, 256, (self.batch,) + self.hw
+                            + (self.chans,), dtype=np.uint8)
+
+    def _canary_ok(self, compiled, store, fields, manifest) -> bool:
+        """Golden-batch gate on a deserialized executable: must execute,
+        score finite at the right shape, and — when the manifest was
+        stamped by THIS checkpoint — bit-identically to the recorded
+        scores.  A fingerprint-skew pass re-stamps the manifest."""
+        import jax
+        import numpy as np
+
+        from ..serving import warmkey
+        try:
+            scores = np.asarray(jax.block_until_ready(compiled(
+                self.variables,
+                jax.device_put(self._golden_input(), self._bsh),
+                self._mean, self._std)))
+        except Exception as e:                     # noqa: BLE001
+            _logger.error("warm store canary: deserialized executable "
+                          "failed to run (%s) — recompiling", e)
+            return False
+        if scores.ndim != 2 or scores.shape[0] != self.batch \
+                or not np.all(np.isfinite(scores)):
+            _logger.error("warm store canary: bad golden scores "
+                          "(shape %s) — recompiling", scores.shape)
+            return False
+        fp = self._fingerprint()
+        if manifest.get("params_fingerprint") == fp:
+            ref = warmkey.decode_array(manifest["golden_scores"])
+            if ref.shape != scores.shape or \
+                    not np.array_equal(ref, scores):
+                _logger.error("warm store canary: golden scores drifted "
+                              "from the manifest — recompiling")
+                return False
+        else:
+            store.refresh_manifest(fields, golden_scores=scores,
+                                   params_fingerprint=fp)
+        return True
 
     def dispatch(self, slab):
         """Async: host→device transfer + compiled call; returns the
@@ -227,6 +355,14 @@ def run_backfill(cfg, stop: Optional[threading.Event] = None
                                    install_backend_compile_listener)
 
     cfg.validate_required()
+    if getattr(cfg, "compile_cache_dir", ""):
+        # jax persistent compilation cache: the fallback tier under the
+        # AOT executable store (PERF.md §9) — before the first compile
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(cfg.compile_cache_dir))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     install_backend_compile_listener()
     stop = stop if stop is not None else threading.Event()
     chaos = chaos_from_env()
@@ -267,6 +403,7 @@ def run_backfill(cfg, stop: Optional[threading.Event] = None
         "failed_this_proc": 0, "skipped_dup_this_proc": 0,
         "lease_lost": 0, "lease_steals": 0,
         "steady_recompiles": 0, "clips_per_s": 0.0, "elapsed_s": 0.0,
+        "warmstart_source": "", "warmstart_fallback": "",
     }
     pipe: Optional[_Pipeline] = None
     if pending:
@@ -288,9 +425,14 @@ def run_backfill(cfg, stop: Optional[threading.Event] = None
                     f"the batch geometry (last error: {probe_err}) — "
                     f"set --image-size explicitly or repair the corpus")
         pipe = _Pipeline(cfg, frames, source.sample_hw)
+        summary["warmstart_source"] = pipe.warm_source
+        summary["warmstart_fallback"] = pipe.warm_fallback
         _logger.info(
-            "bucket compiled in %.1fs: batch %d × %dx%d × %dch on mesh "
-            "%s; %d/%d shards pending", pipe.compile_s, pipe.batch,
+            "bucket %s in %.1fs: batch %d × %dx%d × %dch on mesh "
+            "%s; %d/%d shards pending",
+            ("deserialized from the warm store"
+             if pipe.warm_source == "store" else "compiled"),
+            pipe.compile_s, pipe.batch,
             source.sample_hw[1], source.sample_hw[0], pipe.chans,
             dict(pipe.mesh.shape), len(pending), len(manifest["shards"]))
     log.event("run_start", mode="backfill", manifest=cfg.manifest,

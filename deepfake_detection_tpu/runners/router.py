@@ -114,10 +114,13 @@ def build_router(cfg):
             interval_s=cfg.autoscale_interval_s,
             tenant=tenant, trace_path=cfg.autoscale_trace,
             migrate_timeout_s=cfg.migrate_timeout_s,
-            settle_timeout_s=cfg.settle_timeout_s)
+            settle_timeout_s=cfg.settle_timeout_s,
+            standby_replicas=cfg.standby_replicas)
         _logger.info(
-            "autoscaler: slo p99 %.0fms, %d..%d replicas%s%s",
+            "autoscaler: slo p99 %.0fms, %d..%d replicas%s%s%s",
             cfg.slo_p99_ms, cfg.min_replicas, cfg.max_replicas,
+            f", {cfg.standby_replicas} warm standby(s)"
+            if int(cfg.standby_replicas) > 0 else "",
             f", backfill tenant on {cfg.backfill_tenant}"
             if tenant is not None else "",
             f", trace -> {cfg.autoscale_trace}"

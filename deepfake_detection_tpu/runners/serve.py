@@ -29,11 +29,32 @@ import os
 import signal
 import sys
 import threading
+import time
 from typing import Optional, Sequence
 
 _logger = logging.getLogger(__name__)
 
 __all__ = ["build_engine", "build_server", "main"]
+
+
+def _skeleton_variables(model, image_size, in_chans):
+    """Zero-compile variable skeleton: ``jax.eval_shape`` traces the
+    init without building or running an executable, and host zeros fill
+    the shapes.  ONLY valid under a strict (complete) checkpoint load,
+    which overwrites every leaf — see ``_load_model_variables``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def _init(rng, dummy):
+        return model.init({"params": rng, "dropout": rng}, dummy,
+                          training=False)
+
+    shapes = jax.eval_shape(
+        _init, jax.ShapeDtypeStruct((2,), jnp.uint32),
+        jax.ShapeDtypeStruct((1, image_size, image_size, in_chans),
+                             jnp.float32))
+    return jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), shapes)
 
 
 def _load_model_variables(model, model_path, *, image_size, in_chans,
@@ -45,6 +66,21 @@ def _load_model_variables(model, model_path, *, image_size, in_chans,
     from ..models import init_model
     from ..models.helpers import load_checkpoint
 
+    if model_path and os.path.isfile(model_path):
+        # warm-start fast path (ISSUE 19): a checkpoint that strict-load
+        # accepts overwrites EVERY leaf, so the init values are dead
+        # weight — eval_shape skips the init jit (the bulk of the
+        # params_load stage wall and its backend compile).  Any strict
+        # failure (missing keys, shape drift) falls back to the real
+        # init + lenient merge below, loudly.
+        try:
+            return load_checkpoint(
+                _skeleton_variables(model, image_size, in_chans),
+                model_path, use_ema=use_ema, strict=True)
+        except Exception as e:                     # noqa: BLE001
+            _logger.warning(
+                "skeleton params load of %r failed (%s) — paying the "
+                "full init for the lenient merge", name, e)
     variables = init_model(model, jax.random.PRNGKey(0),
                            (1, image_size, image_size, in_chans))
     if model_path and os.path.isdir(model_path):
@@ -65,11 +101,29 @@ def build_engine(cfg):
     HTTP server and ``runners/stream.py``'s streaming pipeline both sit
     on exactly this stack).  The primary --model is the flagship entry;
     every --models spec adds one more, all AOT-warmed before ready."""
-    from ..models import create_model
+    t_entry = time.monotonic()
+    from ..models import create_model          # pays the jax import
     from ..serving.batcher import MicroBatcher
     from ..serving.engine import InferenceEngine
-    from ..serving.metrics import ServingMetrics
+    from ..serving.metrics import (ServingMetrics,
+                                   install_backend_compile_listener)
 
+    # the probe must see EVERY compile this process pays — including
+    # the params-load init jit — so the warm path's zero-backend-compile
+    # contract is checked against the whole start, not just the engine
+    install_backend_compile_listener()
+
+    if cfg.compile_cache_dir:
+        # jax's persistent compilation cache: the fallback tier under
+        # the AOT executable store — must be configured before the first
+        # compile (PERF.md §9; size/time floors dropped so CPU-sized
+        # serving programs actually persist)
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(cfg.compile_cache_dir))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    t_import = time.monotonic()
     _logger.info("building %s (in_chans=%d, canvas %d², dtype=%s)",
                  cfg.model, cfg.in_chans, cfg.image_size, cfg.dtype)
     model = create_model(cfg.model, num_classes=cfg.num_classes,
@@ -78,6 +132,11 @@ def build_engine(cfg):
         model, cfg.model_path, image_size=cfg.image_size,
         in_chans=cfg.in_chans, use_ema=cfg.use_ema, name=cfg.model)
     metrics = ServingMetrics(throughput_window_s=cfg.throughput_window_s)
+    warmstart = None
+    if cfg.warmstart_dir:
+        from ..serving.warmstart import ExecutableStore
+        warmstart = ExecutableStore(cfg.warmstart_dir)
+        _logger.info("warm-start executable store: %s", warmstart.root)
     engine = InferenceEngine(
         model, variables, image_size=cfg.image_size, img_num=cfg.img_num,
         buckets=cfg.buckets, metrics=metrics, wire=cfg.wire,
@@ -87,7 +146,10 @@ def build_engine(cfg):
         breaker_threshold=cfg.breaker_threshold,
         breaker_open_s=cfg.breaker_open_s,
         reload_drift_tol=cfg.reload_drift_tol,
-        retry_jitter_s=cfg.retry_jitter_s)
+        retry_jitter_s=cfg.retry_jitter_s,
+        warmstart=warmstart,
+        warm_priority=cfg.warm_priority_buckets() or None,
+        warm_parallel=cfg.warm_parallel)
     specs = cfg.model_specs()
     for spec in specs:
         in_chans = 3 * spec["img_num"]
@@ -102,9 +164,15 @@ def build_engine(cfg):
         engine.add_model(spec["id"], extra, extra_vars,
                          image_size=spec["size"], img_num=spec["img_num"],
                          dtype=spec["dtype"])
-    _logger.info("AOT-warming buckets %s × %d model(s) ...",
-                 list(cfg.buckets), 1 + len(specs))
-    engine.warmup()
+    # cold-start stage walls up to here (the engine stamps compile/warm
+    # inside warmup; main() stamps spawn/ready around the whole build)
+    t_params = time.monotonic()
+    metrics.warmup_seconds["import"] = t_import - t_entry
+    metrics.warmup_seconds["params_load"] = t_params - t_import
+    _logger.info("AOT-warming buckets %s × %d model(s)%s ...",
+                 list(cfg.buckets), 1 + len(specs),
+                 " (staged)" if cfg.warm_staged else "")
+    engine.warmup(staged=cfg.warm_staged)
     if engine.chaos.active:
         _logger.warning("DFD_CHAOS active: %s", sorted(engine.chaos.points))
     cache = None
@@ -170,6 +238,7 @@ def build_server(cfg):
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
+    t_main = time.time()
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s: %(message)s")
@@ -186,6 +255,16 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_cpu_multi_thread_eigen=false").strip()
     server = build_server(cfg)
+    # spawn/ready stage walls: a parent (fleet controller, bench) stamps
+    # DFD_SPAWN_T at fork so the breakdown starts at the true spawn; a
+    # bare launch starts at main() entry (spawn stage reads 0)
+    try:
+        spawn_t = float(os.environ.get("DFD_SPAWN_T", "") or t_main)
+    except ValueError:
+        spawn_t = t_main
+    m = server.engine.metrics
+    m.warmup_seconds["spawn"] = max(0.0, t_main - spawn_t)
+    m.warmup_seconds["ready"] = max(0.0, time.time() - spawn_t)
     server.engine.start(server.batcher)
 
     stop = threading.Event()

@@ -168,6 +168,13 @@ def build_datasets(cfg: TrainConfig, input_size, pack_dir=None,
 
 def main(cfg: TrainConfig) -> Dict[str, float]:
     """Train to completion; returns the best eval metrics."""
+    if cfg.compile_cache_dir:
+        # jax persistent compilation cache (PERF.md §9): restarted runs
+        # skip the XLA compile wall — must land before the first compile
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(cfg.compile_cache_dir))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     rank = jax.process_index()
     if cfg.tp_size > 1:
         if cfg.mesh_shape is not None or cfg.fsdp:

@@ -24,6 +24,7 @@ _LAZY = {
     "quantize_tree": "quant", "realize_tree": "quant",
     "canonical_mode": "quant", "QUANT_MODES": "quant",
     "CascadeRouter": "cascade", "CascadeResult": "cascade",
+    "ExecutableStore": "warmstart", "WarmstartMiss": "warmstart",
 }
 
 __all__ = sorted(_LAZY)

@@ -83,11 +83,13 @@ Design (mirrors what ``data/loader.py`` does for training input):
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import tempfile
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -161,15 +163,17 @@ class _ModelEntry:
         # every reload), never to the template
         self.variables = jax.device_put(quantize_tree(variables,
                                                       self.dtype))
-        self.mean = jax.device_put(jnp.asarray(img_mean))
-        self.std = jax.device_put(jnp.asarray(img_std))
+        # device_put of host arrays is a pure transfer: the warm path
+        # must not pay (or count) a single backend compile for constants
+        self.mean = jax.device_put(np.asarray(img_mean, np.float32))
+        self.std = jax.device_put(np.asarray(img_std, np.float32))
         # multi-frame wire: mean/std tiled to the 3·img_num clip channels
         # so the SAME per-element arithmetic runs whether the channels
         # came from replication or img_num distinct frames
-        self.mean_multi = jax.device_put(jnp.asarray(
-            np.tile(img_mean, self.img_num)))
-        self.std_multi = jax.device_put(jnp.asarray(
-            np.tile(img_std, self.img_num)))
+        self.mean_multi = jax.device_put(
+            np.tile(np.asarray(img_mean, np.float32), self.img_num))
+        self.std_multi = jax.device_put(
+            np.tile(np.asarray(img_std, np.float32), self.img_num))
         self.compiled: Dict[Tuple[int, int], Any] = {}  # (bucket, chans)
         self.golden: Optional[np.ndarray] = None
         self.golden_ref: Optional[np.ndarray] = None
@@ -215,10 +219,30 @@ class InferenceEngine:
                  breaker_open_s: float = 5.0,
                  reload_drift_tol: float = -1.0,
                  retry_jitter_s: float = 2.0,
+                 warmstart=None,
+                 warm_priority: Optional[Sequence[int]] = None,
+                 warm_parallel: int = 0,
                  chaos=None):
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not self.buckets or self.buckets[0] < 1:
             raise ValueError(f"invalid buckets {buckets}")
+        #: warm-start executable store (serving/warmstart.py) or None —
+        #: warmup consults it before paying lower().compile()
+        self.warmstart = warmstart
+        self._warm_priority = tuple(int(b) for b in (warm_priority or ()))
+        bad = [b for b in self._warm_priority if b not in self.buckets]
+        if bad:
+            raise ValueError(
+                f"warm_priority {bad} not in buckets {self.buckets}")
+        self._warm_parallel = int(warm_parallel)
+        #: readiness phase: cold -> degraded (staged warmup: priority
+        #: bucket serving, rest warming in background) -> ready
+        self._phase = "cold"
+        self._warm_thread: Optional[threading.Thread] = None
+        #: per-unit compile walls + last warmup wall (the staged-warmup
+        #: overlap test reads these; keys are (bucket, chans))
+        self.warm_compile_walls: Dict[Tuple[int, int], float] = {}
+        self.last_warmup_wall = 0.0
         if wire not in ("float32", "uint8"):
             raise ValueError(f"wire must be float32|uint8, got {wire!r}")
         self.wire = wire
@@ -442,6 +466,10 @@ class InferenceEngine:
         down" (no response at all) without parsing metrics text."""
         return {
             "ready": bool(self.metrics.ready),
+            # degraded = ready on a SUBSET of buckets while the rest warm
+            # in background (staged warmup); the router's scraper routes
+            # any 200, so degraded capacity is routable by construction
+            "phase": self._phase,
             # snapshot: a live add_model grows the table from another
             # thread (the PR 14 warmup/_rewarm discipline)
             "models": {
@@ -450,22 +478,45 @@ class InferenceEngine:
                       "img_num": e.img_num,
                       "dtype": e.dtype,
                       "fingerprint": e.fingerprint,
-                      "reloads": e.reload_count}
+                      "reloads": e.reload_count,
+                      "warm_buckets": sorted(
+                          {b for (b, _c) in list(e.compiled)})}
                 for mid, e in list(self._models.items())},
             "breaker": self.breaker.state,
             "queue_depth": int(self.metrics.queue_depth),
             "inflight": int(self.metrics.inflight),
         }
 
-    def warmup(self) -> None:
-        """AOT-compile every (model, bucket, chans) executable and execute
-        each once (primes any first-run allocation paths), then flip
-        ready.  Idempotent per entry: adding a model to a warmed engine
-        only compiles the new entry's programs."""
+    def _warm_order(self) -> Tuple[int, ...]:
+        """Bucket warm order: the configured priority first, remaining
+        buckets smallest-first (small buckets compile fastest and already
+        serve single requests — the best capacity-per-second spent)."""
+        rest = [b for b in self.buckets if b not in self._warm_priority]
+        return self._warm_priority + tuple(rest)
+
+    def warmup(self, staged: bool = False) -> None:
+        """Obtain every (model, bucket, chans) executable — from the
+        warm-start store when attached, else a fresh AOT compile — and
+        execute each once (primes any first-run allocation paths), then
+        flip ready.  Idempotent per entry: adding a model to a warmed
+        engine only builds the new entry's programs.
+
+        ``staged=True`` warms only the FIRST priority bucket before
+        declaring readiness (phase ``degraded``: /readyz goes 200, the
+        dispatch path pads into the already-warm buckets only) and warms
+        the remaining buckets on a background thread, flipping the phase
+        to ``ready`` when the full set is live.  A recovery firing
+        mid-stage aborts the background warm — the recovery generation
+        owns readiness and the warmed subset keeps serving."""
         gen = self._gen
+        t0 = time.monotonic()
+        compile0 = self.metrics.warmup_seconds["compile"]
+        order = self._warm_order()
+        first, rest = order[:1], order[1:]
         # snapshot: a concurrent add_model may grow the table mid-loop
         for entry in list(self._models.values()):
-            self._warm_entry(entry)
+            self._warm_entry(entry, buckets=(first if staged and rest
+                                             else order))
         # the live add_model path runs this on the caller's thread while
         # the watchdog (or a reload canary) may be mid-recovery: only the
         # generation that was current for the WHOLE warmup may declare
@@ -473,56 +524,274 @@ class InferenceEngine:
         # re-warm proves the device before it restores ready)
         with self._recover_lock:
             if gen == self._gen:
+                self._phase = "degraded" if staged and rest else "ready"
                 self.metrics.ready = True
+        self.last_warmup_wall = time.monotonic() - t0
+        # warm = everything warmup did beyond obtaining executables
+        # (execute-once priming, canaries, store serialization)
+        self.metrics.warmup_seconds["warm"] += max(
+            0.0, self.last_warmup_wall
+            - (self.metrics.warmup_seconds["compile"] - compile0))
+        if staged and rest:
+            t = threading.Thread(target=self._warm_rest,
+                                 args=(gen, rest), daemon=True,
+                                 name="serving-warm-bg")
+            self._warm_thread = t
+            t.start()
 
-    def _warm_entry(self, entry: _ModelEntry) -> None:
+    def _warm_rest(self, gen: int, buckets: Tuple[int, ...]) -> None:
+        """Background half of a staged warmup: one bucket at a time, so
+        dispatch sees capacity grow between buckets, not after all."""
+        try:
+            for b in buckets:
+                if gen != self._gen or self._stop.is_set():
+                    return             # a recovery owns readiness now
+                for entry in list(self._models.values()):
+                    self._warm_entry(entry, buckets=(b,))
+            with self._recover_lock:
+                if gen == self._gen:
+                    self._phase = "ready"
+        except Exception:                              # noqa: BLE001
+            _logger.exception("staged warmup: background bucket warm "
+                              "failed; engine stays degraded on the "
+                              "already-warm buckets")
+
+    # -- warm-start store plumbing -------------------------------------
+    def _store_fields(self, entry: _ModelEntry, bucket: int,
+                      chans: int) -> Dict[str, Any]:
+        """The complete warmstart key fields of one executable (see
+        serving/warmkey.py).  The program hash digests the model config
+        (flax dataclass repr), the *signature* of the quantized params
+        tree (paths/shapes/dtypes — weights are call arguments, so
+        checkpoints of one architecture share executables) and the
+        normalization constants; quant/wire/geometry ride as their own
+        loud fields."""
+        from . import warmkey
+        h = hashlib.sha256()
+        h.update(repr(entry.model).encode())
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                entry.variables)[0]:
+            h.update(jax.tree_util.keystr(path).encode())
+            h.update(str(jnp.shape(leaf)).encode())
+            h.update(str(jnp.result_type(leaf)).encode())
+        for a in (entry.mean, entry.std, entry.mean_multi,
+                  entry.std_multi):
+            h.update(np.asarray(a).tobytes())
+        dev = jax.devices()[0]
+        return warmkey.key_fields(
+            backend=jax.default_backend(),
+            device_kind=dev.device_kind,
+            program=h.hexdigest(),
+            geometry={"image_size": entry.image_size,
+                      "img_num": entry.img_num,
+                      "multi_frame": entry.multi_frame,
+                      "model_class": type(entry.model).__name__},
+            bucket=bucket, chans=chans, wire=self.wire,
+            quant=entry.dtype, sharding="")
+
+    def _warm_golden_input(self, entry: _ModelEntry, bucket: int,
+                           chans: int) -> np.ndarray:
+        """Deterministic canary input for one (bucket, chans): identical
+        across processes (fixed seed), so manifest golden scores from the
+        serializing process can demand bit-exactness in the loading one."""
         s = entry.image_size
         _, dtype = self._entry_wire_spec(entry)
-        for chans in self._entry_chans(entry):
-            for b in self.buckets:
-                if (b, chans) in entry.compiled:
-                    continue
-                t0 = time.monotonic()
-                x_spec = jax.ShapeDtypeStruct((b, s, s, chans),
-                                              jnp.dtype(dtype))
-                fn = self._make_program(entry, chans)
-                # per-bucket AOT lowering is the POINT of this loop: one
-                # deliberate compile per declared (model, bucket, chans)
-                # at warmup, counted in compiles_total, zero recompiles
-                # after ready
-                if self.wire == "uint8":
-                    mean, std = (entry.mean, entry.std) if chans == 3 \
-                        else (entry.mean_multi, entry.std_multi)
-                    lowered = jax.jit(fn).lower(  # dfdlint: disable=DFD004
-                        entry.variables, x_spec, mean, std)
-                else:
-                    lowered = jax.jit(fn).lower(entry.variables,  # dfdlint: disable=DFD004
-                                                x_spec)
-                entry.compiled[(b, chans)] = lowered.compile()
-                self.metrics.compiles_total.inc()
-                out = self._run(entry, b, chans, entry.variables,
-                                jnp.zeros((b, s, s, chans), dtype))
-                jax.block_until_ready(out)
-                _logger.info("model %r bucket %d (%dch) compiled + "
-                             "warmed in %.1fs", entry.model_id, b, chans,
-                             time.monotonic() - t0)
+        rng = np.random.default_rng(0xCA9A87)
+        if np.dtype(dtype) == np.uint8:
+            return rng.integers(0, 256, (bucket, s, s, chans),
+                                dtype=np.uint8)
+        return rng.random((bucket, s, s, chans), dtype=np.float32)
+
+    def _store_load(self, entry: _ModelEntry, bucket: int, chans: int):
+        """Try the store for one executable.  Returns ``(compiled,
+        (fields, manifest))`` or None (counted miss/fallback)."""
+        if self.warmstart is None:
+            return None
+        from .warmstart import WarmstartMiss
+        fields = self._store_fields(entry, bucket, chans)
+        try:
+            compiled, manifest = self.warmstart.load(fields)
+        except WarmstartMiss as e:
+            if e.reason == "absent":
+                self.metrics.warmstart_misses_total.inc()
+            else:
+                # present but unusable — corrupt blob, foreign manifest,
+                # version skew baked into the key fields: fall back to a
+                # fresh compile, loudly, and re-serialize over it
+                self.metrics.warmstart_fallbacks_total.inc()
+                _logger.warning(
+                    "warmstart: %s bucket %d (%dch): %s — compiling "
+                    "fresh", entry.model_id, bucket, chans, e)
+            return None
+        self.metrics.warmstart_hits_total.inc()
+        return compiled, (fields, manifest)
+
+    def _warm_canary(self, entry: _ModelEntry, bucket: int, chans: int,
+                     fields: Dict[str, Any],
+                     manifest: Dict[str, Any]) -> bool:
+        """Golden-batch gate for ONE deserialized executable: scores must
+        be finite and shape-correct, and — when the manifest was written
+        under the currently-served checkpoint (fingerprint match, the
+        scale-up common path) — bit-exact against the recorded scores.
+        A fingerprint-skewed entry that passes gets its manifest
+        re-stamped so the next same-checkpoint spawn regains the
+        bit-exact gate."""
+        from . import warmkey
+        gx = self._warm_golden_input(entry, bucket, chans)
+        why = ""
+        scores: Optional[np.ndarray] = None
+        try:
+            scores = np.asarray(self._run(entry, bucket, chans,
+                                          entry.variables, gx))
+        except Exception as e:                         # noqa: BLE001
+            why = f"execution failed: {e}"
+        if why == "" and (scores.ndim != 2 or scores.shape[0] != bucket):
+            why = f"scores shape {scores.shape} for bucket {bucket}"
+        if why == "" and not np.isfinite(scores).all():
+            why = "non-finite scores"
+        same_ckpt = (manifest.get("params_fingerprint")
+                     == entry.fingerprint)
+        if why == "" and same_ckpt:
+            try:
+                ref = warmkey.decode_array(manifest["golden_scores"])
+            except Exception as e:                     # noqa: BLE001
+                why = f"manifest golden scores unreadable: {e}"
+            else:
+                if ref.shape != scores.shape or \
+                        not np.array_equal(ref, scores):
+                    why = ("scores not bit-identical to the manifest's "
+                           "(same checkpoint fingerprint)")
+        if why:
+            self.metrics.warmstart_canary_rejects_total.inc()
+            _logger.error("warmstart: canary REJECTED deserialized "
+                          "executable %s bucket %d (%dch): %s — "
+                          "recompiling fresh", entry.model_id, bucket,
+                          chans, why)
+            return False
+        if not same_ckpt and self.warmstart is not None:
+            self.warmstart.refresh_manifest(
+                fields, golden_scores=scores,
+                params_fingerprint=entry.fingerprint)
+        return True
+
+    def _store_save(self, entry: _ModelEntry, bucket: int,
+                    chans: int) -> None:
+        if self.warmstart is None:
+            return
+        fields = self._store_fields(entry, bucket, chans)
+        gx = self._warm_golden_input(entry, bucket, chans)
+        scores = np.asarray(self._run(entry, bucket, chans,
+                                      entry.variables, gx))
+        if self.warmstart.save(fields, entry.compiled[(bucket, chans)],
+                               golden_scores=scores,
+                               params_fingerprint=entry.fingerprint):
+            self.metrics.warmstart_serialized_total.inc()
+
+    def _compile_units(self, entry: _ModelEntry,
+                       units: List[Tuple[int, int]]) -> None:
+        """Fresh-compile the given (bucket, chans) units, dispatching
+        independent compiles concurrently: ``lower()`` traces under the
+        GIL but ``compile()`` releases it inside XLA, so a thread pool
+        overlaps the bucket compiles (the wall win materializes with
+        spare cores; the per-unit walls in ``warm_compile_walls`` always
+        prove the overlap).  Metrics/store writes stay on the caller's
+        thread."""
+        if not units:
+            return
+        s = entry.image_size
+        _, dtype = self._entry_wire_spec(entry)
+
+        def _build(unit: Tuple[int, int]):
+            b, chans = unit
+            t0 = time.monotonic()
+            x_spec = jax.ShapeDtypeStruct((b, s, s, chans),
+                                          jnp.dtype(dtype))
+            fn = self._make_program(entry, chans)
+            # per-bucket AOT lowering is the POINT of this loop: one
+            # deliberate compile per declared (model, bucket, chans)
+            # at warmup, counted in compiles_total, zero recompiles
+            # after ready
+            if self.wire == "uint8":
+                mean, std = (entry.mean, entry.std) if chans == 3 \
+                    else (entry.mean_multi, entry.std_multi)
+                lowered = jax.jit(fn).lower(entry.variables, x_spec,
+                                            mean, std)
+            else:
+                lowered = jax.jit(fn).lower(entry.variables, x_spec)
+            return unit, lowered.compile(), time.monotonic() - t0
+
+        workers = self._warm_parallel if self._warm_parallel > 0 \
+            else min(4, len(units))
+        if workers <= 1 or len(units) == 1:
+            results = [_build(u) for u in units]
+        else:
+            with ThreadPoolExecutor(
+                    max_workers=min(workers, len(units)),
+                    thread_name_prefix="serving-warm-compile") as pool:
+                results = list(pool.map(_build, units))
+        for unit, compiled, wall in results:
+            entry.compiled[unit] = compiled
+            self.warm_compile_walls[unit] = wall
+            self.metrics.compiles_total.inc()
+            _logger.info("model %r bucket %d (%dch) compiled in %.1fs",
+                         entry.model_id, unit[0], unit[1], wall)
+
+    def _warm_entry(self, entry: _ModelEntry,
+                    buckets: Optional[Sequence[int]] = None) -> None:
+        """Bring one entry's executables live for ``buckets`` (None =
+        the full warm order): store-deserialize what the warm-start tier
+        has (canary-gated), fresh-compile the rest (concurrently), warm-
+        execute every new unit once, then (re)serialize fresh compiles."""
+        warm_buckets = tuple(buckets) if buckets is not None \
+            else self._warm_order()
+        s = entry.image_size
+        _, dtype = self._entry_wire_spec(entry)
+        units = [(b, chans) for chans in self._entry_chans(entry)
+                 for b in warm_buckets if (b, chans) not in entry.compiled]
+        t_compile0 = time.monotonic()
+        loaded: Dict[Tuple[int, int], Tuple[Dict, Dict]] = {}
+        misses: List[Tuple[int, int]] = []
+        for unit in units:
+            got = self._store_load(entry, *unit)
+            if got is not None:
+                entry.compiled[unit] = got[0]
+                self.warm_compile_walls[unit] = 0.0
+                loaded[unit] = got[1]
+            else:
+                misses.append(unit)
+        self._compile_units(entry, misses)
+        self.metrics.warmup_seconds["compile"] += \
+            time.monotonic() - t_compile0
+        # canary-gate every deserialized executable BEFORE it can serve;
+        # a reject is evicted, recompiled fresh and re-serialized over
+        for unit, (fields, manifest) in loaded.items():
+            if not self._warm_canary(entry, unit[0], unit[1], fields,
+                                     manifest):
+                entry.compiled.pop(unit, None)
+                self._compile_units(entry, [unit])
+                misses.append(unit)
+        # one warm execution per new unit primes first-run allocations
+        # (host zeros + device_put: a jnp.zeros fill would compile a tiny
+        # broadcast program and break the warm path's zero-compile bar)
+        for b, chans in units:
+            jax.block_until_ready(self._run(
+                entry, b, chans, entry.variables,
+                jax.device_put(np.zeros((b, s, s, chans), dtype))))
+        for unit in misses:
+            self._store_save(entry, *unit)
         # golden canary batch: a fixed seeded input whose scores under the
         # CURRENT weights baseline both the reload canary and (optionally)
-        # its drift tolerance
-        if entry.golden is None:
-            b0 = self.buckets[0]
-            chans, dtype = self._entry_wire_spec(entry)
-            rng = np.random.default_rng(0xCA9A87)
-            if np.dtype(dtype) == np.uint8:
-                entry.golden = rng.integers(0, 256, (b0, s, s, chans),
-                                            dtype=np.uint8)
-            else:
-                entry.golden = rng.random((b0, s, s, chans),
-                                          dtype=np.float32)
-        chans, _ = self._entry_wire_spec(entry)
-        entry.golden_ref = np.asarray(
-            self._run(entry, self.buckets[0], chans, entry.variables,
-                      entry.golden))
+        # its drift tolerance — tied to the canonical smallest bucket, so
+        # a staged/priority warm that hasn't built it yet defers to the
+        # _warm_entry call that does
+        base_chans, dtype = self._entry_wire_spec(entry)
+        if (self.buckets[0], base_chans) in entry.compiled:
+            if entry.golden is None:
+                entry.golden = self._warm_golden_input(
+                    entry, self.buckets[0], base_chans)
+            entry.golden_ref = np.asarray(
+                self._run(entry, self.buckets[0], base_chans,
+                          entry.variables, entry.golden))
         entry.warmed = True
 
     def _rewarm(self) -> None:
@@ -540,11 +809,12 @@ class InferenceEngine:
                 continue       # cold add_model entry: no executables yet
             s = entry.image_size
             _, dtype = self._entry_wire_spec(entry)
-            for chans in self._entry_chans(entry):
-                for b in self.buckets:
-                    jax.block_until_ready(self._run(
-                        entry, b, chans, entry.variables,
-                        jnp.zeros((b, s, s, chans), dtype)))
+            # the executables that exist, not the full bucket grid: a
+            # staged warmup may still be building the tail buckets
+            for b, chans in sorted(list(entry.compiled)):
+                jax.block_until_ready(self._run(
+                    entry, b, chans, entry.variables,
+                    jax.device_put(np.zeros((b, s, s, chans), dtype))))
         self.metrics.rewarms_total.inc()
 
     # ------------------------------------------------------------------
@@ -563,10 +833,20 @@ class InferenceEngine:
                 f"multi_frame={entry.multi_frame})")
         return chans
 
+    def _warm_buckets(self, entry: _ModelEntry,
+                      chans: int) -> Tuple[int, ...]:
+        """Buckets with a LIVE executable for this channel width — the
+        only shapes dispatch may pad into.  During a staged warmup this
+        is a growing prefix of the bucket grid; fully warmed it equals
+        ``self.buckets``.  ``list()`` snapshots against the background
+        warm thread growing the dict mid-iteration."""
+        avail = sorted(b for (b, c) in list(entry.compiled) if c == chans)
+        return tuple(avail) if avail else self.buckets
+
     def _pad_batch(self, entry: _ModelEntry, arrays: List[np.ndarray],
                    chans: int) -> Tuple[np.ndarray, int]:
         n = len(arrays)
-        bucket = pick_bucket(n, self.buckets)
+        bucket = pick_bucket(n, self._warm_buckets(entry, chans))
         s = entry.image_size
         _, dtype = self._entry_wire_spec(entry)
         # fresh buffer every batch: jax CPU device_put zero-copies aligned
@@ -627,28 +907,38 @@ class InferenceEngine:
         try:
             for (model_id, chans), grp in groups.items():
                 entry = self._models[model_id]
-                seq = self._batch_seq
-                self._batch_seq += 1
-                if self.chaos.active and self.chaos.fires("serve_exc", seq):
-                    self.metrics.count_chaos("serve_exc")
-                    raise RuntimeError(
-                        f"chaos: injected score-fn exception (batch {seq})")
-                buf, bucket = self._pad_batch(
-                    entry, [r.array for r in grp], chans)
-                out = self._run(entry, bucket, chans, entry.variables,
-                                jax.device_put(buf))
-                now = time.monotonic()
-                for r in grp:
-                    r.timings["queue"] = now - r.enqueue_t
-                st = _Staged(grp, out, bucket, now, seq, model_id)
-                # gauge bump + ledger entry are ONE atom vs the recovery
-                # path (which zeroes the gauge and clears the ledger under
-                # the same lock) — split, a recovery landing between them
-                # would leave the inflight gauge permanently negative
-                with self._pending_lock:
-                    self.metrics.inflight += len(grp)
-                    self._pending.append(st)
-                staged.append(st)
+                # during a staged warmup the coalesced group may exceed
+                # the largest LIVE bucket: split it — each chunk is still
+                # a pre-compiled bucket, dispatched back-to-back (fully
+                # warmed, cap == max_batch and this is one chunk)
+                cap = self._warm_buckets(entry, chans)[-1]
+                for i0 in range(0, len(grp), cap):
+                    sub = grp[i0:i0 + cap]
+                    seq = self._batch_seq
+                    self._batch_seq += 1
+                    if self.chaos.active and \
+                            self.chaos.fires("serve_exc", seq):
+                        self.metrics.count_chaos("serve_exc")
+                        raise RuntimeError(
+                            f"chaos: injected score-fn exception "
+                            f"(batch {seq})")
+                    buf, bucket = self._pad_batch(
+                        entry, [r.array for r in sub], chans)
+                    out = self._run(entry, bucket, chans,
+                                    entry.variables, jax.device_put(buf))
+                    now = time.monotonic()
+                    for r in sub:
+                        r.timings["queue"] = now - r.enqueue_t
+                    st = _Staged(sub, out, bucket, now, seq, model_id)
+                    # gauge bump + ledger entry are ONE atom vs the
+                    # recovery path (which zeroes the gauge and clears
+                    # the ledger under the same lock) — split, a recovery
+                    # landing between them would leave the inflight gauge
+                    # permanently negative
+                    with self._pending_lock:
+                        self.metrics.inflight += len(sub)
+                        self._pending.append(st)
+                    staged.append(st)
         except Exception:
             # a later group poisoned the stage: the caller fails EVERY
             # request of the coalesced batch, so unwind the sub-batches
@@ -1067,7 +1357,8 @@ class InferenceEngine:
             s = entry.image_size
             probe = self._run(
                 entry, self.buckets[0], chans, new_vars,
-                jnp.zeros((self.buckets[0], s, s, chans), dtype))
+                jax.device_put(
+                    np.zeros((self.buckets[0], s, s, chans), dtype)))
             jax.block_until_ready(probe)
             return None
         canary = np.asarray(self._run(entry, self.buckets[0], chans,

@@ -85,6 +85,13 @@ MODEL_BOOK_KINDS = ("accepted", "scored", "failed", "shed", "deadline",
 #: cascade tiers (serving/cascade.py latency histograms)
 CASCADE_TIERS = ("student", "flagship")
 
+#: cold-start stages in pipeline order (spawn → serving): the runner
+#: stamps spawn/import/params_load/ready, the engine stamps compile
+#: (deserialize-or-compile) and warm — SERVE_BENCH §Cold start reads
+#: the breakdown off one /metrics scrape
+WARMUP_STAGES = ("spawn", "import", "params_load", "compile", "warm",
+                 "ready")
+
 
 class ServingMetrics:
     """One registry per server process."""
@@ -129,6 +136,22 @@ class ServingMetrics:
         self.cache_expired_total = _Counter()
         self.cache_evicted_total = _Counter()
         self.cache_invalidated_total = _Counter()
+        # warm-start executable store books (ISSUE 19): every store
+        # interaction at warmup lands in exactly one of hit (entry
+        # deserialized), miss (absent — fresh compile), fallback
+        # (present but corrupt/foreign/version-skewed — fresh compile,
+        # loudly); canary_rejects count deserialized executables the
+        # golden-batch gate refused to let serve (also recompiled);
+        # serialized counts entries (re)written to the store
+        self.warmstart_hits_total = _Counter()
+        self.warmstart_misses_total = _Counter()
+        self.warmstart_fallbacks_total = _Counter()
+        self.warmstart_canary_rejects_total = _Counter()
+        self.warmstart_serialized_total = _Counter()
+        # per-stage cold-start walls (gauges, seconds): stamped once on
+        # the way up, so one scrape yields the whole breakdown
+        self.warmup_seconds: Dict[str, float] = {
+            s: 0.0 for s in WARMUP_STAGES}
         self.chaos_injections_total: Dict[str, _Counter] = {}
         self._chaos_lock = threading.Lock()
         # per-model request books (ISSUE 14 multi-model engine): the
@@ -317,6 +340,24 @@ class ServingMetrics:
                 "by a reload's fingerprint bump (stale hits are "
                 "impossible by construction; this reclaims the memory)",
                 self.cache_invalidated_total.value)
+        counter("warmstart_hits_total", "Warm-start store entries "
+                "deserialized at warmup (each still gated by the "
+                "golden-batch canary before serving)",
+                self.warmstart_hits_total.value)
+        counter("warmstart_misses_total", "Warm-start store lookups "
+                "that found no entry (fresh compile + serialize)",
+                self.warmstart_misses_total.value)
+        counter("warmstart_fallbacks_total", "Warm-start entries "
+                "present but unusable (corrupt/foreign/version-skew) — "
+                "counted fallback to fresh compile, never a crash",
+                self.warmstart_fallbacks_total.value)
+        counter("warmstart_canary_rejects_total", "Deserialized "
+                "executables rejected by the golden-batch canary "
+                "(non-finite/shape/bit-drift) and recompiled fresh",
+                self.warmstart_canary_rejects_total.value)
+        counter("warmstart_serialized_total", "Executables serialized "
+                "into the warm-start store this process",
+                self.warmstart_serialized_total.value)
         # per-model request books (multi-model engine): one labeled
         # family per resolution kind, mirroring the global ledger
         with self._model_lock:
@@ -376,6 +417,11 @@ class ServingMetrics:
         gauge("throughput_rps",
               f"Scored requests/sec, trailing {self._window_s:.0f}s window",
               round(self.throughput(), 3))
+        doc.header("warmup_seconds", "Cold-start stage walls "
+                   "(spawn -> serving), seconds", "gauge")
+        for stage in WARMUP_STAGES:
+            doc.sample("warmup_seconds", f'{{stage="{stage}"}}',
+                       round(self.warmup_seconds[stage], 6))
 
         for stage in STAGES:
             # one-snapshot consistency per stage lives in PromText.histogram

@@ -1,0 +1,142 @@
+"""Content-addressed keys + manifests for the warm-start executable store.
+
+jax-free on purpose (enforced by dfdlint's purity rule): the key of a
+compiled executable must be computable — and auditable — without paying
+the jax import, so the router/autoscaler side and offline tooling can
+reason about store contents.  The jax-touching serialize/deserialize
+half lives in ``serving.warmstart``.
+
+A store key is the sha256 of the canonical-JSON rendering of a *loud,
+complete* fingerprint of everything compilation is a pure function of:
+
+===================  =====================================================
+field                meaning
+===================  =====================================================
+``schema``           ``dfd.serving.warmstart.v1`` — bump to orphan a store
+``jax`` / ``jaxlib`` installed dists (XLA ships pinned inside jaxlib)
+``backend``          ``jax.default_backend()`` at compile time
+``device_kind``      ``devices()[0].device_kind`` (cpu / TPU v4 / …)
+``program``          sha256 of the program identity: model repr + the
+                     (path, shape, dtype) signature of the params tree +
+                     normalization constants — weights are *arguments*,
+                     so checkpoints of one architecture share executables
+``geometry``         image_size / img_num / num_classes-bearing dict
+``bucket``/``chans`` the padded batch bucket and input channel width
+``wire``             wire dtype (``uint8`` / ``float32``)
+``quant``            params quantization mode (``f32``/``bf16``/``int8``)
+``sharding``         donation + in/out sharding signature ("" when unsharded)
+===================  =====================================================
+
+Any field drift → different key → clean miss; a *foreign* file under the
+right name is still rejected by the manifest echo-check and then by the
+golden-batch canary (see ``warmstart.ExecutableStore``).  Manifests ride
+next to the payload as JSON and additionally record the golden-batch
+scores + params fingerprint at serialize time so a same-checkpoint load
+can demand bit-exactness.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import tempfile
+from typing import Any, Dict
+
+import numpy as np
+
+WARMSTART_SCHEMA = "dfd.serving.warmstart.v1"
+
+#: key fields that must be present before hashing — a partial key is a bug,
+#: not a cache miss, so ``store_key`` refuses to hash one.
+KEY_FIELDS = (
+    "schema", "jax", "jaxlib", "backend", "device_kind",
+    "program", "geometry", "bucket", "chans", "wire", "quant", "sharding",
+)
+
+
+def runtime_versions() -> Dict[str, str]:
+    """Installed jax/jaxlib dist versions without importing jax."""
+    from importlib import metadata
+    out = {}
+    for dist in ("jax", "jaxlib"):
+        try:
+            out[dist] = metadata.version(dist)
+        except metadata.PackageNotFoundError:  # pragma: no cover - dev tree
+            out[dist] = "unknown"
+    return out
+
+
+def key_fields(*, backend: str, device_kind: str, program: str,
+               geometry: Dict[str, Any], bucket: int, chans: int,
+               wire: str, quant: str, sharding: str = "") -> Dict[str, Any]:
+    """Assemble the complete key-field dict (versions filled in here)."""
+    vers = runtime_versions()
+    return {
+        "schema": WARMSTART_SCHEMA,
+        "jax": vers["jax"],
+        "jaxlib": vers["jaxlib"],
+        "backend": str(backend),
+        "device_kind": str(device_kind),
+        "program": str(program),
+        "geometry": dict(geometry),
+        "bucket": int(bucket),
+        "chans": int(chans),
+        "wire": str(wire),
+        "quant": str(quant),
+        "sharding": str(sharding),
+    }
+
+
+def store_key(fields: Dict[str, Any]) -> str:
+    """sha256 over the canonical JSON of a *complete* field dict."""
+    missing = [f for f in KEY_FIELDS if f not in fields]
+    if missing:
+        raise ValueError(f"incomplete warmstart key, missing {missing}")
+    blob = json.dumps(fields, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def encode_array(arr: np.ndarray) -> Dict[str, Any]:
+    """Bit-exact JSON-able encoding of an ndarray (golden scores)."""
+    a = np.ascontiguousarray(arr)
+    return {
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+        "data": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def decode_array(enc: Dict[str, Any]) -> np.ndarray:
+    buf = base64.b64decode(enc["data"])
+    return np.frombuffer(buf, dtype=np.dtype(enc["dtype"])).reshape(enc["shape"])
+
+
+def write_atomic(path: str, blob: bytes) -> None:
+    """write → fsync → atomic rename, same idiom as data/packed.py."""
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".warm-", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def write_manifest(path: str, manifest: Dict[str, Any]) -> None:
+    blob = (json.dumps(manifest, sort_keys=True) + "\n").encode()
+    write_atomic(path, blob)
+
+
+def read_manifest(path: str) -> Dict[str, Any]:
+    with open(path, "rb") as f:
+        return json.loads(f.read().decode())

@@ -126,6 +126,25 @@ def _old_serving_render(self) -> str:
             "by a reload's fingerprint bump (stale hits are "
             "impossible by construction; this reclaims the memory)",
             self.cache_invalidated_total.value)
+    # the ISSUE 19 warm-start store counters, same hand-rolled style
+    counter("warmstart_hits_total", "Warm-start store entries "
+            "deserialized at warmup (each still gated by the "
+            "golden-batch canary before serving)",
+            self.warmstart_hits_total.value)
+    counter("warmstart_misses_total", "Warm-start store lookups "
+            "that found no entry (fresh compile + serialize)",
+            self.warmstart_misses_total.value)
+    counter("warmstart_fallbacks_total", "Warm-start entries "
+            "present but unusable (corrupt/foreign/version-skew) — "
+            "counted fallback to fresh compile, never a crash",
+            self.warmstart_fallbacks_total.value)
+    counter("warmstart_canary_rejects_total", "Deserialized "
+            "executables rejected by the golden-batch canary "
+            "(non-finite/shape/bit-drift) and recompiled fresh",
+            self.warmstart_canary_rejects_total.value)
+    counter("warmstart_serialized_total", "Executables serialized "
+            "into the warm-start store this process",
+            self.warmstart_serialized_total.value)
     # per-model request books (ISSUE 14 multi-model engine)
     from deepfake_detection_tpu.serving.metrics import MODEL_BOOK_KINDS
     with self._model_lock:
@@ -187,6 +206,13 @@ def _old_serving_render(self) -> str:
     gauge("throughput_rps",
           f"Scored requests/sec, trailing {self._window_s:.0f}s window",
           round(self.throughput(), 3))
+    from deepfake_detection_tpu.serving.metrics import WARMUP_STAGES
+    lines.append(f"# HELP {_PREFIX}_warmup_seconds Cold-start stage "
+                 "walls (spawn -> serving), seconds")
+    lines.append(f"# TYPE {_PREFIX}_warmup_seconds gauge")
+    for stage in WARMUP_STAGES:
+        lines.append(f'{_PREFIX}_warmup_seconds{{stage="{stage}"}} '
+                     f'{round(self.warmup_seconds[stage], 6)}')
     for stage in STAGES:
         h = self.latency[stage]
         name = f"{_PREFIX}_latency_seconds"
@@ -275,6 +301,18 @@ class TestSharedRenderer:
         m.cache_expired_total.inc()
         m.cache_evicted_total.inc()
         m.cache_invalidated_total.inc(2)
+        # the ISSUE 19 warm-start counters + stage walls
+        m.warmstart_hits_total.inc(2)
+        m.warmstart_misses_total.inc()
+        m.warmstart_fallbacks_total.inc()
+        m.warmstart_canary_rejects_total.inc()
+        m.warmstart_serialized_total.inc(2)
+        m.warmup_seconds["spawn"] = 0.25
+        m.warmup_seconds["import"] = 4.5
+        m.warmup_seconds["params_load"] = 1.125
+        m.warmup_seconds["compile"] = 30.0625
+        m.warmup_seconds["warm"] = 2.5
+        m.warmup_seconds["ready"] = 38.4375
         m.cache_entries = 3
         m.queue_depth = 5
         m.inflight = 2
@@ -388,6 +426,10 @@ def _old_router_render(self) -> str:
     counter("autoscale_down_total", "Acted scale-in decisions "
             "(idle held through the hysteresis window; drain-first)",
             self.autoscale_down_total.value)
+    counter("standby_promotions_total", "Scale-ups served by "
+            "promoting a parked warm standby into the registry "
+            "(ms-scale, no spawn, no compile)",
+            self.standby_promotions_total.value)
     counter("backfill_workers_spawned_total", "Backfill tenant "
             "workers launched onto idle capacity",
             self.backfill_workers_spawned_total.value)
@@ -420,6 +462,9 @@ def _old_router_render(self) -> str:
     gauge("autoscale_target_replicas", "The autoscaler's current "
           "desired fleet size (0 while autoscaling is off)",
           self.autoscale_target_replicas)
+    gauge("standby_replicas", "Parked fully-warmed standby replicas "
+          "(unregistered: hold a capacity slot, invisible to the "
+          "ring until promoted)", self.standby_replicas)
     gauge("backfill_workers", "Live backfill tenant workers on "
           "idle capacity", self.backfill_workers)
     for stage in STAGES:
@@ -463,10 +508,12 @@ class TestRouterRenderer:
         m.replicas_killed_total.inc()
         m.autoscale_up_total.inc(2)
         m.autoscale_down_total.inc()
+        m.standby_promotions_total.inc()
         m.backfill_workers_spawned_total.inc(2)
         m.backfill_yields_total.inc()
         m.backfill_workers = 1
         m.autoscale_target_replicas = 2
+        m.standby_replicas = 1
         m.count_forward("127.0.0.1:8377")
         m.count_forward("127.0.0.1:8379")
         m.latency["upstream"].observe(0.004)
