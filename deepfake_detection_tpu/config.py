@@ -1196,6 +1196,21 @@ class StreamConfig(ServeConfig):
     max_inflight_windows: int = 4        # per-stream bound; beyond it the
     # OLDEST pending window is dropped (drop-oldest backpressure)
 
+    # --- host fast path (streaming/ring.py, ISSUE 20) ---
+    # 'ring' = frame-once lifecycle: per-track preallocated crop rings,
+    # one prepare_canvas + one sha256 per crop, zero-copy FrameStack
+    # window payloads gathered straight into the engine's batch slab.
+    # 'concat' = the historical standalone-canvas + np.concatenate path
+    # (in-tree parity and bench reference)
+    assembly: str = "ring"
+    # consecutive-duplicate elision (frozen/low-motion streams): frames
+    # whose encoded bytes match their predecessor skip decode, and a
+    # window whose clip content equals the track's previous window skips
+    # submission — both counted (frames_dup_elided / windows_dup_elided),
+    # never silent.  Off by default: with it off the emitted-window
+    # stream is exactly the pre-fast-path one
+    dedup_frames: bool = False
+
     # --- verdict hysteresis (streaming/verdict.py) ---
     verdict_ema_alpha: float = 0.3       # EMA over window scores
     suspect_enter: float = 0.5
@@ -1244,6 +1259,9 @@ class StreamConfig(ServeConfig):
                 float(self.crop_margin) < 0 or float(self.stream_ttl_s) < 0:
             raise ValueError("window-hop / track-max-coast / crop-margin / "
                              "stream-ttl-s must be >= 0")
+        if self.assembly not in ("ring", "concat"):
+            raise ValueError(f"--assembly must be 'ring' or 'concat', "
+                             f"got {self.assembly!r}")
 
     @classmethod
     def argument_parser(cls) -> argparse.ArgumentParser:

@@ -49,6 +49,7 @@ JAX_FREE_MODULES = (
     "deepfake_detection_tpu.data.shm_ring",
     "deepfake_detection_tpu.obs",           # lazy __init__ (PEP 562)
     "deepfake_detection_tpu.obs.events",
+    "deepfake_detection_tpu.streaming.ring",
     "deepfake_detection_tpu.streaming.tracker",
     "deepfake_detection_tpu.streaming.verdict",
     "deepfake_detection_tpu.lint",          # the linter itself

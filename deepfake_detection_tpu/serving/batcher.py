@@ -77,13 +77,17 @@ class Request:
     _claim_guard = threading.Lock()
 
     __slots__ = ("id", "array", "model_id", "enqueue_t", "deadline_t",
-                 "timings", "on_resolve", "_event", "_result", "_error",
-                 "_claimed")
+                 "timings", "on_resolve", "from_cache", "_event",
+                 "_result", "_error", "_claimed")
 
     def __init__(self, array: Any, timeout_s: Optional[float] = None,
                  model_id: str = "default"):
         self.id = next(self._ids)
         self.array = array
+        #: True when the verdict cache resolved this request (exact/near
+        #: probe or coalesced rider) — callers that keep their own books
+        #: (the streaming dispatcher) split cache_hit from scored on it
+        self.from_cache = False
         self.model_id = model_id    # engine model-table key (per-model
         # books + compiled-program routing; "default" = primary model)
         self.enqueue_t = time.monotonic()
@@ -326,6 +330,7 @@ class MicroBatcher:
                 self.metrics.cache_near_hit_total.inc()
         req.timings["queue"] = 0.0
         req.timings["device"] = 0.0
+        req.from_cache = True
         req.set_result(np.array(value, copy=True))
         return req
 
@@ -358,6 +363,7 @@ class MicroBatcher:
                         self.metrics.count_model("cache_hit", f.model_id)
                         self.metrics.cache_coalesced_total.inc()
                     f.timings["device"] = 0.0
+                    f.from_cache = True
                     f.set_result(np.array(row, copy=True))
                 else:
                     # mirror the leader's outcome so the books identity

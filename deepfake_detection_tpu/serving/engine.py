@@ -855,7 +855,15 @@ class InferenceEngine:
         # block_until_ready)
         buf = np.zeros((bucket, s, s, chans), dtype)
         for i, a in enumerate(arrays):
-            buf[i] = a
+            write_into = getattr(a, "write_into", None)
+            if write_into is not None:
+                # streaming FrameStack payload (streaming/ring.py): the
+                # window's frames gather straight from the crop ring into
+                # this slab row — the ONE copy of the window's life —
+                # and the ring pins release
+                write_into(buf[i])
+            else:
+                buf[i] = a
         return buf, bucket
 
     def score_batch(self, arrays: List[np.ndarray],

@@ -32,6 +32,12 @@ _LAZY = {
     "WindowDispatcher": "windows",
     "WindowJob": "windows",
     "build_payload": "windows",
+    "CanvasRing": "ring",
+    "FrameRef": "ring",
+    "FrameStack": "ring",
+    "RingLease": "ring",
+    "frame_digest": "ring",
+    "window_key": "ring",
     "StreamingMetrics": "metrics",
     "StreamManager": "ingest",
     "StreamSession": "ingest",

@@ -45,6 +45,7 @@ else.
 from __future__ import annotations
 
 import collections
+import hashlib
 import json
 import logging
 import os
@@ -61,7 +62,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..serving.http import multipart_boundary, split_multipart
+from . import ring as ring_mod
 from .metrics import StreamingMetrics
+from .ring import CanvasRing, FrameStack, RingLease
 from .tracker import GreedyIouTracker, crop_box, make_localizer
 from .verdict import SEVERITY, VerdictMachine, VerdictThresholds
 from .windows import TrackWindower, WindowDispatcher, WindowJob, build_payload
@@ -71,7 +74,8 @@ _logger = logging.getLogger(__name__)
 __all__ = ["StreamSession", "StreamManager", "StreamServer",
            "multipart_boundary",
            "make_stream_server", "split_multipart", "split_jpeg_stream",
-           "decode_frame_bytes", "FfmpegDemuxer", "parse_verdict_vector"]
+           "decode_frame_bytes", "decode_frames_batch", "FfmpegDemuxer",
+           "parse_verdict_vector"]
 
 _MAX_BODY = 64 * 1024 * 1024     # one chunk of frames, not one image
 _ID_RE = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
@@ -133,6 +137,38 @@ def decode_frame_bytes(data: bytes) -> Optional[np.ndarray]:
         return np.asarray(img.convert("RGB"), np.uint8)
     except Exception:                              # noqa: BLE001 — 0-accept
         return None
+
+
+_decode_pool = None
+_decode_pool_lock = threading.Lock()
+
+
+def _get_decode_pool():
+    """Lazy shared decode fan-out pool.  ``decode_jpeg_bytes`` is a
+    ctypes call into the native libjpeg pool — it releases the GIL, so
+    a chunk's frames decode in parallel on a thread pool without any
+    new native ABI.  Lazy so pure-host users (tests, raw-wire) never
+    spawn the threads."""
+    global _decode_pool
+    if _decode_pool is None:
+        with _decode_pool_lock:
+            if _decode_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                workers = max(2, min(8, os.cpu_count() or 2))
+                _decode_pool = ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="stream-decode")
+    return _decode_pool
+
+
+def decode_frames_batch(encoded: List[bytes]) -> List[Optional[np.ndarray]]:
+    """Decode a whole chunk's encoded frames in ONE fan-out to the
+    native pool (the training ``_load_images`` idiom) instead of a
+    serial per-frame loop; order is preserved, failures stay ``None``
+    (counted by the caller).  Single frames skip the pool round-trip."""
+    if len(encoded) < 2:
+        return [decode_frame_bytes(d) for d in encoded]
+    return list(_get_decode_pool().map(decode_frame_bytes, encoded))
 
 
 def parse_verdict_vector(spec: str) -> List[float]:
@@ -302,8 +338,36 @@ class StreamSession:
         self.tracker = GreedyIouTracker(
             iou_min=cfg.track_iou_min, ema_alpha=cfg.track_ema_alpha,
             max_coast=cfg.track_max_coast, min_hits=cfg.track_min_hits)
-        self.windower = TrackWindower(cfg.img_num, stride=cfg.window_stride,
-                                      hop=cfg.window_hop)
+        #: 'ring' (frame-once fast path: preallocated crop rings, digests,
+        #: zero-copy FrameStack payloads) or 'concat' (the historical
+        #: standalone-canvas + np.concatenate path, kept as the in-tree
+        #: parity/bench reference)
+        self._assembly = getattr(cfg, "assembly", "ring")
+        self._dedup = bool(getattr(cfg, "dedup_frames", False))
+        self.windower = TrackWindower(
+            cfg.img_num, stride=cfg.window_stride, hop=cfg.window_hop,
+            digest_frames=(self._assembly == "ring"))
+        #: per-track crop rings (frame-once path).  Capacity covers the
+        #: windower span plus every window the per-stream queue bound
+        #: allows in flight (+ headroom for engine-staged windows);
+        #: exhaustion degrades to counted standalone rows, never a stall
+        self._rings: Dict[int, CanvasRing] = {}
+        self._ring_capacity = 1 + self.windower.span + self.windower.hop \
+            * (int(getattr(cfg, "max_inflight_windows", 4)) + 4)
+        self._norm: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        # consecutive-duplicate elision state (dedup_frames): encoded-byte
+        # digest + decoded array of the LAST frame, the localizer's last
+        # detections (deterministic on pixels, so a byte-identical frame
+        # reuses them), and each track's last submitted window key
+        self._last_enc_digest: Optional[bytes] = None
+        self._last_frame: Optional[np.ndarray] = None
+        self._last_detections: Optional[Any] = None
+        self._last_window_key: Dict[int, str] = {}
+        # per-track (smoothed box, pinned FrameRef) of the last canvas
+        # built: a byte-identical frame whose track box is exactly
+        # unchanged yields an identical crop, so the previous ring row is
+        # pinned again instead of re-running resize+pad+digest
+        self._crop_memo: Dict[int, Tuple[Tuple[float, ...], Any]] = {}
         self.thresholds = VerdictThresholds(
             cfg.suspect_enter, cfg.suspect_exit,
             cfg.fake_enter, cfg.fake_exit)
@@ -332,6 +396,10 @@ class StreamSession:
         self.windows_dropped = 0
         self.windows_shed = 0
         self.windows_failed = 0
+        self.windows_cache_hit = 0       # resolved from the verdict cache
+        self.windows_dup_elided = 0      # identical clip content, skipped
+        self.frames_dup_elided = 0       # byte-identical frames, no decode
+        self.canvas_copies_elided = 0    # redundant staging copies skipped
         self.demuxer: Optional[FfmpegDemuxer] = None
         self.closed = False
         # migration export set this: the session object may still be
@@ -371,9 +439,17 @@ class StreamSession:
         with self._lock:
             self.last_activity = time.monotonic()
 
-    def ingest_arrays(self, frames: List[np.ndarray]) -> Dict[str, Any]:
+    def ingest_arrays(self, frames: List[np.ndarray],
+                      dup_flags: Optional[List[bool]] = None
+                      ) -> Dict[str, Any]:
         """Run decoded frames through localize → track → window →
         dispatch; returns the chunk ack.
+
+        ``dup_flags[i]`` marks frame *i* byte-identical to its
+        predecessor (:meth:`decode_chunk` dedup): the localizer —
+        deterministic on pixels — is then skipped and its previous
+        detections reused; the tracker still runs, so EMA box state stays
+        bit-identical to ingesting the duplicate normally.
 
         The session lock is taken PER FRAME, not across the chunk: the
         process-wide collector thread needs the same lock to fold scores,
@@ -381,49 +457,154 @@ class StreamSession:
         verdict folding for every other stream while its canvases
         resize."""
         emitted = 0
-        for frame in frames:
+        for j, frame in enumerate(frames):
+            dup = bool(dup_flags[j]) if dup_flags is not None else False
             with self._lock:
                 self.last_activity = time.monotonic()
                 closed = self.closed
                 t0 = time.monotonic()
-                detections = self.localizer.localize(frame)
+                if dup and self._last_detections is not None:
+                    detections = self._last_detections
+                else:
+                    detections = self.localizer.localize(frame)
+                self._last_detections = detections
                 born0 = self.tracker.born_total
                 upd = self.tracker.update(self.frame_idx, detections)
                 self.metrics.tracks_born_total.inc(
                     self.tracker.born_total - born0)
                 for t in upd.died:
                     self.windower.drop_track(t.id)
+                    self._rings.pop(t.id, None)
+                    self._last_window_key.pop(t.id, None)
+                    memo = self._crop_memo.pop(t.id, None)
+                    if memo is not None:
+                        memo[1].decref()
                     vm = self.track_verdicts.pop(t.id, None)
                     if vm is not None:
                         self.dead_tracks.append(
                             {"track_id": t.id, **vm.snapshot()})
                     self.metrics.tracks_died_total.inc()
                 for t in upd.fresh:
-                    crop = crop_box(frame, t.box, self.cfg.crop_margin)
-                    canvas = self._canvas(crop)
-                    win = self.windower.push(t.id, self.frame_idx, canvas)
+                    win = self._push_crop(t, frame, dup)
                     if win is not None:
-                        self.windows_emitted += 1
-                        self.metrics.windows_emitted_total.inc()
-                        if closed:
-                            # close-time tail (ffmpeg flush): scoring a
-                            # window nobody can observe would also leak a
-                            # queue slot under a dead stream id — count
-                            # it dropped instead
-                            self.windows_dropped += 1
-                            self.metrics.windows_dropped_total.inc()
-                            continue
-                        payload = build_payload(win.frames, self.wire)
-                        self.dispatcher.push(WindowJob(
-                            self.id, t.id, win.window_idx, win.frame_idxs,
-                            payload, context=self))
-                        emitted += 1
+                        emitted += self._emit_window(t.id, win, closed)
                 self.frame_idx += 1
                 self.frames_ingested += 1
                 self.metrics.frames_ingested_total.inc()
                 self.metrics.latency["track"].observe(
                     time.monotonic() - t0)
         return {"frames_accepted": len(frames), "windows_emitted": emitted}
+
+    # -- frame-once fast path (ISSUE 20) -------------------------------
+    def _push_crop(self, track, frame: np.ndarray, dup: bool):
+        """Track → crop → windower entry.  Ring mode runs
+        ``prepare_canvas`` geometry straight into an acquired ring row
+        (the frame's ONE copy) and digests it once; concat mode is the
+        historical standalone-canvas path.  Under ``dedup_frames`` a
+        byte-identical frame whose smoothed box is exactly unchanged
+        provably yields the same canvas, so the previous row is pinned
+        again (counted) instead of rebuilt."""
+        track_id = track.id
+        if self._assembly != "ring":
+            crop = crop_box(frame, track.box, self.cfg.crop_margin)
+            canvas = self._canvas(crop)
+            return self.windower.push(track_id, self.frame_idx, canvas)
+        memo = self._crop_memo.get(track_id) if self._dedup else None
+        box = tuple(float(v) for v in track.box)
+        if dup and memo is not None and memo[0] == box:
+            ref = memo[1]
+            ref.incref()                  # the new buffer entry's pin
+            self._count_copies_elided(1)
+            return self.windower.push(track_id, self.frame_idx,
+                                      ref.canvas, digest=ref.digest,
+                                      ref=ref)
+        crop = crop_box(frame, track.box, self.cfg.crop_margin)
+        ring = self._rings.get(track_id)
+        if ring is None:
+            ring = self._rings[track_id] = CanvasRing(
+                self._ring_capacity, self.image_size)
+        ref = ring.acquire()
+        if ref.ring is None:              # pool exhausted: counted, safe
+            self.metrics.ring_overflow_total.inc()
+        self._canvas_into(ref.canvas, crop)
+        ref.digest = ring_mod.frame_digest(ref.canvas)
+        if self._dedup:
+            ref.incref()                  # the memo slot's own pin
+            if memo is not None:
+                memo[1].decref()
+            self._crop_memo[track_id] = (box, ref)
+        return self.windower.push(track_id, self.frame_idx, ref.canvas,
+                                  digest=ref.digest, ref=ref)
+
+    def _emit_window(self, track_id: int, win, closed: bool) -> int:
+        """Book one emitted window and stage it for scoring; returns 1
+        when a job was dispatched (the ack's ``windows_emitted``)."""
+        self.windows_emitted += 1
+        self.metrics.windows_emitted_total.inc()
+        if closed:
+            # close-time tail (ffmpeg flush): scoring a window nobody can
+            # observe would also leak a queue slot under a dead stream id
+            # — count it dropped instead
+            self.windows_dropped += 1
+            self.metrics.windows_dropped_total.inc()
+            self._release_window(win)
+            return 0
+        t0 = time.monotonic()
+        key = None
+        if win.digests is not None:
+            key = ring_mod.window_key(win.digests)
+            if self._dedup and key == self._last_window_key.get(track_id):
+                # identical clip content as this track's previous window
+                # (frozen/low-motion stream): the verdict machines already
+                # consumed this exact evidence one hop ago — skip
+                # submission entirely, counted, never silently
+                self.windows_dup_elided += 1
+                self.metrics.windows_dup_elided_total.inc()
+                self._release_window(win)
+                return 0
+            self._last_window_key[track_id] = key
+        if self._assembly == "ring":
+            lease = RingLease(win.refs or [])
+            payload = FrameStack(win.frames, norm=self._wire_norm(),
+                                 on_consumed=lease.release)
+        else:
+            lease = None
+            payload = build_payload(win.frames, self.wire,
+                                    on_elide=self._count_copies_elided)
+        content_key = (key, None) if key is not None and \
+            self._cache_live() else None
+        self.metrics.latency["assemble"].observe(time.monotonic() - t0)
+        self.dispatcher.push(WindowJob(
+            self.id, track_id, win.window_idx, win.frame_idxs, payload,
+            context=self, content_key=content_key, lease=lease))
+        return 1
+
+    @staticmethod
+    def _release_window(win) -> None:
+        """Free the ring pins of a window that will never be dispatched
+        (closed-stream tail, duplicate elision)."""
+        if win.refs:
+            for r in win.refs:
+                r.decref()
+
+    def _wire_norm(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Normalization constants for the float32 wire (None on uint8):
+        the FrameStack gather applies the exact ``normalize_concat``
+        per-frame expression while writing the batch slab."""
+        if self.wire != "float32":
+            return None
+        if self._norm is None:
+            from ..params import img_mean, img_std
+            self._norm = (img_mean, img_std)
+        return self._norm
+
+    def _cache_live(self) -> bool:
+        b = getattr(self.dispatcher, "batcher", None)
+        return b is not None and getattr(b, "cache", None) is not None
+
+    def _count_copies_elided(self, n: int) -> None:
+        self.canvas_copies_elided += n
+        self.metrics.canvas_copies_elided_total.inc(n)
 
     def current_verdict(self) -> str:
         """The status() verdict rule without building the whole status
@@ -439,12 +620,102 @@ class StreamSession:
         """Crop → engine canvas: the CLI's exact geometric preprocess
         (aspect-preserving downfit + center pad), skipped when the crop
         already IS the canvas (the full-frame / pre-sized parity path —
-        prepare_canvas is already a no-op there, this just saves work)."""
+        prepare_canvas is already a no-op there, this just saves work).
+        The historical unconditional ``ascontiguousarray`` is elided
+        (counted) for crops that are already contiguous."""
         h, w = crop.shape[:2]
+        if crop.flags.c_contiguous:
+            self._count_copies_elided(1)
+        else:
+            crop = np.ascontiguousarray(crop)
         if h == self.image_size and w == self.image_size:
-            return np.ascontiguousarray(crop)
+            return crop
         from ..params import prepare_canvas
-        return prepare_canvas(np.ascontiguousarray(crop), self.image_size)
+        return prepare_canvas(crop, self.image_size)
+
+    def _canvas_into(self, row: np.ndarray, crop: np.ndarray) -> None:
+        """``prepare_canvas`` written straight into a ring row — the
+        frame's ONE copy.  Bit-identical to
+        ``params.prepare_canvas(crop, image_size)``: same aspect-
+        preserving BILINEAR downfit, same center zero-pad placement
+        (``padding_image``'s ``(size - fitted) // 2`` top/left)."""
+        h, w = crop.shape[:2]
+        size = self.image_size
+        if h == size and w == size:
+            row[...] = crop               # pre-sized parity path: no-op fit
+            return
+        from ..params import resize
+        if not crop.flags.c_contiguous:
+            crop = np.ascontiguousarray(crop)
+        fitted = resize(crop, (size, size))
+        fh, fw = fitted.shape[:2]
+        if fh == size and fw == size:
+            row[...] = fitted
+            return
+        row[...] = 0
+        top = (size - fh) // 2
+        left = (size - fw) // 2
+        row[top:top + fh, left:left + fw] = fitted
+
+    # ------------------------------------------------------------------
+    def decode_chunk(self, encoded: List[bytes]
+                     ) -> Tuple[List[np.ndarray], List[bool], int]:
+        """One chunk's encoded frames → (decoded arrays, per-frame dup
+        flags, decode-error count), via ONE batched fan-out to the
+        native decode pool.
+
+        With ``dedup_frames`` on, a frame whose encoded bytes digest
+        equals its predecessor's skips decode entirely — counted
+        (``frames_dup_elided``), never silent — and reuses the previous
+        decoded array; a duplicate of an undecodable frame is an error
+        without burning a decode (same bytes, same failure)."""
+        if not self._dedup:
+            decoded = decode_frames_batch(encoded)
+            arrays = [a for a in decoded if a is not None]
+            errors = len(decoded) - len(arrays)
+            return arrays, [False] * len(arrays), errors
+        with self._lock:
+            prev_digest = self._last_enc_digest
+            last = self._last_frame
+        digests = [hashlib.sha256(d).digest() for d in encoded]
+        dup: List[bool] = []
+        unique_idx: List[int] = []
+        p = prev_digest
+        for i, dg in enumerate(digests):
+            is_dup = p is not None and dg == p
+            dup.append(is_dup)
+            if not is_dup:
+                unique_idx.append(i)
+            p = dg
+        by_idx = dict(zip(unique_idx, decode_frames_batch(
+            [encoded[i] for i in unique_idx])))
+        arrays: List[np.ndarray] = []
+        flags: List[bool] = []
+        errors = elided = 0
+        for i in range(len(encoded)):
+            if dup[i]:
+                if last is None:
+                    errors += 1
+                else:
+                    elided += 1
+                    arrays.append(last)
+                    flags.append(True)
+            else:
+                a = by_idx[i]
+                last = a
+                if a is None:
+                    errors += 1
+                else:
+                    arrays.append(a)
+                    flags.append(False)
+        with self._lock:
+            if digests:
+                self._last_enc_digest = digests[-1]
+                self._last_frame = last
+            self.frames_dup_elided += elided
+        if elided:
+            self.metrics.frames_dup_elided_total.inc(elided)
+        return arrays, flags, errors
 
     # ------------------------------------------------------------------
     def on_window_result(self, job: WindowJob,
@@ -463,11 +734,20 @@ class StreamSession:
                 return
             fake = float(scores[0])
             if self.verdict_vector:
-                # planted score (bench/test): indexed by arrival order
-                i = min(self.windows_scored, len(self.verdict_vector) - 1)
+                # planted score (bench/test): indexed by arrival order —
+                # cache hits arrive too, so the index is hits + scored
+                i = min(self.windows_scored + self.windows_cache_hit,
+                        len(self.verdict_vector) - 1)
                 fake = self.verdict_vector[i]
-            self.windows_scored += 1
-            self.metrics.windows_scored_total.inc()
+            if getattr(job, "cache_hit", False):
+                # resolved from the verdict cache: a real score for this
+                # clip content, folded into the verdict machines like any
+                # other — but booked as a hit, not a device window
+                self.windows_cache_hit += 1
+                self.metrics.windows_cache_hit_total.inc()
+            else:
+                self.windows_scored += 1
+                self.metrics.windows_scored_total.inc()
             self.metrics.latency["score"].observe(
                 time.monotonic() - job.enqueue_t)
             frame_idx = job.frame_idxs[-1]
@@ -528,6 +808,10 @@ class StreamSession:
                     "windows_dropped": self.windows_dropped,
                     "windows_shed": self.windows_shed,
                     "windows_failed": self.windows_failed,
+                    "windows_cache_hit": self.windows_cache_hit,
+                    "windows_dup_elided": self.windows_dup_elided,
+                    "frames_dup_elided": self.frames_dup_elided,
+                    "canvas_copies_elided": self.canvas_copies_elided,
                 },
                 "events": self.events[-events:],
             }
@@ -559,10 +843,12 @@ class StreamSession:
             # windows still in flight at snapshot time can never report
             # back into the restored session — account them dropped NOW so
             # the per-stream books (emitted == scored + dropped + shed +
-            # failed) still balance after the bounce
+            # failed + cache_hit + dup_elided) still balance after the
+            # bounce
             pending = self.windows_emitted - self.windows_scored - \
                 self.windows_dropped - self.windows_shed - \
-                self.windows_failed
+                self.windows_failed - self.windows_cache_hit - \
+                self.windows_dup_elided
             if pending > 0:
                 self.windows_dropped += pending
                 self.metrics.windows_dropped_total.inc(pending)
@@ -580,6 +866,10 @@ class StreamSession:
                     "windows_dropped": self.windows_dropped,
                     "windows_shed": self.windows_shed,
                     "windows_failed": self.windows_failed,
+                    "windows_cache_hit": self.windows_cache_hit,
+                    "windows_dup_elided": self.windows_dup_elided,
+                    "frames_dup_elided": self.frames_dup_elided,
+                    "canvas_copies_elided": self.canvas_copies_elided,
                 },
                 "stream_verdict": self.stream_verdict.state_dict(),
                 "track_verdicts": {
@@ -611,6 +901,24 @@ class StreamSession:
             self.windows_dropped = int(c["windows_dropped"])
             self.windows_shed = int(c["windows_shed"])
             self.windows_failed = int(c["windows_failed"])
+            # pre-ISSUE-20 snapshots predate these terms (schema v1
+            # layout unchanged — absent keys restore as 0)
+            self.windows_cache_hit = int(c.get("windows_cache_hit", 0))
+            self.windows_dup_elided = int(c.get("windows_dup_elided", 0))
+            self.frames_dup_elided = int(c.get("frames_dup_elided", 0))
+            self.canvas_copies_elided = int(
+                c.get("canvas_copies_elided", 0))
+            # duplicate-elision chains never cross a restore (the decoded
+            # predecessor is gone) and restored windower entries live
+            # outside the rings
+            self._last_enc_digest = None
+            self._last_frame = None
+            self._last_detections = None
+            self._last_window_key.clear()
+            for _box, ref in self._crop_memo.values():
+                ref.decref()
+            self._crop_memo.clear()
+            self._rings.clear()
             self.stream_verdict.load_state_dict(d["stream_verdict"])
             self.track_verdicts = {}
             for tid_s, vmd in d["track_verdicts"].items():
@@ -823,7 +1131,9 @@ class StreamManager:
         while time.monotonic() < deadline:
             with s._lock:
                 pending = s.windows_emitted - s.windows_scored - \
-                    s.windows_dropped - s.windows_shed - s.windows_failed
+                    s.windows_dropped - s.windows_shed - \
+                    s.windows_failed - s.windows_cache_hit - \
+                    s.windows_dup_elided
             if pending <= 0:
                 break
             time.sleep(0.02)
@@ -1129,20 +1439,13 @@ class _StreamHandler(BaseHTTPRequestHandler):
             return self._ingest_container(session, body, t0)
         else:                        # octet-stream: concatenated JPEGs
             encoded = split_jpeg_stream(body)
-        arrays = []
-        errors = 0
-        for data in encoded:
-            arr = decode_frame_bytes(data)
-            if arr is None:
-                errors += 1
-            else:
-                arrays.append(arr)
+        arrays, dup_flags, errors = session.decode_chunk(encoded)
         with session._lock:
             session.decode_errors += errors
         self.server.metrics.frames_decode_errors_total.inc(errors)
         self.server.metrics.latency["decode"].observe(
             time.monotonic() - t0)
-        ack = session.ingest_arrays(arrays) if arrays else \
+        ack = session.ingest_arrays(arrays, dup_flags) if arrays else \
             {"frames_accepted": 0, "windows_emitted": 0}
         ack["decode_errors"] = errors
         return ack
@@ -1161,6 +1464,12 @@ class _StreamHandler(BaseHTTPRequestHandler):
                               f"multiple of {h}x{w}x3")
         n = len(body) // frame_bytes
         arrays = list(np.frombuffer(body, np.uint8).reshape(n, h, w, 3))
+        with session._lock:
+            # raw frames break the encoded-byte duplicate chain: the next
+            # encoded chunk's first frame is no longer "consecutive" with
+            # the last encoded one
+            session._last_enc_digest = None
+            session._last_frame = None
         self.server.metrics.latency["decode"].observe(
             time.monotonic() - t0)
         ack = session.ingest_arrays(arrays)
@@ -1203,19 +1512,13 @@ class _StreamHandler(BaseHTTPRequestHandler):
             raise _ChunkError(
                 422, f"ffmpeg demuxer failed ({e!r}); demuxer reset — "
                      f"resend from a container keyframe") from None
-        arrays, errors = [], 0
-        for data in encoded:
-            arr = decode_frame_bytes(data)
-            if arr is None:
-                errors += 1
-            else:
-                arrays.append(arr)
+        arrays, dup_flags, errors = session.decode_chunk(encoded)
         with session._lock:
             session.decode_errors += errors
         self.server.metrics.frames_decode_errors_total.inc(errors)
         self.server.metrics.latency["decode"].observe(
             time.monotonic() - t0)
-        ack = session.ingest_arrays(arrays) if arrays else \
+        ack = session.ingest_arrays(arrays, dup_flags) if arrays else \
             {"frames_accepted": 0, "windows_emitted": 0}
         ack["decode_errors"] = errors
         ack["note"] = "container frames surface as ffmpeg flushes"

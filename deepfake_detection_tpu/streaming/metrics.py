@@ -11,6 +11,7 @@ Stage histograms follow a frame/window's life:
 
 * ``decode`` — chunk bytes → uint8 frames (native pool or PIL);
 * ``track`` — localize + tracker update + crop + canvas per frame;
+* ``assemble`` — window emission → job dispatched (key + payload);
 * ``score`` — window queued → softmax row back (queue + device);
 * ``ingest`` — whole ``POST /streams/<id>/frames`` handler.
 """
@@ -32,7 +33,7 @@ _PREFIX = "dfd_streaming"
 _BOUNDS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
            0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
-STAGES = ("decode", "track", "score", "ingest")
+STAGES = ("decode", "track", "assemble", "score", "ingest")
 
 
 class StreamingMetrics:
@@ -54,6 +55,18 @@ class StreamingMetrics:
         self.windows_dropped_total = _Counter()    # drop-oldest backpressure
         self.windows_shed_total = _Counter()       # batcher QueueFull
         self.windows_failed_total = _Counter()     # deadline / engine error
+        self.windows_cache_hit_total = _Counter()  # resolved from the
+        # verdict cache (content-identical clip scored before) — never
+        # entered a device bucket
+        self.windows_dup_elided_total = _Counter()  # clip content identical
+        # to the track's previous window (dedup_frames): submission skipped
+        self.frames_dup_elided_total = _Counter()  # encoded bytes identical
+        # to the previous frame (dedup_frames): decode skipped
+        self.canvas_copies_elided_total = _Counter()  # redundant host
+        # staging work skipped (already-contiguous crops; duplicate-frame
+        # canvas reuse under dedup_frames)
+        self.ring_overflow_total = _Counter()      # crop-ring pool
+        # exhausted: counted standalone-row fallback (never a stall)
         self.demux_failures_total = _Counter()     # ffmpeg died mid-stream
         self.streams_restored_total = _Counter()   # sessions resumed from
         # a state-dir snapshot after a server bounce
@@ -107,6 +120,21 @@ class StreamingMetrics:
                 "(queue full)", self.windows_shed_total.value)
         counter("windows_failed_total", "Windows failed (deadline or "
                 "engine error)", self.windows_failed_total.value)
+        counter("windows_cache_hit_total", "Windows resolved from the "
+                "verdict cache (never entered a bucket)",
+                self.windows_cache_hit_total.value)
+        counter("windows_dup_elided_total", "Windows skipped as exact "
+                "duplicates of the track's previous window",
+                self.windows_dup_elided_total.value)
+        counter("frames_dup_elided_total", "Frames whose decode was "
+                "skipped as byte-identical to their predecessor",
+                self.frames_dup_elided_total.value)
+        counter("canvas_copies_elided_total", "Redundant host canvas "
+                "staging skipped (contiguous crops, duplicate-frame "
+                "reuse)", self.canvas_copies_elided_total.value)
+        counter("ring_overflow_total", "Crop-ring pool exhaustions "
+                "(counted standalone-row fallback)",
+                self.ring_overflow_total.value)
         counter("demux_failures_total", "ffmpeg demuxer deaths surfaced "
                 "as per-stream errors (422 + demuxer reset)",
                 self.demux_failures_total.value)

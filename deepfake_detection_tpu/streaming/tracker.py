@@ -287,32 +287,54 @@ class GreedyIouTracker:
     def update(self, frame_idx: int,
                detections: Sequence[Detection]) -> TrackerUpdate:
         tracks = list(self.tracks.values())
-        pairs = []
-        for t in tracks:
-            for di, (box, _score) in enumerate(detections):
-                v = iou(t.box, box)
-                if v >= self.iou_min:
-                    # -iou first => descending; id/index tiebreak => stable
-                    pairs.append((-v, t.id, di))
-        pairs.sort()
         used_tracks, used_dets = set(), set()
         matched: List[Track] = []
-        for neg_iou, tid, di in pairs:
-            if tid in used_tracks or di in used_dets:
-                continue
-            used_tracks.add(tid)
-            used_dets.add(di)
-            t = self.tracks[tid]
-            box, score = detections[di]
-            a = self.ema_alpha
-            t.box = tuple(a * float(d) + (1.0 - a) * p
-                          for d, p in zip(box, t.box))
-            t.score = float(score)
-            t.hits += 1
-            t.misses = 0
-            t.last_frame = int(frame_idx)
-            if t.hits >= self.min_hits:
-                matched.append(t)
+        if tracks and detections:
+            # full IoU matrix in one numpy pass (ISSUE 20): float64
+            # arithmetic in the exact order of the scalar iou(), so every
+            # candidate value — and therefore every greedy assignment —
+            # is bit-identical to the historical nested-loop version
+            tb = np.asarray([t.box for t in tracks], np.float64)
+            db = np.asarray([d[0] for d in detections], np.float64)
+            ix1 = np.maximum(tb[:, None, 0], db[None, :, 0])
+            iy1 = np.maximum(tb[:, None, 1], db[None, :, 1])
+            ix2 = np.minimum(tb[:, None, 2], db[None, :, 2])
+            iy2 = np.minimum(tb[:, None, 3], db[None, :, 3])
+            inter = np.maximum(0.0, ix2 - ix1) * np.maximum(0.0, iy2 - iy1)
+            area_t = np.maximum(0.0, tb[:, 2] - tb[:, 0]) * \
+                np.maximum(0.0, tb[:, 3] - tb[:, 1])
+            area_d = np.maximum(0.0, db[:, 2] - db[:, 0]) * \
+                np.maximum(0.0, db[:, 3] - db[:, 1])
+            union = area_t[:, None] + area_d[None, :] - inter
+            # inter > 0 implies union >= inter > 0 (each area bounds the
+            # intersection), so the guarded divide mirrors iou()'s early
+            # returns exactly
+            with np.errstate(divide="ignore", invalid="ignore"):
+                v = np.where(inter > 0.0, inter / union, 0.0)
+            ti, dj = np.nonzero(v >= self.iou_min)
+            if ti.size:
+                tids = np.asarray([t.id for t in tracks], np.int64)[ti]
+                # lexsort keys are LAST-is-primary: -iou descending, then
+                # track id, then detection index — the tuple order
+                # pairs.sort() used on (-iou, t.id, di)
+                order = np.lexsort((dj, tids, -v[ti, dj]))
+                a = self.ema_alpha
+                for k in order:
+                    tid, di = int(tids[k]), int(dj[k])
+                    if tid in used_tracks or di in used_dets:
+                        continue
+                    used_tracks.add(tid)
+                    used_dets.add(di)
+                    t = self.tracks[tid]
+                    box, score = detections[di]
+                    t.box = tuple(a * float(d) + (1.0 - a) * p
+                                  for d, p in zip(box, t.box))
+                    t.score = float(score)
+                    t.hits += 1
+                    t.misses = 0
+                    t.last_frame = int(frame_idx)
+                    if t.hits >= self.min_hits:
+                        matched.append(t)
         born: List[Track] = []
         confirmed_born: List[Track] = []
         for di, (box, score) in enumerate(detections):

@@ -43,6 +43,8 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import ring as ring_mod
+
 __all__ = ["Window", "TrackWindower", "build_payload", "WindowJob",
            "WindowDispatcher"]
 
@@ -50,16 +52,28 @@ _logger = logging.getLogger(__name__)
 
 
 class Window:
-    """One emitted clip: ``img_num`` uint8 canvases + their frame indices."""
+    """One emitted clip: ``img_num`` uint8 canvases + their frame indices.
 
-    __slots__ = ("track_id", "frames", "frame_idxs", "window_idx")
+    On the frame-once path (ISSUE 20) the frames are views into the
+    per-track :class:`~.ring.CanvasRing`; ``digests`` carries the cached
+    per-crop sha256s (frame order) for window content keys, and ``refs``
+    the ring pins this window took at emission — whoever consumes the
+    window releases them (the session wraps them in a ``RingLease``).
+    """
+
+    __slots__ = ("track_id", "frames", "frame_idxs", "window_idx",
+                 "digests", "refs")
 
     def __init__(self, track_id: int, frames: List[np.ndarray],
-                 frame_idxs: Tuple[int, ...], window_idx: int):
+                 frame_idxs: Tuple[int, ...], window_idx: int,
+                 digests: Optional[Tuple[bytes, ...]] = None,
+                 refs: Optional[List[Any]] = None):
         self.track_id = track_id
         self.frames = frames
         self.frame_idxs = frame_idxs
         self.window_idx = window_idx
+        self.digests = digests
+        self.refs = refs
 
 
 class TrackWindower:
@@ -73,7 +87,8 @@ class TrackWindower:
     training clips.
     """
 
-    def __init__(self, img_num: int, stride: int = 1, hop: int = 0):
+    def __init__(self, img_num: int, stride: int = 1, hop: int = 0,
+                 digest_frames: bool = False):
         if img_num < 1:
             raise ValueError(f"img_num must be >= 1, got {img_num}")
         if stride < 1:
@@ -84,22 +99,35 @@ class TrackWindower:
         if self.hop < 1:
             raise ValueError(f"hop must be >= 1, got {self.hop}")
         self.span = (self.img_num - 1) * self.stride + 1
-        self._buffers: Dict[int, Deque[Tuple[int, np.ndarray]]] = {}
+        # frame-once mode: restored snapshot frames get their canonical
+        # digest computed once here, so post-restore windows stay keyable
+        self.digest_frames = bool(digest_frames)
+        # entries: (frame_idx, canvas, digest|None, FrameRef|None)
+        self._buffers: Dict[int, Deque[Tuple[int, np.ndarray,
+                                             Optional[bytes], Any]]] = {}
         self._pushes: Dict[int, int] = {}
         self._emitted: Dict[int, int] = {}
         self._last_emit_push: Dict[int, int] = {}
 
     # ------------------------------------------------------------------
-    def push(self, track_id: int, frame_idx: int,
-             canvas: np.ndarray) -> Optional[Window]:
-        """Add one crop; returns a :class:`Window` when one is due."""
+    def push(self, track_id: int, frame_idx: int, canvas: np.ndarray,
+             digest: Optional[bytes] = None,
+             ref: Any = None) -> Optional[Window]:
+        """Add one crop; returns a :class:`Window` when one is due.
+
+        ``digest``/``ref`` ride along on the frame-once path: the buffer
+        takes ownership of one reference on ``ref`` and releases it when
+        the entry falls out of the span (or the track drops)."""
         buf = self._buffers.get(track_id)
         if buf is None:
-            buf = self._buffers[track_id] = collections.deque(
-                maxlen=self.span)
+            buf = self._buffers[track_id] = collections.deque()
             self._pushes[track_id] = 0
             self._emitted[track_id] = 0
-        buf.append((int(frame_idx), canvas))
+        buf.append((int(frame_idx), canvas, digest, ref))
+        if len(buf) > self.span:
+            old = buf.popleft()
+            if old[3] is not None:
+                old[3].decref()
         self._pushes[track_id] += 1
         pushes = self._pushes[track_id]
         if len(buf) < self.span:
@@ -113,12 +141,31 @@ class TrackWindower:
         self._last_emit_push[track_id] = pushes
         picked = [buf[i] for i in range(self.span - 1, -1, -self.stride)]
         picked.reverse()                            # oldest → newest
-        idxs = tuple(i for i, _ in picked)
-        frames = [c for _, c in picked]
-        return Window(track_id, frames, idxs, emitted)
+        idxs = tuple(e[0] for e in picked)
+        frames = [e[1] for e in picked]
+        digests: Optional[Tuple[bytes, ...]] = tuple(
+            e[2] for e in picked)
+        if any(d is None for d in digests):
+            digests = None
+        refs = [e[3] for e in picked if e[3] is not None]
+        for r in refs:                              # pin rows for the
+            r.incref()                              # window's lifetime
+        return Window(track_id, frames, idxs, emitted, digests,
+                      refs or None)
+
+    def newest(self, track_id: int) -> Optional[Tuple[int, np.ndarray,
+                                                      Optional[bytes],
+                                                      Any]]:
+        """The track's most recent buffer entry (duplicate-frame reuse)."""
+        buf = self._buffers.get(track_id)
+        return buf[-1] if buf else None
 
     def drop_track(self, track_id: int) -> None:
-        self._buffers.pop(track_id, None)
+        buf = self._buffers.pop(track_id, None)
+        if buf:
+            for e in buf:
+                if e[3] is not None:
+                    e[3].decref()
         self._pushes.pop(track_id, None)
         self._emitted.pop(track_id, None)
         self._last_emit_push.pop(track_id, None)
@@ -145,7 +192,7 @@ class TrackWindower:
                      "dtype": str(np.asarray(canvas).dtype),
                      "data_b64": base64.b64encode(
                          np.ascontiguousarray(canvas).tobytes()).decode()}
-                    for fi, canvas in buf],
+                    for fi, canvas, _digest, _ref in buf],
             }
         return {"img_num": self.img_num, "stride": self.stride,
                 "hop": self.hop, "tracks": tracks}
@@ -158,18 +205,25 @@ class TrackWindower:
                 f"img_num={d['img_num']} stride={d['stride']} "
                 f"hop={d['hop']}, server runs img_num={self.img_num} "
                 f"stride={self.stride} hop={self.hop}")
+        for tid in list(self._buffers):
+            self.drop_track(tid)                   # release any ring pins
         self._buffers.clear()
         self._pushes.clear()
         self._emitted.clear()
         self._last_emit_push.clear()
         for tid_s, td in d["tracks"].items():
             tid = int(tid_s)
-            buf = collections.deque(maxlen=self.span)
+            buf = collections.deque()
             for fr in td["frames"]:
                 canvas = np.frombuffer(
                     base64.b64decode(fr["data_b64"]),
                     dtype=np.dtype(fr["dtype"])).reshape(fr["shape"])
-                buf.append((int(fr["frame_idx"]), canvas))
+                # snapshots predate digests (schema v1 unchanged): the
+                # canonical digest is recomputed once at restore so
+                # post-restore windows stay cache-keyable
+                digest = ring_mod.frame_digest(canvas) \
+                    if self.digest_frames else None
+                buf.append((int(fr["frame_idx"]), canvas, digest, None))
             self._buffers[tid] = buf
             self._pushes[tid] = int(td["pushes"])
             self._emitted[tid] = int(td["emitted"])
@@ -177,20 +231,29 @@ class TrackWindower:
                 self._last_emit_push[tid] = int(td["last_emit_push"])
 
 
-def build_payload(frames: List[np.ndarray], wire: str) -> np.ndarray:
+def build_payload(frames: List[np.ndarray], wire: str,
+                  on_elide: Optional[Callable[[int], None]] = None
+                  ) -> np.ndarray:
     """Window frames (uint8 HWC canvases) → one wire-format sample.
 
     float32: exact CLI preprocess per frame + channel concat
     (``params.normalize_concat``) — scores are bit-identical to the CLI
     path because the engine's float32 buckets ARE the CLI program.
     uint8: channel-concat only; normalization runs inside the engine's
-    multi-frame device program.
+    multi-frame device program.  ``np.concatenate`` copies its inputs
+    regardless of contiguity, so the historical per-frame
+    ``ascontiguousarray`` staging copy is elided (counted via
+    ``on_elide`` for the frames that would actually have copied —
+    non-contiguous crops).
     """
     from ..params import normalize_concat
     if wire == "float32":
         return normalize_concat(frames)
-    return np.concatenate([np.ascontiguousarray(f) for f in frames],
-                          axis=-1)
+    if on_elide is not None:
+        elided = sum(1 for f in frames if not f.flags.c_contiguous)
+        if elided:
+            on_elide(elided)
+    return np.concatenate(frames, axis=-1)
 
 
 # ---------------------------------------------------------------------------
@@ -199,14 +262,22 @@ def build_payload(frames: List[np.ndarray], wire: str) -> np.ndarray:
 
 class WindowJob:
     """One window queued for scoring, with enough context for the result
-    callback to route it back to its stream/track verdict state."""
+    callback to route it back to its stream/track verdict state.
+
+    ``content_key`` (when the verdict cache is live) is the window's
+    ``(content_hash, phash)`` identity for ``MicroBatcher.submit``;
+    ``lease`` holds the ring pins released on every terminal path;
+    ``cache_hit`` is set by the collector when the request resolved from
+    the cache instead of a device bucket."""
 
     __slots__ = ("stream_id", "track_id", "window_idx", "frame_idxs",
-                 "payload", "enqueue_t", "context", "attempts")
+                 "payload", "enqueue_t", "context", "attempts",
+                 "content_key", "lease", "cache_hit")
 
     def __init__(self, stream_id: str, track_id: int, window_idx: int,
                  frame_idxs: Tuple[int, ...], payload: np.ndarray,
-                 context: Any = None):
+                 context: Any = None, content_key: Any = None,
+                 lease: Any = None):
         self.stream_id = stream_id
         self.track_id = track_id
         self.window_idx = window_idx
@@ -215,6 +286,9 @@ class WindowJob:
         self.enqueue_t = time.monotonic()
         self.context = context
         self.attempts = 0
+        self.content_key = content_key
+        self.lease = lease
+        self.cache_hit = False
 
 
 class WindowDispatcher:
@@ -239,6 +313,9 @@ class WindowDispatcher:
             raise ValueError(f"max_pending must be >= 1, got {max_pending}")
         self.batcher = batcher
         self.max_pending = int(max_pending)
+        #: bounded wait for a queue slot before drop-oldest fires (see
+        #: push()); 0 restores the historical drop-immediately behavior
+        self.push_grace_s = 0.02
         self.request_timeout_s = float(request_timeout_s)
         self.shed_retries = max(0, int(shed_retries))
         self._on_result = on_result
@@ -255,6 +332,7 @@ class WindowDispatcher:
         self.shed_total = 0
         self.failed_total = 0
         self.scored_total = 0
+        self.cache_hit_total = 0
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -278,11 +356,21 @@ class WindowDispatcher:
         self._submit_thread = self._collect_thread = None
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _release_lease(job) -> None:
+        """Terminal paths free the job's ring pins; idempotent (the
+        engine's staging gather may already have consumed them)."""
+        lease = getattr(job, "lease", None)
+        if lease is not None:
+            job.lease = None
+            lease.release()
+
     def on_result(self, job: WindowJob, scores, error) -> None:
         """Guarded callback: an exception in the sink (event-log disk
         full, plugin bug) must not kill the dispatcher threads — every
         stream's verdicts would silently freeze while /healthz stays
         green."""
+        self._release_lease(job)
         try:
             self._on_result(job, scores, error)
         except Exception:                          # noqa: BLE001
@@ -290,6 +378,7 @@ class WindowDispatcher:
                               "window %d", job.stream_id, job.window_idx)
 
     def on_drop(self, job: WindowJob, reason: str) -> None:
+        self._release_lease(job)
         try:
             self._on_drop(job, reason)
         except Exception:                          # noqa: BLE001
@@ -298,20 +387,43 @@ class WindowDispatcher:
 
     # ------------------------------------------------------------------
     def push(self, job: WindowJob) -> None:
-        """Queue a window (ingest thread); never blocks — drops oldest
-        past the per-stream bound."""
-        with self._cv:
-            q = self._queues.get(job.stream_id)
-            if q is None:
-                q = self._queues[job.stream_id] = collections.deque()
-            dropped = None
-            if len(q) >= self.max_pending:
-                dropped = q.popleft()
-                self.dropped_total += 1
-            q.append(job)
-            self._cv.notify()
-        if dropped is not None:
-            self.on_drop(dropped, "backpressure")
+        """Queue a window (ingest thread); drops oldest past the
+        per-stream bound.
+
+        A full queue first gets a short bounded grace (``push_grace_s``)
+        for the submit thread to drain a slot: the frame-once assembly
+        path emits a chunk's windows microseconds apart, so without the
+        grace a burst smaller than the engine's throughput would shed
+        windows purely because the submit thread hadn't had a GIL slice
+        yet (the historical per-window copy chain paced this
+        accidentally).  Under sustained overload the queue is still full
+        when the grace lapses and the oldest window drops, exactly as
+        before — bounded wait, never unbounded blocking."""
+        deadline = None
+        while True:
+            with self._cv:
+                q = self._queues.get(job.stream_id)
+                if q is None:
+                    q = self._queues[job.stream_id] = collections.deque()
+                if len(q) < self.max_pending:
+                    q.append(job)
+                    self._cv.notify()
+                    return
+                now = time.monotonic()
+                if deadline is None:
+                    # no submit thread (unit tests, post-stop) ⇒ nothing
+                    # will ever drain: drop immediately, as before
+                    deadline = now + (self.push_grace_s
+                                      if self._submit_thread is not None
+                                      else 0.0)
+                if now >= deadline:
+                    dropped = q.popleft()
+                    self.dropped_total += 1
+                    q.append(job)
+                    self._cv.notify()
+                    break
+            time.sleep(0.0005)
+        self.on_drop(dropped, "backpressure")
 
     def drop_stream(self, stream_id: str) -> int:
         """Discard a closed stream's pending windows; returns the count."""
@@ -350,8 +462,13 @@ class WindowDispatcher:
             if job is None:
                 return
             try:
-                req = self.batcher.submit(job.payload,
-                                          timeout_s=self.request_timeout_s)
+                if job.content_key is not None:
+                    req = self.batcher.submit(
+                        job.payload, timeout_s=self.request_timeout_s,
+                        content_key=job.content_key)
+                else:
+                    req = self.batcher.submit(
+                        job.payload, timeout_s=self.request_timeout_s)
             except QueueFull:
                 if job.attempts < self.shed_retries:
                     # one paced retry before giving the window up: a shed
@@ -398,5 +515,9 @@ class WindowDispatcher:
                 self.failed_total += 1
                 self.on_result(job, None, e)
                 continue
-            self.scored_total += 1
+            if getattr(req, "from_cache", False):
+                self.cache_hit_total += 1
+                job.cache_hit = True
+            else:
+                self.scored_total += 1
             self.on_result(job, np.asarray(scores), None)

@@ -1,0 +1,378 @@
+"""Streaming frame-once fast path (ISSUE 20): crop rings, zero-copy
+window assembly, per-window dedup, and the exact-books contract.
+
+The oracle everywhere is the historical concat path (kept in-tree as
+``assembly="concat"``) plus from-scratch recomputation: fast-path
+payloads and content keys must be bit-identical to what the old
+``prepare_canvas`` + ``np.concatenate`` chain produces on the same
+frames, across every overlap regime (hop x stride), and the 6-term
+window books must balance exactly through dedup/drop paths.
+"""
+
+import io
+import itertools
+import types
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from deepfake_detection_tpu.streaming.metrics import StreamingMetrics
+from deepfake_detection_tpu.streaming.ring import (CanvasRing, FrameStack,
+                                                   RingLease, frame_digest,
+                                                   window_key)
+from deepfake_detection_tpu.streaming.tracker import GreedyIouTracker, iou
+from deepfake_detection_tpu.streaming.windows import build_payload
+
+pytestmark = [pytest.mark.smoke, pytest.mark.streaming]
+
+_SIZE = 16
+
+
+def _frames(n, h=20, w=24, seed=3):
+    """Deterministic non-square frames: prepare_canvas must resize AND
+    pad, exercising the full geometry of the ring write."""
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+            for _ in range(n)]
+
+
+def _jpeg(frame):
+    buf = io.BytesIO()
+    Image.fromarray(frame).save(buf, format="JPEG", quality=90)
+    return buf.getvalue()
+
+
+def _session(jobs, cache_live=False, **cfg_kw):
+    from deepfake_detection_tpu.config import StreamConfig
+    from deepfake_detection_tpu.streaming.ingest import StreamSession
+    kw = dict(image_size=_SIZE, img_num=4, buckets=(1,), max_queue=1,
+              stream_ttl_s=0.0, verdict_vector="0.1*2,0.95*8")
+    kw.update(cfg_kw)
+    cfg = StreamConfig(**kw)
+    disp = types.SimpleNamespace(push=jobs.append)
+    if cache_live:
+        # a non-None .batcher.cache is all _cache_live() checks: content
+        # keys get computed without a real micro-batcher in the loop
+        disp.batcher = types.SimpleNamespace(cache=object())
+    return StreamSession("fp", cfg, disp, StreamingMetrics(), _SIZE,
+                         kw.get("wire", "float32"))
+
+
+def _score_all(session, jobs):
+    """Resolve every pending job the way the dispatcher would: score it
+    and release its ring lease."""
+    while jobs:
+        job = jobs.pop(0)
+        session.on_window_result(job, np.asarray([0.5, 0.5]), None)
+        if getattr(job, "lease", None) is not None:
+            job.lease.release()
+
+
+# ---------------------------------------------------------------------------
+# ring primitives
+# ---------------------------------------------------------------------------
+
+def test_canvas_ring_refcount_overflow_and_reuse():
+    r = CanvasRing(2, 8)
+    a, b = r.acquire(), r.acquire()
+    assert a.ring is r and b.ring is r and a.row != b.row
+    assert r.free_rows() == 0
+    # exhausted pool degrades to a counted standalone row, never blocks
+    c = r.acquire()
+    assert c.ring is None and c.canvas.shape == (8, 8, 3)
+    assert r.overflow_total == 1
+    c.incref()
+    c.decref()                            # standalone: no-ops, GC-owned
+    # release recirculates the row; extra pins hold it
+    a.decref()
+    assert r.free_rows() == 1
+    d = r.acquire()
+    assert d.ring is r and d.row == a.row
+    b.incref()
+    b.decref()
+    assert r.free_rows() == 0             # still pinned by the first ref
+    b.decref()
+    assert r.free_rows() == 1
+    # a double-release clamps instead of corrupting the freelist
+    b.decref()
+    assert r.free_rows() == 1
+
+
+def test_ring_lease_release_is_idempotent():
+    r = CanvasRing(1, 4)
+    ref = r.acquire()
+    lease = RingLease([ref])
+    lease.release()
+    assert r.free_rows() == 1
+    lease.release()                       # engine gather + dispatcher
+    assert r.free_rows() == 1             # terminal path may both fire
+
+
+def test_framestack_matches_build_payload_both_wires():
+    from deepfake_detection_tpu.params import img_mean, img_std
+    frames = [np.ascontiguousarray(f[:_SIZE, :_SIZE])
+              for f in _frames(4, h=_SIZE, w=_SIZE + 4)]
+    for wire, norm in (("float32", (img_mean, img_std)), ("uint8", None)):
+        want = build_payload(frames, wire)
+        fired = []
+        fs = FrameStack(frames, norm=norm, on_consumed=lambda: fired.append(1))
+        assert fs.shape == want.shape and fs.dtype == want.dtype
+        np.testing.assert_array_equal(fs.materialize(), want)
+        assert not fired                  # materialize never consumes
+        buf = np.zeros(fs.shape, fs.dtype)
+        fs.write_into(buf)
+        np.testing.assert_array_equal(buf, want)
+        assert fired == [1]
+        fs.write_into(buf)
+        assert fired == [1]               # consumed exactly once
+        np.testing.assert_array_equal(np.asarray(fs), want)
+
+
+# ---------------------------------------------------------------------------
+# overlap parity: ring fast path vs the historical concat path
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("hop,stride", list(itertools.product(
+    (1, 2, 4), (1, 2))))
+def test_window_payloads_and_keys_bit_identical_across_overlap(hop, stride):
+    """For every overlap regime, the zero-copy FrameStack payload must be
+    byte-for-byte the old concat payload, and the fast path's content key
+    must equal a from-scratch ``prepare_canvas`` -> digest -> compose
+    recomputation."""
+    from deepfake_detection_tpu.params import prepare_canvas
+    frames = _frames(30)
+    ring_jobs, concat_jobs = [], []
+    s_ring = _session(ring_jobs, cache_live=True, assembly="ring",
+                      window_hop=hop, window_stride=stride)
+    s_concat = _session(concat_jobs, assembly="concat", window_hop=hop,
+                        window_stride=stride)
+    for f in frames:
+        s_ring.ingest_arrays([f])
+        s_concat.ingest_arrays([f])
+    assert len(ring_jobs) == len(concat_jobs) > 0
+    for rj, cj in zip(ring_jobs, concat_jobs):
+        assert (rj.track_id, rj.window_idx, tuple(rj.frame_idxs)) == \
+            (cj.track_id, cj.window_idx, tuple(cj.frame_idxs))
+        got = rj.payload.materialize()
+        assert got.dtype == cj.payload.dtype
+        np.testing.assert_array_equal(got, cj.payload)
+        # content key == digest-of-digests recomputed from scratch (the
+        # full-frame localizer makes crop == frame exactly)
+        want_key = window_key(tuple(
+            frame_digest(prepare_canvas(frames[i], _SIZE))
+            for i in rj.frame_idxs))
+        assert rj.content_key == (want_key, None)
+        assert cj.content_key is None     # concat path computes no keys
+    assert s_ring.canvas_copies_elided == 0
+
+
+def test_contiguity_elision_is_counted_on_concat_path():
+    """The concat path must skip (and count) the historical redundant
+    ``ascontiguousarray`` on already-contiguous crops."""
+    jobs = []
+    s = _session(jobs, assembly="concat", img_num=2, window_hop=2)
+    for f in _frames(4, h=_SIZE, w=_SIZE):   # size match: crop IS canvas
+        s.ingest_arrays([np.ascontiguousarray(f)])
+    assert jobs
+    assert s.canvas_copies_elided > 0
+    assert s.metrics.canvas_copies_elided_total.value == \
+        s.canvas_copies_elided
+
+
+# ---------------------------------------------------------------------------
+# duplicate elision: decode chain, window dedup, exact books
+# ---------------------------------------------------------------------------
+
+def test_decode_chunk_duplicate_and_error_chain():
+    jobs = []
+    s = _session(jobs, assembly="ring", dedup_frames=True)
+    a, b = (_jpeg(f) for f in _frames(2))
+    arrays, flags, errors = s.decode_chunk([a, a, b, b, b])
+    assert errors == 0
+    assert flags == [False, True, False, True, True]
+    assert s.frames_dup_elided == 3
+    assert arrays[0] is arrays[1] and arrays[2] is arrays[3] is arrays[4]
+    # the chain crosses chunk boundaries...
+    arrays2, flags2, _ = s.decode_chunk([b, a])
+    assert flags2 == [True, False]
+    # ...a duplicate of an undecodable frame is an error without a decode
+    bad = b"\xff\xd8not-a-jpeg"
+    arrays3, flags3, errors3 = s.decode_chunk([bad, bad, a])
+    assert errors3 == 2 and flags3 == [False] and len(arrays3) == 1
+    # ...and never survives a restore (the decoded predecessor is gone)
+    s.load_state(s.state_dict())
+    _, flags4, _ = s.decode_chunk([a])
+    assert flags4 == [False]
+
+
+def test_dedup_stream_books_exact_and_content_stream_preserved():
+    """dedup_frames on a frozen/replayed stream: (a) the submitted
+    content-key stream equals the baseline stream with consecutive
+    per-track duplicates removed, (b) surviving payloads are
+    bit-identical to the baseline window at the same window_idx, and
+    (c) emitted == scored + dropped + shed + failed + cache_hit +
+    dup_elided exactly."""
+    uniq = _frames(2, seed=9)
+    chunks = [[_jpeg(uniq[0])] * 6,
+              [_jpeg(uniq[0])] * 2 + [_jpeg(uniq[1])] * 4,
+              [_jpeg(uniq[1])] * 6,
+              [_jpeg(uniq[0])] * 6]
+    base_jobs, dd_jobs = [], []
+    base = _session(base_jobs, cache_live=True, assembly="ring",
+                    img_num=2, window_hop=1)
+    dd = _session(dd_jobs, cache_live=True, assembly="ring", img_num=2,
+                  window_hop=1, dedup_frames=True)
+    base_keys, dd_keys, dd_by_idx, base_by_idx = [], [], {}, {}
+    for chunk in chunks:
+        for sess, jobs, keys, by_idx in (
+                (base, base_jobs, base_keys, base_by_idx),
+                (dd, dd_jobs, dd_keys, dd_by_idx)):
+            arrays, flags, errors = sess.decode_chunk(chunk)
+            assert errors == 0
+            sess.ingest_arrays(arrays, flags)
+            for j in list(jobs):
+                keys.append(j.content_key[0])
+                by_idx[j.window_idx] = j.payload.materialize()
+            _score_all(sess, jobs)
+    assert base.frames_dup_elided == 0 and base.windows_dup_elided == 0
+    assert dd.frames_dup_elided > 0 and dd.windows_dup_elided > 0
+    assert dd.canvas_copies_elided > 0            # duplicate-crop reuse
+    # (a) consecutive-duplicate removal, nothing else
+    want = [k for i, k in enumerate(base_keys)
+            if i == 0 or k != base_keys[i - 1]]
+    assert dd_keys == want
+    # (b) surviving windows carry the exact baseline bytes
+    for idx, payload in dd_by_idx.items():
+        np.testing.assert_array_equal(payload, base_by_idx[idx])
+    # (c) exact 6-term books, in both sessions
+    for s in (base, dd):
+        assert s.windows_emitted == (
+            s.windows_scored + s.windows_dropped + s.windows_shed +
+            s.windows_failed + s.windows_cache_hit + s.windows_dup_elided)
+    assert base.windows_emitted == dd.windows_emitted
+    assert dd.windows_scored == base.windows_scored - dd.windows_dup_elided
+
+
+def test_cache_hit_books_via_dispatcher_collector():
+    """A from_cache resolution must book windows_cache_hit (not scored)
+    and still keep the 6-term identity."""
+    jobs = []
+    s = _session(jobs, cache_live=True, assembly="ring", img_num=2,
+                 window_hop=2)
+    for f in _frames(4, h=_SIZE, w=_SIZE, seed=5):
+        s.ingest_arrays([f])
+    assert len(jobs) >= 2
+    hit, miss = jobs[0], jobs[1]
+    hit.cache_hit = True                  # what _collect_loop sets
+    s.on_window_result(hit, np.asarray([0.5, 0.5]), None)
+    s.on_window_result(miss, np.asarray([0.5, 0.5]), None)
+    for job in jobs:
+        if job.lease is not None:
+            job.lease.release()
+    assert s.windows_cache_hit == 1
+    assert s.metrics.windows_cache_hit_total.value == 1
+    counters = s.status()["counters"]
+    assert counters["windows_cache_hit"] == 1
+    assert s.windows_emitted >= s.windows_scored + s.windows_cache_hit
+
+
+# ---------------------------------------------------------------------------
+# tracker: vectorized assignment vs the scalar reference
+# ---------------------------------------------------------------------------
+
+def _reference_assign(tracks, detections, iou_min):
+    """The historical nested-loop greedy assignment, verbatim: candidate
+    tuples (-iou, track_id, det_idx), sorted, claimed greedily."""
+    pairs = []
+    for t in tracks:
+        for di, (box, _score) in enumerate(detections):
+            v = iou(t.box, box)
+            if v >= iou_min:
+                pairs.append((-v, t.id, di))
+    pairs.sort()
+    used_t, used_d, assign = set(), set(), []
+    for _nv, tid, di in pairs:
+        if tid in used_t or di in used_d:
+            continue
+        used_t.add(tid)
+        used_d.add(di)
+        assign.append((tid, di))
+    return assign
+
+
+@pytest.mark.parametrize("seed,iou_min", [(0, 0.3), (7, 0.3), (123, 0.1),
+                                          (11, 0.0)])
+def test_tracker_vectorized_assignment_matches_scalar_reference(
+        seed, iou_min):
+    """Property test over jittery multi-box scenes: the numpy IoU-matrix
+    assignment must reproduce the scalar loop's matches AND the exact
+    EMA arithmetic (bit-identical boxes), including the iou_min=0 edge
+    where zero-overlap pairs are eligible."""
+    rng = np.random.default_rng(seed)
+    tr = GreedyIouTracker(iou_min=iou_min, ema_alpha=0.6, max_coast=2)
+    alpha = tr.ema_alpha
+    for frame_idx in range(60):
+        n = int(rng.integers(0, 4))
+        dets = []
+        for _ in range(n):
+            x1, y1 = rng.uniform(0, 80, 2)
+            bw, bh = rng.uniform(5, 30, 2)
+            dets.append(((float(x1), float(y1), float(x1 + bw),
+                          float(y1 + bh)), float(rng.uniform(0.5, 1.0))))
+        live = list(tr.tracks.values())
+        pre_boxes = {t.id: t.box for t in live}
+        want = _reference_assign(live, dets, iou_min)
+        upd = tr.update(frame_idx, dets)
+        got_ids = [t.id for t in upd.matched]
+        assert got_ids == [tid for tid, _di in want]
+        for tid, di in want:
+            box = dets[di][0]
+            expect = tuple(alpha * float(d) + (1.0 - alpha) * p
+                           for d, p in zip(box, pre_boxes[tid]))
+            assert tr.tracks[tid].box == expect     # exact, not approx
+
+
+# ---------------------------------------------------------------------------
+# snapshot compatibility across assembly modes
+# ---------------------------------------------------------------------------
+
+def test_concat_snapshot_restores_into_ring_session_bit_identically():
+    """dfd.streaming.session_state.v1 is assembly-agnostic: a snapshot
+    taken by the historical concat path restores into a ring-mode
+    session, and the continuation emits bit-identical payloads, keys and
+    books vs an uninterrupted ring session."""
+    frames = _frames(24, seed=21)
+    ref_jobs, old_jobs = [], []
+    ref = _session(ref_jobs, cache_live=True, assembly="ring",
+                   img_num=2, window_hop=1)
+    old = _session(old_jobs, assembly="concat", img_num=2, window_hop=1)
+    for f in frames[:10]:
+        ref.ingest_arrays([f])
+        old.ingest_arrays([f])
+        _score_all(ref, ref_jobs)
+        _score_all(old, old_jobs)
+    snap = old.state_dict()
+    # pre-ISSUE-20 producers never wrote the new counter keys: strip
+    # them so the snapshot is byte-layout what an old writer serialized
+    for k in ("windows_cache_hit", "windows_dup_elided",
+              "frames_dup_elided", "canvas_copies_elided"):
+        snap["counters"].pop(k)
+    res_jobs = []
+    res = _session(res_jobs, cache_live=True, assembly="ring",
+                   img_num=2, window_hop=1)
+    res.load_state(snap)
+    assert res.windows_cache_hit == 0
+    for f in frames[10:]:
+        ref.ingest_arrays([f])
+        res.ingest_arrays([f])
+        assert len(res_jobs) == len(ref_jobs)
+        for rj, fj in zip(res_jobs, ref_jobs):
+            assert rj.window_idx == fj.window_idx
+            assert rj.content_key == fj.content_key
+            np.testing.assert_array_equal(rj.payload.materialize(),
+                                          fj.payload.materialize())
+        _score_all(ref, ref_jobs)
+        _score_all(res, res_jobs)
+    a, b = ref.status()["counters"], res.status()["counters"]
+    assert a == b

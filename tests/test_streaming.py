@@ -494,6 +494,19 @@ def test_streaming_metrics_render():
     assert 'dfd_streaming_latency_seconds_bucket{stage="score",le="+Inf"}' \
         " 1" in text
     assert "dfd_streaming_windows_shed_total 0" in text
+    # ISSUE 20 host-path families: registered exactly once, exposed even
+    # at zero, plus the window-assembly latency stage
+    m.windows_cache_hit_total.inc()
+    m.windows_dup_elided_total.inc(2)
+    m.latency["assemble"].observe(0.001)
+    text = m.render_prometheus()
+    assert "dfd_streaming_windows_cache_hit_total 1" in text
+    assert "dfd_streaming_windows_dup_elided_total 2" in text
+    assert "dfd_streaming_frames_dup_elided_total 0" in text
+    assert "dfd_streaming_canvas_copies_elided_total 0" in text
+    assert "dfd_streaming_ring_overflow_total 0" in text
+    assert 'dfd_streaming_latency_seconds_bucket{stage="assemble",' \
+        'le="+Inf"} 1' in text
 
 
 # ---------------------------------------------------------------------------

@@ -50,11 +50,19 @@ def _no_persistent_jax_cache():
     payload XLA refuses to deserialize (ExecutableStore.save detects
     and refuses it) — so the store-lifecycle tests here must compile
     for real.  Scoped per-test so the rest of the suite keeps the warm
-    cache."""
+    cache.  Flipping the config dir alone is NOT enough: jax memoizes
+    the per-backend cache-used decision once (`_cache_checked`), so if
+    any earlier test in the process compiled with the cache armed the
+    dir=None update is silently ignored and these engines load from
+    disk — whose executables serialize to Symbols-not-found payloads.
+    reset_cache() drops the memo on both sides of the test."""
+    from jax._src import compilation_cache as _cc
     prev = jax.config.jax_compilation_cache_dir
+    _cc.reset_cache()
     jax.config.update("jax_compilation_cache_dir", None)
     yield
     jax.config.update("jax_compilation_cache_dir", prev)
+    _cc.reset_cache()
 
 
 def _perturbed_variables(model, size, chans, seed=0):

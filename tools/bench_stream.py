@@ -406,6 +406,238 @@ def run_flood_probe(netloc: str, args) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# host-ceiling mode (ISSUE 20): engine nulled on both sides, host path
+# measured — the bench_backfill null-device idiom for streaming
+# ---------------------------------------------------------------------------
+
+class _NullRequest:
+    __slots__ = ("_scores", "from_cache")
+
+    def __init__(self, scores):
+        self._scores = scores
+        self.from_cache = False
+
+    def result(self, timeout=None):
+        return self._scores
+
+
+class _NullBatcher:
+    """Null engine: ``submit`` performs the engine's ``_pad_batch`` slab
+    write (a fresh zeroed row + the payload's gather — the exact host
+    copy a real engine performs) and resolves instantly with a fixed
+    score row.  Everything else about the host path — decode, track,
+    canvas, digest, window assembly, dispatch, verdict fold — is real.
+    With ``cache`` attached it mirrors the micro-batcher's exact-key
+    probe so the session's content keys resolve as counted hits."""
+
+    def __init__(self, cache=None):
+        self.cache = cache
+        self._scores = np.asarray([0.07, 0.93], np.float32)
+        self.gathers = 0
+
+    def submit(self, array, timeout_s=None, model_id=None,
+               content_key=None):
+        req = _NullRequest(self._scores)
+        if self.cache is not None and content_key is not None:
+            if self.cache.get(content_key[0], "null", "nullfp") is not None:
+                req.from_cache = True
+                return req
+        buf = np.zeros(np.shape(array),
+                       getattr(array, "dtype", np.uint8))
+        write_into = getattr(array, "write_into", None)
+        if write_into is not None:
+            write_into(buf)          # FrameStack: the one gather-memcpy
+        else:
+            buf[...] = array         # concat payload: the slab copy
+        self.gathers += 1
+        if self.cache is not None and content_key is not None:
+            self.cache.put(content_key[0], "null", "nullfp", self._scores)
+        return req
+
+
+def _proc_cpu_s() -> float:
+    """Process CPU seconds (utime+stime, all threads) from
+    /proc/self/stat — the PR 16 portable host-cost control."""
+    with open("/proc/self/stat") as f:
+        raw = f.read()
+    fields = raw[raw.rindex(")") + 2:].split()
+    return (int(fields[11]) + int(fields[12])) / os.sysconf("SC_CLK_TCK")
+
+
+_HC_BOOK_TERMS = ("windows_scored", "windows_dropped", "windows_shed",
+                  "windows_failed", "windows_cache_hit",
+                  "windows_dup_elided")
+
+
+def _host_phase(args, name: str, assembly: str, dedup: bool,
+                chunks: List[List[bytes]], cache=None) -> dict:
+    """One in-process phase: fresh session + dispatcher over the null
+    batcher, chunks fed closed-loop for ``--duration`` seconds."""
+    from deepfake_detection_tpu.config import StreamConfig
+    from deepfake_detection_tpu.streaming.ingest import (StreamSession,
+                                                         decode_frame_bytes)
+    from deepfake_detection_tpu.streaming.metrics import StreamingMetrics
+    from deepfake_detection_tpu.streaming.windows import WindowDispatcher
+
+    cfg = StreamConfig(
+        model=args.model, image_size=args.image_size,
+        img_num=args.img_num, window_hop=args.window_hop or 1,
+        wire=args.wire, assembly=assembly, dedup_frames=dedup)
+    metrics = StreamingMetrics()
+    batcher = _NullBatcher(cache=cache)
+    disp = WindowDispatcher(
+        batcher, max_pending=4096, request_timeout_s=10.0,
+        on_result=lambda job, s, e: job.context.on_window_result(job, s, e),
+        on_drop=lambda job, r: job.context.on_window_drop(job, r))
+    disp.start()
+    session = StreamSession(f"ceiling-{assembly}", cfg, disp, metrics,
+                            args.image_size, args.wire)
+
+    def feed(chunk: List[bytes]) -> int:
+        if assembly == "concat":
+            # the pre-PR handler loop: serial per-frame decode
+            arrays = [a for a in (decode_frame_bytes(d) for d in chunk)
+                      if a is not None]
+            session.ingest_arrays(arrays)
+        else:
+            arrays, flags, _errs = session.decode_chunk(chunk)
+            session.ingest_arrays(arrays, flags)
+        return len(chunk)
+
+    def drain(timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with session._lock:
+                pending = session.windows_emitted - sum(
+                    getattr(session, k) for k in _HC_BOOK_TERMS)
+            if pending <= 0:
+                return
+            time.sleep(0.005)
+
+    for chunk in chunks[:3]:         # warmup: imports, pools, PIL state
+        feed(chunk)
+    drain()
+    base = {k: getattr(session, k) for k in
+            _HC_BOOK_TERMS + ("windows_emitted", "frames_ingested",
+                              "frames_dup_elided")}
+    t0, c0 = time.monotonic(), _proc_cpu_s()
+    deadline = t0 + args.duration
+    frames = i = 0
+    while time.monotonic() < deadline:
+        frames += feed(chunks[i % len(chunks)])
+        i += 1
+    drain()
+    t1, c1 = time.monotonic(), _proc_cpu_s()
+    disp.stop()
+    out = {k: getattr(session, k) - base[k] for k in base}
+    with session._lock:
+        balanced = session.windows_emitted == sum(
+            getattr(session, k) for k in _HC_BOOK_TERMS)
+    wall = t1 - t0
+    emitted = out["windows_emitted"]
+    out.update(
+        name=name, assembly=assembly, dedup=dedup,
+        cache="on" if cache is not None else "off",
+        frames_fed=frames, wall_s=wall, cpu_s=c1 - c0,
+        balanced=balanced, gathers=batcher.gathers,
+        wps=emitted / wall if wall > 0 else 0.0,
+        fps=out["frames_ingested"] / wall if wall > 0 else 0.0,
+        cpu_us_per_window=(c1 - c0) * 1e6 / emitted if emitted else
+        float("nan"))
+    _log(f"  {name}: {out['wps']:.1f} windows/s, "
+         f"{out['cpu_us_per_window']:.0f} cpu µs/window, "
+         f"scored {out['windows_scored']} hit {out['windows_cache_hit']} "
+         f"dup {out['windows_dup_elided']} balanced={balanced}")
+    return out
+
+
+def run_host_ceiling(args) -> Dict[str, dict]:
+    """Three phases, engine nulled in all of them:
+
+    * ``concat``  — the pre-PR host path (serial decode, standalone
+      canvases, per-window ``np.concatenate``), unique-content frames;
+    * ``ring``    — the frame-once path (batched decode, crop rings,
+      FrameStack gather), same unique-content frames;
+    * ``replay``  — frame-once + ``dedup_frames`` + verdict cache on a
+      replayed low-motion stream (frozen runs, recurring content) — the
+      regime the per-window dedup tier is built for.
+    """
+    from deepfake_detection_tpu.cache.store import VerdictCache
+    w, h = args.frame_w, args.frame_h
+    cf = args.chunk_frames
+    uniq = make_stream_jpegs(48, w, h, seed=7)
+    unique_chunks = [uniq[i:i + cf]
+                     for i in range(0, len(uniq) - cf + 1, cf)]
+    low = make_stream_jpegs(6, w, h, seed=11)
+    lowmotion_chunks = [[j] * cf for j in low]
+
+    phases: Dict[str, dict] = {}
+    _log("host-ceiling phase A: concat (pre-PR path), unique frames")
+    phases["concat"] = _host_phase(args, "concat (pre-PR)", "concat",
+                                   False, unique_chunks)
+    _log("host-ceiling phase B: ring (frame-once), unique frames")
+    phases["ring"] = _host_phase(args, "ring (frame-once)", "ring",
+                                 False, unique_chunks)
+    _log("host-ceiling phase C: ring+dedup+cache, low-motion replay")
+    phases["replay"] = _host_phase(
+        args, "ring+dedup+cache (replay)", "ring", True,
+        lowmotion_chunks, cache=VerdictCache(4096, 3600.0))
+    return phases
+
+
+def render_host_md(args, phases: Dict[str, dict]) -> str:
+    import platform
+    a, b, c = phases["concat"], phases["ring"], phases["replay"]
+    lines = []
+    w = lines.append
+    w("## Host ceiling (`--host-ceiling`: engine nulled both sides)")
+    w("")
+    w(f"*Generated {time.strftime('%Y-%m-%d %H:%M:%S')}; host: "
+      f"{os.cpu_count()} CPUs, {platform.platform()}.  In-process, no "
+      f"HTTP: the null batcher still performs the engine's batch-slab "
+      f"write (the gather/copy), so these rows are the host path's "
+      f"ceiling, not the engine's.*")
+    w("")
+    w(f"Shape: img_num {args.img_num}, hop {args.window_hop or 1} "
+      f"(max-overlap), wire `{args.wire}`, {args.image_size}² canvas, "
+      f"{args.frame_w}×{args.frame_h} JPEG frames, "
+      f"{args.chunk_frames} frames/chunk.")
+    w("")
+    w("| phase | windows/s | cpu µs/window | frames/s | scored | "
+      "cache hit | dup elided | frames dup elided | slab gathers | "
+      "books |")
+    w("|---|---:|---:|---:|---:|---:|---:|---:|---:|---|")
+    for p in (a, b, c):
+        w(f"| {p['name']} | {p['wps']:.1f} | "
+          f"{p['cpu_us_per_window']:.0f} | {p['fps']:.1f} | "
+          f"{p['windows_scored']} | {p['windows_cache_hit']} | "
+          f"{p['windows_dup_elided']} | {p['frames_dup_elided']} | "
+          f"{p['gathers']} | "
+          f"{'exact' if p['balanced'] else 'UNBALANCED'} |")
+    w("")
+    ru = b["wps"] / a["wps"] if a["wps"] else float("nan")
+    rr = c["wps"] / a["wps"] if a["wps"] else float("nan")
+    cu = a["cpu_us_per_window"] / b["cpu_us_per_window"] \
+        if b["cpu_us_per_window"] else float("nan")
+    cr = a["cpu_us_per_window"] / c["cpu_us_per_window"] \
+        if c["cpu_us_per_window"] else float("nan")
+    w(f"Ratios vs the pre-PR concat path: frame-once on unique content "
+      f"**{ru:.2f}×** windows/s ({cu:.2f}× cpu/window); frame-once + "
+      f"dedup + cache on the low-motion replay **{rr:.2f}×** windows/s "
+      f"({cr:.2f}× cpu/window) — the pre-registered ≥3× bar targets the "
+      f"replay/low-motion regime, where duplicate frames skip decode and "
+      f"recurring windows resolve from the cache without a slab gather.  "
+      f"Unique-content traffic pays full decode + resize on every frame "
+      f"(irreducible here), so its row reports the honest copy-path gain "
+      f"only.")
+    w("")
+    w("Zero-recompile probe: trivially satisfied in this mode (no "
+      "engine); the live-engine phases above carry the real probe.")
+    w("")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # report
 # ---------------------------------------------------------------------------
 
@@ -534,6 +766,11 @@ def main(argv=None) -> int:
     ap.add_argument("--keep-env", action="store_true")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale run (CI smoke)")
+    ap.add_argument("--host-ceiling", action="store_true",
+                    help="in-process host-path bench: engine nulled on "
+                         "both sides (concat vs ring vs ring+dedup+"
+                         "cache), windows/s + cpu µs/window from "
+                         "/proc/self/stat")
     ap.add_argument("--out", default="", help="write the markdown here")
     args = ap.parse_args(argv)
     if args.smoke:
@@ -542,6 +779,24 @@ def main(argv=None) -> int:
         args.flood_chunks = 1
         args.flood_frames = 128
         args.flood_streams = 3
+
+    if args.host_ceiling:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        phases = run_host_ceiling(args)
+        md = render_host_md(args, phases)
+        print(md)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(md)
+            _log(f"wrote {args.out}")
+        ratio = phases["replay"]["wps"] / phases["concat"]["wps"] \
+            if phases["concat"]["wps"] else 0.0
+        ok = all(p["balanced"] for p in phases.values()) and ratio >= 3.0
+        if not ok:
+            _log("HOST-CEILING ACCEPTANCE FAILURE "
+                 f"(ratio {ratio:.2f}, books "
+                 f"{[p['balanced'] for p in phases.values()]})")
+        return 0 if ok else 1
 
     jpegs = make_stream_jpegs(16, args.frame_w, args.frame_h)
     _log(f"{len(jpegs)} synthetic JPEGs, ~{len(jpegs[0]) // 1024} KiB "
